@@ -1,0 +1,164 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Tasks, actors, and immutable shared objects over a lease-scheduled multi-
+process runtime with a shared-memory object store — the capability set of the
+reference Ray runtime (see SURVEY.md), re-designed TPU-first: JAX/XLA is the
+compute plane (pjit/shard_map over device meshes, Pallas kernels), the
+framework supplies orchestration, gang scheduling, and an XLA/ICI collective
+layer in place of NCCL.
+
+Public API parity map (reference: python/ray/__init__.py):
+  init/shutdown/is_initialized, remote, get/put/wait, kill, cancel,
+  get_actor, nodes, cluster_resources, available_resources,
+  ObjectRef, exceptions, util.*, train.*, tune.*, serve.*, data.*, rllib.*
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import exceptions
+from ._private import worker as _worker_mod
+from ._private.worker import init, is_initialized, shutdown
+from .actor import ActorClass, ActorHandle
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorHandle", "exceptions",
+    "method", "timeline", "get_runtime_context",
+]
+
+
+def _core():
+    return _worker_mod.global_runtime().core
+
+
+def _set_runtime_for_worker(core):
+    """Called by worker_main so user code inside tasks can use the API."""
+    # global runtime already installed by worker module; nothing else needed.
+
+
+def remote(*args, **kwargs):
+    """Decorator turning a function into a remote task or a class into an
+    actor class. Usable bare (@remote) or with options
+    (@remote(num_cpus=2, num_tpus=1, max_restarts=3))."""
+    if len(args) == 1 and not kwargs and (callable(args[0])):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def deco(target):
+        if isinstance(target, type):
+            cls_kwargs = {k: v for k, v in kwargs.items() if k in (
+                "num_cpus", "num_tpus", "resources", "max_restarts",
+                "max_concurrency", "name", "namespace", "lifetime",
+                "runtime_env", "scheduling_strategy", "get_if_exists")}
+            return ActorClass(target, **cls_kwargs)
+        fn_kwargs = {k: v for k, v in kwargs.items() if k in (
+            "num_returns", "num_cpus", "num_tpus", "resources",
+            "max_retries", "scheduling_strategy", "runtime_env", "name")}
+        return RemoteFunction(target, **fn_kwargs)
+
+    return deco
+
+
+def method(num_returns: int = 1):
+    """Per-method options for actor methods (reference: ray.method)."""
+    def deco(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+    return deco
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    return _core().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _core().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return _core().wait(refs, num_returns=num_returns, timeout=timeout,
+                        fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _core().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    # Round 1: best-effort — queued tasks aren't individually addressable yet.
+    raise NotImplementedError(
+        "cancel is not yet supported; kill the actor or let the task finish")
+
+
+def get_actor(name: str) -> ActorHandle:
+    info = _core().get_actor_info(name=name)
+    if info is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(bytes(info["actor_id"]), info.get("class_name", ""))
+
+
+def nodes() -> List[dict]:
+    core = _core()
+    return core._run(core.gcs.call("get_nodes", {}))
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["resources_available"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+class _RuntimeContext:
+    @property
+    def job_id(self):
+        return _core().job_id
+
+    @property
+    def node_id(self):
+        return _core().node_id
+
+    @property
+    def worker_id(self):
+        return _core().worker_id
+
+    def get_task_id(self):
+        return _core().current_task_id
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace dump (reference: ray.timeline, _private/state.py:441).
+    Round 1: task events are not yet aggregated; returns an empty trace."""
+    import json
+    events: list = []
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,494 @@
+"""Per-node agent: worker pool, lease scheduler, object-store host.
+
+Equivalent of the reference's raylet (reference: src/ray/raylet/
+node_manager.h:133, worker_pool.cc, local_lease_manager.cc) hosting the
+shared-memory object store in-process (reference: main.cc:689
+ObjectStoreRunner). Responsibilities:
+
+- owns the node's /dev/shm arena lifecycle (create on start, unlink on exit)
+- spawns and pools worker processes; leases them to submitters
+  (reference: WorkerPool::PopWorker worker_pool.h:55, lease protocol in
+  node_manager.proto:441 RequestWorkerLease)
+- tracks node resources; placement-group bundle prepare/commit
+  (reference: placement_group_resource_manager.cc, 2-phase commit)
+- serves cross-node object pulls out of the local store and fetches remote
+  objects into it (reference: object_manager/pull_manager.cc + push path)
+- registers with the GCS and reports its resource view periodically
+  (reference: ray_syncer)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import protocol, rpc
+from .config import Config, get_config, set_config
+from .ids import NodeID, WorkerID
+from .shm_store import ShmStore
+
+logger = logging.getLogger("ray_tpu.agent")
+
+IDLE_WORKER_KEEP = 8          # pooled idle workers kept hot per node
+LEASE_IDLE_TIMEOUT_S = 2.0
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address = None           # set at registration
+        self.conn: Optional[rpc.Connection] = None   # agent→worker
+        self.registered = asyncio.Event()
+        self.lease_id: Optional[bytes] = None
+        self.lease_resources: Dict[str, float] = {}
+        self.is_actor = False
+        self.actor_id: Optional[bytes] = None
+        self.last_idle = time.monotonic()
+
+
+class NodeAgent:
+    def __init__(self, *, gcs_address, session_dir: str, node_id: bytes,
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 store_capacity: int, host: str = "127.0.0.1"):
+        self.gcs_address = tuple(gcs_address)
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.host = host
+        self.labels = labels
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.store_path = os.path.join(
+            "/dev/shm", f"raytpu_{node_id.hex()[:12]}")
+        self.store = ShmStore.create(self.store_path, store_capacity)
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self.leases: Dict[bytes, WorkerHandle] = {}
+        self.bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self.pinned: Dict[bytes, int] = {}   # object_id -> pin count (owner pins)
+        self._server = rpc.RpcServer(self._handlers(), name="agent")
+        self.gcs: Optional[rpc.Connection] = None
+        self._spawn_lock = asyncio.Lock()
+        self._peer_conns: Dict[tuple, rpc.Connection] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._shutdown = False
+
+    def _handlers(self):
+        return {
+            "register_worker": self.h_register_worker,
+            "request_lease": self.h_request_lease,
+            "return_lease": self.h_return_lease,
+            "create_actor_worker": self.h_create_actor_worker,
+            "actor_worker_died": self.h_actor_worker_died,
+            "prepare_bundle": self.h_prepare_bundle,
+            "commit_bundle": self.h_commit_bundle,
+            "return_bundle": self.h_return_bundle,
+            "pin_object": self.h_pin_object,
+            "unpin_object": self.h_unpin_object,
+            "free_objects": self.h_free_objects,
+            "fetch_from_store": self.h_fetch_from_store,
+            "pull_object": self.h_pull_object,
+            "node_info": self.h_node_info,
+            "store_stats": self.h_store_stats,
+            "ping": lambda conn, p: "pong",
+            "shutdown": self.h_shutdown,
+        }
+
+    # ------------------------------------------------------------ lifecycle --
+    async def start(self) -> tuple:
+        addr = await self._server.start_tcp(self.host, 0)
+        self.address = addr
+        self.gcs = await rpc.connect(self.gcs_address, name="agent->gcs",
+                                     handlers={"pubsub": self._on_pubsub})
+        await self.gcs.call("register_node", {
+            "node_id": self.node_id,
+            "address": list(addr),
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "store_path": self.store_path,
+            "session_dir": self.session_dir,
+        })
+        self._tasks.append(asyncio.ensure_future(self._report_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        logger.info("agent %s on %s, store %s",
+                    self.node_id.hex()[:8], addr, self.store_path)
+        return addr
+
+    async def _report_loop(self):
+        cfg = get_config()
+        period = cfg.resource_report_period_ms / 1000.0
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                if self.gcs and not self.gcs.closed:
+                    await self.gcs.call("report_resources", {
+                        "node_id": self.node_id,
+                        "available": self.resources_available,
+                    })
+            except rpc.RpcError:
+                pass
+
+    async def _reap_loop(self):
+        """Detect dead worker processes, release their leases, tell GCS about
+        dead actors (reference: worker failure path, gcs_service.proto:388
+        ReportWorkerFailure)."""
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            for wid, wh in list(self.workers.items()):
+                if wh.proc.poll() is not None:
+                    await self._on_worker_death(wh)
+
+    async def _on_worker_death(self, wh: WorkerHandle):
+        self.workers.pop(wh.worker_id, None)
+        if wh in self.idle_workers:
+            self.idle_workers.remove(wh)
+        if wh.lease_id is not None:
+            self._release_resources(wh.lease_resources)
+            self.leases.pop(wh.lease_id, None)
+        logger.warning("worker %s (pid %s) died", wh.worker_id.hex()[:8],
+                       wh.proc.pid)
+        if wh.is_actor and wh.actor_id and self.gcs and not self.gcs.closed:
+            # Report actor death so the GCS can restart-or-bury (reference:
+            # ReportWorkerFailure → GcsActorManager::OnWorkerDead).
+            try:
+                await self.gcs.call("actor_failed", {
+                    "actor_id": wh.actor_id,
+                    "reason": f"worker process {wh.proc.pid} exited with "
+                              f"code {wh.proc.returncode}"})
+            except rpc.RpcError:
+                pass
+
+    def _on_pubsub(self, conn, p):
+        pass  # agents currently only publish
+
+    async def close(self):
+        self._shutdown = True
+        for t in self._tasks:
+            t.cancel()
+        for wh in list(self.workers.values()):
+            try:
+                wh.proc.terminate()
+            except ProcessLookupError:
+                pass
+        await self._server.close()
+        self.store.close()
+        try:
+            os.unlink(self.store_path)
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------- workers --
+    async def _spawn_worker(self, env_extra: Dict[str, str] | None = None
+                            ) -> WorkerHandle:
+        worker_id = WorkerID.from_random().binary()
+        from .node import child_env
+        env = child_env(env_extra)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
+        env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_STORE_PATH"] = self.store_path
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
+        err = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=err,
+            cwd=os.getcwd(), start_new_session=True)
+        wh = WorkerHandle(worker_id, proc)
+        self.workers[worker_id] = wh
+        return wh
+
+    async def h_register_worker(self, conn, p):
+        wh = self.workers.get(p["worker_id"])
+        if wh is None:
+            raise rpc.RpcError("unknown worker")
+        wh.address = tuple(p["address"])
+        wh.conn = conn
+        conn.on_close = lambda c, wh=wh: None
+        wh.registered.set()
+        return {"node_id": self.node_id}
+
+    async def _pop_worker(self, env_extra=None) -> WorkerHandle:
+        """Reuse an idle pooled worker or spawn one (reference:
+        WorkerPool::PopWorker, worker_pool.h:55; reuse keyed by runtime env —
+        round 1 pools only default-env workers)."""
+        if not env_extra:
+            while self.idle_workers:
+                wh = self.idle_workers.pop()
+                if wh.proc.poll() is None and wh.conn and not wh.conn.closed:
+                    return wh
+        wh = await self._spawn_worker(env_extra)
+        cfg = get_config()
+        try:
+            await asyncio.wait_for(wh.registered.wait(),
+                                   cfg.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            wh.proc.kill()
+            raise rpc.RpcError("worker failed to register in time")
+        return wh
+
+    def _try_acquire(self, resources: Dict[str, float]) -> bool:
+        avail = self.resources_available
+        if not all(avail.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()
+                   if v > 0):
+            return False
+        for k, v in resources.items():
+            avail[k] = avail.get(k, 0.0) - v
+        return True
+
+    def _release_resources(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+
+    # -------------------------------------------------------------- leasing --
+    async def h_request_lease(self, conn, p):
+        """Grant a worker lease or reply spillback with a better node
+        (reference: NodeManager::HandleRequestWorkerLease
+        node_manager.cc:1776; spillback in cluster_lease_manager.cc)."""
+        resources = p.get("resources", {})
+        pg = p.get("placement_group")
+        if pg:
+            key = (pg["pg_id"], pg.get("bundle_index", 0))
+            if key not in self.bundles:
+                return {"granted": False, "reason": "bundle not on this node"}
+        if not self._try_acquire(resources):
+            spill = await self._find_spillback(resources)
+            if spill is not None:
+                return {"granted": False, "spillback": spill}
+            return {"granted": False, "reason": "infeasible",
+                    "retry_after_ms": 100}
+        try:
+            wh = await self._pop_worker(p.get("env"))
+        except rpc.RpcError as e:
+            self._release_resources(resources)
+            return {"granted": False, "reason": str(e), "retry_after_ms": 200}
+        lease_id = os.urandom(16)
+        wh.lease_id = lease_id
+        wh.lease_resources = resources
+        self.leases[lease_id] = wh
+        return {"granted": True, "lease_id": lease_id,
+                "worker_addr": list(wh.address),
+                "worker_id": wh.worker_id}
+
+    async def _find_spillback(self, resources) -> Optional[list]:
+        """Ask GCS's resource view for a feasible node (stands in for the
+        reference's in-raylet cluster view synced by ray_syncer)."""
+        try:
+            nodes = await self.gcs.call("get_nodes", {})
+        except rpc.RpcError:
+            return None
+        best, best_avail = None, -1.0
+        for n in nodes:
+            if not n["alive"] or bytes(n["node_id"]) == self.node_id:
+                continue
+            avail = n["resources_available"]
+            if all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
+                s = sum(avail.values())
+                if s > best_avail:
+                    best, best_avail = n, s
+        return list(best["address"]) if best else None
+
+    async def h_return_lease(self, conn, p):
+        wh = self.leases.pop(p["lease_id"], None)
+        if wh is None:
+            return False
+        self._release_resources(wh.lease_resources)
+        wh.lease_id = None
+        wh.lease_resources = {}
+        wh.last_idle = time.monotonic()
+        if (wh.proc.poll() is None and not wh.is_actor
+                and len(self.idle_workers) < IDLE_WORKER_KEEP):
+            self.idle_workers.append(wh)
+        elif not wh.is_actor:
+            wh.proc.terminate()
+        return True
+
+    # --------------------------------------------------------------- actors --
+    async def h_create_actor_worker(self, conn, p):
+        """Lease a dedicated worker and instantiate the actor in it
+        (reference: GcsActorScheduler leasing from raylet + PushTask of the
+        creation task)."""
+        resources = p.get("resources", {})
+        if not self._try_acquire(resources):
+            raise rpc.RpcError("insufficient resources for actor")
+        env_extra = {}
+        renv = p.get("runtime_env") or {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            env_extra[k] = str(v)
+        try:
+            wh = await self._pop_worker(env_extra or None)
+        except rpc.RpcError:
+            self._release_resources(resources)
+            raise
+        wh.is_actor = True
+        wh.actor_id = p["actor_id"]
+        wh.lease_id = os.urandom(16)
+        wh.lease_resources = resources
+        self.leases[wh.lease_id] = wh
+        try:
+            await wh.conn.call("actor_init", p, timeout=115)
+        except rpc.RpcError as e:
+            self._release_resources(resources)
+            self.leases.pop(wh.lease_id, None)
+            wh.proc.terminate()
+            raise rpc.RpcError(f"actor __init__ failed: {e}")
+        return {"worker_addr": list(wh.address), "worker_id": wh.worker_id}
+
+    async def h_actor_worker_died(self, conn, p):
+        await self.gcs.call("actor_failed", p)
+        return True
+
+    # ------------------------------------------------------ placement groups --
+    async def h_prepare_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        if key in self.bundles:
+            return True
+        if not self._try_acquire(p["resources"]):
+            return False
+        self.bundles[key] = dict(p["resources"])
+        return True
+
+    async def h_commit_bundle(self, conn, p):
+        return (p["pg_id"], p["bundle_index"]) in self.bundles
+
+    async def h_return_bundle(self, conn, p):
+        res = self.bundles.pop((p["pg_id"], p["bundle_index"]), None)
+        if res:
+            self._release_resources(res)
+        return True
+
+    # -------------------------------------------------------------- objects --
+    async def h_pin_object(self, conn, p):
+        """Owner-requested pin of a primary copy (reference: raylet
+        PinObjectIDs keeping plasma objects alive for their owner)."""
+        oid = p["object_id"]
+        if self.store.get(oid, timeout_ms=0) is None:
+            return False
+        self.pinned[oid] = self.pinned.get(oid, 0) + 1
+        return True
+
+    async def h_unpin_object(self, conn, p):
+        oid = p["object_id"]
+        n = self.pinned.get(oid, 0)
+        if n <= 1:
+            self.pinned.pop(oid, None)
+        else:
+            self.pinned[oid] = n - 1
+        if n >= 1:
+            self.store.release(oid)
+        return True
+
+    async def h_free_objects(self, conn, p):
+        for oid in p["object_ids"]:
+            while self.pinned.pop(oid, 0) > 0:
+                self.store.release(oid)
+            self.store.delete(oid)
+        return True
+
+    async def h_fetch_from_store(self, conn, p):
+        """Serve object bytes to a remote agent (push side of object
+        transfer; reference: object_manager.cc chunked Push)."""
+        view = self.store.get(p["object_id"], timeout_ms=p.get("timeout_ms", 0))
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.store.release(p["object_id"])
+
+    async def h_pull_object(self, conn, p):
+        """Fetch a remote object into the local store (reference:
+        pull_manager.cc). `from_addr` is the agent holding the primary copy."""
+        oid = p["object_id"]
+        if self.store.contains(oid):
+            return True
+        from_addr = tuple(p["from_addr"])
+        peer = self._peer_conns.get(from_addr)
+        if peer is None or peer.closed:
+            peer = await rpc.connect(from_addr, name="agent->agent")
+            self._peer_conns[from_addr] = peer
+        data = await peer.call("fetch_from_store",
+                               {"object_id": oid,
+                                "timeout_ms": p.get("timeout_ms", 10000)},
+                               timeout=60)
+        if data is None:
+            return False
+        try:
+            self.store.put(oid, [data])
+        except Exception:
+            return self.store.contains(oid)
+        return True
+
+    async def h_node_info(self, conn, p):
+        return {
+            "node_id": self.node_id,
+            "address": list(self.address),
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "store_path": self.store_path,
+            "num_workers": len(self.workers),
+        }
+
+    async def h_store_stats(self, conn, p):
+        return self.store.stats()
+
+    async def h_shutdown(self, conn, p):
+        asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
+        return True
+
+
+async def _amain(args):
+    set_config(Config(json.loads(args.system_config) if args.system_config else None))
+    agent = NodeAgent(
+        gcs_address=json.loads(args.gcs_address),
+        session_dir=args.session_dir,
+        node_id=bytes.fromhex(args.node_id),
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        store_capacity=args.store_capacity,
+    )
+    addr = await agent.start()
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"address": list(addr), "store_path": agent.store_path}, f)
+        os.replace(tmp, args.ready_file)
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    await stop.wait()
+    await agent.close()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--store-capacity", type=int, default=1 << 30)
+    parser.add_argument("--system-config", default="")
+    parser.add_argument("--ready-file", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(level=args.log_level)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Runtime config/flag registry.
+
+TPU-native equivalent of the reference's RAY_CONFIG X-macro registry
+(reference: src/ray/common/ray_config_def.h — 234 entries, each overridable by
+an `RAY_<name>` env var and cluster-wide via the `_system_config` JSON passed
+to init). Here every flag is declared once with a typed default, overridable by
+`RAY_TPU_<name>` in the process environment and by the `_system_config` dict
+passed to `ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, tuple] = {}
+
+
+def _define(name: str, default: Any, doc: str = ""):
+    _REGISTRY[name] = (type(default), default, doc)
+
+
+# ---- core runtime -----------------------------------------------------------
+_define("object_store_memory_bytes", 0, "0 = auto (30% of system RAM, capped)")
+_define("object_store_auto_fraction", 0.3)
+_define("object_store_max_auto_bytes", 16 * 1024**3)
+_define("object_store_table_slots", 1 << 16)
+_define("max_direct_call_object_size", 100 * 1024,
+        "results <= this are returned inline to the owner's memory store "
+        "(reference: RAY_CONFIG max_direct_call_object_size, 100KB)")
+_define("memory_store_max_bytes", 512 * 1024 * 1024)
+_define("worker_register_timeout_s", 60.0)
+_define("worker_lease_timeout_s", 30.0)
+_define("num_workers_soft_limit", 0, "0 = num_cpus")
+_define("worker_niceness", 0)
+_define("maximum_gcs_destroyed_actor_cached_count", 100_000)
+_define("task_max_retries_default", 3)
+_define("actor_max_restarts_default", 0)
+_define("health_check_period_ms", 1000,
+        "reference: gcs_health_check_manager.h health_check_period_ms")
+_define("health_check_failure_threshold", 5)
+_define("resource_report_period_ms", 250,
+        "ray_syncer-equivalent periodic resource view broadcast")
+_define("lineage_max_entries", 100_000,
+        "owner-side lineage cap (reference: task_manager.h max_lineage_bytes)")
+_define("object_spill_dir", "", "empty = <session_dir>/spill")
+_define("object_spill_threshold", 0.8,
+        "fraction of store capacity above which sealed unpinned objects spill")
+_define("rpc_connect_retries", 10)
+_define("rpc_connect_retry_delay_s", 0.2)
+_define("rpc_chaos", "",
+        "deterministic RPC fault injection: 'Method=N:req%:resp%' "
+        "(reference: src/ray/rpc/rpc_chaos.cc RAY_testing_rpc_failure)")
+_define("grant_or_reject_spillback", True)
+_define("scheduler_top_k_fraction", 0.2,
+        "hybrid policy: pick among best-k nodes "
+        "(reference: hybrid_scheduling_policy.h)")
+_define("scheduler_spread_threshold", 0.5,
+        "node utilization below which hybrid policy packs "
+        "(reference: RAY_scheduler_spread_threshold)")
+_define("put_small_object_in_memory_store", True)
+_define("metrics_report_interval_ms", 2000)
+_define("event_buffer_max_events", 10_000)
+_define("log_rotation_bytes", 100 * 1024 * 1024)
+
+# ---- TPU specifics ----------------------------------------------------------
+_define("tpu_chips_per_host_default", 4)
+_define("tpu_visible_chips_env", "TPU_VISIBLE_CHIPS")
+_define("jax_platforms_for_workers", "", "empty = inherit")
+_define("mesh_default_axis_names", "dp,fsdp,tp")
+
+
+class Config:
+    """Resolved config: defaults < env (RAY_TPU_<name>) < _system_config."""
+
+    def __init__(self, system_config: Dict[str, Any] | None = None):
+        self._values: Dict[str, Any] = {}
+        for name, (typ, default, _doc) in _REGISTRY.items():
+            val = default
+            env = os.environ.get(f"RAY_TPU_{name}")
+            if env is not None:
+                val = _parse(typ, env)
+            self._values[name] = val
+        for k, v in (system_config or {}).items():
+            if k not in _REGISTRY:
+                raise ValueError(f"unknown _system_config key: {k}")
+            self._values[k] = v
+
+    def __getattr__(self, name):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        cfg = cls.__new__(cls)
+        cfg._values = dict(d)
+        return cfg
+
+
+def _parse(typ, s: str):
+    if typ is bool:
+        return s.lower() in ("1", "true", "yes")
+    if typ in (int, float):
+        return typ(s)
+    if typ in (dict, list):
+        return json.loads(s)
+    return s
+
+
+_global: Config | None = None
+
+
+def get_config() -> Config:
+    global _global
+    if _global is None:
+        _global = Config()
+    return _global
+
+
+def set_config(cfg: Config):
+    global _global
+    _global = cfg

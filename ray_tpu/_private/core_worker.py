@@ -1,0 +1,792 @@
+"""Per-process core runtime: task submission, objects, actors.
+
+Equivalent of the reference's CoreWorker (reference:
+src/ray/core_worker/core_worker.h:167) linked into every driver and worker:
+
+- task submission with per-scheduling-key lease caching and pipelining
+  (reference: task_submission/normal_task_submitter.cc:70 — leases are reused
+  for tasks with the same scheduling key; here we additionally pipeline a
+  small number of pushes per leased worker to hide RPC latency)
+- dependency resolution: pending/small args are awaited and inlined into the
+  spec; large args travel by reference (reference: dependency_resolver.cc)
+- in-process memory store for small results + shared-memory store for large
+  ones (reference: memory_store/ + plasma_store_provider.h)
+- ownership: the submitting process owns task returns and puts, serves their
+  values to borrowers over its RPC server, and frees primary copies when
+  reference counts drop to zero (reference: reference_count.cc)
+- actor task submission over direct worker connections with per-handle
+  sequence numbers (reference: actor_task_submitter.cc,
+  sequential_actor_submit_queue.cc)
+
+The driver runs the asyncio loop on a daemon thread and the public sync API
+bridges via run_coroutine_threadsafe; workers run the loop in the foreground
+(worker_main.py) and execute user code on executor threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import exceptions as exc
+from ..object_ref import ObjectRef
+from . import protocol, rpc
+from .config import get_config
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .memory_store import MemoryStore
+from .reference_counter import ReferenceCounter
+from .serialization import get_context
+from .shm_store import ShmStore, StoreFullError
+
+logger = logging.getLogger("ray_tpu.core_worker")
+
+PIPELINE_DEPTH = 4      # concurrent pushes per leased worker
+MAX_LEASES_PER_KEY = 0  # 0 = node CPU count
+
+
+class _PendingTask:
+    __slots__ = ("spec", "ref_args")
+
+    def __init__(self, spec: dict, ref_args: List[bytes]):
+        self.spec = spec
+        self.ref_args = ref_args  # owned object ids pinned while in flight
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_addr", "worker_id", "conn", "inflight",
+                 "agent_conn", "idle_since")
+
+    def __init__(self, lease_id, worker_addr, worker_id, conn, agent_conn):
+        self.lease_id = lease_id
+        self.worker_addr = worker_addr
+        self.worker_id = worker_id
+        self.conn = conn
+        self.agent_conn = agent_conn
+        self.inflight = 0
+        self.idle_since = time.monotonic()
+
+
+class _KeyState:
+    __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
+                 "strategy")
+
+    def __init__(self, resources, strategy):
+        self.queue: deque[_PendingTask] = deque()
+        self.leases: List[_Lease] = []
+        self.pending_lease_requests = 0
+        self.resources = resources
+        self.strategy = strategy
+
+
+class _ActorState:
+    __slots__ = ("actor_id", "address", "conn", "seq", "dead", "death_cause",
+                 "resolving")
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.address = None
+        self.conn: Optional[rpc.Connection] = None
+        self.seq = 0
+        self.dead = False
+        self.death_cause = ""
+        self.resolving: Optional[asyncio.Future] = None
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, gcs_address, agent_address,
+                 store_path: str, node_id: bytes, session_dir: str,
+                 job_id: Optional[bytes] = None,
+                 worker_id: Optional[bytes] = None):
+        self.mode = mode
+        self.gcs_address = tuple(gcs_address)
+        self.agent_address = tuple(agent_address)
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.worker_id = worker_id or WorkerID.from_random().binary()
+        self.job_id = job_id
+        self.store = ShmStore.attach(store_path)
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self._on_ref_zero)
+        self.current_task_id: bytes = b""
+        self._put_counter = 0
+        self._keys: Dict[bytes, _KeyState] = {}
+        self._actors: Dict[bytes, _ActorState] = {}
+        self._worker_conns: Dict[tuple, rpc.Connection] = {}
+        self._owner_conns: Dict[tuple, rpc.Connection] = {}
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._inflight_replies: Dict[bytes, asyncio.Future] = {}
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self.gcs: Optional[rpc.Connection] = None
+        self.agent: Optional[rpc.Connection] = None
+        self.address: Optional[tuple] = None
+        self._server: Optional[rpc.RpcServer] = None
+        self.executor = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="ray_tpu_exec")
+        self._shutdown = False
+        cfg = get_config()
+        self._inline_limit = cfg.max_direct_call_object_size
+        ctx = get_context()
+        ctx.ref_factory = self._ref_factory
+        ctx.ref_hook = self._ref_serialized_hook
+
+    # ------------------------------------------------------------ lifecycle --
+    def start_driver(self):
+        """Start the loop on a daemon thread and connect (driver mode)."""
+        ready = threading.Event()
+
+        def _run():
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_until_complete(self._connect())
+            ready.set()
+            self.loop.run_forever()
+
+        self._loop_thread = threading.Thread(target=_run, daemon=True,
+                                             name="ray_tpu_io")
+        self._loop_thread.start()
+        if not ready.wait(30):
+            raise TimeoutError("driver core worker failed to start")
+        if self.job_id is None:
+            self.job_id = JobID.from_int(
+                self._run(self.gcs.call("next_job_id", {}))).binary()
+        self.current_task_id = TaskID.for_driver(JobID(self.job_id)).binary()
+        self._run(self.gcs.call("register_job", {
+            "job_id": self.job_id, "driver_addr": list(self.address)}))
+
+    async def start_in_loop(self):
+        """Connect using the already-running loop (worker mode)."""
+        self.loop = asyncio.get_running_loop()
+        await self._connect()
+
+    async def _connect(self):
+        self._server = rpc.RpcServer(self._handlers(), name=f"cw-{self.mode}")
+        self.address = await self._server.start_tcp("127.0.0.1", 0)
+        self.gcs = await rpc.connect(self.gcs_address, name="cw->gcs")
+        self.agent = await rpc.connect(self.agent_address, name="cw->agent")
+
+    def _handlers(self):
+        return {
+            "get_object": self.h_get_object,
+            "free_notify": self.h_free_notify,
+            "ping": lambda conn, p: "pong",
+        }
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        if self.loop and self._loop_thread:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._loop_thread.join(timeout=5)
+        self.executor.shutdown(wait=False)
+        self.store.close()
+
+    def _run(self, coro, timeout=None):
+        """Run a coroutine from a sync caller thread."""
+        if self.loop is None:
+            raise RuntimeError("core worker not started")
+        if threading.current_thread() is self._loop_thread or (
+                self._loop_thread is None
+                and threading.current_thread().name == "MainThread"
+                and self.mode == "worker"):
+            raise RuntimeError(
+                "sync API called from the event-loop thread; use `await` "
+                "inside async actors")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------- ref plumbing ---
+    def _ref_factory(self, object_id: bytes, owner_addr):
+        return ObjectRef(object_id, owner_addr, worker=self)
+
+    def _ref_serialized_hook(self, ref: ObjectRef):
+        # A ref we own is being serialized into some value that may outlive
+        # this process's knowledge of it: pin conservatively (round-1
+        # borrowing, see reference_counter.py docstring).
+        if ref.owner_address == self.address:
+            self.reference_counter.mark_escaped(ref.binary())
+
+    def _on_ref_zero(self, object_id: bytes):
+        entry = self.memory_store.get(object_id)
+        self.memory_store.delete(object_id)
+        if entry is not None and entry.plasma_node is not None:
+            node = tuple(entry.plasma_node)
+            if self.loop and not self._shutdown:
+                asyncio.run_coroutine_threadsafe(
+                    self._free_plasma(node, object_id), self.loop)
+
+    async def _free_plasma(self, agent_addr, object_id: bytes):
+        try:
+            conn = self.agent if agent_addr == self.agent_address else \
+                await self._peer_owner(agent_addr)
+            await conn.call("free_objects", {"object_ids": [object_id]})
+        except rpc.RpcError:
+            pass
+
+    async def _peer_owner(self, addr) -> rpc.Connection:
+        addr = tuple(addr)
+        conn = self._owner_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(addr, name="cw->peer", retries=3)
+            self._owner_conns[addr] = conn
+        return conn
+
+    # ------------------------------------------------------------- put/get --
+    def put(self, value: Any) -> ObjectRef:
+        return self._run(self.put_async(value))
+
+    async def put_async(self, value: Any) -> ObjectRef:
+        self._put_counter += 1
+        oid = ObjectID.for_put(TaskID(self.current_task_id),
+                               self._put_counter).binary()
+        ctx = get_context()
+        parts = ctx.serialize(value)
+        size = ctx.total_size(parts)
+        self.reference_counter.add_owned(oid)
+        cfg = get_config()
+        if size <= self._inline_limit and cfg.put_small_object_in_memory_store:
+            self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+        else:
+            await self._put_plasma(oid, parts)
+        return ObjectRef(oid, self.address, worker=self)
+
+    async def _put_plasma(self, oid: bytes, parts):
+        try:
+            self.store.put(oid, parts)
+        except StoreFullError:
+            # TODO(round2): spill-to-disk path; for now surface the error.
+            raise exc.ObjectStoreFullError(
+                f"object of size {get_context().total_size(parts)} does not fit")
+        await self.agent.call("pin_object", {"object_id": oid})
+        self.memory_store.put_plasma_location(oid, list(self.agent_address))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(
+                f"get() accepts ObjectRef or a list of ObjectRefs; got "
+                f"{type(bad[0]).__name__}")
+        values = self._run(self._get_many(refs, timeout))
+        return values[0] if single else values
+
+    async def get_async(self, ref: ObjectRef, timeout=None):
+        return (await self._get_many([ref], timeout))[0]
+
+    def get_future(self, ref: ObjectRef):
+        return asyncio.run_coroutine_threadsafe(
+            self._get_many([ref], None), self.loop)
+
+    async def _get_many(self, refs: List[ObjectRef], timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return await asyncio.gather(
+            *[self._get_one(r, deadline) for r in refs])
+
+    async def _get_one(self, ref: ObjectRef, deadline):
+        data = await self._fetch_serialized(ref, deadline)
+        value = get_context().deserialize(data)
+        if isinstance(value, exc.RayError):
+            raise value
+        return value
+
+    async def _fetch_serialized(self, ref: ObjectRef, deadline) -> memoryview:
+        oid = ref.binary()
+        owner = ref.owner_address or self.address
+        while True:
+            # 1. Local memory store (owned objects / cached results).
+            entry = self.memory_store.get(oid)
+            if entry is not None:
+                if entry.data is not None:
+                    return memoryview(entry.data)
+                return await self._read_plasma(oid, entry.plasma_node, deadline)
+            # 2. Local shared memory.
+            view = self.store.get(oid, timeout_ms=0)
+            if view is not None:
+                return view  # zero-copy; pin retained for the view's lifetime
+            # 3. Owner-mediated resolution.
+            if tuple(owner) == self.address:
+                timeout = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                entry = await self.memory_store.wait_for(oid, timeout)
+                if entry is None:
+                    raise exc.GetTimeoutError(f"timed out getting {oid.hex()}")
+                continue
+            conn = await self._peer_owner(owner)
+            timeout_ms = -1 if deadline is None else int(
+                max(0.0, deadline - time.monotonic()) * 1000)
+            try:
+                res = await conn.call(
+                    "get_object", {"object_id": oid, "timeout_ms": timeout_ms},
+                    timeout=None if deadline is None else
+                    max(0.1, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError(f"timed out getting {oid.hex()}")
+            except rpc.ConnectionLost:
+                raise exc.OwnerDiedError(
+                    f"owner {owner} of {oid.hex()} is unreachable")
+            if res is None:
+                raise exc.GetTimeoutError(f"timed out getting {oid.hex()}")
+            if "inline" in res:
+                return memoryview(res["inline"])
+            return await self._read_plasma(oid, res["plasma"], deadline)
+
+    async def _read_plasma(self, oid: bytes, agent_addr, deadline) -> memoryview:
+        view = self.store.get(oid, timeout_ms=0)
+        if view is not None:
+            return view
+        if tuple(agent_addr) == self.agent_address:
+            timeout_ms = 30_000 if deadline is None else int(
+                max(0.0, deadline - time.monotonic()) * 1000)
+            view = self.store.get(oid, timeout_ms=timeout_ms)
+            if view is None:
+                raise exc.ObjectLostError(f"{oid.hex()} not in local store")
+            return view
+        ok = await self.agent.call("pull_object", {
+            "object_id": oid, "from_addr": list(agent_addr)}, timeout=120)
+        if not ok:
+            raise exc.ObjectLostError(f"failed to pull {oid.hex()}")
+        view = self.store.get(oid, timeout_ms=5000)
+        if view is None:
+            raise exc.ObjectLostError(f"{oid.hex()} pulled but not sealed")
+        return view
+
+    # Owner-side service: borrowers resolve objects through us.
+    async def h_get_object(self, conn, p):
+        oid = p["object_id"]
+        timeout_ms = p.get("timeout_ms", 0)
+        timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+        entry = await self.memory_store.wait_for(oid, timeout)
+        if entry is None:
+            return None
+        if entry.data is not None:
+            return {"inline": entry.data}
+        return {"plasma": list(entry.plasma_node)}
+
+    async def h_free_notify(self, conn, p):
+        for oid in p["object_ids"]:
+            self.memory_store.delete(oid)
+        return True
+
+    # ----------------------------------------------------------------- wait --
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        return self._run(self._wait(refs, num_returns, timeout))
+
+    async def _wait(self, refs, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                if await self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.005)
+        return ready, pending
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.binary()
+        if self.memory_store.contains(oid) or self.store.contains(oid):
+            return True
+        owner = ref.owner_address or self.address
+        if tuple(owner) == self.address:
+            return False
+        try:
+            conn = await self._peer_owner(owner)
+            res = await conn.call("get_object",
+                                  {"object_id": oid, "timeout_ms": 0},
+                                  timeout=5)
+            return res is not None
+        except (rpc.RpcError, asyncio.TimeoutError):
+            return False
+
+    # ------------------------------------------------------- normal tasks ----
+    def submit_task(self, *, fn, fn_id: Optional[bytes], args, kwargs,
+                    num_returns: int, resources: Dict[str, float],
+                    max_retries: int, scheduling_strategy=None,
+                    runtime_env=None, name="") -> List[ObjectRef]:
+        return self._run(self.submit_task_async(
+            fn=fn, fn_id=fn_id, args=args, kwargs=kwargs,
+            num_returns=num_returns, resources=resources,
+            max_retries=max_retries, scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env, name=name))
+
+    async def submit_task_async(self, *, fn, fn_id, args, kwargs, num_returns,
+                                resources, max_retries,
+                                scheduling_strategy=None, runtime_env=None,
+                                name="") -> List[ObjectRef]:
+        if fn_id is None:
+            fn_id = await self._export_function(fn)
+        task_id = TaskID.for_normal_task(JobID(self.job_id)).binary()
+        arg_entries, ref_args = await self._resolve_args(args, kwargs)
+        spec = protocol.make_task_spec(
+            task_id=task_id, job_id=self.job_id, fn_id=fn_id,
+            args=arg_entries, nreturns=num_returns, owner_addr=list(self.address),
+            resources=resources, retries_left=max_retries,
+            scheduling_strategy=scheduling_strategy, runtime_env=runtime_env,
+            name=name or getattr(fn, "__name__", ""))
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            self.reference_counter.add_owned(oid, lineage=spec)
+            refs.append(ObjectRef(oid, self.address, worker=self))
+        for oid in ref_args:
+            self.reference_counter.add_submitted(oid)
+        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy)
+        state = self._keys.get(key)
+        if state is None:
+            state = self._keys[key] = _KeyState(resources, scheduling_strategy)
+        state.queue.append(_PendingTask(spec, ref_args))
+        self._pump(key, state)
+        return refs
+
+    async def _export_function(self, fn) -> bytes:
+        ctx = get_context()
+        blob = ctx.dumps_code(fn)
+        fn_id = protocol.function_id(blob)
+        if fn_id not in self._fn_cache:
+            await self.gcs.call("kv_put", {
+                "ns": "fn", "key": fn_id.hex(), "value": blob,
+                "overwrite": False})
+            self._fn_cache[fn_id] = fn
+        return fn_id
+
+    async def _resolve_args(self, args, kwargs) -> Tuple[list, List[bytes]]:
+        """Inline small/available values; pass big ones by reference
+        (reference: dependency_resolver.cc inlining rules)."""
+        entries = []
+        ref_args: List[bytes] = []
+        ctx = get_context()
+        items = [("", a) for a in args] + list(kwargs.items())
+        for kw, a in items:
+            if isinstance(a, ObjectRef):
+                resolved = await self._resolve_ref_arg(a)
+                entry = dict(resolved)
+                if "ref" in entry:
+                    ref_args.append(a.binary())
+            else:
+                parts = ctx.serialize(a)
+                size = ctx.total_size(parts)
+                if size <= self._inline_limit:
+                    entry = {"v": protocol.concat_parts(parts)}
+                else:
+                    self._put_counter += 1
+                    oid = ObjectID.for_put(TaskID(self.current_task_id),
+                                           self._put_counter).binary()
+                    self.reference_counter.add_owned(oid)
+                    await self._put_plasma(oid, parts)
+                    entry = {"ref": [oid, list(self.address),
+                                     list(self.agent_address)]}
+                    ref_args.append(oid)
+            if kw:
+                entry["kw"] = kw
+            entries.append(entry)
+        return entries, ref_args
+
+    async def _resolve_ref_arg(self, ref: ObjectRef) -> dict:
+        oid = ref.binary()
+        owner = ref.owner_address or self.address
+        if tuple(owner) == self.address:
+            entry = await self.memory_store.wait_for(oid)  # waits for pending
+            if entry.is_exception:
+                # Dependency failed: propagate the stored exception by value.
+                return {"v": entry.data}
+            if entry.data is not None:
+                return {"v": entry.data}
+            return {"ref": [oid, list(owner), list(entry.plasma_node)]}
+        # Borrowed ref: let the executor resolve it via the owner.
+        return {"ref": [oid, list(owner), None]}
+
+    def _pump(self, key: bytes, state: _KeyState):
+        """Dispatch queued tasks onto leased workers; grow leases on demand
+        (reference: normal_task_submitter.cc lease pool + pipelining)."""
+        for lease in state.leases:
+            while state.queue and lease.inflight < PIPELINE_DEPTH:
+                if lease.conn.closed:
+                    break
+                task = state.queue.popleft()
+                lease.inflight += 1
+                asyncio.ensure_future(self._push_and_track(key, state, lease, task))
+        max_leases = MAX_LEASES_PER_KEY or os.cpu_count() or 8
+        want = min(len(state.queue), max_leases - len(state.leases)
+                   - state.pending_lease_requests)
+        for _ in range(max(0, want)):
+            state.pending_lease_requests += 1
+            asyncio.ensure_future(self._request_lease(key, state))
+
+    async def _request_lease(self, key: bytes, state: _KeyState,
+                             agent_conn: Optional[rpc.Connection] = None,
+                             hops: int = 0):
+        agent_conn = agent_conn or self.agent
+        try:
+            res = await agent_conn.call("request_lease", {
+                "resources": state.resources,
+                "placement_group": (state.strategy or {}).get("pg")
+                if state.strategy else None,
+            }, timeout=130)
+        except (rpc.RpcError, asyncio.TimeoutError):
+            state.pending_lease_requests -= 1
+            if state.queue:
+                await asyncio.sleep(0.2)
+                self._pump(key, state)
+            return
+        if not res.get("granted"):
+            spill = res.get("spillback")
+            if spill and hops < 4:
+                try:
+                    peer = await self._peer_owner(tuple(spill))
+                    await self._request_lease(key, state, peer, hops + 1)
+                    return
+                except rpc.ConnectionLost:
+                    pass
+            state.pending_lease_requests -= 1
+            if state.queue:
+                await asyncio.sleep(res.get("retry_after_ms", 100) / 1000)
+                self._pump(key, state)
+            return
+        state.pending_lease_requests -= 1
+        worker_addr = tuple(res["worker_addr"])
+        conn = await self._worker_conn(worker_addr)
+        lease = _Lease(res["lease_id"], worker_addr, res["worker_id"], conn,
+                       agent_conn)
+        state.leases.append(lease)
+        self._pump(key, state)
+        asyncio.ensure_future(self._lease_reaper(key, state, lease))
+
+    async def _worker_conn(self, addr: tuple) -> rpc.Connection:
+        conn = self._worker_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(addr, name="cw->worker", retries=3)
+            self._worker_conns[addr] = conn
+        return conn
+
+    async def _lease_reaper(self, key, state, lease: _Lease):
+        while True:
+            await asyncio.sleep(0.25)
+            if lease.conn.closed:
+                if lease in state.leases:
+                    state.leases.remove(lease)
+                return
+            if lease.inflight == 0 and not state.queue:
+                if time.monotonic() - lease.idle_since > 0.5:
+                    if lease in state.leases:
+                        state.leases.remove(lease)
+                    try:
+                        await lease.agent_conn.call(
+                            "return_lease", {"lease_id": lease.lease_id})
+                    except rpc.RpcError:
+                        pass
+                    return
+
+    async def _push_and_track(self, key, state, lease: _Lease, task: _PendingTask):
+        spec = task.spec
+        try:
+            reply = await lease.conn.call("push_task", spec)
+        except rpc.ConnectionLost:
+            lease.inflight -= 1
+            if lease in state.leases:
+                state.leases.remove(lease)
+            if spec["retries_left"] > 0:
+                spec["retries_left"] -= 1
+                state.queue.append(task)
+            else:
+                self._store_task_failure(
+                    spec, exc.WorkerCrashedError(
+                        f"worker at {lease.worker_addr} died running "
+                        f"{spec['name']}"))
+            self._pump(key, state)
+            return
+        lease.inflight -= 1
+        lease.idle_since = time.monotonic()
+        self._handle_reply(spec, task, reply)
+        self._pump(key, state)
+
+    def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
+        for oid in (task.ref_args if task else []):
+            self.reference_counter.remove_submitted(oid)
+        task_id = spec["task_id"]
+        if reply.get("status") == "ok":
+            for i, entry in enumerate(reply["returns"]):
+                oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+                if "inline" in entry:
+                    self.memory_store.put_inline(oid, entry["inline"])
+                else:
+                    self.memory_store.put_plasma_location(oid, entry["plasma"])
+        else:
+            err = get_context().loads_code(reply["error"])
+            wrapped = exc.RayTaskError(
+                f"task {spec['name']} failed", cause=err,
+                remote_traceback=reply.get("traceback", ""))
+            self._store_task_exception(spec, wrapped)
+
+    def _store_task_failure(self, spec, error: Exception):
+        self._store_task_exception(spec, error)
+
+    def _store_task_exception(self, spec, error):
+        data = protocol.concat_parts(get_context().serialize(error))
+        for i in range(spec["nreturns"]):
+            oid = ObjectID.for_task_return(
+                TaskID(spec["task_id"]), i + 1).binary()
+            self.memory_store.put_inline(oid, data, is_exception=True)
+
+    # ------------------------------------------------------------- actors ----
+    def create_actor(self, *, cls, actor_id: bytes, args, kwargs, resources,
+                     name=None, get_if_exists=False, max_restarts=0,
+                     max_concurrency=1, runtime_env=None,
+                     scheduling_strategy=None, class_name="") -> dict:
+        return self._run(self._create_actor(
+            cls=cls, actor_id=actor_id, args=args, kwargs=kwargs,
+            resources=resources, name=name, get_if_exists=get_if_exists,
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            runtime_env=runtime_env, scheduling_strategy=scheduling_strategy,
+            class_name=class_name))
+
+    async def _create_actor(self, *, cls, actor_id, args, kwargs, resources,
+                            name, get_if_exists, max_restarts, max_concurrency,
+                            runtime_env, scheduling_strategy, class_name):
+        ctx = get_context()
+        blob = ctx.dumps_code(cls)
+        cls_id = protocol.function_id(blob)
+        await self.gcs.call("kv_put", {"ns": "actor_cls", "key": cls_id.hex(),
+                                       "value": blob, "overwrite": False})
+        arg_entries, _ = await self._resolve_args(args, kwargs)
+        spec = {
+            "actor_id": actor_id,
+            "job_id": self.job_id,
+            "class_id": cls_id,
+            "class_name": class_name,
+            "args": arg_entries,
+            "resources": resources,
+            "name": name,
+            "get_if_exists": get_if_exists,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "runtime_env": runtime_env,
+            "scheduling_strategy": scheduling_strategy,
+            "owner_addr": list(self.address),
+        }
+        res = await self.gcs.call("register_actor", {"spec": spec}, timeout=180)
+        return res["actor"]
+
+    def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
+                          num_returns: int, max_task_retries: int = 0
+                          ) -> List[ObjectRef]:
+        return self._run(self.submit_actor_task_async(
+            actor_id=actor_id, method=method, args=args, kwargs=kwargs,
+            num_returns=num_returns))
+
+    async def submit_actor_task_async(self, *, actor_id, method, args, kwargs,
+                                      num_returns) -> List[ObjectRef]:
+        state = self._actors.get(actor_id)
+        if state is None:
+            state = self._actors[actor_id] = _ActorState(actor_id)
+        task_id = TaskID.for_actor_task(ActorID(actor_id)).binary()
+        arg_entries, ref_args = await self._resolve_args(args, kwargs)
+        state.seq += 1
+        spec = protocol.make_task_spec(
+            task_id=task_id, job_id=self.job_id, fn_id=b"", args=arg_entries,
+            nreturns=num_returns, owner_addr=list(self.address), resources={},
+            actor_id=actor_id, method=method, seq=state.seq, name=method)
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            self.reference_counter.add_owned(oid)
+            refs.append(ObjectRef(oid, self.address, worker=self))
+        for oid in ref_args:
+            self.reference_counter.add_submitted(oid)
+        asyncio.ensure_future(self._push_actor_task(state, spec,
+                                                    _PendingTask(spec, ref_args)))
+        return refs
+
+    async def _actor_conn(self, state: _ActorState) -> rpc.Connection:
+        if state.conn is not None and not state.conn.closed:
+            return state.conn
+        if state.resolving is not None:
+            await state.resolving
+            if state.conn is not None and not state.conn.closed:
+                return state.conn
+        state.resolving = asyncio.get_running_loop().create_future()
+        try:
+            for attempt in range(60):
+                info = await self.gcs.call(
+                    "get_actor", {"actor_id": state.actor_id,
+                                  "wait_alive": True}, timeout=60)
+                if info is None:
+                    raise exc.ActorDiedError("actor was never registered")
+                if info["state"] == protocol.ACTOR_DEAD:
+                    state.dead = True
+                    state.death_cause = info.get("death_cause") or "dead"
+                    raise exc.ActorDiedError(state.death_cause)
+                if info["state"] == protocol.ACTOR_ALIVE and info["address"]:
+                    try:
+                        state.conn = await rpc.connect(
+                            tuple(info["address"]), name="cw->actor", retries=3)
+                        state.address = tuple(info["address"])
+                        return state.conn
+                    except rpc.ConnectionLost:
+                        pass
+                await asyncio.sleep(0.25)
+            raise exc.ActorDiedError("timed out resolving actor address")
+        finally:
+            fut, state.resolving = state.resolving, None
+            fut.set_result(None)
+
+    async def _push_actor_task(self, state: _ActorState, spec, task):
+        try:
+            conn = await self._actor_conn(state)
+        except exc.ActorDiedError as e:
+            self._store_task_exception(spec, e)
+            for oid in task.ref_args:
+                self.reference_counter.remove_submitted(oid)
+            return
+        try:
+            reply = await conn.call("push_actor_task", spec)
+        except rpc.ConnectionLost:
+            state.conn = None
+            self._store_task_exception(spec, exc.ActorDiedError(
+                f"actor {state.actor_id.hex()[:8]} died during "
+                f"{spec['method']}"))
+            for oid in task.ref_args:
+                self.reference_counter.remove_submitted(oid)
+            return
+        self._handle_reply(spec, task, reply)
+
+    def kill_actor(self, actor_id: bytes, no_restart=True):
+        self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
+        st = self._actors.get(actor_id)
+        if st:
+            st.dead = True
+
+    def kill_actor_nowait(self, actor_id: bytes):
+        """Fire-and-forget termination used by handle GC — safe to call from
+        __del__ on any thread, including the loop thread."""
+        if self._shutdown or self.loop is None or not self.loop.is_running():
+            return
+        def _go():
+            if self.gcs and not self.gcs.closed:
+                try:
+                    self.gcs.notify("kill_actor", {"actor_id": actor_id})
+                except rpc.RpcError:
+                    pass
+        self.loop.call_soon_threadsafe(_go)
+
+    def get_actor_info(self, *, actor_id=None, name=None):
+        return self._run(self.gcs.call(
+            "get_actor", {"actor_id": actor_id, "name": name,
+                          "wait_alive": False}))

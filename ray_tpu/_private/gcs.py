@@ -1,0 +1,552 @@
+"""Global Control Service: cluster membership, actor directory, KV, pubsub.
+
+Control-plane equivalent of the reference's GCS server (reference:
+src/ray/gcs/gcs_server.h:98 and the services in gcs_service.proto — JobInfo,
+ActorInfo, NodeInfo, KV, PlacementGroup, WorkerInfo). One asyncio process on
+the head node. Tables live in memory with an optional JSON-lines append log
+for restart replay (the reference's Redis-backed store_client fills this role;
+a file journal gives the same GCS-restart fault-tolerance story on one host).
+
+Actor scheduling follows the reference's GcsActorScheduler: pick a node from
+the live resource view, ask that node's agent to lease a worker and
+instantiate the actor, publish lifecycle events on the actor channel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import protocol, rpc
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+
+class NodeInfo:
+    def __init__(self, node_id: bytes, address, resources: Dict[str, float],
+                 labels: Dict[str, str], store_path: str, session_dir: str):
+        self.node_id = node_id
+        self.address = tuple(address)
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = dict(labels)
+        self.store_path = store_path
+        self.session_dir = session_dir
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.conn: Optional[rpc.Connection] = None  # GCS→agent client
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "address": list(self.address),
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "store_path": self.store_path,
+            "alive": self.alive,
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id: bytes, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec                     # creation spec (class key, args..)
+        self.name = spec.get("name") or None
+        self.state = protocol.ACTOR_PENDING
+        self.address = None                  # worker RPC address when ALIVE
+        self.node_id: Optional[bytes] = None
+        self.restarts = 0
+        self.max_restarts = spec.get("max_restarts", 0)
+        self.death_cause: Optional[str] = None
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "name": self.name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "restarts": self.restarts,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+            "class_name": self.spec.get("class_name", ""),
+        }
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self.placement_groups: Dict[bytes, dict] = {}
+        self._job_counter = 0
+        self._subscribers: Dict[str, List[rpc.Connection]] = {}
+        self._server = rpc.RpcServer(self._handlers(), name="gcs")
+        self._health_task: Optional[asyncio.Task] = None
+
+    def _handlers(self):
+        return {
+            "kv_put": self.h_kv_put, "kv_get": self.h_kv_get,
+            "kv_del": self.h_kv_del, "kv_keys": self.h_kv_keys,
+            "kv_exists": self.h_kv_exists,
+            "register_node": self.h_register_node,
+            "get_nodes": self.h_get_nodes,
+            "report_resources": self.h_report_resources,
+            "drain_node": self.h_drain_node,
+            "next_job_id": self.h_next_job_id,
+            "register_job": self.h_register_job,
+            "get_jobs": self.h_get_jobs,
+            "register_actor": self.h_register_actor,
+            "get_actor": self.h_get_actor,
+            "list_actors": self.h_list_actors,
+            "kill_actor": self.h_kill_actor,
+            "actor_failed": self.h_actor_failed,
+            "subscribe": self.h_subscribe,
+            "publish": self.h_publish,
+            "create_placement_group": self.h_create_placement_group,
+            "remove_placement_group": self.h_remove_placement_group,
+            "get_placement_group": self.h_get_placement_group,
+            "ping": lambda conn, p: "pong",
+            "get_cluster_info": self.h_get_cluster_info,
+        }
+
+    async def start(self):
+        addr = await self._server.start_tcp(self.host, self.port)
+        self.address = addr
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        logger.info("GCS listening on %s", addr)
+        return addr
+
+    async def close(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self._server.close()
+
+    # ------------------------------------------------------------------ KV --
+    async def h_kv_put(self, conn, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        key = p["key"]
+        if not p.get("overwrite", True) and key in ns:
+            return False
+        ns[key] = p["value"]
+        return True
+
+    async def h_kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    async def h_kv_exists(self, conn, p):
+        return p["key"] in self.kv.get(p.get("ns", ""), {})
+
+    async def h_kv_del(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        prefix = p.get("prefix", False)
+        if prefix:
+            n = 0
+            for k in [k for k in ns if k.startswith(p["key"])]:
+                del ns[k]
+                n += 1
+            return n
+        return 1 if ns.pop(p["key"], None) is not None else 0
+
+    async def h_kv_keys(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        pref = p.get("prefix", b"")
+        return [k for k in ns if k.startswith(pref)]
+
+    # ---------------------------------------------------------------- nodes --
+    async def h_register_node(self, conn, p):
+        node = NodeInfo(p["node_id"], p["address"], p["resources"],
+                        p.get("labels", {}), p.get("store_path", ""),
+                        p.get("session_dir", ""))
+        self.nodes[node.node_id] = node
+        asyncio.ensure_future(self._connect_agent(node))
+        self._publish(protocol.CH_NODE, {"event": "alive", "node": node.view()})
+        return {"cluster_nodes": [n.view() for n in self.nodes.values()]}
+
+    async def _connect_agent(self, node: NodeInfo):
+        try:
+            node.conn = await rpc.connect(node.address, name="gcs->agent")
+        except rpc.ConnectionLost:
+            logger.warning("cannot connect to agent %s", node.address)
+
+    async def h_get_nodes(self, conn, p):
+        return [n.view() for n in self.nodes.values()]
+
+    async def h_report_resources(self, conn, p):
+        node = self.nodes.get(p["node_id"])
+        if node:
+            node.resources_available = p["available"]
+            node.last_heartbeat = time.monotonic()
+        return True
+
+    async def h_drain_node(self, conn, p):
+        await self._mark_node_dead(p["node_id"], "drained")
+        return True
+
+    async def _health_loop(self):
+        """Active health checking (reference: gcs_health_check_manager.h —
+        FailNode after `health_check_failure_threshold` missed periods)."""
+        from .config import get_config
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > period * threshold:
+                    await self._mark_node_dead(node.node_id, "health check failed")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        node = self.nodes.get(node_id)
+        if not node or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self._publish(protocol.CH_NODE, {"event": "dead", "node": node.view(),
+                                         "reason": reason})
+        # Fail actors on that node; restart if allowed.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state == protocol.ACTOR_ALIVE:
+                await self._handle_actor_death(actor, f"node died: {reason}")
+
+    # --------------------------------------------------------------- pubsub --
+    async def h_subscribe(self, conn, p):
+        self._subscribers.setdefault(p["channel"], []).append(conn)
+        return True
+
+    async def h_publish(self, conn, p):
+        self._publish(p["channel"], p["message"])
+        return True
+
+    def _publish(self, channel: str, message):
+        subs = self._subscribers.get(channel, [])
+        dead = []
+        for c in subs:
+            if c.closed:
+                dead.append(c)
+                continue
+            try:
+                c.notify("pubsub", {"channel": channel, "message": message})
+            except rpc.ConnectionLost:
+                dead.append(c)
+        for c in dead:
+            subs.remove(c)
+
+    # ----------------------------------------------------------------- jobs --
+    async def h_next_job_id(self, conn, p):
+        self._job_counter += 1
+        return self._job_counter
+
+    async def h_register_job(self, conn, p):
+        self.jobs[p["job_id"]] = {"job_id": p["job_id"],
+                                  "driver_addr": p.get("driver_addr"),
+                                  "start_time": time.time(), "alive": True}
+        return True
+
+    async def h_get_jobs(self, conn, p):
+        return list(self.jobs.values())
+
+    # --------------------------------------------------------------- actors --
+    async def h_register_actor(self, conn, p):
+        """Register + schedule an actor (reference: gcs_actor_manager.cc
+        RegisterActor/CreateActor; scheduling in gcs_actor_scheduler.cc)."""
+        spec = p["spec"]
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        if name:
+            existing_id = self.named_actors.get(name)
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing and existing.state != protocol.ACTOR_DEAD:
+                    if spec.get("get_if_exists"):
+                        return {"existing": True, "actor": existing.view()}
+                    raise ValueError(f"actor name {name!r} already taken")
+        actor = ActorInfo(actor_id, spec)
+        self.actors[actor_id] = actor
+        if name:
+            self.named_actors[name] = actor_id
+        ok = await self._schedule_actor(actor)
+        if not ok:
+            actor.state = protocol.ACTOR_DEAD
+            actor.death_cause = "scheduling failed: no feasible node"
+            raise RuntimeError(actor.death_cause)
+        return {"existing": False, "actor": actor.view()}
+
+    def _pick_node(self, resources: Dict[str, float],
+                   strategy: Optional[dict]) -> Optional[NodeInfo]:
+        """Feasibility + best-fit over the live resource view. Honors
+        node-affinity and placement-group strategies; falls back to
+        most-available (spread-ish, mirroring hybrid policy's behavior
+        below the packing threshold)."""
+        if strategy and strategy.get("type") == "node_affinity":
+            node = self.nodes.get(strategy["node_id"])
+            if node and node.alive:
+                return node
+            if not strategy.get("soft"):
+                return None
+        if strategy and strategy.get("type") == "placement_group":
+            pg = self.placement_groups.get(strategy["pg_id"])
+            if pg:
+                bundle = pg["bundles"][strategy.get("bundle_index", 0)]
+                node = self.nodes.get(bundle["node_id"])
+                if node and node.alive:
+                    return node
+                return None
+        candidates = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            avail = node.resources_available
+            if all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
+                candidates.append(node)
+        if not candidates:
+            return None
+        return max(candidates, key=lambda n: sum(n.resources_available.values()))
+
+    async def _schedule_actor(self, actor: ActorInfo, timeout_s: float = 90.0
+                              ) -> bool:
+        """Queue-until-feasible scheduling (reference: GcsActorScheduler keeps
+        pending actors and reschedules as resources free up)."""
+        spec = actor.spec
+        deadline = time.monotonic() + timeout_s
+        node = None
+        while time.monotonic() < deadline:
+            node = self._pick_node(spec.get("resources", {}),
+                                   spec.get("scheduling_strategy"))
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    result = await node.conn.call("create_actor_worker", spec,
+                                                  timeout=120)
+                    break
+                except (rpc.RpcError, asyncio.TimeoutError) as e:
+                    logger.warning("actor creation on %s failed: %s; retrying",
+                                   node.node_id.hex()[:8], str(e).split("\n")[0])
+            await asyncio.sleep(0.2)
+        else:
+            return False
+        actor.state = protocol.ACTOR_ALIVE
+        actor.address = result["worker_addr"]
+        actor.node_id = node.node_id
+        self._publish(protocol.CH_ACTOR, {"event": "alive", "actor": actor.view()})
+        return True
+
+    async def h_get_actor(self, conn, p):
+        actor = None
+        if p.get("actor_id"):
+            actor = self.actors.get(p["actor_id"])
+        elif p.get("name"):
+            aid = self.named_actors.get(p["name"])
+            actor = self.actors.get(aid) if aid else None
+        if actor is None:
+            return None
+        if p.get("wait_alive") and actor.state == protocol.ACTOR_PENDING:
+            for _ in range(600):
+                if actor.state != protocol.ACTOR_PENDING:
+                    break
+                await asyncio.sleep(0.05)
+        return actor.view()
+
+    async def h_list_actors(self, conn, p):
+        return [a.view() for a in self.actors.values()]
+
+    async def h_kill_actor(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return False
+        actor.max_restarts = 0  # explicit kill is permanent
+        if actor.state == protocol.ACTOR_ALIVE and actor.address:
+            try:
+                c = await rpc.connect(tuple(actor.address), retries=1)
+                c.notify("kill", {"no_restart": True})
+                await c.close()
+            except rpc.ConnectionLost:
+                pass
+        await self._handle_actor_death(actor, "killed via kill_actor")
+        return True
+
+    async def h_actor_failed(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return False
+        await self._handle_actor_death(actor, p.get("reason", "worker died"))
+        return True
+
+    async def _handle_actor_death(self, actor: ActorInfo, reason: str):
+        """Restart-or-bury (reference: gcs_actor_manager.cc OnActorWorkerDead;
+        restart counting at :283)."""
+        if actor.state == protocol.ACTOR_DEAD:
+            return
+        if actor.restarts < actor.max_restarts or actor.max_restarts < 0:
+            actor.restarts += 1
+            actor.state = protocol.ACTOR_RESTARTING
+            actor.address = None
+            self._publish(protocol.CH_ACTOR,
+                          {"event": "restarting", "actor": actor.view()})
+            ok = await self._schedule_actor(actor)
+            if ok:
+                return
+            reason = f"{reason}; restart failed"
+        actor.state = protocol.ACTOR_DEAD
+        actor.death_cause = reason
+        actor.address = None
+        if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
+            del self.named_actors[actor.name]
+        self._publish(protocol.CH_ACTOR, {"event": "dead", "actor": actor.view()})
+
+    # ----------------------------------------------------- placement groups --
+    async def h_create_placement_group(self, conn, p):
+        """Two-phase bundle reservation across agents (reference:
+        gcs_placement_group_scheduler.cc prepare/commit;
+        node_manager.proto:471-476)."""
+        pg_id = p["pg_id"]
+        bundles = p["bundles"]          # list of resource dicts
+        strategy = p.get("strategy", "PACK")
+        chosen = self._place_bundles(bundles, strategy)
+        if chosen is None:
+            return {"ok": False, "reason": "infeasible"}
+        # Phase 1: prepare on every node; roll back on any failure.
+        prepared = []
+        try:
+            for idx, (bundle, node) in enumerate(zip(bundles, chosen)):
+                ok = await node.conn.call("prepare_bundle", {
+                    "pg_id": pg_id, "bundle_index": idx, "resources": bundle,
+                }, timeout=30)
+                if not ok:
+                    raise RuntimeError(f"prepare failed on {node.node_id.hex()[:8]}")
+                prepared.append((idx, node))
+        except Exception as e:
+            for idx, node in prepared:
+                try:
+                    await node.conn.call("return_bundle",
+                                         {"pg_id": pg_id, "bundle_index": idx})
+                except rpc.RpcError:
+                    pass
+            return {"ok": False, "reason": str(e)}
+        # Phase 2: commit.
+        for idx, node in prepared:
+            await node.conn.call("commit_bundle",
+                                 {"pg_id": pg_id, "bundle_index": idx})
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "strategy": strategy,
+            "bundles": [{"node_id": n.node_id, "resources": b,
+                         "node_addr": list(n.address)}
+                        for b, n in zip(bundles, chosen)],
+            "state": "CREATED",
+        }
+        return {"ok": True, "pg": self.placement_groups[pg_id]}
+
+    def _place_bundles(self, bundles, strategy) -> Optional[List[NodeInfo]]:
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        remaining = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(node, bundle):
+            avail = remaining[node.node_id]
+            return all(avail.get(k, 0.0) >= v for k, v in bundle.items() if v > 0)
+
+        def take(node, bundle):
+            avail = remaining[node.node_id]
+            for k, v in bundle.items():
+                avail[k] = avail.get(k, 0.0) - v
+
+        chosen: List[NodeInfo] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(alive, key=lambda n: -sum(n.resources_available.values()))
+            for bundle in bundles:
+                placed = None
+                for node in (chosen[-1:] if chosen else []) + order:
+                    if fits(node, bundle):
+                        placed = node
+                        break
+                if placed is None:
+                    return None
+                if strategy == "STRICT_PACK" and chosen and placed is not chosen[0]:
+                    if fits(chosen[0], bundle):
+                        placed = chosen[0]
+                    else:
+                        return None
+                take(placed, bundle)
+                chosen.append(placed)
+        else:  # SPREAD / STRICT_SPREAD
+            used: set = set()
+            for bundle in bundles:
+                order = sorted(alive, key=lambda n: (n.node_id in used,
+                               -sum(remaining[n.node_id].values())))
+                placed = None
+                for node in order:
+                    if strategy == "STRICT_SPREAD" and node.node_id in used:
+                        continue
+                    if fits(node, bundle):
+                        placed = node
+                        break
+                if placed is None:
+                    return None
+                take(placed, bundle)
+                used.add(placed.node_id)
+                chosen.append(placed)
+        return chosen
+
+    async def h_remove_placement_group(self, conn, p):
+        pg = self.placement_groups.pop(p["pg_id"], None)
+        if pg is None:
+            return False
+        for idx, bundle in enumerate(pg["bundles"]):
+            node = self.nodes.get(bundle["node_id"])
+            if node and node.conn and not node.conn.closed:
+                try:
+                    await node.conn.call("return_bundle",
+                                         {"pg_id": p["pg_id"], "bundle_index": idx})
+                except rpc.RpcError:
+                    pass
+        return True
+
+    async def h_get_placement_group(self, conn, p):
+        return self.placement_groups.get(p["pg_id"])
+
+    async def h_get_cluster_info(self, conn, p):
+        return {
+            "nodes": [n.view() for n in self.nodes.values()],
+            "num_actors": len(self.actors),
+            "num_jobs": len(self.jobs),
+        }
+
+
+async def _amain(args):
+    server = GcsServer(port=args.port)
+    addr = await server.start()
+    # Signal readiness to the parent via a file it watches.
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"address": list(addr)}, f)
+        os.replace(tmp, args.ready_file)
+    await asyncio.Event().wait()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ready-file", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(level=args.log_level)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""In-process memory store for small objects owned by this worker.
+
+Equivalent of the reference's CoreWorkerMemoryStore (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.cc): task
+returns at or under `max_direct_call_object_size` are sent inline in the
+task reply and land here, keeping the shared-memory store and the agent off
+the hot path. Supports async waiters so `get` (and owner-served
+`fetch_object` RPCs from borrowers) can block until a pending task finishes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+
+class _Entry:
+    __slots__ = ("data", "is_exception", "plasma_node")
+
+    def __init__(self, data: Optional[bytes], is_exception: bool = False,
+                 plasma_node=None):
+        self.data = data              # serialized payload, None if in plasma
+        self.is_exception = is_exception
+        self.plasma_node = plasma_node  # node address holding primary copy
+
+
+class MemoryStore:
+    def __init__(self):
+        self._objects: Dict[bytes, _Entry] = {}
+        self._waiters: Dict[bytes, List[asyncio.Event]] = {}
+
+    def put_inline(self, object_id: bytes, data: bytes, is_exception=False):
+        self._objects[object_id] = _Entry(data, is_exception)
+        self._wake(object_id)
+
+    def put_plasma_location(self, object_id: bytes, node_addr):
+        self._objects[object_id] = _Entry(None, plasma_node=node_addr)
+        self._wake(object_id)
+
+    def _wake(self, object_id: bytes):
+        for ev in self._waiters.pop(object_id, []):
+            ev.set()
+
+    def get(self, object_id: bytes) -> Optional[_Entry]:
+        return self._objects.get(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return object_id in self._objects
+
+    async def wait_for(self, object_id: bytes, timeout: float | None = None
+                       ) -> Optional[_Entry]:
+        entry = self._objects.get(object_id)
+        if entry is not None:
+            return entry
+        ev = asyncio.Event()
+        self._waiters.setdefault(object_id, []).append(ev)
+        try:
+            if timeout is None:
+                await ev.wait()
+            else:
+                await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            lst = self._waiters.get(object_id)
+            if lst and ev in lst:
+                lst.remove(ev)
+        return self._objects.get(object_id)
+
+    def delete(self, object_id: bytes):
+        self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        return len(self._objects)

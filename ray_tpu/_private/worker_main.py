@@ -1,0 +1,242 @@
+"""Worker process entry point: executes tasks and hosts actors.
+
+Equivalent of the reference's default_worker.py + the Cython execute_task path
+(reference: python/ray/_private/workers/default_worker.py,
+python/ray/_raylet.pyx:1557 execute_task, task_execution/task_receiver.cc).
+
+The worker runs an asyncio loop serving push_task / push_actor_task RPCs from
+submitters. Sync user functions run on executor threads so the loop keeps
+serving (and the embedded CoreWorker can submit nested tasks); async actor
+methods run as coroutines on the loop itself with a max_concurrency
+semaphore (reference: fiber.h async actors + ConcurrencyGroupManager).
+Normal tasks execute one-at-a-time per worker — parallelism comes from the
+submitter holding many leases, as in the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import traceback
+from typing import Any, Dict, Optional
+
+from . import protocol, rpc
+from .config import get_config
+from .core_worker import CoreWorker
+from .ids import ObjectID, TaskID
+from .serialization import get_context
+from .shm_store import StoreFullError
+from .. import exceptions as exc
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class Executor:
+    def __init__(self, core: CoreWorker, agent_conn_holder):
+        self.core = core
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._task_lock = asyncio.Lock()       # normal tasks: serial
+        self.actor: Any = None
+        self.actor_id: Optional[bytes] = None
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._actor_is_async = False
+
+    # ------------------------------------------------------------ helpers ---
+    async def _load_function(self, fn_id: bytes):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            blob = await self.core.gcs.call(
+                "kv_get", {"ns": "fn", "key": fn_id.hex()})
+            if blob is None:
+                raise exc.RayError(f"function {fn_id.hex()} not exported")
+            fn = get_context().loads_code(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    async def _resolve_arg_entries(self, entries):
+        args, kwargs = [], {}
+        ctx = get_context()
+        for e in entries:
+            if "v" in e:
+                val = ctx.deserialize(memoryview(e["v"]))
+                if isinstance(val, exc.RayError):
+                    raise val
+            else:
+                oid, owner_addr, plasma_hint = e["ref"]
+                from ..object_ref import ObjectRef
+                ref = ObjectRef(bytes(oid), tuple(owner_addr), worker=self.core)
+                if plasma_hint is not None and not self.core.store.contains(
+                        bytes(oid)) and tuple(plasma_hint) != \
+                        self.core.agent_address:
+                    await self.core.agent.call("pull_object", {
+                        "object_id": bytes(oid),
+                        "from_addr": list(plasma_hint)}, timeout=120)
+                val = await self.core._get_one(ref, None)
+            if e.get("kw"):
+                kwargs[e["kw"]] = val
+            else:
+                args.append(val)
+        return args, kwargs
+
+    def _serialize_returns(self, task_id: bytes, nreturns: int, result):
+        """Small returns inline in the reply; large ones go to the local
+        shared-memory store with the agent pinning the primary copy
+        (reference: core_worker.h:1045 AllocateReturnObject — same split)."""
+        if nreturns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != nreturns:
+                raise ValueError(
+                    f"task declared {nreturns} returns but produced "
+                    f"{len(results)}")
+        ctx = get_context()
+        out = []
+        for i, value in enumerate(results):
+            parts = ctx.serialize(value)
+            size = ctx.total_size(parts)
+            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            if size <= self.core._inline_limit:
+                out.append({"inline": protocol.concat_parts(parts)})
+            else:
+                self.core.store.put(oid, parts)
+                out.append({"plasma": list(self.core.agent_address),
+                            "pin": oid})
+        return out
+
+    async def _post_serialize(self, entries):
+        for e in entries:
+            oid = e.pop("pin", None)
+            if oid is not None:
+                await self.core.agent.call("pin_object", {"object_id": oid})
+
+    # ------------------------------------------------------------ handlers --
+    async def h_push_task(self, conn, spec):
+        async with self._task_lock:
+            return await self._execute(spec)
+
+    async def h_push_actor_task(self, conn, spec):
+        if self._actor_is_async:
+            method = getattr(self.actor, spec["method"], None)
+            if method is not None and asyncio.iscoroutinefunction(method):
+                async with self._actor_sem:
+                    return await self._execute(spec)
+        async with self._task_lock:
+            return await self._execute(spec)
+
+    async def _execute(self, spec):
+        loop = asyncio.get_running_loop()
+        prev_task_id = self.core.current_task_id
+        self.core.current_task_id = spec["task_id"]
+        try:
+            args, kwargs = await self._resolve_arg_entries(spec["args"])
+            if spec.get("actor_id"):
+                if self.actor is None:
+                    raise exc.RayError("actor task on non-actor worker")
+                method = getattr(self.actor, spec["method"])
+                if asyncio.iscoroutinefunction(method):
+                    result = await method(*args, **kwargs)
+                else:
+                    result = await loop.run_in_executor(
+                        self.core.executor, lambda: method(*args, **kwargs))
+            else:
+                fn = await self._load_function(spec["fn_id"])
+                result = await loop.run_in_executor(
+                    self.core.executor, lambda: fn(*args, **kwargs))
+            returns = self._serialize_returns(
+                spec["task_id"], spec["nreturns"], result)
+            await self._post_serialize(returns)
+            return {"status": "ok", "returns": returns}
+        except Exception as e:  # noqa: BLE001 — every user error is reported
+            tb = traceback.format_exc()
+            try:
+                blob = get_context().dumps_code(e)
+            except Exception:
+                blob = get_context().dumps_code(
+                    exc.RayError(f"{type(e).__name__}: {e} (unpicklable)"))
+            return {"status": "error", "error": blob, "traceback": tb}
+        finally:
+            self.core.current_task_id = prev_task_id
+
+    async def h_actor_init(self, conn, spec):
+        blob = await self.core.gcs.call(
+            "kv_get", {"ns": "actor_cls", "key": spec["class_id"].hex()
+                       if isinstance(spec["class_id"], bytes)
+                       else spec["class_id"]})
+        if blob is None:
+            raise exc.RayError("actor class not exported")
+        cls = get_context().loads_code(blob)
+        args, kwargs = await self._resolve_arg_entries(spec["args"])
+        loop = asyncio.get_running_loop()
+        self.actor = await loop.run_in_executor(
+            self.core.executor, lambda: cls(*args, **kwargs))
+        self.actor_id = spec["actor_id"]
+        max_conc = spec.get("max_concurrency", 1) or 1
+        self._actor_is_async = any(
+            asyncio.iscoroutinefunction(getattr(type(self.actor), m, None))
+            for m in dir(type(self.actor)) if not m.startswith("__"))
+        if self._actor_is_async and max_conc == 1:
+            max_conc = 1000  # async actors default to high concurrency
+        self._actor_sem = asyncio.Semaphore(max_conc)
+        return True
+
+    async def h_kill(self, conn, p):
+        logger.info("worker exiting on kill request")
+        asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
+        return True
+
+
+async def amain():
+    worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+    agent_addr = json.loads(os.environ["RAY_TPU_AGENT_ADDR"])
+    gcs_addr = json.loads(os.environ["RAY_TPU_GCS_ADDR"])
+    node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
+    store_path = os.environ["RAY_TPU_STORE_PATH"]
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+
+    core = CoreWorker(
+        mode="worker", gcs_address=gcs_addr, agent_address=agent_addr,
+        store_path=store_path, node_id=node_id, session_dir=session_dir,
+        worker_id=worker_id, job_id=b"\x00\x00\x00\x00")
+    await core.start_in_loop()
+    executor = Executor(core, None)
+    exec_handlers = {
+        "push_task": executor.h_push_task,
+        "push_actor_task": executor.h_push_actor_task,
+        "actor_init": executor.h_actor_init,
+        "kill": executor.h_kill,
+    }
+    core._server.handlers.update(exec_handlers)
+    # Register with the agent over a dedicated connection that stays open —
+    # the agent uses its closure to detect worker death, and sends actor_init
+    # over it, so it must carry the executor handlers too.
+    agent_conn = await rpc.connect(tuple(agent_addr), name="worker->agent",
+                                   handlers=exec_handlers)
+    reply = await agent_conn.call("register_worker", {
+        "worker_id": worker_id, "address": list(core.address)})
+
+    # Make this process's runtime available to user code (nested submits).
+    from . import worker as worker_mod
+    worker_mod._set_global_from_existing(core)
+
+    import ray_tpu
+    ray_tpu._set_runtime_for_worker(core)
+
+    await asyncio.Event().wait()
+
+
+def main():
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
+    signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

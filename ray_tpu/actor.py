@@ -1,0 +1,133 @@
+"""Actor API: @ray_tpu.remote classes, ActorClass / ActorHandle / ActorMethod.
+
+Reference: python/ray/actor.py — ActorClass at :1189 (_remote :1499),
+ActorHandle at :1873, ActorMethod at :583 (_remote :792).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ._private.ids import ActorID, JobID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ._private.worker import global_runtime
+        core = global_runtime().core
+        refs = core.submit_actor_task(
+            actor_id=self._handle._actor_id, method=self._method_name,
+            args=args, kwargs=kwargs, num_returns=self._num_returns)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"actor method {self._method_name} must be called with .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_name: str = "",
+                 owned: bool = False):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        # True only for the creator's original handle: when it is GC'd the
+        # actor is terminated (reference: actor.py — non-detached actors die
+        # when the original handle goes out of scope). Copies (serialized
+        # handles, get_actor results) never terminate the actor.
+        self._owned = owned
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    @property
+    def actor_id(self) -> bytes:
+        return self._actor_id
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        # Handles are freely serializable into tasks/objects (reference:
+        # actor handles are first-class serializable values).
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __del__(self):
+        if not getattr(self, "_owned", False):
+            return
+        try:
+            from ._private.worker import is_initialized, global_runtime
+            if is_initialized():
+                global_runtime().core.kill_actor_nowait(self._actor_id)
+        except Exception:
+            pass
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=1, num_tpus=0, resources=None,
+                 max_restarts=0, max_concurrency=1, name=None, namespace=None,
+                 lifetime=None, runtime_env=None, scheduling_strategy=None,
+                 get_if_exists=False):
+        self._cls = cls
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = dict(resources or {})
+        self._max_restarts = max_restarts
+        self._max_concurrency = max_concurrency
+        self._name = name
+        self._lifetime = lifetime
+        self._runtime_env = runtime_env
+        self._scheduling_strategy = scheduling_strategy
+        self._get_if_exists = get_if_exists
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote(...)")
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(
+            num_cpus=self._num_cpus, num_tpus=self._num_tpus,
+            resources=self._resources, max_restarts=self._max_restarts,
+            max_concurrency=self._max_concurrency, name=self._name,
+            lifetime=self._lifetime, runtime_env=self._runtime_env,
+            scheduling_strategy=self._scheduling_strategy,
+            get_if_exists=self._get_if_exists)
+        merged.update(overrides)
+        return ActorClass(self._cls, **merged)
+
+    def _resource_dict(self) -> Dict[str, float]:
+        res = dict(self._resources)
+        if self._num_cpus:
+            res["CPU"] = float(self._num_cpus)
+        if self._num_tpus:
+            res["TPU"] = float(self._num_tpus)
+        return res
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ._private.worker import global_runtime
+        from .util.scheduling_strategies import strategy_to_dict
+        core = global_runtime().core
+        actor_id = ActorID.of(JobID(core.job_id)).binary()
+        info = core.create_actor(
+            cls=self._cls, actor_id=actor_id, args=args, kwargs=kwargs,
+            resources=self._resource_dict(), name=self._name,
+            get_if_exists=self._get_if_exists,
+            max_restarts=self._max_restarts,
+            max_concurrency=self._max_concurrency,
+            runtime_env=self._runtime_env,
+            scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
+            class_name=self._cls.__name__)
+        owned = self._lifetime != "detached"
+        return ActorHandle(bytes(info["actor_id"]), self._cls.__name__,
+                           owned=owned)

@@ -1,0 +1,65 @@
+"""Public exception hierarchy (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayError):
+    """A task raised; carries the cause and the remote traceback
+    (reference: RayTaskError which re-raises as the cause's type)."""
+
+    def __init__(self, message, cause=None, remote_traceback=""):
+        super().__init__(message)
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+
+    def __str__(self):
+        base = super().__str__()
+        if self.cause is not None:
+            base += f"\nCaused by: {type(self.cause).__name__}: {self.cause}"
+        if self.remote_traceback:
+            base += f"\n{self.remote_traceback}"
+        return base
+
+
+class RayActorError(RayError):
+    """Actor is unreachable."""
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class WorkerCrashedError(RayError):
+    pass
+
+
+class ObjectStoreFullError(RayError):
+    pass
+
+
+class ObjectLostError(RayError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayError):
+    pass
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class NodeDiedError(RayError):
+    pass

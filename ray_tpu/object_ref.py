@@ -1,0 +1,81 @@
+"""ObjectRef: a handle to an immutable object owned by some worker.
+
+Equivalent of the reference's ObjectRef/ObjectID (reference:
+python/ray/includes/object_ref.pxi; ownership semantics in
+src/ray/core_worker/reference_count.cc). The ref carries its 20-byte id (which
+embeds the creating task, see _private/ids.py) and the owner's RPC address so
+any holder can resolve the value without a directory service. Refs are
+awaitable inside async actors (`await ref`), and Python GC drives the owner's
+reference counting via __del__.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_addr", "_worker", "__weakref__")
+
+    def __init__(self, object_id: bytes, owner_addr=None, worker=None,
+                 skip_adding_local_ref: bool = False):
+        self._id = object_id
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._worker = worker
+        if worker is not None and not skip_adding_local_ref:
+            worker.reference_counter.add_local_ref(object_id)
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def owner_address(self) -> Optional[Tuple[str, int]]:
+        return self._owner_addr
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        if self._worker is None:
+            raise RuntimeError("ObjectRef is not attached to a worker")
+        return self._worker.get_future(self)
+
+    def __await__(self):
+        if self._worker is None:
+            raise RuntimeError("ObjectRef is not attached to a worker")
+        return self._worker.get_async(self).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # Rehydrated through the current process's serialization context so
+        # the local worker is attached and borrows are registered.
+        from ray_tpu._private.serialization import get_context
+        ctx = get_context()
+        if ctx.ref_hook is not None:
+            ctx.ref_hook(self)
+        return (_rebuild_ref, (self._id, self._owner_addr))
+
+    def __del__(self):
+        worker = self._worker
+        if worker is not None:
+            try:
+                worker.reference_counter.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+
+def _rebuild_ref(object_id: bytes, owner_addr):
+    from ray_tpu._private.serialization import get_context
+    ctx = get_context()
+    if ctx.ref_factory is not None:
+        return ctx.ref_factory(object_id, owner_addr)
+    return ObjectRef(object_id, owner_addr)

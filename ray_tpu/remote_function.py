@@ -1,0 +1,69 @@
+"""@ray_tpu.remote for functions (reference: python/ray/remote_function.py —
+RemoteFunction at :41, _remote at :314)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ._private import protocol
+from ._private.serialization import get_context
+
+
+class RemoteFunction:
+    def __init__(self, fn, *, num_returns=1, num_cpus=1, num_tpus=0,
+                 resources=None, max_retries=None, scheduling_strategy=None,
+                 runtime_env=None, name=None):
+        self._fn = fn
+        self._num_returns = num_returns
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = dict(resources or {})
+        self._max_retries = max_retries
+        self._scheduling_strategy = scheduling_strategy
+        self._runtime_env = runtime_env
+        self._name = name or getattr(fn, "__name__", "fn")
+        self._fn_id: Optional[bytes] = None  # cached after first export
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._name} cannot be called directly; use "
+            f"{self._name}.remote(...)")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(
+            num_returns=self._num_returns, num_cpus=self._num_cpus,
+            num_tpus=self._num_tpus, resources=self._resources,
+            max_retries=self._max_retries,
+            scheduling_strategy=self._scheduling_strategy,
+            runtime_env=self._runtime_env, name=self._name)
+        merged.update(overrides)
+        return RemoteFunction(self._fn, **merged)
+
+    def _resource_dict(self) -> Dict[str, float]:
+        res = dict(self._resources)
+        if self._num_cpus:
+            res["CPU"] = float(self._num_cpus)
+        if self._num_tpus:
+            res["TPU"] = float(self._num_tpus)
+        return res
+
+    def remote(self, *args, **kwargs):
+        from ._private.worker import global_runtime
+        from .util.scheduling_strategies import strategy_to_dict
+        core = global_runtime().core
+        from ._private.config import get_config
+        max_retries = (self._max_retries if self._max_retries is not None
+                       else get_config().task_max_retries_default)
+        if self._fn_id is None:
+            blob = get_context().dumps_code(self._fn)
+            self._fn_id = protocol.function_id(blob)
+            self._export_blob = blob
+        refs = core.submit_task(
+            fn=self._fn, fn_id=None, args=args, kwargs=kwargs,
+            num_returns=self._num_returns, resources=self._resource_dict(),
+            max_retries=max_retries,
+            scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
+            runtime_env=self._runtime_env, name=self._name)
+        return refs[0] if self._num_returns == 1 else refs

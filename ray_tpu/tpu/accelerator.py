@@ -1,0 +1,145 @@
+"""TPU accelerator manager: chip detection, topology, slice metadata.
+
+Equivalent of the reference's TPUAcceleratorManager (reference:
+python/ray/_private/accelerators/tpu.py — chip counting per host :294,
+TPU_VISIBLE_CHIPS :377, pod type via GCE metadata :420, worker-id/topology
+env+metadata :479,:514, synthetic `TPU-{pod_type}-head` resource :576,
+accelerator labels :642). On non-GCE machines (like CI) detection degrades
+gracefully: chips come from jax.devices() if JAX sees a TPU, else 0.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+_GCE_TPU_ENV = "TPU_ACCELERATOR_TYPE"     # e.g. "v5litepod-16"
+_TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+_TPU_TOPOLOGY_ENV = "TPU_TOPOLOGY"        # e.g. "4x4"
+_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+
+
+class TPUAcceleratorManager:
+    """Static methods mirroring the reference's AcceleratorManager ABC
+    (reference: _private/accelerators/accelerator.py:18)."""
+
+    _cached_num_chips: Optional[int] = None
+
+    @staticmethod
+    def accelerator_name() -> str:
+        return "TPU"
+
+    @classmethod
+    def num_chips(cls) -> int:
+        """Chips visible to this host."""
+        if cls._cached_num_chips is not None:
+            return cls._cached_num_chips
+        visible = os.environ.get(_VISIBLE_CHIPS_ENV)
+        if visible:
+            cls._cached_num_chips = len([c for c in visible.split(",") if c])
+            return cls._cached_num_chips
+        # Device files exist on TPU VMs without touching the jax client.
+        n = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*[0-9]"))
+        if n == 0 and os.environ.get("JAX_PLATFORMS", "").startswith("tpu"):
+            try:
+                import jax
+                n = len([d for d in jax.devices()
+                         if d.platform.startswith("tpu")])
+            except Exception:
+                n = 0
+        cls._cached_num_chips = n
+        return n
+
+    @staticmethod
+    def pod_type() -> Optional[str]:
+        """e.g. 'v5litepod-16'. Env first, then GCE metadata server."""
+        env = os.environ.get(_GCE_TPU_ENV)
+        if env:
+            return env
+        return _gce_metadata("instance/attributes/accelerator-type")
+
+    @staticmethod
+    def topology() -> Optional[str]:
+        env = os.environ.get(_TPU_TOPOLOGY_ENV)
+        if env:
+            return env
+        return _gce_metadata("instance/attributes/topology")
+
+    @staticmethod
+    def worker_id() -> Optional[int]:
+        env = os.environ.get(_TPU_WORKER_ID_ENV)
+        if env is not None:
+            return int(env)
+        v = _gce_metadata("instance/attributes/agent-worker-number")
+        return int(v) if v is not None else None
+
+    @staticmethod
+    def slice_name() -> Optional[str]:
+        return (os.environ.get("TPU_NAME")
+                or _gce_metadata("instance/attributes/instance-id"))
+
+    @classmethod
+    def num_hosts_in_slice(cls) -> int:
+        pod = cls.pod_type()
+        if not pod:
+            return 1
+        try:
+            total_chips = int(pod.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return 1
+        per_host = cls.num_chips() or 4
+        return max(1, total_chips // per_host)
+
+    @classmethod
+    def node_resources(cls) -> Dict[str, float]:
+        """Resources this host contributes, including the synthetic slice-head
+        resource used for gang reservation of whole slices (reference:
+        tpu.py:576 `TPU-{pod_type}-head` on worker 0)."""
+        out: Dict[str, float] = {}
+        n = cls.num_chips()
+        if n:
+            out["TPU"] = float(n)
+            pod = cls.pod_type()
+            if pod:
+                out[f"TPU-{pod}"] = float(n)
+                if cls.worker_id() == 0:
+                    out[f"TPU-{pod}-head"] = 1.0
+        return out
+
+    @classmethod
+    def node_labels(cls) -> Dict[str, str]:
+        """Accelerator labels (reference: tpu.py:642)."""
+        out: Dict[str, str] = {}
+        if cls.num_chips():
+            out["accelerator-type"] = "TPU"
+            if cls.pod_type():
+                out["tpu-pod-type"] = cls.pod_type()
+            if cls.topology():
+                out["tpu-topology"] = cls.topology()
+            if cls.slice_name():
+                out["tpu-slice-name"] = cls.slice_name()
+            wid = cls.worker_id()
+            if wid is not None:
+                out["tpu-worker-id"] = str(wid)
+        return out
+
+    @staticmethod
+    def set_visible_chips(chip_ids: List[int]) -> Dict[str, str]:
+        """Env vars confining a worker to specific chips (reference:
+        tpu.py:377 set_current_process_visible_accelerator_ids)."""
+        return {_VISIBLE_CHIPS_ENV: ",".join(str(c) for c in chip_ids),
+                "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1"}
+
+
+def _gce_metadata(path: str, timeout: float = 0.35) -> Optional[str]:
+    """GCE metadata lookup with a short timeout; None off-GCE."""
+    import urllib.request
+    try:
+        req = urllib.request.Request(
+            f"http://metadata.google.internal/computeMetadata/v1/{path}",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None

@@ -1,0 +1,56 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py
+— PlacementGroupSchedulingStrategy :17, NodeAffinitySchedulingStrategy :43,
+NodeLabelSchedulingStrategy :164)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks)
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id, soft: bool = False):
+        self.node_id = node_id if isinstance(node_id, bytes) else \
+            bytes.fromhex(node_id)
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: Optional[dict] = None,
+                 soft: Optional[dict] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+
+def strategy_to_dict(strategy) -> Optional[dict]:
+    """Convert a strategy object (or the strings 'DEFAULT'/'SPREAD') into the
+    wire dict understood by the GCS/agent schedulers."""
+    if strategy is None or strategy == "DEFAULT":
+        return None
+    if strategy == "SPREAD":
+        return {"type": "spread"}
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"type": "node_affinity", "node_id": strategy.node_id,
+                "soft": strategy.soft}
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return {"type": "node_label", "hard": strategy.hard,
+                "soft": strategy.soft}
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        return {"type": "placement_group",
+                "pg_id": pg.id if isinstance(pg.id, bytes) else pg.id,
+                "bundle_index": strategy.placement_group_bundle_index,
+                "pg": {"pg_id": pg.id,
+                       "bundle_index": max(
+                           0, strategy.placement_group_bundle_index)}}
+    if isinstance(strategy, dict):
+        return strategy
+    raise ValueError(f"unknown scheduling strategy: {strategy!r}")

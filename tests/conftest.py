@@ -1,0 +1,44 @@
+"""Shared fixtures (reference: python/ray/tests/conftest.py —
+ray_start_regular :596, ray_start_cluster :686).
+
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+# Must be set before any jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Shared cluster: initialized on first use, reused across tests, torn
+    down at interpreter exit (isolated-fixture tests shut it down and the
+    next user re-initializes)."""
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """Fresh cluster per test (slower; for failure-injection tests)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,294 @@
+"""Core API integration tests: tasks, objects, actors, failures.
+
+Test model follows the reference's core suite (reference:
+python/ray/tests/test_basic.py, test_actor.py, test_failure.py).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+# ---------------------------------------------------------------- tasks -----
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_exception(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(exc.RayTaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_dependency_exception_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("upstream")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(exc.RayError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(n):
+        return sum(ray_tpu.get([child.remote(i) for i in range(n)]))
+
+    assert ray_tpu.get(parent.remote(4)) == 10
+
+
+def test_chained_refs(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 11
+
+
+def test_options_name(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom").remote()) == 1
+
+
+# --------------------------------------------------------------- objects ----
+def test_put_get_small(ray_start_regular):
+    ref = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.random.rand(512, 512)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_large_arg_by_reference(ray_start_regular):
+    arr = np.ones((1024, 1024), dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == 1024.0 * 1024.0
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def delay(t):
+        time.sleep(t)
+        return t
+
+    fast = delay.remote(0.01)
+    slow = delay.remote(2.0)
+    ready, pending = ray_tpu.wait([fast, slow], num_returns=1, timeout=1.5)
+    assert ready == [fast] and pending == [slow]
+
+
+def test_object_ref_in_container(ray_start_regular):
+    inner = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def unwrap(d):
+        return ray_tpu.get(d["ref"]) + 1
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner})) == 42
+
+
+# ---------------------------------------------------------------- actors ----
+def test_actor_basic(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, by=1):
+            self.v += by
+            return self.v
+
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote()) == 6
+    assert ray_tpu.get(c.incr.remote(10)) == 16
+
+
+def test_actor_method_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def app(self, x):
+            self.log.append(x)
+            return list(self.log)
+
+    s = Seq.remote()
+    refs = [s.app.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg_test", lifetime="detached").remote()
+    h = ray_tpu.get_actor("reg_test")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+
+
+def test_async_actor_concurrency(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        async def work(self):
+            import asyncio
+            await asyncio.sleep(0.1)
+            return 1
+
+    a = A.remote()
+    t0 = time.time()
+    assert sum(ray_tpu.get([a.work.remote() for _ in range(10)])) == 10
+    assert time.time() - t0 < 0.8  # concurrent, not 1.0s serial
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    @ray_tpu.remote
+    class Holder:
+        def value(self):
+            return 7
+
+    h = Holder.remote()
+
+    @ray_tpu.remote
+    def probe(handle):
+        return ray_tpu.get(handle.value.remote())
+
+    assert ray_tpu.get(probe.remote(h)) == 7
+
+
+def test_actor_exception(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def explode(self):
+            raise RuntimeError("actor boom")
+
+    b = Bad.remote()
+    with pytest.raises(exc.RayTaskError, match="actor boom"):
+        ray_tpu.get(b.explode.remote())
+
+
+# --------------------------------------------------------------- failures ---
+def test_kill_actor(ray_start_isolated):
+    @ray_tpu.remote
+    class K:
+        def ping(self):
+            return 1
+
+    k = K.remote()
+    assert ray_tpu.get(k.ping.remote()) == 1
+    ray_tpu.kill(k)
+    time.sleep(0.3)
+    with pytest.raises(exc.RayActorError):
+        ray_tpu.get(k.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start_isolated):
+    @ray_tpu.remote(max_restarts=1)
+    class F:
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    f = F.remote()
+    pid1 = ray_tpu.get(f.pid.remote())
+    with pytest.raises(exc.RayActorError):
+        ray_tpu.get(f.die.remote(), timeout=10)
+    time.sleep(2.0)
+    pid2 = ray_tpu.get(f.pid.remote(), timeout=30)
+    assert pid2 != pid1
+
+
+def test_task_retry_on_worker_death(ray_start_isolated):
+    marker = f"/tmp/retry_marker_{os.getpid()}_{os.urandom(3).hex()}"
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)  # first attempt crashes the worker
+        return "survived"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=60) == "survived"
+    os.unlink(marker)
+
+
+# ----------------------------------------------------------- cluster info ---
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 4

@@ -1,0 +1,132 @@
+"""Shared-memory object store unit tests (reference test model:
+src/ray/object_manager/plasma tests + python/ray/tests/test_object_store*)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.shm_store import (ObjectExistsError, ShmStore,
+                                        StoreFullError)
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = f"/dev/shm/rts_pytest_{os.getpid()}_{os.urandom(4).hex()}"
+    s = ShmStore.create(path, 32 * 1024 * 1024, table_slots=1 << 12)
+    yield s
+    s.close()
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
+def test_put_get_roundtrip(store):
+    oid = os.urandom(20)
+    data = np.arange(4096, dtype=np.int64)
+    store.put(oid, [data.tobytes()])
+    view = store.get(oid)
+    assert view is not None
+    out = np.frombuffer(view, dtype=np.int64)
+    np.testing.assert_array_equal(out, data)
+    store.release(oid)
+
+
+def test_zero_copy_view(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"\x01" * 1024])
+    v1 = store.get(oid)
+    v2 = store.get(oid)
+    # Both views window the same shared memory.
+    assert bytes(v1) == bytes(v2)
+    store.release(oid)
+    store.release(oid)
+
+
+def test_duplicate_create_rejected(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"x"])
+    with pytest.raises(ObjectExistsError):
+        store.create_buffer(oid, 10)
+
+
+def test_get_absent_nonblocking(store):
+    assert store.get(os.urandom(20), timeout_ms=0) is None
+
+
+def test_get_blocks_until_seal(store):
+    import threading, time
+    oid = os.urandom(20)
+    buf = store.create_buffer(oid, 8)
+
+    def sealer():
+        time.sleep(0.1)
+        buf[:] = b"ABCDEFGH"
+        store.seal(oid)
+        store.release(oid)
+
+    t = threading.Thread(target=sealer)
+    t.start()
+    view = store.get(oid, timeout_ms=5000)
+    t.join()
+    assert view is not None and bytes(view) == b"ABCDEFGH"
+    store.release(oid)
+
+
+def test_lru_eviction(store):
+    big = b"z" * (4 * 1024 * 1024)
+    ids = []
+    for _ in range(20):  # 80 MiB through a 32 MiB store
+        oid = os.urandom(20)
+        store.put(oid, [big])
+        ids.append(oid)
+    st = store.stats()
+    assert st["num_evictions"] > 0
+    # Newest object survives; oldest was evicted.
+    assert store.contains(ids[-1])
+    assert not store.contains(ids[0])
+
+
+def test_pinned_objects_not_evicted(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"p" * 1024])
+    assert store.get(oid) is not None  # pin
+    for _ in range(20):
+        store.put(os.urandom(20), [b"z" * (4 * 1024 * 1024)])
+    assert store.contains(oid)
+    store.release(oid)
+
+
+def test_store_full_when_all_pinned(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"a" * (16 * 1024 * 1024)])
+    assert store.get(oid) is not None  # pin half the store
+    with pytest.raises(StoreFullError):
+        store.create_buffer(os.urandom(20), 30 * 1024 * 1024)
+    store.release(oid)
+
+
+def test_cross_process_attach(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"hello shm"])
+    s2 = ShmStore.attach(store.path)
+    v = s2.get(oid, timeout_ms=1000)
+    assert v is not None and bytes(v) == b"hello shm"
+    s2.release(oid)
+    s2.close()
+
+
+def test_delete(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"bye"])
+    assert store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_multipart_put(store):
+    oid = os.urandom(20)
+    store.put(oid, [b"abc", b"def", b"ghi"])
+    v = store.get(oid)
+    assert bytes(v) == b"abcdefghi"
+    store.release(oid)
