@@ -1,0 +1,13 @@
+"""Model zoo: TPU-first JAX models used by Train/Tune/Serve/RLlib and the
+driver gates.  Flagship: Llama-style decoder (transformer.py)."""
+
+from .transformer import (PRESETS, TransformerConfig, forward, init_params,
+                          loss_fn, param_logical_axes)
+from .train_step import (TrainStepBundle, make_eval_step, make_optimizer,
+                         make_train_step)
+
+__all__ = [
+    "PRESETS", "TransformerConfig", "forward", "init_params", "loss_fn",
+    "param_logical_axes", "TrainStepBundle", "make_eval_step",
+    "make_optimizer", "make_train_step",
+]

@@ -1,0 +1,129 @@
+"""Sharded training step factory: init + fwd/bwd + optax update under jit.
+
+This is the compute core the Train stack (ray_tpu/train) drives and the
+driver's dryrun_multichip compiles: one jitted function whose in/out
+shardings come from the model's logical axes, so the same code runs 1-chip,
+8-virtual-CPU, or a v5e-64 dp×fsdp×tp×sp mesh unchanged (SURVEY.md §7
+build-order step 4's "ONE model" gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import LogicalAxisRules, tree_shardings
+from .transformer import (TransformerConfig, forward, init_params, loss_fn,
+                          param_logical_axes)
+
+
+@dataclasses.dataclass
+class TrainStepBundle:
+    """Everything a Train worker needs to run steps on a mesh."""
+    cfg: TransformerConfig
+    mesh: Mesh
+    init: Callable[[jax.Array], Any]          # key -> state (sharded, jitted)
+    step: Callable[[Any, Dict[str, jax.Array]], Tuple[Any, Dict[str, jax.Array]]]
+    state_shardings: Any
+    rules: LogicalAxisRules
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                   warmup_steps: int = 100, decay_steps: int = 10000,
+                   b1: float = 0.9, b2: float = 0.95,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh,
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    rules: Optional[LogicalAxisRules] = None,
+                    donate_state: bool = True) -> TrainStepBundle:
+    rules = rules or LogicalAxisRules.default()
+    tx = optimizer or make_optimizer()
+
+    param_shardings = tree_shardings(param_logical_axes(cfg), mesh, rules)
+    repl = NamedSharding(mesh, P())
+
+    def _init(key):
+        params = init_params(cfg, key)
+        opt_state = tx.init(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    # Adam moments shard like their params; scalars replicate.  Resolve the
+    # opt_state sharding structurally from an eval_shape of init.
+    state_shape = jax.eval_shape(_init, jax.random.key(0))
+
+    def _shard_like(path_shape_tree):
+        # opt_state leaves that have the same shape-structure as params get
+        # the param sharding; everything else is replicated.
+        param_leaves = jax.tree.leaves(param_shardings)
+        param_shapes = [
+            (tuple(l.shape), s) for l, s in zip(
+                jax.tree.leaves(state_shape["params"]), param_leaves)]
+
+        def leaf_sharding(leaf):
+            shp = tuple(leaf.shape)
+            for pshp, psh in param_shapes:
+                if shp == pshp:
+                    return psh
+            return repl
+
+        return jax.tree.map(leaf_sharding, path_shape_tree)
+
+    state_shardings = {
+        "params": param_shardings,
+        "opt_state": _shard_like(state_shape["opt_state"]),
+        "step": repl,
+    }
+
+    init = jax.jit(_init, out_shardings=state_shardings)
+
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    def _step(state, batch):
+        def _loss(p):
+            return loss_fn(p, batch, cfg, mesh, rules)
+
+        loss, grads = jax.value_and_grad(_loss)(state["params"])
+        updates, new_opt = tx.update(grads, state["opt_state"],
+                                     state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        new_state = {"params": new_params, "opt_state": new_opt,
+                     "step": state["step"] + 1}
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "step": new_state["step"]}
+
+    step = jax.jit(
+        _step,
+        in_shardings=(state_shardings,
+                      {"tokens": batch_sharding}),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if donate_state else (),
+    )
+    return TrainStepBundle(cfg=cfg, mesh=mesh, init=init, step=step,
+                           state_shardings=state_shardings, rules=rules)
+
+
+def make_eval_step(cfg: TransformerConfig, mesh: Mesh,
+                   rules: Optional[LogicalAxisRules] = None):
+    rules = rules or LogicalAxisRules.default()
+
+    @jax.jit
+    def _eval(params, batch):
+        return loss_fn(params, batch, cfg, mesh, rules)
+
+    return _eval

@@ -1,0 +1,297 @@
+"""Llama-style decoder-only transformer, TPU-first functional JAX.
+
+The flagship model family for the framework's Train stack and the driver's
+compile gates (BASELINE.md north star: Llama-2-7B fine-tune on v5e-64 at
+≥40% MFU).  The reference delegates model code to user frameworks (MaxText in
+the JaxTrainer docstring, reference: python/ray/train/v2/jax/jax_trainer.py:40-46);
+here the model ships in-tree so the whole stack is self-contained.
+
+Design for the MXU/HBM (see SURVEY.md §7):
+  - params are pure pytrees; every tensor carries a *logical axis* tuple so
+    GSPMD shards it via LogicalAxisRules (parallel/sharding.py) — dp/fsdp/
+    tp/sp all come from annotations, zero hand-written collectives.
+  - bfloat16 activations/weights, f32 RMSNorm accumulation and logits.
+  - per-layer jax.checkpoint (remat) with dots-saveable policy to trade
+    FLOPs for HBM.
+  - layers stacked with lax.scan over a (L, ...) leading dim: one compiled
+    layer body, fast compile times, clean pipeline-parallel slicing.
+  - GQA (num_kv_heads < num_heads), RoPE, SwiGLU — the Llama-2/3 recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.sharding import LogicalAxisRules, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # "xla" = reference dot-product attention (works everywhere);
+    # "flash" = Pallas TPU kernel (ops/flash_attention.py);
+    # "ring" = ring attention over the sp axis (ops/ring_attention.py).
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate train FLOPs/token (fwd+bwd = 6*N + attention term)."""
+        s = seq_len or self.max_seq_len
+        n_params = self.param_count()
+        attn = 12 * self.num_layers * self.hidden_size * s
+        return 6 * n_params + attn
+
+    def param_count(self) -> int:
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        d = self.head_dim_
+        qkv = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d)
+        o = self.num_heads * d * h
+        mlp = 3 * h * self.intermediate_size
+        return v * h + l * (qkv + o + mlp + 2 * h) + h + v * h
+
+
+PRESETS: Dict[str, TransformerConfig] = {
+    # test-size: runs on the 8-device virtual CPU mesh in seconds
+    "tiny": TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+        num_heads=8, num_kv_heads=4, max_seq_len=256, dtype=jnp.float32),
+    "nano": TransformerConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=8, num_kv_heads=8, max_seq_len=512),
+    "1b": TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_layers=22, num_heads=16, num_kv_heads=16, max_seq_len=2048),
+    # Llama-2-7B dims (the BASELINE.md north-star config)
+    "7b": TransformerConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096),
+    # Llama-3-8B-style GQA config
+    "8b-gqa": TransformerConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        rope_theta=500000.0),
+}
+
+
+# ---------------------------------------------------------------------------
+# Logical axis annotations (consumed by parallel.tree_shardings)
+# ---------------------------------------------------------------------------
+
+def param_logical_axes(cfg: TransformerConfig):
+    """Pytree (same structure as init params) of logical-axis tuples."""
+    layer = {
+        "attn": {
+            "wq": ("layer", "embed", "heads", "head_dim"),
+            "wk": ("layer", "embed", "kv_heads", "head_dim"),
+            "wv": ("layer", "embed", "kv_heads", "head_dim"),
+            "wo": ("layer", "heads", "head_dim", "embed"),
+        },
+        "mlp": {
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        },
+        "ln_attn": ("layer", "norm"),
+        "ln_mlp": ("layer", "norm"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "ln_f": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    h, d = cfg.hidden_size, cfg.head_dim_
+    nh, nkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    k = iter(jax.random.split(key, 16))
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dt)
+
+    params = {
+        "embed": dense(next(k), (cfg.vocab_size, h), h),
+        "layers": {
+            "attn": {
+                "wq": dense(next(k), (L, h, nh, d), h),
+                "wk": dense(next(k), (L, h, nkv, d), h),
+                "wv": dense(next(k), (L, h, nkv, d), h),
+                "wo": dense(next(k), (L, nh, d, h), nh * d),
+            },
+            "mlp": {
+                "w_gate": dense(next(k), (L, h, cfg.intermediate_size), h),
+                "w_up": dense(next(k), (L, h, cfg.intermediate_size), h),
+                "w_down": dense(next(k), (L, cfg.intermediate_size, h),
+                                cfg.intermediate_size),
+            },
+            "ln_attn": jnp.ones((L, h), jnp.float32),
+            "ln_mlp": jnp.ones((L, h), jnp.float32),
+        },
+        "ln_f": jnp.ones((h,), jnp.float32),
+        "lm_head": dense(next(k), (h, cfg.vocab_size), h),
+    }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Ops
+# ---------------------------------------------------------------------------
+
+def rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def rope_angles(seq_len: int, head_dim: int, theta: float,
+                offset: int = 0) -> Tuple[jax.Array, jax.Array]:
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                             / head_dim))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]           # (S, D/2)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, S, H, D); rotate-half formulation."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _xla_attention(q, k, v, causal: bool = True):
+    """Reference dot-product attention; XLA fuses this well on its own.
+    q: (B,S,Hq,D)  k,v: (B,S,Hkv,D); GQA via head-group reshape."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    q = q.reshape(B, S, Hkv, G, D)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", w, v)
+    return out.reshape(B, S, Hq, D)
+
+
+def _attention(cfg: TransformerConfig, q, k, v, mesh: Optional[Mesh]):
+    if cfg.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attention_impl == "ring" and mesh is not None:
+        from ..ops.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
+    if cfg.attention_impl not in ("xla", "ring"):
+        raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+    return _xla_attention(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+            rules: Optional[LogicalAxisRules] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) float32.
+
+    `rules` must match the table used to shard the params
+    (train_step.make_train_step threads its rules through here)."""
+    rules = rules or LogicalAxisRules.default()
+
+    def constrain(x, axes):
+        if mesh is None:
+            return x
+        return with_logical_constraint(x, axes, mesh, rules)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"))
+    S = tokens.shape[1]
+    cos, sin = rope_angles(S, cfg.head_dim_, cfg.rope_theta)
+
+    def layer_body(x, lp):
+        h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, lp["attn"]["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bse,ekd->bskd", h, lp["attn"]["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bse,ekd->bskd", h, lp["attn"]["wv"].astype(cfg.dtype))
+        q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+        k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = _attention(cfg, q, k, v, mesh)
+        o = constrain(o, ("batch", "seq", "heads", "head_dim"))
+        o = jnp.einsum("bshd,hde->bse", o, lp["attn"]["wo"].astype(cfg.dtype))
+        x = x + constrain(o, ("batch", "seq", "embed"))
+
+        h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        g = jnp.einsum("bse,em->bsm", h, lp["mlp"]["w_gate"].astype(cfg.dtype))
+        u = jnp.einsum("bse,em->bsm", h, lp["mlp"]["w_up"].astype(cfg.dtype))
+        g = constrain(g, ("batch", "seq", "mlp"))
+        d = jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                       lp["mlp"]["w_down"].astype(cfg.dtype))
+        x = x + constrain(d, ("batch", "seq", "embed"))
+        return x, None
+
+    body = layer_body
+    if cfg.remat:
+        body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    logits = jnp.einsum("bse,ev->bsv", x,
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params, batch, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None,
+            rules: Optional[LogicalAxisRules] = None) -> jax.Array:
+    """Next-token cross-entropy; batch = {"tokens": (B,S)} or
+    {"inputs","targets"}; ignores padding id 0 when targets provided."""
+    if "targets" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        weights = (targets != 0).astype(jnp.float32)
+    else:
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        weights = jnp.ones(targets.shape, jnp.float32)
+    logits = forward(params, inputs, cfg, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)

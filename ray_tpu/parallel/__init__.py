@@ -1,0 +1,18 @@
+"""SPMD parallelism layer: device meshes, logical-axis shardings.
+
+This is the TPU-first core that replaces the reference's NCCL/process-group
+machinery (SURVEY.md §2.4): parallelism strategies (dp/fsdp/tp/sp/pp/ep) are
+expressed as named mesh axes + sharding rules, and XLA compiles the
+collectives over ICI.
+"""
+
+from .mesh import (AXES, MeshSpec, build_mesh, host_local_mesh, mesh_info,
+                   single_device_mesh)
+from .sharding import (LogicalAxisRules, replicated, shard_batch,
+                       tree_shardings, with_logical_constraint)
+
+__all__ = [
+    "AXES", "MeshSpec", "build_mesh", "host_local_mesh", "mesh_info",
+    "single_device_mesh", "LogicalAxisRules", "replicated", "shard_batch",
+    "tree_shardings", "with_logical_constraint",
+]

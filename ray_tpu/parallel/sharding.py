@@ -1,0 +1,144 @@
+"""Logical-axis sharding rules → NamedSharding / PartitionSpec resolution.
+
+The TPU-native alternative to hand-rolled tensor-parallel allreduces
+(reference: python/ray/util/collective/collective.py:339 allreduce — users
+hand-roll TP with it): annotate every parameter and activation with *logical*
+axis names, map logical→mesh axes with a rule table, and let GSPMD insert the
+collectives.  This is the standard t5x/maxtext-style recipe, implemented
+fresh.
+
+Example:
+    rules = LogicalAxisRules.default()
+    pspec = rules.spec(("batch", "seq", "embed"))   # → P(("dp","fsdp"), "sp", None)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), mesh, rules)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import EP_AXES
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+class LogicalAxisRules:
+    """Ordered mapping logical-axis-name → mesh axis (or tuple, or None).
+
+    First matching rule wins; a mesh axis already consumed by an earlier
+    dimension of the same spec is skipped (an axis can shard only one dim).
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, MeshAxes]]):
+        self.rules: List[Tuple[str, MeshAxes]] = list(rules)
+
+    @classmethod
+    def default(cls) -> "LogicalAxisRules":
+        """Llama-style decoder rules for a pp×dp×fsdp×sp×tp mesh.
+
+        batch       → dp+fsdp   (data parallel over both DP-ish axes)
+        seq         → sp        (sequence/context parallel)
+        embed       → fsdp      (ZeRO-3 style weight sharding on ICI)
+        mlp/heads/kv_heads/vocab → tp  (megatron-style tensor parallel)
+        stage       → pp        (pipeline stages)
+        expert      → fsdp+sp   (MoE expert parallel submesh)
+        """
+        return cls([
+            ("batch", ("dp", "fsdp")),
+            ("seq", "sp"),
+            ("embed", "fsdp"),
+            ("mlp", "tp"),
+            ("heads", "tp"),
+            ("kv_heads", "tp"),
+            ("qkv", "tp"),
+            ("vocab", "tp"),
+            ("expert", EP_AXES),
+            ("stage", "pp"),
+            ("kv", None),
+            ("head_dim", None),
+            ("norm", None),
+        ])
+
+    def with_overrides(self, *overrides: Tuple[str, MeshAxes]):
+        return LogicalAxisRules(list(overrides) + self.rules)
+
+    def _lookup(self, name: Optional[str]) -> MeshAxes:
+        if name is None:
+            return None
+        for key, axes in self.rules:
+            if key == name:
+                return axes
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]],
+             mesh: Optional[Mesh] = None) -> P:
+        used: set = set()
+        out: List[MeshAxes] = []
+        mesh_sizes = dict(mesh.shape) if mesh is not None else None
+        for name in logical_axes:
+            axes = self._lookup(name)
+            if axes is None:
+                out.append(None)
+                continue
+            if isinstance(axes, str):
+                axes = (axes,)
+            picked = []
+            for ax in axes:
+                if ax in used:
+                    continue
+                # Trivial axes (size 1) are kept — they're no-ops but keep
+                # specs stable across mesh shapes.
+                if mesh_sizes is not None and ax not in mesh_sizes:
+                    continue
+                picked.append(ax)
+                used.add(ax)
+            out.append(tuple(picked) if len(picked) > 1
+                       else (picked[0] if picked else None))
+        # Trim trailing Nones (canonical PartitionSpec form).
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def sharding(self, logical_axes: Sequence[Optional[str]],
+                 mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes, mesh))
+
+
+def with_logical_constraint(x, logical_axes, mesh: Mesh,
+                            rules: Optional[LogicalAxisRules] = None):
+    """lax.with_sharding_constraint via logical names; no-op off-mesh."""
+    rules = rules or LogicalAxisRules.default()
+    return jax.lax.with_sharding_constraint(
+        x, rules.sharding(logical_axes, mesh))
+
+
+def tree_shardings(logical_tree, mesh: Mesh,
+                   rules: Optional[LogicalAxisRules] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    rules = rules or LogicalAxisRules.default()
+    return jax.tree.map(
+        lambda axes: rules.sharding(axes, mesh), logical_tree,
+        is_leaf=lambda v: isinstance(v, tuple) and all(
+            a is None or isinstance(a, str) for a in v))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh,
+                rules: Optional[LogicalAxisRules] = None):
+    """Device_put a host batch with ("batch", ...) sharding on leading dim."""
+    rules = rules or LogicalAxisRules.default()
+
+    def _put(x):
+        ndim = getattr(x, "ndim", 0)
+        if ndim == 0:
+            return jax.device_put(x, replicated(mesh))
+        axes = ("batch",) + (None,) * (ndim - 1)
+        return jax.device_put(x, rules.sharding(axes, mesh))
+
+    return jax.tree.map(_put, batch)

@@ -8,10 +8,26 @@ path via __graft_entry__.dryrun_multichip).
 
 import os
 
-# Must be set before any jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment pins JAX_PLATFORMS to the real TPU tunnel and
+# sitecustomize pre-imports jax, so env vars are too late — override via
+# jax.config before any backend initialization.  The suite runs sharding
+# logic on a virtual 8-device CPU mesh (the driver benches the real chip
+# separately, outside pytest).
+if os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except RuntimeError:
+        # backend already initialized (e.g. a plugin touched jax.devices());
+        # tests that need the 8-device mesh will fail loudly instead of the
+        # whole session aborting at collection.
+        pass
 
 import pytest
 
