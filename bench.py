@@ -37,8 +37,9 @@ def pick_config(platform: str, hbm_bytes: float):
     if platform != "tpu":
         # CPU smoke path: tiny model so the line still prints in CI.
         return PRESETS["tiny"], 8, 256
-    # Adam fp32 moments dominate: ~18 bytes/param (bf16 p + g, 2x f32 m).
-    if hbm_bytes > 60e9:
+    # Adam fp32 moments dominate: ~18 bytes/param (bf16 p + g, 2x f32 m),
+    # so 7B needs ~126 GB + activations.
+    if hbm_bytes > 140e9:
         cfg, batch, seq = PRESETS["7b"], 8, 2048
     elif hbm_bytes > 24e9:
         return PRESETS["1b"], 8, 2048

@@ -94,6 +94,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
     batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
 
     def _step(state, batch):
+        # Constrain whatever batch pytree arrives ({"tokens"} or
+        # {"inputs","targets"}) to batch-sharded leading dims.
+        batch = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, batch_sharding)
+            if getattr(x, "ndim", 0) >= 1 else x, batch)
+
         def _loss(p):
             return loss_fn(p, batch, cfg, mesh, rules)
 
@@ -109,8 +115,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     step = jax.jit(
         _step,
-        in_shardings=(state_shardings,
-                      {"tokens": batch_sharding}),
+        in_shardings=(state_shardings, None),  # batch: any pytree, see _step
         out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate_state else (),
     )

@@ -190,21 +190,10 @@ def apply_rope(x, cos, sin):
 
 
 def _xla_attention(q, k, v, causal: bool = True):
-    """Reference dot-product attention; XLA fuses this well on its own.
-    q: (B,S,Hq,D)  k,v: (B,S,Hkv,D); GQA via head-group reshape."""
-    B, S, Hq, D = q.shape
-    Hkv = k.shape[2]
-    G = Hq // Hkv
-    q = q.reshape(B, S, Hkv, G, D)
-    scores = jnp.einsum("bskgd,btkd->bkgst", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / math.sqrt(D)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", w, v)
-    return out.reshape(B, S, Hq, D)
+    """Reference dot-product attention (single implementation lives in
+    ops/flash_attention.py; XLA fuses it well on its own)."""
+    from ..ops.flash_attention import reference_attention
+    return reference_attention(q, k, v, causal=causal)
 
 
 def _attention(cfg: TransformerConfig, q, k, v, mesh: Optional[Mesh]):

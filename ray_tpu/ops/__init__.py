@@ -1,0 +1,13 @@
+"""TPU compute ops: Pallas kernels and sequence-parallel attention.
+
+flash_attention — tiled online-softmax attention (Pallas TPU kernel, XLA
+reference fallback); ring_attention / ulysses_attention — sequence/context
+parallelism over the `sp` mesh axis (absent from the reference, SURVEY.md
+§5.7 — first-class here).
+"""
+
+from .flash_attention import flash_attention, reference_attention
+from .ring_attention import ring_attention, ulysses_attention
+
+__all__ = ["flash_attention", "reference_attention", "ring_attention",
+           "ulysses_attention"]

@@ -1,0 +1,142 @@
+"""Flash attention: Pallas TPU kernel with online softmax + XLA fallback.
+
+The hot attention op for the model zoo (models/transformer.py selects it via
+TransformerConfig.attention_impl="flash").  Tiled over (batch*head, q-block,
+kv-block) with the kv dimension innermost so the running max/денom/accumulator
+live in VMEM scratch across kv steps — the standard flash recipe, written for
+the MXU/VMEM model of /opt/skills/guides/pallas_guide.md.
+
+Falls back to a fused-by-XLA reference implementation off-TPU or for shapes
+the kernel doesn't tile well (head_dim not multiple of 128-lane tiling, tiny
+sequences), so the same model code runs on the CPU test mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """(B,S,Hq,D),(B,S,Hkv,D) GQA dot-product attention; f32 softmax."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qg = q.reshape(B, S, Hkv, G, D)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None, None], s, -1e30)
+    w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bkgst,btkd->bskgd", w, v)
+    return o.reshape(B, S, Hq, D)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               scale: float, block_q: int, block_k: int, causal: bool):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Blocks fully above the causal diagonal contribute nothing.
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)            # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, -1e30)
+        m_prev = m_scr[...]                          # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256):
+    """Public entry: q (B,S,Hq,D), k/v (B,S,Hkv,D) → (B,S,Hq,D).
+
+    Dispatches to the Pallas kernel on TPU when shapes tile cleanly,
+    otherwise to the XLA reference path.
+    """
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bq, bk = min(block_q, S), min(block_k, S)
+    tiles_ok = (S % bq == 0 and S % bk == 0 and D % 128 == 0
+                and Hq % Hkv == 0)
+    if not (on_tpu and tiles_ok):
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qh = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * Hkv, S, D)
+
+    group = Hq // Hkv
+    nq, nk = S // bq, S // bk
+    kernel = functools.partial(_fa_kernel, scale=scale, block_q=bq,
+                               block_k=bk, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, qi, ki: (h, qi, 0)),
+            # kv head = (batch of h) * Hkv + (head of h) // group
+            pl.BlockSpec((1, bk, D),
+                         lambda h, qi, ki:
+                         ((h // Hq) * Hkv + (h % Hq) // group, ki, 0)),
+            pl.BlockSpec((1, bk, D),
+                         lambda h, qi, ki:
+                         ((h // Hq) * Hkv + (h % Hq) // group, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda h, qi, ki: (h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),      # running max
+            pltpu.VMEM((bq, 1), jnp.float32),      # running denom
+            pltpu.VMEM((bq, D), jnp.float32),      # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qh, kh, vh)
+    return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
