@@ -41,6 +41,11 @@ IDLE_WORKER_KEEP = 8          # pooled idle workers kept hot per node
 LEASE_IDLE_TIMEOUT_S = 2.0
 
 
+def _needs_tpu(resources) -> bool:
+    return any(k == "TPU" or k.startswith("TPU-") for k, v in
+               (resources or {}).items() if v > 0)
+
+
 class WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -50,7 +55,9 @@ class WorkerHandle:
         self.registered = asyncio.Event()
         self.lease_id: Optional[bytes] = None
         self.lease_resources: Dict[str, float] = {}
-        self.is_actor = False
+        self.lease_bundle: Optional[Tuple[bytes, int]] = None  # PG bundle key
+        self.needs_tpu = False        # pooled separately: TPU workers keep
+        self.is_actor = False         # the accelerator client initialized
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
 
@@ -70,7 +77,8 @@ class NodeAgent:
             "/dev/shm", f"raytpu_{node_id.hex()[:12]}")
         self.store = ShmStore.create(self.store_path, store_capacity)
         self.workers: Dict[bytes, WorkerHandle] = {}
-        self.idle_workers: List[WorkerHandle] = []
+        self.idle_workers: List[WorkerHandle] = []      # CPU pool
+        self.idle_tpu_workers: List[WorkerHandle] = []  # TPU pool
         self.leases: Dict[bytes, WorkerHandle] = {}
         self.bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self.pinned: Dict[bytes, int] = {}   # object_id -> pin count (owner pins)
@@ -118,9 +126,24 @@ class NodeAgent:
         })
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        self._tasks.append(asyncio.ensure_future(self._prestart_workers()))
         logger.info("agent %s on %s, store %s",
                     self.node_id.hex()[:8], addr, self.store_path)
         return addr
+
+    async def _prestart_workers(self):
+        """Warm the idle pool so first leases skip process startup
+        (reference: worker_pool.cc PrestartWorkers)."""
+        n = int(get_config().worker_prestart_count)
+        for _ in range(max(0, n)):
+            if self._shutdown:
+                return
+            try:
+                wh = await self._pop_worker(None)
+            except rpc.RpcError:
+                return
+            wh.last_idle = time.monotonic()
+            self.idle_workers.append(wh)
 
     async def _report_loop(self):
         cfg = get_config()
@@ -150,8 +173,10 @@ class NodeAgent:
         self.workers.pop(wh.worker_id, None)
         if wh in self.idle_workers:
             self.idle_workers.remove(wh)
+        if wh in self.idle_tpu_workers:
+            self.idle_tpu_workers.remove(wh)
         if wh.lease_id is not None:
-            self._release_resources(wh.lease_resources)
+            self._release_resources(wh.lease_resources, wh.lease_bundle)
             self.leases.pop(wh.lease_id, None)
         logger.warning("worker %s (pid %s) died", wh.worker_id.hex()[:8],
                        wh.proc.pid)
@@ -186,11 +211,21 @@ class NodeAgent:
             pass
 
     # ------------------------------------------------------------- workers --
-    async def _spawn_worker(self, env_extra: Dict[str, str] | None = None
-                            ) -> WorkerHandle:
+    async def _spawn_worker(self, env_extra: Dict[str, str] | None = None,
+                            needs_tpu: bool = False) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
         from .node import child_env
         env = child_env(env_extra)
+        if not needs_tpu:
+            # Strip accelerator site hooks (e.g. a sitecustomize that eagerly
+            # initializes the TPU client): CPU workers start in ~20ms instead
+            # of seconds and never touch chip state (reference analogue:
+            # workers outside TPU leases get no TPU_VISIBLE_CHIPS).
+            strip = get_config().worker_pythonpath_strip_cpu
+            if strip:
+                parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                         if p and strip not in p]
+                env["PYTHONPATH"] = os.pathsep.join(parts)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
@@ -206,6 +241,7 @@ class NodeAgent:
             env=env, stdout=out, stderr=err,
             cwd=os.getcwd(), start_new_session=True)
         wh = WorkerHandle(worker_id, proc)
+        wh.needs_tpu = needs_tpu
         self.workers[worker_id] = wh
         return wh
 
@@ -219,16 +255,20 @@ class NodeAgent:
         wh.registered.set()
         return {"node_id": self.node_id}
 
-    async def _pop_worker(self, env_extra=None) -> WorkerHandle:
+    async def _pop_worker(self, env_extra=None,
+                          needs_tpu: bool = False) -> WorkerHandle:
         """Reuse an idle pooled worker or spawn one (reference:
         WorkerPool::PopWorker, worker_pool.h:55; reuse keyed by runtime env —
-        round 1 pools only default-env workers)."""
+        round 1 pools only default-env workers).  CPU and TPU workers pool
+        separately: CPU workers spawn without the accelerator client (fast
+        startup, no chip state); TPU workers keep it."""
         if not env_extra:
-            while self.idle_workers:
-                wh = self.idle_workers.pop()
+            pool = self.idle_tpu_workers if needs_tpu else self.idle_workers
+            while pool:
+                wh = pool.pop()
                 if wh.proc.poll() is None and wh.conn and not wh.conn.closed:
                     return wh
-        wh = await self._spawn_worker(env_extra)
+        wh = await self._spawn_worker(env_extra, needs_tpu=needs_tpu)
         cfg = get_config()
         try:
             await asyncio.wait_for(wh.registered.wait(),
@@ -238,8 +278,9 @@ class NodeAgent:
             raise rpc.RpcError("worker failed to register in time")
         return wh
 
-    def _try_acquire(self, resources: Dict[str, float]) -> bool:
-        avail = self.resources_available
+    @staticmethod
+    def _try_acquire_from(avail: Dict[str, float],
+                          resources: Dict[str, float]) -> bool:
         if not all(avail.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()
                    if v > 0):
             return False
@@ -247,7 +288,23 @@ class NodeAgent:
             avail[k] = avail.get(k, 0.0) - v
         return True
 
-    def _release_resources(self, resources: Dict[str, float]):
+    def _try_acquire(self, resources: Dict[str, float]) -> bool:
+        return self._try_acquire_from(self.resources_available, resources)
+
+    def _release_resources(self, resources: Dict[str, float],
+                           bundle_key: Optional[Tuple[bytes, int]] = None):
+        """Return lease resources to their pool: the PG bundle they came
+        from (if it still exists — a removed bundle already gave the node
+        pool its total back), else the node pool."""
+        if bundle_key is not None:
+            bundle = self.bundles.get(bundle_key)
+            if bundle is not None:
+                for k, v in resources.items():
+                    bundle["available"][k] = bundle["available"].get(k, 0.0) + v
+                return
+            # Bundle was removed while the lease ran: its unused part went
+            # back to the node pool at return_bundle; the lease's share
+            # comes back here.
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) + v
 
@@ -258,28 +315,63 @@ class NodeAgent:
         node_manager.cc:1776; spillback in cluster_lease_manager.cc)."""
         resources = p.get("resources", {})
         pg = p.get("placement_group")
+        bundle_key = None
         if pg:
-            key = (pg["pg_id"], pg.get("bundle_index", 0))
-            if key not in self.bundles:
-                return {"granted": False, "reason": "bundle not on this node"}
-        if not self._try_acquire(resources):
+            # Leases inside a PG draw from the bundle's reservation, not the
+            # node pool (reference: bundle resources become `CPU_group_*`
+            # resources the lease consumes instead of the node's).
+            bundle_key = self._find_bundle(pg["pg_id"],
+                                           pg.get("bundle_index", 0),
+                                           resources)
+            if bundle_key is None:
+                return {"granted": False,
+                        "reason": "bundle not on this node or exhausted",
+                        "retry_after_ms": 100}
+            acquired = self._try_acquire_from(
+                self.bundles[bundle_key]["available"], resources)
+        else:
+            acquired = self._try_acquire(resources)
+        if not acquired:
+            if pg:
+                # Bundle exhausted: generic spillback would point off-PG;
+                # the client retries (rotating bundles for index -1).
+                return {"granted": False, "reason": "bundle exhausted",
+                        "retry_after_ms": 100}
             spill = await self._find_spillback(resources)
             if spill is not None:
                 return {"granted": False, "spillback": spill}
             return {"granted": False, "reason": "infeasible",
                     "retry_after_ms": 100}
         try:
-            wh = await self._pop_worker(p.get("env"))
+            wh = await self._pop_worker(
+                p.get("env"), needs_tpu=_needs_tpu(resources))
         except rpc.RpcError as e:
-            self._release_resources(resources)
+            self._release_resources(resources, bundle_key)
             return {"granted": False, "reason": str(e), "retry_after_ms": 200}
         lease_id = os.urandom(16)
         wh.lease_id = lease_id
         wh.lease_resources = resources
+        wh.lease_bundle = bundle_key
         self.leases[lease_id] = wh
         return {"granted": True, "lease_id": lease_id,
                 "worker_addr": list(wh.address),
                 "worker_id": wh.worker_id}
+
+    def _find_bundle(self, pg_id: bytes, bundle_index: int,
+                     resources: Dict[str, float]
+                     ) -> Optional[Tuple[bytes, int]]:
+        """Resolve a bundle key on this node; -1 = any bundle with room."""
+        if bundle_index >= 0:
+            key = (pg_id, bundle_index)
+            return key if key in self.bundles else None
+        for key, bundle in self.bundles.items():
+            if key[0] != pg_id:
+                continue
+            avail = bundle["available"]
+            if all(avail.get(k, 0.0) >= v - 1e-9
+                   for k, v in resources.items() if v > 0):
+                return key
+        return None
 
     async def _find_spillback(self, resources) -> Optional[list]:
         """Ask GCS's resource view for a feasible node (stands in for the
@@ -303,13 +395,15 @@ class NodeAgent:
         wh = self.leases.pop(p["lease_id"], None)
         if wh is None:
             return False
-        self._release_resources(wh.lease_resources)
+        self._release_resources(wh.lease_resources, wh.lease_bundle)
         wh.lease_id = None
         wh.lease_resources = {}
+        wh.lease_bundle = None
         wh.last_idle = time.monotonic()
+        pool = self.idle_tpu_workers if wh.needs_tpu else self.idle_workers
         if (wh.proc.poll() is None and not wh.is_actor
-                and len(self.idle_workers) < IDLE_WORKER_KEEP):
-            self.idle_workers.append(wh)
+                and len(pool) < IDLE_WORKER_KEEP):
+            pool.append(wh)
         elif not wh.is_actor:
             wh.proc.terminate()
         return True
@@ -320,27 +414,44 @@ class NodeAgent:
         (reference: GcsActorScheduler leasing from raylet + PushTask of the
         creation task)."""
         resources = p.get("resources", {})
-        if not self._try_acquire(resources):
+        strategy = p.get("scheduling_strategy") or {}
+        bundle_key = None
+        if strategy.get("type") == "placement_group":
+            bundle_key = self._find_bundle(
+                strategy["pg_id"], strategy.get("bundle_index", 0), resources)
+            if bundle_key is None:
+                raise rpc.RpcError("PG bundle not on this node or exhausted")
+            acquired = self._try_acquire_from(
+                self.bundles[bundle_key]["available"], resources)
+        else:
+            acquired = self._try_acquire(resources)
+        if not acquired:
             raise rpc.RpcError("insufficient resources for actor")
         env_extra = {}
         renv = p.get("runtime_env") or {}
         for k, v in (renv.get("env_vars") or {}).items():
             env_extra[k] = str(v)
         try:
-            wh = await self._pop_worker(env_extra or None)
+            wh = await self._pop_worker(env_extra or None,
+                                        needs_tpu=_needs_tpu(resources))
         except rpc.RpcError:
-            self._release_resources(resources)
+            self._release_resources(resources, bundle_key)
             raise
         wh.is_actor = True
         wh.actor_id = p["actor_id"]
         wh.lease_id = os.urandom(16)
         wh.lease_resources = resources
+        wh.lease_bundle = bundle_key
         self.leases[wh.lease_id] = wh
         try:
             await wh.conn.call("actor_init", p, timeout=115)
         except rpc.RpcError as e:
-            self._release_resources(resources)
+            self._release_resources(resources, bundle_key)
             self.leases.pop(wh.lease_id, None)
+            # Clear lease fields so _on_worker_death doesn't release again.
+            wh.lease_id = None
+            wh.lease_resources = {}
+            wh.lease_bundle = None
             wh.proc.terminate()
             raise rpc.RpcError(f"actor __init__ failed: {e}")
         return {"worker_addr": list(wh.address), "worker_id": wh.worker_id}
@@ -356,16 +467,21 @@ class NodeAgent:
             return True
         if not self._try_acquire(p["resources"]):
             return False
-        self.bundles[key] = dict(p["resources"])
+        self.bundles[key] = {"total": dict(p["resources"]),
+                             "available": dict(p["resources"])}
         return True
 
     async def h_commit_bundle(self, conn, p):
         return (p["pg_id"], p["bundle_index"]) in self.bundles
 
     async def h_return_bundle(self, conn, p):
-        res = self.bundles.pop((p["pg_id"], p["bundle_index"]), None)
-        if res:
-            self._release_resources(res)
+        bundle = self.bundles.pop((p["pg_id"], p["bundle_index"]), None)
+        if bundle:
+            # Only the unused part returns now; resources still held by
+            # running leases come back to the node pool as each lease
+            # returns (see _release_resources fallthrough) — never
+            # double-counted against physical chips.
+            self._release_resources(bundle["available"])
         return True
 
     # -------------------------------------------------------------- objects --

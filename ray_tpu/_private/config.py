@@ -33,6 +33,16 @@ _define("memory_store_max_bytes", 512 * 1024 * 1024)
 _define("worker_register_timeout_s", 60.0)
 _define("worker_lease_timeout_s", 30.0)
 _define("num_workers_soft_limit", 0, "0 = num_cpus")
+_define("max_leases_per_scheduling_key", 64,
+        "client-side cap on concurrent worker leases per scheduling key "
+        "(reference: normal_task_submitter lease pool; queue-bounded anyway)")
+_define("worker_pythonpath_strip_cpu", ".axon_site",
+        "PYTHONPATH entries containing this substring are stripped from "
+        "CPU-only workers so accelerator site hooks (eager TPU client "
+        "init) don't slow spawn or grab chip state; empty disables")
+_define("worker_prestart_count", 2,
+        "workers spawned at agent boot so first leases don't pay process "
+        "startup (reference: worker_pool.cc prestart)")
 _define("worker_niceness", 0)
 _define("maximum_gcs_destroyed_actor_cached_count", 100_000)
 _define("task_max_retries_default", 3)

@@ -47,8 +47,11 @@ from .shm_store import ShmStore, StoreFullError
 
 logger = logging.getLogger("ray_tpu.core_worker")
 
-PIPELINE_DEPTH = 4      # concurrent pushes per leased worker
-MAX_LEASES_PER_KEY = 0  # 0 = node CPU count
+# One task per leased worker at a time (reference semantics: a granted
+# lease runs one task; concurrency comes from holding many leases).  >1
+# pipelines pushes into a busy worker — better tiny-task throughput but
+# long tasks pile onto one worker while other nodes idle.
+PIPELINE_DEPTH = 1
 
 
 class _PendingTask:
@@ -121,6 +124,9 @@ class CoreWorker:
         self._worker_conns: Dict[tuple, rpc.Connection] = {}
         self._owner_conns: Dict[tuple, rpc.Connection] = {}
         self._fn_cache: Dict[bytes, Any] = {}
+        self._pg_cache: Dict[bytes, dict] = {}
+        self._pg_rr: Dict[bytes, int] = {}
+        self.current_placement_group: Optional[dict] = None
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
@@ -188,6 +194,10 @@ class CoreWorker:
             self._loop_thread.join(timeout=5)
         self.executor.shutdown(wait=False)
         self.store.close()
+
+    def gcs_call(self, method: str, payload: dict, timeout: float = 60):
+        """Synchronous GCS RPC for API modules (placement groups, state)."""
+        return self._run(self.gcs.call(method, payload, timeout=timeout))
 
     def _run(self, coro, timeout=None):
         """Run a coroutine from a sync caller thread."""
@@ -523,7 +533,7 @@ class CoreWorker:
                 task = state.queue.popleft()
                 lease.inflight += 1
                 asyncio.ensure_future(self._push_and_track(key, state, lease, task))
-        max_leases = MAX_LEASES_PER_KEY or os.cpu_count() or 8
+        max_leases = get_config().max_leases_per_scheduling_key
         want = min(len(state.queue), max_leases - len(state.leases)
                    - state.pending_lease_requests)
         for _ in range(max(0, want)):
@@ -533,12 +543,34 @@ class CoreWorker:
     async def _request_lease(self, key: bytes, state: _KeyState,
                              agent_conn: Optional[rpc.Connection] = None,
                              hops: int = 0):
+        strat = state.strategy or {}
+        is_pg = strat.get("type") == "placement_group"
+        if agent_conn is None and is_pg:
+            # Route the lease to the agent hosting the target bundle — the
+            # local agent may not hold it at all (reference: lease_policy.cc
+            # picks the raylet by bundle locality).
+            status, agent_conn = await self._pg_agent_conn(strat)
+            if status == "removed":
+                state.pending_lease_requests -= 1
+                self._fail_queued_tasks(
+                    state, exc.RayError(
+                        "placement group was removed; task can never be "
+                        "scheduled"))
+                return
+            if agent_conn is None:       # PG still pending / node down
+                state.pending_lease_requests -= 1
+                if state.queue:
+                    await asyncio.sleep(0.2)
+                    self._pump(key, state)
+                return
         agent_conn = agent_conn or self.agent
         try:
             res = await agent_conn.call("request_lease", {
                 "resources": state.resources,
-                "placement_group": (state.strategy or {}).get("pg")
-                if state.strategy else None,
+                "placement_group": ({"pg_id": strat["pg_id"],
+                                     "bundle_index":
+                                     strat.get("bundle_index", 0)}
+                                    if is_pg else None),
             }, timeout=130)
         except (rpc.RpcError, asyncio.TimeoutError):
             state.pending_lease_requests -= 1
@@ -547,6 +579,11 @@ class CoreWorker:
                 self._pump(key, state)
             return
         if not res.get("granted"):
+            if is_pg and "bundle" in (res.get("reason") or ""):
+                # Bundle gone or exhausted at the routed node: drop the
+                # cached table so the next attempt re-resolves (and notices
+                # PG removal).
+                self._pg_cache.pop(strat["pg_id"], None)
             spill = res.get("spillback")
             if spill and hops < 4:
                 try:
@@ -568,6 +605,45 @@ class CoreWorker:
         state.leases.append(lease)
         self._pump(key, state)
         asyncio.ensure_future(self._lease_reaper(key, state, lease))
+
+    async def _pg_agent_conn(self, strat: dict):
+        """Resolve the agent hosting a PG-targeted lease's bundle.
+
+        Returns (status, conn): ("ok", conn) | ("pending", None) |
+        ("removed", None).  Bundle locations are immutable once placed, so
+        the table is cached until a denial invalidates it; bundle_index -1
+        round-robins across the PG's nodes."""
+        pg_id = strat["pg_id"]
+        table = self._pg_cache.get(pg_id)
+        if table is None:
+            table = await self.gcs.call("get_placement_group",
+                                        {"pg_id": pg_id})
+            if table is None or table.get("state") == "REMOVED":
+                return "removed", None
+            if table.get("state") != "CREATED":
+                return "pending", None
+            self._pg_cache[pg_id] = table
+        bundles = table["bundles"]
+        idx = strat.get("bundle_index", 0)
+        if idx >= len(bundles):
+            return "removed", None     # invalid index: task can never run
+        if idx < 0:
+            n = self._pg_rr.get(pg_id, 0)
+            self._pg_rr[pg_id] = n + 1
+            idx = n % len(bundles)
+        addr = tuple(bundles[idx]["node_addr"])
+        if addr == self.agent_address:
+            return "ok", self.agent
+        try:
+            return "ok", await self._peer_owner(addr)
+        except rpc.ConnectionLost:
+            return "pending", None
+
+    def _fail_queued_tasks(self, state: _KeyState, error: Exception):
+        """Resolve every queued task's return refs to an error."""
+        while state.queue:
+            task = state.queue.popleft()
+            self._store_task_exception(task.spec, error)
 
     async def _worker_conn(self, addr: tuple) -> rpc.Connection:
         conn = self._worker_conns.get(addr)

@@ -89,6 +89,7 @@ class GcsServer:
         self.named_actors: Dict[str, bytes] = {}
         self.jobs: Dict[bytes, dict] = {}
         self.placement_groups: Dict[bytes, dict] = {}
+        self._pg_rr: Dict[bytes, int] = {}   # any-bundle rotation counters
         self._job_counter = 0
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
         self._server = rpc.RpcServer(self._handlers(), name="gcs")
@@ -116,6 +117,7 @@ class GcsServer:
             "create_placement_group": self.h_create_placement_group,
             "remove_placement_group": self.h_remove_placement_group,
             "get_placement_group": self.h_get_placement_group,
+            "list_placement_groups": self.h_list_placement_groups,
             "ping": lambda conn, p: "pong",
             "get_cluster_info": self.h_get_cluster_info,
         }
@@ -297,12 +299,26 @@ class GcsServer:
                 return None
         if strategy and strategy.get("type") == "placement_group":
             pg = self.placement_groups.get(strategy["pg_id"])
-            if pg:
-                bundle = pg["bundles"][strategy.get("bundle_index", 0)]
+            if pg and pg["state"] == "CREATED":
+                idx = strategy.get("bundle_index", 0)
+                if idx < 0:
+                    # any-bundle: rotate across live bundle nodes so retries
+                    # reach a bundle with room (the GCS does not track
+                    # per-bundle usage; agents reject exhausted bundles)
+                    live = [b for b in pg["bundles"]
+                            if (n := self.nodes.get(b["node_id"]))
+                            and n.alive]
+                    if not live:
+                        return None
+                    self._pg_rr[pg["pg_id"]] = (
+                        self._pg_rr.get(pg["pg_id"], -1) + 1)
+                    b = live[self._pg_rr[pg["pg_id"]] % len(live)]
+                    return self.nodes[b["node_id"]]
+                bundle = pg["bundles"][idx]
                 node = self.nodes.get(bundle["node_id"])
                 if node and node.alive:
                     return node
-                return None
+            return None
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
@@ -406,45 +422,90 @@ class GcsServer:
 
     # ----------------------------------------------------- placement groups --
     async def h_create_placement_group(self, conn, p):
-        """Two-phase bundle reservation across agents (reference:
+        """Register a PG in PENDING state and place it asynchronously with a
+        two-phase bundle reservation across agents (reference:
+        gcs_placement_group_manager.cc pending queue +
         gcs_placement_group_scheduler.cc prepare/commit;
-        node_manager.proto:471-476)."""
+        node_manager.proto:471-476).  Returns immediately; clients poll
+        get_placement_group / wait on the CH_PG channel."""
         pg_id = p["pg_id"]
-        bundles = p["bundles"]          # list of resource dicts
-        strategy = p.get("strategy", "PACK")
-        chosen = self._place_bundles(bundles, strategy)
-        if chosen is None:
-            return {"ok": False, "reason": "infeasible"}
-        # Phase 1: prepare on every node; roll back on any failure.
-        prepared = []
-        try:
-            for idx, (bundle, node) in enumerate(zip(bundles, chosen)):
-                ok = await node.conn.call("prepare_bundle", {
-                    "pg_id": pg_id, "bundle_index": idx, "resources": bundle,
-                }, timeout=30)
-                if not ok:
-                    raise RuntimeError(f"prepare failed on {node.node_id.hex()[:8]}")
-                prepared.append((idx, node))
-        except Exception as e:
-            for idx, node in prepared:
-                try:
-                    await node.conn.call("return_bundle",
-                                         {"pg_id": pg_id, "bundle_index": idx})
-                except rpc.RpcError:
-                    pass
-            return {"ok": False, "reason": str(e)}
-        # Phase 2: commit.
-        for idx, node in prepared:
-            await node.conn.call("commit_bundle",
-                                 {"pg_id": pg_id, "bundle_index": idx})
-        self.placement_groups[pg_id] = {
-            "pg_id": pg_id, "strategy": strategy,
-            "bundles": [{"node_id": n.node_id, "resources": b,
-                         "node_addr": list(n.address)}
-                        for b, n in zip(bundles, chosen)],
-            "state": "CREATED",
+        entry = {
+            "pg_id": pg_id,
+            "strategy": p.get("strategy", "PACK"),
+            "bundle_specs": p["bundles"],     # list of resource dicts
+            "bundles": [],                    # filled once placed
+            "name": p.get("name", ""),
+            "state": "PENDING",
         }
-        return {"ok": True, "pg": self.placement_groups[pg_id]}
+        self.placement_groups[pg_id] = entry
+        asyncio.ensure_future(self._place_pg(entry))
+        return {"ok": True, "pg_id": pg_id}
+
+    async def _place_pg(self, entry: dict):
+        """Retry placement until feasible or the PG is removed (the
+        reference keeps infeasible PGs pending forever too)."""
+        pg_id = entry["pg_id"]
+        bundles = entry["bundle_specs"]
+        while entry["state"] == "PENDING":
+            chosen = self._place_bundles(bundles, entry["strategy"])
+            if chosen is None:
+                await asyncio.sleep(0.2)
+                continue
+            # Phase 1: prepare on every node; roll back on any failure.
+            prepared = []
+            failed = False
+            for idx, (bundle, node) in enumerate(zip(bundles, chosen)):
+                try:
+                    ok = await node.conn.call("prepare_bundle", {
+                        "pg_id": pg_id, "bundle_index": idx,
+                        "resources": bundle}, timeout=30)
+                except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
+                    ok = False
+                if not ok:
+                    failed = True
+                    break
+                prepared.append((idx, node))
+            if failed:
+                for idx, node in prepared:
+                    try:
+                        await node.conn.call("return_bundle", {
+                            "pg_id": pg_id, "bundle_index": idx})
+                    except rpc.RpcError:
+                        pass
+                await asyncio.sleep(0.2)
+                continue
+            # Phase 2: commit; on any failure return every bundle and retry
+            # placement from scratch (a node died between prepare and commit).
+            try:
+                for idx, node in prepared:
+                    await node.conn.call("commit_bundle",
+                                         {"pg_id": pg_id, "bundle_index": idx})
+            except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
+                for idx, node in prepared:
+                    try:
+                        await node.conn.call("return_bundle", {
+                            "pg_id": pg_id, "bundle_index": idx})
+                    except (rpc.RpcError, AttributeError,
+                            asyncio.TimeoutError):
+                        pass
+                await asyncio.sleep(0.2)
+                continue
+            if entry["state"] != "PENDING":     # removed mid-placement
+                for idx, node in prepared:
+                    try:
+                        await node.conn.call("return_bundle", {
+                            "pg_id": pg_id, "bundle_index": idx})
+                    except rpc.RpcError:
+                        pass
+                return
+            entry["bundles"] = [
+                {"node_id": n.node_id, "resources": b,
+                 "node_addr": list(n.address)}
+                for b, n in zip(bundles, chosen)]
+            entry["state"] = "CREATED"
+            self._publish(protocol.CH_PG,
+                          {"event": "created", "pg_id": pg_id})
+            return
 
     def _place_bundles(self, bundles, strategy) -> Optional[List[NodeInfo]]:
         alive = [n for n in self.nodes.values() if n.alive]
@@ -502,6 +563,7 @@ class GcsServer:
         pg = self.placement_groups.pop(p["pg_id"], None)
         if pg is None:
             return False
+        pg["state"] = "REMOVED"         # stops a pending _place_pg loop
         for idx, bundle in enumerate(pg["bundles"]):
             node = self.nodes.get(bundle["node_id"])
             if node and node.conn and not node.conn.closed:
@@ -514,6 +576,9 @@ class GcsServer:
 
     async def h_get_placement_group(self, conn, p):
         return self.placement_groups.get(p["pg_id"])
+
+    async def h_list_placement_groups(self, conn, p):
+        return list(self.placement_groups.values())
 
     async def h_get_cluster_info(self, conn, p):
         return {

@@ -90,3 +90,4 @@ CH_ACTOR = "actor"
 CH_NODE = "node"
 CH_ERROR = "error"
 CH_LOG = "log"
+CH_PG = "placement_group"

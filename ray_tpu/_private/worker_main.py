@@ -132,6 +132,14 @@ class Executor:
         loop = asyncio.get_running_loop()
         prev_task_id = self.core.current_task_id
         self.core.current_task_id = spec["task_id"]
+        strat = spec.get("scheduling_strategy") or {}
+        prev_pg = self.core.current_placement_group
+        if strat.get("type") == "placement_group":
+            # Feeds util.placement_group.get_current_placement_group; the
+            # finally below restores, so pooled workers don't leak a task's
+            # PG context into the next task (actors keep theirs: prev_pg is
+            # the actor-lifetime context set in h_actor_init).
+            self.core.current_placement_group = {"pg_id": strat["pg_id"]}
         try:
             args, kwargs = await self._resolve_arg_entries(spec["args"])
             if spec.get("actor_id"):
@@ -161,8 +169,14 @@ class Executor:
             return {"status": "error", "error": blob, "traceback": tb}
         finally:
             self.core.current_task_id = prev_task_id
+            self.core.current_placement_group = prev_pg
 
     async def h_actor_init(self, conn, spec):
+        strat = spec.get("scheduling_strategy") or {}
+        if strat.get("type") == "placement_group":
+            # PG context for the whole actor lifetime (reference:
+            # get_current_placement_group inside actor methods).
+            self.core.current_placement_group = {"pg_id": strat["pg_id"]}
         blob = await self.core.gcs.call(
             "kv_get", {"ns": "actor_cls", "key": spec["class_id"].hex()
                        if isinstance(spec["class_id"], bytes)

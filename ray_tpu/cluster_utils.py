@@ -1,0 +1,132 @@
+"""Multi-node-on-one-machine test cluster.
+
+Equivalent of the reference's load-bearing test utility (reference:
+python/ray/cluster_utils.py:135 Cluster — add_node :202 starts additional
+real raylet processes with distinct resource specs, remove_node :286 kills
+them to simulate node failure).  Every distributed test (spillback,
+STRICT_SPREAD, node death, PG routing) builds on this.
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"TPU": 4})
+    ray_tpu.init(address=cluster.address)
+    ...
+    cluster.remove_node(node)      # hard-kill: simulates node failure
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from ._private import node as node_mod
+from ._private.ids import NodeID
+
+
+class ClusterNode:
+    def __init__(self, proc: subprocess.Popen, address: tuple,
+                 store_path: str, node_id: bytes):
+        self.proc = proc
+        self.address = address
+        self.store_path = store_path
+        self.node_id = node_id
+
+    @property
+    def node_id_hex(self) -> str:
+        return self.node_id.hex()
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.session_dir = node_mod.new_session_dir()
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.gcs_address: Optional[tuple] = None
+        self.nodes: List[ClusterNode] = []
+        self.head_node: Optional[ClusterNode] = None
+        if initialize_head:
+            self.gcs_proc, self.gcs_address = node_mod.start_gcs(
+                self.session_dir)
+            self.head_node = self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self) -> str:
+        host, port = self.gcs_address
+        return f"{host}:{port}"
+
+    def add_node(self, *, num_cpus: Optional[int] = 1,
+                 num_tpus: Optional[int] = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: int = 256 << 20) -> ClusterNode:
+        """Start a real node agent process with its own /dev/shm store
+        (reference: cluster_utils.py:202 add_node)."""
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus or 0))
+        if num_tpus:
+            res.setdefault("TPU", float(num_tpus))
+        res.setdefault("memory", float(1 << 30))
+        proc, addr, store_path, node_id = node_mod.start_agent(
+            self.session_dir, self.gcs_address, res, labels=labels,
+            store_capacity=object_store_memory)
+        node = ClusterNode(proc, addr, store_path, node_id)
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode,
+                    allow_graceful: bool = False) -> None:
+        """Kill a node's agent (and its workers) — simulates node failure
+        (reference: cluster_utils.py:286 remove_node)."""
+        if allow_graceful:
+            node.proc.terminate()
+        else:
+            node.proc.kill()
+        try:
+            node.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            node.proc.kill()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, timeout: float = 15.0) -> None:
+        """Block until the GCS sees every added node alive."""
+        import asyncio
+        from ._private import rpc as rpc_mod
+
+        want = {n.node_id for n in self.nodes}
+        deadline = time.monotonic() + timeout
+
+        async def _alive() -> set:
+            conn = await rpc_mod.connect(self.gcs_address)
+            nodes = await conn.call("get_nodes", {})
+            await conn.close()
+            return {bytes(n["node_id"]) for n in nodes if n["alive"]}
+
+        while time.monotonic() < deadline:
+            if want <= asyncio.run(_alive()):
+                return
+            time.sleep(0.1)
+        raise TimeoutError("cluster nodes did not come up")
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        for node in list(self.nodes):
+            self.remove_node(node, allow_graceful=True)
+        if self.gcs_proc is not None:
+            self.gcs_proc.terminate()
+            try:
+                self.gcs_proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.gcs_proc.kill()
+        # /dev/shm arenas are unlinked by the agents on SIGTERM; hard-killed
+        # agents leave theirs behind until reboot — remove defensively.
+        for node in self.nodes:
+            try:
+                os.unlink(node.store_path)
+            except OSError:
+                pass

@@ -1,0 +1,13 @@
+"""TPU layer: accelerator detection, slice-aware gang scheduling.
+
+Reference parity: python/ray/_private/accelerators/tpu.py (manager) and
+python/ray/util/tpu.py (slice reservation); redesigned so slice/topology
+awareness is first-class in the resource model (SURVEY.md §7 design stance).
+"""
+
+from .accelerator import TPUAcceleratorManager
+from .slices import (fetch_tpu_slice_name_from_pg, reserve_tpu_slice,
+                     slice_bundles)
+
+__all__ = ["TPUAcceleratorManager", "reserve_tpu_slice", "slice_bundles",
+           "fetch_tpu_slice_name_from_pg"]

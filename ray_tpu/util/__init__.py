@@ -1,0 +1,18 @@
+"""ray_tpu.util: scheduling strategies, placement groups, collective API.
+
+Reference: python/ray/util/__init__.py surface.
+"""
+
+from .placement_group import (PlacementGroup, get_current_placement_group,
+                              placement_group, placement_group_table,
+                              remove_placement_group)
+from .scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                    NodeLabelSchedulingStrategy,
+                                    PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "placement_group_table",
+    "remove_placement_group", "get_current_placement_group",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]

@@ -1,0 +1,125 @@
+"""Placement groups: gang-reserved resource bundles across nodes.
+
+API parity with the reference (reference: python/ray/util/placement_group.py
+— strategies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD :17-20, placement_group()
+:148, PlacementGroup.ready()/wait(), remove_placement_group,
+get_current_placement_group).  On TPU these are the gang-scheduling primitive
+for SPMD jobs: a STRICT_SPREAD PG over hosts reserves one bundle per TPU host
+(see ray_tpu.tpu.reserve_tpu_slice).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a (possibly still pending) placement group."""
+
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK", name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+
+    # -- state ---------------------------------------------------------------
+    def _table(self) -> Optional[dict]:
+        from .._private.worker import global_runtime
+        core = global_runtime().core
+        return core.gcs_call("get_placement_group", {"pg_id": self.id})
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        """Block until the PG is placed; False on timeout (reference:
+        PlacementGroup.wait)."""
+        deadline = time.monotonic() + timeout_seconds
+        delay = 0.02
+        while time.monotonic() < deadline:
+            t = self._table()
+            if t is None:
+                return False            # removed
+            if t["state"] == "CREATED":
+                return True
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.5)
+        return False
+
+    def ready(self):
+        """ObjectRef that resolves when the PG is placed — a no-op task
+        scheduled into bundle 0, exactly the reference's trick
+        (reference: util/placement_group.py PlacementGroup.ready)."""
+        import ray_tpu
+        from .scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        @ray_tpu.remote
+        def _pg_ready():
+            return True
+
+        return _pg_ready.options(
+            num_cpus=0,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=self, placement_group_bundle_index=0),
+        ).remote()
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    """Create a placement group asynchronously; returns a handle immediately
+    (reference: python/ray/util/placement_group.py:148)."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if lifetime not in (None, "detached"):
+        raise ValueError("lifetime must be None or 'detached'")
+    # PGs live in the GCS and already survive the creating driver, so
+    # 'detached' is the default behavior here.
+    if not bundles:
+        raise ValueError("bundles must be non-empty")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b}")
+    from .._private.worker import global_runtime
+    core = global_runtime().core
+    pg_id = os.urandom(14)
+    core.gcs_call("create_placement_group", {
+        "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+        "name": name})
+    return PlacementGroup(pg_id, bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release all bundles (running leases keep their workers; their
+    resources are not returned twice — reference: remove_placement_group
+    kills tasks, here leases drain naturally)."""
+    from .._private.worker import global_runtime
+    global_runtime().core.gcs_call("remove_placement_group", {"pg_id": pg.id})
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None):
+    from .._private.worker import global_runtime
+    core = global_runtime().core
+    if pg is not None:
+        return core.gcs_call("get_placement_group", {"pg_id": pg.id})
+    return core.gcs_call("list_placement_groups", {})
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """PG capturing for tasks running inside a PG (reference:
+    get_current_placement_group) — populated from the worker's task context."""
+    from .._private.worker import _runtime
+    if _runtime is None or _runtime.core is None:
+        return None
+    ctx = getattr(_runtime.core, "current_placement_group", None)
+    if not ctx:
+        return None
+    return PlacementGroup(ctx["pg_id"], ctx.get("bundles", []),
+                          ctx.get("strategy", "PACK"))

@@ -10,6 +10,12 @@ from typing import Optional
 class PlacementGroupSchedulingStrategy:
     def __init__(self, placement_group, placement_group_bundle_index: int = -1,
                  placement_group_capture_child_tasks: bool = False):
+        specs = getattr(placement_group, "bundle_specs", None)
+        if (specs is not None
+                and placement_group_bundle_index >= len(specs)):
+            raise ValueError(
+                f"bundle index {placement_group_bundle_index} out of range "
+                f"for a {len(specs)}-bundle placement group")
         self.placement_group = placement_group
         self.placement_group_bundle_index = placement_group_bundle_index
         self.placement_group_capture_child_tasks = (
@@ -45,12 +51,11 @@ def strategy_to_dict(strategy) -> Optional[dict]:
                 "soft": strategy.soft}
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
         pg = strategy.placement_group
+        idx = strategy.placement_group_bundle_index
         return {"type": "placement_group",
-                "pg_id": pg.id if isinstance(pg.id, bytes) else pg.id,
-                "bundle_index": strategy.placement_group_bundle_index,
-                "pg": {"pg_id": pg.id,
-                       "bundle_index": max(
-                           0, strategy.placement_group_bundle_index)}}
+                "pg_id": pg.id,
+                "bundle_index": idx,
+                "pg": {"pg_id": pg.id, "bundle_index": idx}}
     if isinstance(strategy, dict):
         return strategy
     raise ValueError(f"unknown scheduling strategy: {strategy!r}")

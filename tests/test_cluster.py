@@ -1,0 +1,109 @@
+"""Multi-node tests over cluster_utils.Cluster (reference model:
+python/ray/tests/ using ray_start_cluster; cluster_utils.py:135)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_two_nodes_spillback(cluster):
+    """Tasks overflow to the second node when the first is saturated."""
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    def hold(t):
+        import os, time
+        time.sleep(t)
+        return os.getpid()
+
+    pids = set(ray_tpu.get([hold.options(num_cpus=2).remote(0.5)
+                            for _ in range(4)], timeout=60))
+    assert len(pids) >= 2   # ran on both nodes' workers
+
+
+def test_strict_spread_across_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    table = placement_group_table(pg)
+    nids = {bytes(b["node_id"]) for b in table["bundles"]}
+    assert len(nids) == 2
+    remove_placement_group(pg)
+
+
+def test_pg_lease_routed_to_remote_bundle(cluster):
+    """A PG bundle on the non-driver node must still run tasks (lease is
+    routed to the bundle's agent, not the local one)."""
+    remote_node = cluster.add_node(num_cpus=4, resources={"gpu_ish": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    pg = placement_group([{"gpu_ish": 1, "CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    table = placement_group_table(pg)
+    assert bytes(table["bundles"][0]["node_id"]) == remote_node.node_id
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu
+        return ray_tpu.get_runtime_context().node_id
+
+    nid = ray_tpu.get(where.options(
+        num_cpus=1, scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote(),
+        timeout=60)
+    assert bytes(nid) == remote_node.node_id
+    remove_placement_group(pg)
+
+
+def test_node_death_detected(cluster):
+    node = cluster.add_node(num_cpus=2, resources={"mark": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"health_check_period_ms": 100,
+                                 "health_check_failure_threshold": 3})
+    cluster.remove_node(node)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if all(bytes(n["node_id"]) != node.node_id for n in alive):
+            return
+        time.sleep(0.2)
+    raise AssertionError("dead node still marked alive")
+
+
+def test_get_current_placement_group(cluster):
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def inside():
+        from ray_tpu.util import get_current_placement_group
+        cur = get_current_placement_group()
+        return None if cur is None else cur.id
+
+    got = ray_tpu.get(inside.options(
+        num_cpus=1, scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote(),
+        timeout=30)
+    assert bytes(got) == pg.id
+    remove_placement_group(pg)

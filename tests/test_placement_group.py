@@ -1,0 +1,104 @@
+"""Placement group tests (reference test model: python/ray/tests/
+test_placement_group*.py over cluster_utils.Cluster)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+def test_pg_create_wait_remove(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    assert len(table["bundles"]) == 2
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert placement_group_table(pg) is None
+
+
+def test_pg_ready_ref(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=30) is True
+    remove_placement_group(pg)
+
+
+def test_task_in_pg_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def where():
+        import os
+        return os.getpid()
+
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    pid = ray_tpu.get(where.options(
+        num_cpus=1, scheduling_strategy=strat).remote(), timeout=30)
+    assert pid > 0
+    remove_placement_group(pg)
+
+
+def test_pg_bundle_resources_not_double_counted(ray_start_regular):
+    """A PG reserving all CPUs must still run tasks inside its bundles."""
+    import ray_tpu
+    total = ray_tpu.cluster_resources().get("CPU", 4)
+    pg = placement_group([{"CPU": total}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    # all CPUs are reserved by the bundle: a task inside the PG runs...
+    assert ray_tpu.get(
+        f.options(num_cpus=1, scheduling_strategy=strat).remote(),
+        timeout=30) == 1
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_stays_pending(ray_start_regular):
+    pg = placement_group([{"CPU": 512}], strategy="PACK")
+    assert not pg.wait(1.0)
+    table = placement_group_table(pg)
+    assert table["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_pg_actor_in_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_pg_any_bundle_index(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=-1)
+    out = ray_tpu.get([f.options(num_cpus=1, scheduling_strategy=strat)
+                       .remote(i) for i in range(4)], timeout=30)
+    assert out == [0, 2, 4, 6]
+    remove_placement_group(pg)
