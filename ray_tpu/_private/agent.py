@@ -226,6 +226,11 @@ class NodeAgent:
                 parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                          if p and strip not in p]
                 env["PYTHONPATH"] = os.pathsep.join(parts)
+            # A worker with no TPU lease must never initialize the chip —
+            # one client per chip (reference analogue: no TPU_VISIBLE_CHIPS
+            # → no accelerator; jax_trainer.py:92-94 driver warning).
+            if env.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+                env["JAX_PLATFORMS"] = "cpu"
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))

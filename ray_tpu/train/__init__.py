@@ -1,0 +1,21 @@
+"""ray_tpu.train: distributed training orchestration, TPU-first.
+
+Reference surface: python/ray/train/__init__.py + train/v2 (report,
+get_context, Checkpoint, RunConfig/ScalingConfig/FailureConfig/
+CheckpointConfig, Result, DataParallelTrainer) and train/v2/jax
+(JaxTrainer/JaxConfig — the primary backend here; no torch/NCCL path).
+"""
+
+from ._checkpoint import Checkpoint, CheckpointManager
+from ._session import TrainContext, get_context, report
+from .backend import Backend, BackendConfig, JaxConfig
+from .trainer import (CheckpointConfig, DataParallelTrainer, FailureConfig,
+                      JaxTrainer, Result, RunConfig, ScalingConfig)
+from .worker_group import WorkerGroup
+
+__all__ = [
+    "report", "get_context", "TrainContext", "Checkpoint",
+    "CheckpointManager", "Backend", "BackendConfig", "JaxConfig",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Result", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",
+]

@@ -1,0 +1,111 @@
+"""Checkpoint: a directory-of-files abstraction + top-K manager.
+
+Reference: python/ray/train/_checkpoint.py (Checkpoint) and
+train/v2/_internal/execution/checkpoint/ (CheckpointManager tracking top-K by
+metric per CheckpointConfig).  Model state inside the directory is typically
+written with orbax (see models/train_step.py users); the framework only
+manages directories.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """An immutable directory of files."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="raytpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            yield self.path
+        return _cm()
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Keeps the top-K checkpoints by a metric under storage_path
+    (reference: CheckpointConfig num_to_keep /
+    checkpoint_score_attribute/order)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self.entries: List[Dict[str, Any]] = []   # {path, metrics, time}
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, src_path: str, metrics: Dict[str, Any]) -> str:
+        """Persist a worker-reported checkpoint dir into storage."""
+        name = f"checkpoint_{len(self.entries):06d}_{int(time.time())}"
+        dest = os.path.join(self.storage_path, name)
+        if os.path.abspath(src_path) != dest:
+            shutil.copytree(src_path, dest, dirs_exist_ok=True)
+        with open(os.path.join(dest, "_metrics.json"), "w") as f:
+            json.dump({k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))}, f)
+        self.entries.append({"path": dest, "metrics": metrics,
+                             "time": time.time()})
+        self._evict()
+        return dest
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self.entries) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            sign = 1.0 if self.score_order == "max" else -1.0
+            ranked = sorted(
+                self.entries,
+                key=lambda e: sign * float(
+                    e["metrics"].get(self.score_attribute, float("-inf"))),
+                reverse=True)
+        else:
+            ranked = sorted(self.entries, key=lambda e: e["time"],
+                            reverse=True)
+        keep = ranked[:self.num_to_keep]
+        for e in self.entries:
+            if e not in keep:
+                shutil.rmtree(e["path"], ignore_errors=True)
+        self.entries = [e for e in self.entries if e in keep]
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        return Checkpoint(max(self.entries, key=lambda e: e["time"])["path"])
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        if not self.score_attribute:
+            return self.latest
+        sign = 1.0 if self.score_order == "max" else -1.0
+        e = max(self.entries, key=lambda e: sign * float(
+            e["metrics"].get(self.score_attribute, float("-inf"))))
+        return Checkpoint(e["path"])

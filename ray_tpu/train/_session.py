@@ -1,0 +1,96 @@
+"""Per-worker training session: the report() channel.
+
+Equivalent of the reference's train session plumbing (reference:
+python/ray/train/_internal/session.py and v2 thread_runner.py — workers run
+train_loop_per_worker in a thread and ray.train.report(metrics, checkpoint)
+hands results to the controller via the worker actor).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+
+class TrainSession:
+    """Lives in the worker process; the training thread writes, the actor's
+    poll method reads."""
+
+    def __init__(self, world_rank: int, world_size: int,
+                 local_rank: int = 0, storage_path: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.storage_path = storage_path
+        self.lock = threading.Lock()
+        self.reports: List[Dict[str, Any]] = []
+        self.state = "pending"          # pending|running|finished|error
+        self.error: Optional[str] = None
+        self.result: Any = None
+        self.report_seq = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional["Checkpoint"] = None) -> None:
+        from ._checkpoint import Checkpoint
+        entry: Dict[str, Any] = {"metrics": dict(metrics),
+                                 "rank": self.world_rank}
+        if checkpoint is not None:
+            entry["checkpoint_path"] = checkpoint.path
+        with self.lock:
+            self.report_seq += 1
+            entry["seq"] = self.report_seq
+            self.reports.append(entry)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            out, self.reports = self.reports, []
+            return out
+
+
+_session: Optional[TrainSession] = None
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def get_session() -> Optional[TrainSession]:
+    return _session
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    """Called from inside train_loop_per_worker (reference:
+    ray.train.report)."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a "
+                           "training worker")
+    s.report(metrics, checkpoint=checkpoint)
+
+
+class TrainContext:
+    """reference: ray.train.get_context() surface."""
+
+    def get_world_size(self) -> int:
+        s = get_session()
+        return s.world_size if s else 1
+
+    def get_world_rank(self) -> int:
+        s = get_session()
+        return s.world_rank if s else 0
+
+    def get_local_rank(self) -> int:
+        s = get_session()
+        return s.local_rank if s else 0
+
+    def get_storage_path(self) -> str:
+        s = get_session()
+        return s.storage_path if s else ""
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

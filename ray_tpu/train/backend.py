@@ -1,0 +1,78 @@
+"""Training backends: per-worker process-group setup.
+
+Reference: python/ray/train/backend.py:32 (Backend/BackendConfig with
+on_start/on_shutdown hooks) and the TPU-native primary backend
+python/ray/train/v2/jax/config.py:21,74 (_JaxBackend running
+jax.distributed.initialize(master_addr, num_workers, index) on every
+worker).  No NCCL/torch path: JAX's coordination service + XLA collectives
+over ICI/DCN are the only distributed substrate.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks run inside each worker actor around the training function."""
+
+    def __init__(self, config: Optional[BackendConfig] = None):
+        self.config = config
+
+    def on_start(self, worker_ctx: Dict[str, Any]) -> None:
+        """worker_ctx: {world_rank, world_size, master_addr, master_port,
+        local_rank, num_workers}."""
+
+    def on_shutdown(self) -> None:
+        pass
+
+
+class JaxConfig(BackendConfig):
+    """reference: train/v2/jax/config.py:21 JaxConfig — TPU-SPMD backend."""
+
+    def __init__(self, use_tpu: bool = True,
+                 coordinator_port: int = 0):
+        self.use_tpu = use_tpu
+        self.coordinator_port = coordinator_port
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    """Forms the jax.distributed world (reference:
+    train/v2/jax/config.py:29-57 _setup_jax_environment): every worker calls
+    jax.distributed.initialize(coordinator, num_processes, process_id); XLA
+    then sees the full multi-host device set and pjit shards over it."""
+
+    def __init__(self, config: JaxConfig):
+        self.config = config
+        self._initialized = False
+
+    def on_start(self, worker_ctx: Dict[str, Any]) -> None:
+        if worker_ctx["world_size"] <= 1:
+            # Single worker: jax works standalone; don't start a coordinator.
+            return
+        import jax
+        coordinator = (f"{worker_ctx['master_addr']}:"
+                       f"{worker_ctx['master_port']}")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=worker_ctx["world_size"],
+            process_id=worker_ctx["world_rank"])
+        self._initialized = True
+
+    def on_shutdown(self) -> None:
+        if self._initialized:
+            import jax
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._initialized = False

@@ -1,0 +1,67 @@
+"""Flagship integration: JaxTrainer + sharded Llama-style training loop.
+
+The "ONE model" gate from SURVEY.md §7 build-order step 4: controller actor +
+worker group + jax backend running the models/transformer.py train step over
+a mesh, with orbax checkpointing reported through ray_tpu.train.  The same
+loop covers v5e-64 (use_tpu=True, num_workers = hosts) and the CPU test mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def transformer_train_loop(config: Dict[str, Any]) -> None:
+    """train_loop_per_worker for JaxTrainer."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.models import PRESETS, make_train_step
+    from ray_tpu.models.train_step import make_optimizer
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = PRESETS[config.get("preset", "tiny")]
+    mesh_spec = MeshSpec(**config.get("mesh", {"dp": -1}))
+    mesh = build_mesh(mesh_spec)
+    bundle = make_train_step(
+        cfg, mesh,
+        optimizer=make_optimizer(
+            learning_rate=config.get("lr", 1e-2),
+            warmup_steps=config.get("warmup", 1),
+            decay_steps=config.get("steps", 10) * 2))
+
+    resume = config.get("resume_from_checkpoint")
+    start_step = 0
+    if resume:
+        import orbax.checkpoint as ocp
+        restored = ocp.StandardCheckpointer().restore(
+            os.path.join(resume, "state"))
+        state = jax.device_put(restored, bundle.state_shardings)
+        start_step = int(state["step"])
+    else:
+        state = bundle.init(jax.random.key(config.get("seed", 0)))
+
+    rng = np.random.default_rng(config.get("seed", 0))
+    B, S = config.get("batch", 8), config.get("seq", 64)
+    ckpt_every = config.get("checkpoint_every", 0)
+
+    for step in range(start_step, config.get("steps", 10)):
+        batch = {"tokens": jnp.asarray(
+            rng.integers(1, cfg.vocab_size, (B, S + 1)), jnp.int32)}
+        state, metrics = bundle.step(state, batch)
+        loss = float(metrics["loss"])
+        ckpt = None
+        if ckpt_every and (step + 1) % ckpt_every == 0:
+            import orbax.checkpoint as ocp
+            d = tempfile.mkdtemp(prefix="transformer_ckpt_")
+            ocp.StandardCheckpointer().save(
+                os.path.join(d, "state"), jax.device_get(state))
+            ckpt = train.Checkpoint.from_directory(d)
+        train.report({"step": step, "loss": loss,
+                      "grad_norm": float(metrics["grad_norm"])},
+                     checkpoint=ckpt)

@@ -1,0 +1,172 @@
+"""WorkerGroup: gang of training worker actors over a placement group.
+
+Reference: python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:104 — creates a SPREAD placement group (:277) and one actor
+per worker with a bundle index (:398); each worker runs
+train_loop_per_worker in a thread and surfaces report()s for the controller
+to poll.  TPU twist: resources_per_worker={"TPU": chips_per_host} and the
+gang rides a slice reservation (ray_tpu.tpu.reserve_tpu_slice).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          remove_placement_group)
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One training worker process (reference: v2 worker actors).  The
+    train fn runs on a daemon thread so poll()/drain() stay responsive."""
+
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 storage_path: str):
+        from ._session import init_session
+        self._ctx = {"world_rank": world_rank, "world_size": world_size,
+                     "local_rank": local_rank,
+                     "master_addr": "", "master_port": 0}
+        self.session = init_session(
+            world_rank=world_rank, world_size=world_size,
+            local_rank=local_rank, storage_path=storage_path)
+        self._backend = None
+        self._thread: Optional[threading.Thread] = None
+
+    def setup_backend(self, backend_config, master_addr: str,
+                      master_port: int) -> bool:
+        self._ctx["master_addr"] = master_addr
+        self._ctx["master_port"] = master_port
+        self._backend = backend_config.backend_cls()(backend_config)
+        self._backend.on_start(self._ctx)
+        return True
+
+    def address(self) -> tuple:
+        """(host, free_port) of this worker — rank 0's becomes the jax
+        coordinator address."""
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return ("127.0.0.1", port)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any]
+                       ) -> bool:
+        session = self.session
+
+        def _run():
+            session.state = "running"
+            try:
+                import inspect
+                sig = inspect.signature(train_fn)
+                result = (train_fn(config) if len(sig.parameters) >= 1
+                          else train_fn())
+                session.result = result
+                session.state = "finished"
+            except BaseException:  # noqa: BLE001 — report, don't kill actor
+                session.error = traceback.format_exc()
+                session.state = "error"
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="train_loop")
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        return {"state": self.session.state,
+                "error": self.session.error,
+                "reports": self.session.drain()}
+
+    def get_result(self):
+        return self.session.result
+
+    def shutdown_backend(self) -> bool:
+        if self._backend is not None:
+            self._backend.on_shutdown()
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, *, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 storage_path: str = "",
+                 placement_strategy: str = "SPREAD",
+                 pg=None):
+        self.num_workers = num_workers
+        self.resources_per_worker = dict(resources_per_worker or {"CPU": 1})
+        self.storage_path = storage_path
+        self.placement_strategy = placement_strategy
+        self._external_pg = pg is not None
+        self.pg = pg
+        self.workers: List[Any] = []
+
+    def start(self, backend_config, timeout_s: float = 120.0) -> None:
+        if self.pg is None:
+            bundles = [dict(self.resources_per_worker)
+                       for _ in range(self.num_workers)]
+            self.pg = placement_group(bundles,
+                                      strategy=self.placement_strategy)
+            if not self.pg.wait(timeout_s):
+                raise TimeoutError(
+                    f"placement group for {self.num_workers} workers "
+                    f"x {self.resources_per_worker} not placed in "
+                    f"{timeout_s}s")
+        def make_worker(rank):
+            num_cpus = self.resources_per_worker.get("CPU", 0)
+            num_tpus = self.resources_per_worker.get("TPU", 0)
+            extra = {k: v for k, v in self.resources_per_worker.items()
+                     if k not in ("CPU", "TPU")}
+            return TrainWorker.options(
+                num_cpus=num_cpus, num_tpus=num_tpus, resources=extra,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg,
+                    placement_group_bundle_index=rank),
+            ).remote(world_rank=rank, world_size=self.num_workers,
+                     local_rank=0, storage_path=self.storage_path)
+
+        self.workers = [make_worker(r) for r in range(self.num_workers)]
+        # Rank 0 supplies the jax.distributed coordinator address
+        # (reference: _JaxBackend master_addr from worker 0,
+        # train/v2/jax/config.py:29-57).
+        master_addr, master_port = ray_tpu.get(
+            self.workers[0].address.remote(), timeout=60)
+        self._master = (master_addr, master_port)
+        ray_tpu.get([w.setup_backend.remote(backend_config, master_addr,
+                                            master_port)
+                     for w in self.workers], timeout=300)
+
+    def run(self, train_fn: Callable, config: Dict[str, Any]) -> None:
+        ray_tpu.get([w.start_training.remote(train_fn, config)
+                     for w in self.workers], timeout=60)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers],
+                           timeout=60)
+
+    def results(self) -> List[Any]:
+        return ray_tpu.get([w.get_result.remote() for w in self.workers],
+                           timeout=120)
+
+    def shutdown(self, kill_workers: bool = True) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.get(w.shutdown_backend.remote(), timeout=10)
+            except Exception:
+                pass
+            if kill_workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+        self.workers = []
+        if self.pg is not None and not self._external_pg:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
