@@ -9,12 +9,32 @@ manages directories.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import shutil
+import tarfile
 import tempfile
 import time
 from typing import Any, Dict, List, Optional
+
+
+def pack_directory(path: str) -> bytes:
+    """Tar a checkpoint directory into bytes so it can travel between
+    nodes through actor replies / the object store (reference ships
+    checkpoints via StorageContext cloud fs; we ship via the object
+    plane when no shared filesystem exists)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+def unpack_directory(data: bytes, dest: str) -> str:
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+        tar.extractall(dest, filter="data")
+    return dest
 
 
 class Checkpoint:
@@ -26,6 +46,15 @@ class Checkpoint:
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
+
+    def pack(self) -> bytes:
+        return pack_directory(self.path)
+
+    @classmethod
+    def unpack(cls, data: bytes,
+               dest: Optional[str] = None) -> "Checkpoint":
+        dest = dest or tempfile.mkdtemp(prefix="raytpu_ckpt_")
+        return cls(unpack_directory(data, dest))
 
     def to_directory(self, dest: Optional[str] = None) -> str:
         dest = dest or tempfile.mkdtemp(prefix="raytpu_ckpt_")
@@ -58,14 +87,35 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self.entries: List[Dict[str, Any]] = []   # {path, metrics, time}
+        self._seq = 0   # monotonic — dir names stay unique across eviction
         os.makedirs(storage_path, exist_ok=True)
 
+    def _next_dest(self) -> str:
+        # seq keeps ordering readable; the nanosecond stamp keeps names
+        # unique across manager instances reusing one storage_path (a
+        # rerun must never merge files into an older run's checkpoint).
+        self._seq += 1
+        return os.path.join(
+            self.storage_path,
+            f"checkpoint_{self._seq:06d}_{time.time_ns():x}")
+
+    def register_packed(self, data: bytes,
+                        metrics: Dict[str, Any]) -> str:
+        """Persist a worker-shipped packed checkpoint (tar bytes) into
+        storage.  Workers and controller need not share a filesystem."""
+        dest = self._next_dest()
+        unpack_directory(data, dest)
+        return self._finish(dest, metrics)
+
     def register(self, src_path: str, metrics: Dict[str, Any]) -> str:
-        """Persist a worker-reported checkpoint dir into storage."""
-        name = f"checkpoint_{len(self.entries):06d}_{int(time.time())}"
-        dest = os.path.join(self.storage_path, name)
+        """Persist a worker-reported checkpoint dir into storage (same-
+        filesystem path; cross-node flows use register_packed)."""
+        dest = self._next_dest()
         if os.path.abspath(src_path) != dest:
             shutil.copytree(src_path, dest, dirs_exist_ok=True)
+        return self._finish(dest, metrics)
+
+    def _finish(self, dest: str, metrics: Dict[str, Any]) -> str:
         with open(os.path.join(dest, "_metrics.json"), "w") as f:
             json.dump({k: v for k, v in metrics.items()
                        if isinstance(v, (int, float, str, bool))}, f)

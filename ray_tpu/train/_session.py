@@ -33,11 +33,15 @@ class TrainSession:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional["Checkpoint"] = None) -> None:
-        from ._checkpoint import Checkpoint
         entry: Dict[str, Any] = {"metrics": dict(metrics),
                                  "rank": self.world_rank}
-        if checkpoint is not None:
-            entry["checkpoint_path"] = checkpoint.path
+        if checkpoint is not None and self.world_rank == 0:
+            # Ship the directory contents, not a path: the controller may
+            # live on another node with no shared filesystem (reference
+            # uses a shared StorageContext; our transport is the poll RPC
+            # / object plane).  Only rank 0's checkpoint is registered by
+            # the controller, so other ranks don't pay the pack cost.
+            entry["checkpoint_packed"] = checkpoint.pack()
         with self.lock:
             self.report_seq += 1
             entry["seq"] = self.report_seq

@@ -34,12 +34,20 @@ class Backend:
 
 
 class JaxConfig(BackendConfig):
-    """reference: train/v2/jax/config.py:21 JaxConfig — TPU-SPMD backend."""
+    """reference: train/v2/jax/config.py:21 JaxConfig — TPU-SPMD backend.
+
+    cpu_devices_per_process: when use_tpu=False each worker process is
+    pinned to this many virtual CPU devices BEFORE the jax backend
+    initializes.  Without the pin every worker inherits the driver's
+    --xla_force_host_platform_device_count (e.g. 8) and an N-process world
+    sees N*8 devices instead of N*cpu_devices_per_process."""
 
     def __init__(self, use_tpu: bool = True,
-                 coordinator_port: int = 0):
+                 coordinator_port: int = 0,
+                 cpu_devices_per_process: int = 1):
         self.use_tpu = use_tpu
         self.coordinator_port = coordinator_port
+        self.cpu_devices_per_process = cpu_devices_per_process
 
     def backend_cls(self):
         return _JaxBackend
@@ -55,7 +63,40 @@ class _JaxBackend(Backend):
         self.config = config
         self._initialized = False
 
+    def _pin_local_devices(self, strict: bool) -> None:
+        """Pin this worker's platform + local device count before backend
+        init (reference: config.py:29-57 sets JAX_PLATFORMS per worker).
+        On TPU the host's chips define local devices; on CPU we must fix
+        the per-process virtual device count explicitly."""
+        import jax
+        if self.config.use_tpu:
+            os.environ.setdefault("JAX_PLATFORMS", "tpu")
+            return
+        n = self.config.cpu_devices_per_process
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(f for f in flags.split()
+                         if "xla_force_host_platform_device_count" not in f)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", n)
+        except RuntimeError as e:
+            # Backend already initialized in this process — device count
+            # can no longer change.  Only fatal if the count is wrong AND
+            # we are forming a multi-process world (which would silently
+            # mis-size otherwise); a solo worker just keeps its devices.
+            if strict and len(jax.local_devices()) != n:
+                raise RuntimeError(
+                    "jax backend already initialized with "
+                    f"{len(jax.local_devices())} local devices before "
+                    f"_JaxBackend could pin it to {n}; TrainWorker "
+                    "processes must not touch jax before setup_backend()"
+                ) from e
+
     def on_start(self, worker_ctx: Dict[str, Any]) -> None:
+        self._pin_local_devices(strict=worker_ctx["world_size"] > 1)
         if worker_ctx["world_size"] <= 1:
             # Single worker: jax works standalone; don't start a coordinator.
             return

@@ -60,11 +60,12 @@ class TrainController:
     def _ingest(self, polls: List[Dict[str, Any]]):
         for poll in polls:
             for rep in poll["reports"]:
-                if rep.get("rank") == 0:
-                    self.metrics_history.append(rep["metrics"])
-                if rep.get("checkpoint_path") and rep.get("rank") == 0:
-                    self.checkpoint_manager.register(
-                        rep["checkpoint_path"], rep["metrics"])
+                if rep.get("rank") != 0:
+                    continue
+                self.metrics_history.append(rep["metrics"])
+                if rep.get("checkpoint_packed") is not None:
+                    self.checkpoint_manager.register_packed(
+                        rep["checkpoint_packed"], rep["metrics"])
 
     def run(self) -> "Result":
         from .trainer import Result
@@ -112,8 +113,12 @@ class TrainController:
                                error.splitlines()[-1] if error else "?")
                 latest = self.checkpoint_manager.latest
                 if latest is not None:
+                    # Ship the packed checkpoint so restarted workers can
+                    # land on any node; TrainWorker.start_training unpacks
+                    # it locally and rewrites resume_from_checkpoint to
+                    # the local path.
                     self.config = dict(self.config)
-                    self.config["resume_from_checkpoint"] = latest.path
+                    self.config["_resume_ckpt_packed"] = latest.pack()
                 wg = self._start_group()
         finally:
             wg.shutdown()

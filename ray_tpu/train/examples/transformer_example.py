@@ -35,12 +35,19 @@ def transformer_train_loop(config: Dict[str, Any]) -> None:
             warmup_steps=config.get("warmup", 1),
             decay_steps=config.get("steps", 10) * 2))
 
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+
     resume = config.get("resume_from_checkpoint")
     start_step = 0
     if resume:
-        import orbax.checkpoint as ocp
-        restored = ocp.StandardCheckpointer().restore(
-            os.path.join(resume, "state"))
+        # Restore against an abstract target so the optax NamedTuple
+        # opt_state tree structure survives (a target-less restore returns
+        # raw dicts/lists that device_put cannot match to state_shardings).
+        abstract = jax.eval_shape(
+            lambda: bundle.init(jax.random.key(config.get("seed", 0))))
+        restored = ckptr.restore(os.path.join(resume, "state"),
+                                 target=abstract)
         state = jax.device_put(restored, bundle.state_shardings)
         start_step = int(state["step"])
     else:
@@ -57,10 +64,11 @@ def transformer_train_loop(config: Dict[str, Any]) -> None:
         loss = float(metrics["loss"])
         ckpt = None
         if ckpt_every and (step + 1) % ckpt_every == 0:
-            import orbax.checkpoint as ocp
             d = tempfile.mkdtemp(prefix="transformer_ckpt_")
-            ocp.StandardCheckpointer().save(
-                os.path.join(d, "state"), jax.device_get(state))
+            ckptr.save(os.path.join(d, "state"), jax.device_get(state))
+            # save() is async; the directory must be complete before the
+            # controller copies/packs it.
+            ckptr.wait_until_finished()
             ckpt = train.Checkpoint.from_directory(d)
         train.report({"step": step, "loss": loss,
                       "grad_norm": float(metrics["grad_norm"])},

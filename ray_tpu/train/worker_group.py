@@ -20,6 +20,27 @@ from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
                           remove_placement_group)
 
 
+def _node_ip() -> str:
+    """This worker's node address as other hosts can reach it (reference
+    resolves the node IP for the jax coordinator, train/v2/jax/config.py).
+    Prefer the address this process's agent is registered under; fall back
+    to hostname resolution; loopback only as a last resort."""
+    import socket
+    try:
+        host = ray_tpu._core().agent_address[0]
+        if host not in ("127.0.0.1", "localhost", "0.0.0.0"):
+            return host
+    except Exception:
+        pass
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
 @ray_tpu.remote
 class TrainWorker:
     """One training worker process (reference: v2 worker actors).  The
@@ -36,9 +57,14 @@ class TrainWorker:
             local_rank=local_rank, storage_path=storage_path)
         self._backend = None
         self._thread: Optional[threading.Thread] = None
+        self._port_probe = None
 
     def setup_backend(self, backend_config, master_addr: str,
                       master_port: int) -> bool:
+        probe = getattr(self, "_port_probe", None)
+        if probe is not None:
+            probe.close()
+            self._port_probe = None
         self._ctx["master_addr"] = master_addr
         self._ctx["master_port"] = master_port
         self._backend = backend_config.backend_cls()(backend_config)
@@ -47,17 +73,25 @@ class TrainWorker:
 
     def address(self) -> tuple:
         """(host, free_port) of this worker — rank 0's becomes the jax
-        coordinator address."""
+        coordinator address.  The probe socket is held open (SO_REUSEADDR)
+        until setup_backend hands the port to jax.distributed, narrowing
+        the window in which another process could claim it."""
         import socket
         s = socket.socket()
-        s.bind(("127.0.0.1", 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
         port = s.getsockname()[1]
-        s.close()
-        return ("127.0.0.1", port)
+        self._port_probe = s
+        return (_node_ip(), port)
 
     def start_training(self, train_fn: Callable, config: Dict[str, Any]
                        ) -> bool:
         session = self.session
+        if config.get("_resume_ckpt_packed") is not None:
+            from ._checkpoint import Checkpoint
+            config = dict(config)
+            ckpt = Checkpoint.unpack(config.pop("_resume_ckpt_packed"))
+            config["resume_from_checkpoint"] = ckpt.path
 
         def _run():
             session.state = "running"
