@@ -1,0 +1,16 @@
+"""ray_tpu.data — streaming datasets feeding TPU input pipelines.
+
+Reference: python/ray/data/__init__.py public surface (Dataset, read_*,
+from_*); execution model per _internal/execution/streaming_executor.py:67.
+"""
+
+from ._executor import DataContext
+from .dataset import (DataIterator, Dataset, from_blocks, from_items,
+                      from_numpy, range, read_csv, read_json, read_numpy,
+                      read_parquet)
+
+__all__ = [
+    "DataContext", "DataIterator", "Dataset", "from_blocks", "from_items",
+    "from_numpy", "range", "read_csv", "read_json", "read_numpy",
+    "read_parquet",
+]

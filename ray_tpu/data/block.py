@@ -1,0 +1,78 @@
+"""Block model: the unit of data the streaming executor moves around.
+
+Reference: python/ray/data/block.py (Block/BlockAccessor — Arrow or pandas
+tables).  TPU-first difference: the canonical block is a dict of numpy
+arrays (column-major), because that is exactly what a JAX input pipeline
+feeds to `jax.device_put` — no Arrow detour on the hot path.  Row-oriented
+ops (map/filter/flat_map) view the same block as dicts per row.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List
+
+import numpy as np
+
+# A Block is Dict[str, np.ndarray]; all columns share length.
+Block = Dict[str, np.ndarray]
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
+    """Columnarize a list of row-dicts (reference: block builders,
+    data/_internal/table_block.py)."""
+    if not rows:
+        return {}
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r[k])
+    return {k: np.asarray(v) for k, v in cols.items()}
+
+
+def block_from_items(items: List[Any]) -> Block:
+    """Scalars/arrays become a single "item" column (reference:
+    from_items wraps non-dict rows the same way)."""
+    if items and isinstance(items[0], dict):
+        return block_from_rows(items)
+    return {"item": np.asarray(items)}
+
+
+def block_num_rows(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def block_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    n = block_num_rows(block)
+    keys = list(block.keys())
+    for i in range(n):
+        yield {k: block[k][i] for k in keys}
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def block_take(block: Block, indices: np.ndarray) -> Block:
+    return {k: v[indices] for k, v in block.items()}
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b)]
+    if not blocks:
+        return {}
+    keys = list(blocks[0].keys())
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def split_block(block: Block, target_rows: int) -> List[Block]:
+    n = block_num_rows(block)
+    if n <= target_rows:
+        return [block] if n else []
+    return [block_slice(block, i, min(i + target_rows, n))
+            for i in range(0, n, target_rows)]
+
+
+def block_size_bytes(block: Block) -> int:
+    return sum(int(np.asarray(v).nbytes) for v in block.values())

@@ -1,0 +1,371 @@
+"""Dataset: lazy, sharded, streaming-executed data pipelines.
+
+Reference: python/ray/data/dataset.py (Dataset API), read_api.py (sources),
+_internal/execution/streaming_executor.py (execution).  TPU-first design:
+blocks are dicts of numpy arrays (the JAX feed format), per-worker shards
+are deterministic read-task slices (replayable for lineage-style recovery),
+and Train workers run their shard pipeline inline on-host instead of
+round-tripping a split coordinator.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from . import _plan
+from ._executor import execute_local, execute_streaming
+from ._plan import Operator, Plan
+from .block import (Block, block_num_rows, block_rows, block_slice,
+                    concat_blocks, split_block)
+
+
+class Dataset:
+    def __init__(self, plan: Plan):
+        self._plan = plan
+
+    # ------------------------------------------------------------ transforms
+
+    def _materialize_if_limited(self) -> "Dataset":
+        """limit() caps the stream at plan level; any further transform
+        or split first materializes the (bounded, hence cheap) prefix so
+        limit-then-op keeps reference semantics."""
+        if self._plan.limit is not None:
+            return self.materialize()
+        return self
+
+    def _with_op(self, op: Operator) -> "Dataset":
+        return Dataset(self._materialize_if_limited()._plan.with_op(op))
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    compute: Optional[str] = None,
+                    fn_args: tuple = (), fn_kwargs: Optional[Dict] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[Dict] = None,
+                    concurrency: Optional[int] = None,
+                    num_cpus: float = 1.0) -> "Dataset":
+        """fn: Dict[str, np.ndarray] -> Dict[str, np.ndarray] (or a class
+        whose instances are such callables → runs on an actor pool).
+        Reference: dataset.py map_batches / operators/map_operator.py."""
+        fn_kwargs = fn_kwargs or {}
+        if isinstance(fn, type):
+            ctor_kwargs = fn_constructor_kwargs or {}
+            ctor = functools.partial(fn, *fn_constructor_args,
+                                     **ctor_kwargs)
+            op = Operator(
+                name=f"MapBatches({fn.__name__})",
+                transform_from_fn=functools.partial(
+                    _plan.make_map_batches, batch_size=batch_size,
+                    fn_kwargs=fn_kwargs, fn_args=fn_args),
+                fn_constructor=ctor,
+                compute=compute or "actors",
+                actor_pool_size=concurrency or 2,
+                num_cpus=num_cpus)
+        else:
+            op = Operator(
+                name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
+                transform=_plan.make_map_batches(fn, batch_size,
+                                                 fn_kwargs, fn_args),
+                compute=compute or "tasks", num_cpus=num_cpus)
+        return self._with_op(op)
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._with_op(Operator(
+            name=f"Map({getattr(fn, '__name__', 'fn')})",
+            transform=_plan.make_map_rows(fn)))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return self._with_op(Operator(
+            name="FlatMap", transform=_plan.make_flat_map(fn)))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return self._with_op(Operator(
+            name="Filter", transform=_plan.make_filter(fn)))
+
+    def add_column(self, name: str,
+                   fn: Callable[[Block], np.ndarray]) -> "Dataset":
+        return self._with_op(Operator(
+            name=f"AddColumn({name})",
+            transform=_plan.make_add_column(name, fn)))
+
+    def drop_columns(self, names: List[str]) -> "Dataset":
+        return self._with_op(Operator(
+            name="DropColumns", transform=_plan.make_drop_columns(names)))
+
+    def select_columns(self, names: List[str]) -> "Dataset":
+        return self._with_op(Operator(
+            name="SelectColumns",
+            transform=_plan.make_select_columns(names)))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Permutes read-task order + rows within each block (applied at
+        the read stage, before this dataset's ops).  A windowed shuffle
+        (window = block), not the reference's full cluster-wide shuffle
+        (hash_shuffle.py) — sufficient to decorrelate training batches
+        without materializing the dataset."""
+        base = self._materialize_if_limited()._plan
+        rng = np.random.default_rng(seed)
+        tasks = list(base.read_tasks)
+        order = rng.permutation(len(tasks))
+        seeds = (rng.integers(2**31, size=len(tasks))
+                 if seed is not None else [None] * len(tasks))
+        shuffled = [_plan.shuffled_read_task(tasks[i], None if s is None
+                                             else int(s))
+                    for i, s in zip(order, seeds)]
+        return Dataset(Plan(shuffled, list(base.ops)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materializing barrier (reference: repartition is an all-to-all
+        op)."""
+        blocks = list(self.iter_internal_blocks())
+        merged = concat_blocks(blocks)
+        n = block_num_rows(merged)
+        per = max(1, -(-n // num_blocks))
+        pieces = split_block(merged, per)
+        return from_blocks(pieces)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        base = self._materialize_if_limited()._plan
+        tasks = list(base.read_tasks)
+        ops = list(base.ops)
+        for o in others:
+            o = o._materialize_if_limited()
+            if o._plan.ops != ops:
+                # Fold each side's ops into its read tasks for mixed unions.
+                raise ValueError(
+                    "union requires identical downstream ops; materialize "
+                    "first")
+            tasks += o._plan.read_tasks
+        return Dataset(Plan(tasks, ops))
+
+    def limit(self, n: int) -> "Dataset":
+        import dataclasses
+        cur = self._plan.limit
+        return Dataset(dataclasses.replace(
+            self._plan, limit=n if cur is None else min(n, cur)))
+
+    # ----------------------------------------------------------- consumption
+
+    def iter_internal_blocks(self, local: bool = False) -> Iterator[Block]:
+        it = execute_local(self._plan) if local else \
+            execute_streaming(self._plan)
+        if self._plan.limit is not None:
+            it = _limit_blocks(it, self._plan.limit)
+        yield from it
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     local: bool = False) -> Iterator[Block]:
+        yield from _rebatch(self.iter_internal_blocks(local=local),
+                            batch_size, drop_last)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for b in self.iter_internal_blocks():
+            yield from block_rows(b)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for r in self.iter_rows():
+            out.append({k: (v.item() if hasattr(v, "item") and
+                            np.asarray(v).ndim == 0 else v)
+                        for k, v in r.items()})
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return self.take(n=2**62)
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_internal_blocks())
+
+    def schema(self) -> Dict[str, str]:
+        for b in self.iter_internal_blocks(local=len(self._plan.ops) == 0):
+            return {k: str(v.dtype) for k, v in b.items()}
+        return {}
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result reads from in-memory blocks."""
+        return from_blocks(list(self.iter_internal_blocks()))
+
+    def num_blocks(self) -> int:
+        return len(self._plan.read_tasks)
+
+    def stats(self) -> str:
+        return (f"Dataset(read_tasks={len(self._plan.read_tasks)}, "
+                f"ops={[op.name for op in self._plan.ops]})")
+
+    # -------------------------------------------------------------- sharding
+
+    def streaming_split(self, n: int, *, equal: bool = False
+                        ) -> List["DataIterator"]:
+        """n deterministic shards (reference: dataset.py streaming_split
+        feeding Train workers).  Shard i takes read tasks i, i+n, ... —
+        replayable, so a restarted worker re-derives its exact stream.
+        equal=True materializes and redistributes so every shard has the
+        same row count (gang-synchronized SPMD loops hang if one rank
+        runs out of batches early)."""
+        base = self._materialize_if_limited()
+        if equal:
+            merged = concat_blocks(list(base.iter_internal_blocks()))
+            rows = block_num_rows(merged)
+            per = rows // n
+            shards = [from_blocks(
+                [block_slice(merged, i * per, (i + 1) * per)])
+                for i in builtins.range(n)]
+            return [DataIterator(s._plan) for s in shards]
+        return [DataIterator(base._plan.shard(n, i))
+                for i in builtins.range(n)]
+
+    def split(self, n: int) -> List["Dataset"]:
+        base = self._materialize_if_limited()
+        return [Dataset(base._plan.shard(n, i))
+                for i in builtins.range(n)]
+
+    # ---------------------------------------------------------------- output
+
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self.iter_internal_blocks()):
+            with open(os.path.join(path, f"part_{i:06d}.jsonl"), "w") as f:
+                for r in block_rows(b):
+                    f.write(json.dumps({k: (v.item() if hasattr(v, "item")
+                                            else v)
+                                        for k, v in r.items()}) + "\n")
+
+    def write_parquet(self, path: str) -> None:
+        import os
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self.iter_internal_blocks()):
+            pq.write_table(pa.table({k: v for k, v in b.items()}),
+                           os.path.join(path, f"part_{i:06d}.parquet"))
+
+    def __repr__(self):
+        return self.stats()
+
+
+class DataIterator:
+    """A serializable, replayable shard iterator handed to Train workers
+    (reference: data/iterator.py DataIterator /
+    train get_dataset_shard)."""
+
+    def __init__(self, plan: Plan, limit: Optional[int] = None):
+        self._plan = plan
+        self._limit = limit
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Block]:
+        """Runs the shard pipeline inline in this process — a TPU host
+        feeds itself; no driver round-trip."""
+        it = execute_local(self._plan)
+        if self._limit is not None:
+            it = _limit_blocks(it, self._limit)
+        yield from _rebatch(it, batch_size, drop_last)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for b in self.iter_batches(batch_size=4096):
+            yield from block_rows(b)
+
+    def count(self) -> int:
+        it = execute_local(self._plan)
+        if self._limit is not None:
+            it = _limit_blocks(it, self._limit)
+        return sum(block_num_rows(b) for b in it)
+
+
+def _limit_blocks(it: Iterator[Block], limit: int) -> Iterator[Block]:
+    seen = 0
+    for b in it:
+        n = block_num_rows(b)
+        if seen + n >= limit:
+            yield block_slice(b, 0, limit - seen)
+            return
+        seen += n
+        yield b
+
+
+def _rebatch(blocks: Iterator[Block], batch_size: int,
+             drop_last: bool) -> Iterator[Block]:
+    """Re-chunk a block stream into exact batch_size batches across block
+    boundaries (reference: _internal/block_batching).  One concat per
+    incoming block + a moving offset — emitting B batches from an N-row
+    block costs O(N), not O(N^2/B)."""
+    buf: Optional[Block] = None
+    off = 0
+    for b in blocks:
+        if block_num_rows(b) == 0:
+            continue
+        if buf is None or off >= block_num_rows(buf):
+            buf, off = b, 0
+        else:
+            buf = concat_blocks([block_slice(buf, off,
+                                             block_num_rows(buf)), b])
+            off = 0
+        while block_num_rows(buf) - off >= batch_size:
+            yield block_slice(buf, off, off + batch_size)
+            off += batch_size
+    if buf is not None and off < block_num_rows(buf) and not drop_last:
+        yield block_slice(buf, off, block_num_rows(buf))
+
+
+# ------------------------------------------------------------------- sources
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    def make(b: Block):
+        return lambda: [b]
+    return Dataset(Plan([make(b) for b in blocks], []))
+
+
+def range(n: int, *, parallelism: int = 16) -> Dataset:  # noqa: A001
+    return Dataset(Plan(_plan.range_read_tasks(n, parallelism), []))
+
+
+def from_items(items: List[Any], *, parallelism: int = 16) -> Dataset:
+    return Dataset(Plan(_plan.items_read_tasks(items, parallelism), []))
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 16) -> Dataset:
+    chunks = np.array_split(arr, max(1, min(parallelism, len(arr) or 1)))
+    return from_blocks([{"data": c} for c in chunks if len(c)])
+
+
+def _expand(paths) -> List[str]:
+    import glob
+    import os
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def read_numpy(paths) -> Dataset:
+    return Dataset(Plan(_plan.numpy_read_tasks(_expand(paths)), []))
+
+
+def read_json(paths) -> Dataset:
+    return Dataset(Plan(_plan.json_read_tasks(_expand(paths)), []))
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset(Plan(_plan.csv_read_tasks(_expand(paths)), []))
+
+
+def read_parquet(paths) -> Dataset:
+    return Dataset(Plan(_plan.parquet_read_tasks(_expand(paths)), []))

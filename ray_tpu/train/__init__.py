@@ -7,14 +7,16 @@ CheckpointConfig, Result, DataParallelTrainer) and train/v2/jax
 """
 
 from ._checkpoint import Checkpoint, CheckpointManager
-from ._session import TrainContext, get_context, report
+from ._session import (TrainContext, get_context, get_dataset_shard,
+                       report)
 from .backend import Backend, BackendConfig, JaxConfig
 from .trainer import (CheckpointConfig, DataParallelTrainer, FailureConfig,
                       JaxTrainer, Result, RunConfig, ScalingConfig)
 from .worker_group import WorkerGroup
 
 __all__ = [
-    "report", "get_context", "TrainContext", "Checkpoint",
+    "report", "get_context", "get_dataset_shard", "TrainContext",
+    "Checkpoint",
     "CheckpointManager", "Backend", "BackendConfig", "JaxConfig",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",

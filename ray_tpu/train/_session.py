@@ -30,6 +30,9 @@ class TrainSession:
         self.error: Optional[str] = None
         self.result: Any = None
         self.report_seq = 0
+        # name -> DataIterator for this worker's shard (reference:
+        # train session dataset_shard plumbing).
+        self.dataset_shards: Dict[str, Any] = {}
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional["Checkpoint"] = None) -> None:
@@ -98,3 +101,18 @@ class TrainContext:
 
 def get_context() -> TrainContext:
     return TrainContext()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's deterministic shard of a Dataset passed to the
+    trainer (reference: ray.train.get_dataset_shard) — a
+    ray_tpu.data.DataIterator whose pipeline runs inline on this host."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() called outside a "
+                           "training worker")
+    if name not in s.dataset_shards:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(have: {sorted(s.dataset_shards)})")
+    return s.dataset_shards[name]

@@ -92,6 +92,13 @@ class TrainWorker:
             config = dict(config)
             ckpt = Checkpoint.unpack(config.pop("_resume_ckpt_packed"))
             config["resume_from_checkpoint"] = ckpt.path
+        if config.get("_datasets"):
+            config = dict(config)
+            datasets = config.pop("_datasets")
+            rank, world = session.world_rank, session.world_size
+            session.dataset_shards = {
+                name: ds.streaming_split(world)[rank]
+                for name, ds in datasets.items()}
 
         def _run():
             session.state = "running"
