@@ -97,12 +97,32 @@ def main():
         peak = 1e12  # nominal CPU number; the line is a smoke signal only
     mfu = tok_s * flops_per_tok / peak * 100.0
 
+    # Core-runtime microbenchmarks vs BASELINE.md (reference:
+    # ray_perf.py suite); embedded in the same JSON line so the driver's
+    # single-line parse still works.  Failures here must not cost the
+    # headline metric.
+    micro = {}
+    try:
+        import multiprocessing
+
+        import ray_tpu
+        from ray_tpu.util.perf import run_microbenchmarks
+        ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
+        try:
+            micro = {k: [v["value"], v["vs_ref"]]
+                     for k, v in run_microbenchmarks(min_time_s=1.0).items()}
+        finally:
+            ray_tpu.shutdown()
+    except Exception as e:   # pragma: no cover - defensive
+        micro = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "train_mfu_pct",
         "value": round(mfu, 2),
         "unit": "%% of chip peak (tokens/s/chip=%d, model=%dM params)" % (
             int(tok_s), cfg.param_count() // 1_000_000),
         "vs_baseline": round(mfu / 40.0, 3),
+        "micro_value_vs_ref": micro,
     }))
 
 

@@ -96,26 +96,27 @@ class ActorID(BaseID):
 
 
 class TaskID(BaseID):
-    """16 bytes: 4 unique + 12 actor id (or 8 unique + 4 job for normal tasks
-    padded into the actor field). The parent task is the *owner* of the task's
-    return objects."""
+    """16 bytes, job id in the last 4.  Normal tasks carry 12 random bytes
+    (96-bit entropy: the submit fast path mints ids at >10k/s, so the
+    4-byte uniqueness the reference derives from (parent id, index) chains
+    would birthday-collide within ~1e5 tasks); actor tasks carry 8 random
+    + the actor's 4-byte random prefix.  The parent task is the *owner* of
+    the task's return objects."""
 
     SIZE = 16
 
     @classmethod
     def for_normal_task(cls, job_id: JobID):
-        return cls(os.urandom(4) + _NIL * 8 + job_id.binary())
+        return cls(os.urandom(12) + job_id.binary())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID):
-        return cls(os.urandom(4) + actor_id.binary())
+        return cls(os.urandom(8) + actor_id.binary()[:4]
+                   + actor_id.job_id().binary())
 
     @classmethod
     def for_driver(cls, job_id: JobID):
-        return cls(_NIL * 4 + _NIL * 8 + job_id.binary())
-
-    def actor_id(self) -> ActorID:
-        return ActorID(self._bin[4:16])
+        return cls(_NIL * 12 + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._bin[12:16])

@@ -23,6 +23,7 @@ class RemoteFunction:
         self._scheduling_strategy = scheduling_strategy
         self._runtime_env = runtime_env
         self._name = name or getattr(fn, "__name__", "fn")
+        self._export_blob: Optional[bytes] = None
         self._fn_id: Optional[bytes] = None  # cached after first export
         functools.update_wrapper(self, fn)
 
@@ -57,13 +58,18 @@ class RemoteFunction:
         max_retries = (self._max_retries if self._max_retries is not None
                        else get_config().task_max_retries_default)
         if self._fn_id is None:
+            # Pickle the code object ONCE per RemoteFunction; later calls
+            # ride the core's fast path keyed on this id.  Blob is
+            # published before the id: a racing thread that sees a
+            # non-None _fn_id must also see the blob.
             blob = get_context().dumps_code(self._fn)
-            self._fn_id = protocol.function_id(blob)
             self._export_blob = blob
+            self._fn_id = protocol.function_id(blob)
         refs = core.submit_task(
-            fn=self._fn, fn_id=None, args=args, kwargs=kwargs,
+            fn=self._fn, fn_id=self._fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns, resources=self._resource_dict(),
             max_retries=max_retries,
             scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
-            runtime_env=self._runtime_env, name=self._name)
+            runtime_env=self._runtime_env, name=self._name,
+            fn_blob=self._export_blob)
         return refs[0] if self._num_returns == 1 else refs

@@ -1,0 +1,234 @@
+"""Core-runtime microbenchmarks vs BASELINE.md.
+
+Reference: python/ray/_private/ray_perf.py — the suite whose committed
+numbers (release/perf_metrics/microbenchmark.json) define the reference's
+core-throughput envelope: tasks/s, actor calls/s, put/get calls/s, put
+GiB/s, wait on many refs, PG create/remove.  Run with an initialized
+cluster, or as `python -m ray_tpu.util.perf` (which initializes one).
+
+Each benchmark is time-budgeted: batches repeat until `min_time_s` has
+elapsed, so quick mode keeps the whole suite to a few seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+import ray_tpu
+
+
+def _timeit(run_batch: Callable[[], int], min_time_s: float) -> float:
+    """ops/s of run_batch (returns #ops) repeated for >= min_time_s."""
+    run_batch()  # warmup
+    total_ops = 0
+    t0 = time.perf_counter()
+    while True:
+        total_ops += run_batch()
+        dt = time.perf_counter() - t0
+        if dt >= min_time_s:
+            return total_ops / dt
+
+
+@ray_tpu.remote
+def _noop(*args):
+    return None
+
+
+@ray_tpu.remote
+class _Sink:
+    def ping(self):
+        return None
+
+
+def bench_tasks_sync(min_time_s: float, batch: int = 20) -> float:
+    def run():
+        for _ in range(batch):
+            ray_tpu.get(_noop.remote())
+        return batch
+    return _timeit(run, min_time_s)
+
+
+def bench_tasks_async(min_time_s: float, batch: int = 200) -> float:
+    def run():
+        ray_tpu.get([_noop.remote() for _ in range(batch)])
+        return batch
+    return _timeit(run, min_time_s)
+
+
+def bench_actor_calls_sync(min_time_s: float, batch: int = 20) -> float:
+    a = _Sink.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def run():
+        for _ in range(batch):
+            ray_tpu.get(a.ping.remote())
+        return batch
+    try:
+        return _timeit(run, min_time_s)
+    finally:
+        ray_tpu.kill(a)
+
+
+def bench_actor_calls_async(min_time_s: float, batch: int = 200) -> float:
+    a = _Sink.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def run():
+        ray_tpu.get([a.ping.remote() for _ in range(batch)])
+        return batch
+    try:
+        return _timeit(run, min_time_s)
+    finally:
+        ray_tpu.kill(a)
+
+
+def bench_n_n_actor_calls(min_time_s: float, n: int = 4,
+                          batch: int = 50) -> float:
+    actors = [_Sink.remote() for _ in range(n)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+
+    def run():
+        ray_tpu.get([a.ping.remote() for a in actors
+                     for _ in range(batch)])
+        return n * batch
+    try:
+        return _timeit(run, min_time_s)
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def bench_put_calls(min_time_s: float, batch: int = 100) -> float:
+    def run():
+        for i in range(batch):
+            ray_tpu.put(i)
+        return batch
+    return _timeit(run, min_time_s)
+
+
+def bench_get_calls(min_time_s: float, batch: int = 100) -> float:
+    ref = ray_tpu.put(b"x" * 1024)
+
+    def run():
+        for _ in range(batch):
+            ray_tpu.get(ref)
+        return batch
+    return _timeit(run, min_time_s)
+
+
+def bench_put_gigabytes(min_time_s: float,
+                        chunk_mb: int = 64) -> float:
+    """GiB/s of zero-copy puts into the shm store (reference:
+    single_client_put_gigabytes)."""
+    arr = np.random.default_rng(0).bytes(chunk_mb * 1024 * 1024)
+    arr = np.frombuffer(arr, dtype=np.uint8)
+
+    def run():
+        refs = [ray_tpu.put(arr) for _ in range(4)]
+        del refs
+        return 4
+    chunks_per_s = _timeit(run, min_time_s)
+    return chunks_per_s * chunk_mb / 1024.0
+
+
+def bench_wait_many_refs(min_time_s: float, n_refs: int = 1000) -> float:
+    refs = [ray_tpu.put(i) for i in range(n_refs)]
+
+    def run():
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
+        assert len(ready) == len(refs)
+        return 1
+    return _timeit(run, min_time_s)
+
+
+def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    def run():
+        for _ in range(batch):
+            pg = placement_group([{"CPU": 0.01}])
+            pg.wait(10)
+            remove_placement_group(pg)
+        return batch
+    return _timeit(run, min_time_s)
+
+
+BENCHES: Dict[str, Callable[[float], float]] = {
+    # name -> (fn, unit, BASELINE.md reference value)
+    "single_client_tasks_sync": bench_tasks_sync,
+    "single_client_tasks_async": bench_tasks_async,
+    "1_1_actor_calls_sync": bench_actor_calls_sync,
+    "1_1_actor_calls_async": bench_actor_calls_async,
+    "n_n_actor_calls_async": bench_n_n_actor_calls,
+    "single_client_put_calls": bench_put_calls,
+    "single_client_get_calls": bench_get_calls,
+    "single_client_put_gigabytes": bench_put_gigabytes,
+    "single_client_wait_1k_refs": bench_wait_many_refs,
+    "placement_group_create_removal": bench_pg_create_removal,
+}
+
+# Reference values from BASELINE.md (64-core node,
+# release/perf_metrics/microbenchmark.json) for the vs_ref column.
+BASELINE = {
+    "single_client_tasks_sync": 830.0,
+    "single_client_tasks_async": 5868.0,
+    "1_1_actor_calls_sync": 1839.0,
+    "1_1_actor_calls_async": 8399.0,
+    "n_n_actor_calls_async": 23226.0,
+    "single_client_put_calls": 4172.0,
+    "single_client_get_calls": 4031.0,
+    "single_client_put_gigabytes": 18.3,
+    "single_client_wait_1k_refs": 4.4,
+    "placement_group_create_removal": 666.0,
+}
+
+UNITS = {
+    "single_client_put_gigabytes": "GiB/s",
+    "single_client_wait_1k_refs": "waits/s (1k refs)",
+    "placement_group_create_removal": "pg/s",
+}
+
+
+def warmup_cluster(n: int = 200) -> None:
+    """Spawn/prestart the worker pool and export the bench functions so
+    measurements see steady state, not process-spawn latency."""
+    ray_tpu.get([_noop.remote() for _ in range(n)])
+
+
+def run_microbenchmarks(min_time_s: float = 1.0,
+                        only=None) -> Dict[str, Dict[str, Any]]:
+    warmup_cluster()
+    results: Dict[str, Dict[str, Any]] = {}
+    for name, fn in BENCHES.items():
+        if only and name not in only:
+            continue
+        rate = fn(min_time_s)
+        results[name] = {
+            "value": round(rate, 2),
+            "unit": UNITS.get(name, "ops/s"),
+            "vs_ref": round(rate / BASELINE[name], 3),
+        }
+    return results
+
+
+def main():
+    owns = not ray_tpu.is_initialized()
+    if owns:
+        # Logical-CPU oversubscription: the suite measures runtime
+        # overhead, not compute; tiny hosts must still fit the n:n bench.
+        ray_tpu.init(num_cpus=8)
+    try:
+        results = run_microbenchmarks(min_time_s=2.0)
+        for name, r in results.items():
+            print(json.dumps({"metric": name, **r}))
+    finally:
+        if owns:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
