@@ -101,18 +101,19 @@ def main():
     # ray_perf.py suite); embedded in the same JSON line so the driver's
     # single-line parse still works.  Failures here must not cost the
     # headline metric.
+    # Run in a subprocess with a hard timeout: a hang anywhere in the
+    # micro suite (cluster init, a lost task) must not cost the headline
+    # MFU line.
     micro = {}
     try:
-        import multiprocessing
-
-        import ray_tpu
-        from ray_tpu.util.perf import run_microbenchmarks
-        ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
-        try:
-            micro = {k: [v["value"], v["vs_ref"]]
-                     for k, v in run_microbenchmarks(min_time_s=1.0).items()}
-        finally:
-            ray_tpu.shutdown()
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.util.perf", "--compact",
+             "--min-time-s", "1.0"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = proc.stdout.strip().splitlines()[-1]
+        micro = json.loads(line)
     except Exception as e:   # pragma: no cover - defensive
         micro = {"error": str(e)[:200]}
 

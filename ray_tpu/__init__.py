@@ -148,6 +148,10 @@ class _RuntimeContext:
     def get_task_id(self):
         return _core().current_task_id
 
+    def get_actor_id(self):
+        """Actor id when called inside an actor method, else None."""
+        return _core().current_actor_id
+
 
 def get_runtime_context() -> _RuntimeContext:
     return _RuntimeContext()

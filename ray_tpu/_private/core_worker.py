@@ -118,6 +118,7 @@ class CoreWorker:
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
         self.current_task_id: bytes = b""
+        self.current_actor_id: Optional[bytes] = None  # set in actor workers
         self._put_counter = 0
         self._keys: Dict[bytes, _KeyState] = {}
         self._actors: Dict[bytes, _ActorState] = {}
@@ -462,10 +463,11 @@ class CoreWorker:
         for kw, a in items:
             if isinstance(a, ObjectRef):
                 return None          # dependency resolution needs the loop
-            # Cheap size probe before pickling: buffers/arrays that can't
-            # inline would otherwise be serialized here AND again by
-            # _resolve_args on the slow path.
-            approx = (len(a) if isinstance(a, (bytes, bytearray))
+            # Best-effort size probe before pickling: buffers/arrays/
+            # strings that can't inline would otherwise be serialized
+            # here AND again by _resolve_args on the slow path.  (Large
+            # containers without a cheap size still pay double pickling.)
+            approx = (len(a) if isinstance(a, (bytes, bytearray, str))
                       else getattr(a, "nbytes", 0))
             if approx > self._inline_limit:
                 return None

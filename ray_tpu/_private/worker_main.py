@@ -189,6 +189,7 @@ class Executor:
         self.actor = await loop.run_in_executor(
             self.core.executor, lambda: cls(*args, **kwargs))
         self.actor_id = spec["actor_id"]
+        self.core.current_actor_id = spec["actor_id"]
         max_conc = spec.get("max_concurrency", 1) or 1
         self._actor_is_async = any(
             asyncio.iscoroutinefunction(getattr(type(self.actor), m, None))

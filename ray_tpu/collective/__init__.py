@@ -1,0 +1,20 @@
+"""ray_tpu.collective — collective communication on actor/worker groups.
+
+Reference: python/ray/util/collective/__init__.py public surface.
+"""
+
+from .collective import (allgather, allreduce, barrier, broadcast,
+                         create_collective_group, destroy_collective_group,
+                         get_collective_group_size, get_rank,
+                         init_collective_group, is_group_initialized,
+                         recv, reduce, reducescatter, send,
+                         GroupManager, HostCollectiveGroup,
+                         XlaCollectiveGroup)
+
+__all__ = [
+    "allgather", "allreduce", "barrier", "broadcast",
+    "create_collective_group", "destroy_collective_group",
+    "get_collective_group_size", "get_rank", "init_collective_group",
+    "is_group_initialized", "recv", "reduce", "reducescatter", "send",
+    "GroupManager", "HostCollectiveGroup", "XlaCollectiveGroup",
+]

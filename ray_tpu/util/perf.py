@@ -158,7 +158,7 @@ def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
 
 
 BENCHES: Dict[str, Callable[[float], float]] = {
-    # name -> (fn, unit, BASELINE.md reference value)
+    # name -> bench fn; units live in UNITS, reference values in BASELINE
     "single_client_tasks_sync": bench_tasks_sync,
     "single_client_tasks_async": bench_tasks_async,
     "1_1_actor_calls_sync": bench_actor_calls_sync,
@@ -215,16 +215,28 @@ def run_microbenchmarks(min_time_s: float = 1.0,
     return results
 
 
-def main():
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-time-s", type=float, default=2.0)
+    ap.add_argument("--compact", action="store_true",
+                    help="print one JSON dict {name: [value, vs_ref]} "
+                         "(consumed by bench.py)")
+    args = ap.parse_args(argv)
     owns = not ray_tpu.is_initialized()
     if owns:
         # Logical-CPU oversubscription: the suite measures runtime
         # overhead, not compute; tiny hosts must still fit the n:n bench.
-        ray_tpu.init(num_cpus=8)
+        import multiprocessing
+        ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
     try:
-        results = run_microbenchmarks(min_time_s=2.0)
-        for name, r in results.items():
-            print(json.dumps({"metric": name, **r}))
+        results = run_microbenchmarks(min_time_s=args.min_time_s)
+        if args.compact:
+            print(json.dumps({k: [v["value"], v["vs_ref"]]
+                              for k, v in results.items()}))
+        else:
+            for name, r in results.items():
+                print(json.dumps({"metric": name, **r}))
     finally:
         if owns:
             ray_tpu.shutdown()

@@ -1,0 +1,157 @@
+"""Collective API tests (reference model: python/ray/util/collective/tests
+— API parity ops over a group of actors)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Member:
+    """A collective-group member actor running ops in lockstep."""
+
+    def init(self, world_size, rank, group_name):
+        from ray_tpu import collective as col
+        self.col = col
+        self.g = col.init_collective_group(world_size, rank,
+                                           backend="host",
+                                           group_name=group_name)
+        self.rank = rank
+        return True
+
+    def do_allreduce(self, value, op="sum"):
+        out = self.g.allreduce(np.asarray(value, dtype=np.float64), op)
+        return out.tolist()
+
+    def do_allgather(self, value):
+        return [a.tolist() for a in
+                self.g.allgather(np.asarray(value))]
+
+    def do_broadcast(self, value, src):
+        return self.g.broadcast(np.asarray(value), src).tolist()
+
+    def do_reducescatter(self, value):
+        return self.g.reducescatter(np.asarray(value)).tolist()
+
+    def do_reduce(self, value, dst):
+        return self.g.reduce(np.asarray(value, dtype=np.float64), dst).tolist()
+
+    def do_barrier(self):
+        self.g.barrier()
+        return True
+
+    def do_send(self, value, dst):
+        self.g.send(np.asarray(value), dst)
+        return True
+
+    def do_recv(self, src):
+        return self.g.recv(src).tolist()
+
+    def rank_of(self, group_name="default"):
+        from ray_tpu import collective as col
+        return col.get_rank(group_name)
+
+    def declared_allreduce(self, value, group_name):
+        from ray_tpu import collective as col
+        return col.allreduce(np.asarray(value, dtype=np.float64),
+                             group_name=group_name).tolist()
+
+
+def _make_group(n, group_name):
+    actors = [Member.remote() for _ in range(n)]
+    ray_tpu.get([a.init.remote(n, r, group_name)
+                 for r, a in enumerate(actors)])
+    return actors
+
+
+def test_allreduce_allgather(ray_start_regular):
+    actors = _make_group(3, "g1")
+    outs = ray_tpu.get([a.do_allreduce.remote([float(r)])
+                        for r, a in enumerate(actors)])
+    assert outs == [[3.0]] * 3          # 0+1+2
+    gath = ray_tpu.get([a.do_allgather.remote([r * 10])
+                        for r, a in enumerate(actors)])
+    assert gath == [[[0], [10], [20]]] * 3
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_broadcast_reduce_scatter_barrier(ray_start_regular):
+    actors = _make_group(2, "g2")
+    outs = ray_tpu.get([a.do_broadcast.remote([r + 1, r + 2], 0)
+                        for r, a in enumerate(actors)])
+    assert outs == [[1, 2], [1, 2]]
+    rs = ray_tpu.get([a.do_reducescatter.remote([[1.0], [2.0]])
+                      for a in actors])
+    assert rs == [[[2.0]], [[4.0]]]
+    red = ray_tpu.get([a.do_reduce.remote([1.0], 0) for a in actors])
+    assert red[0] == [2.0] and red[1] == [1.0]
+    assert all(ray_tpu.get([a.do_barrier.remote() for a in actors]))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_send_recv(ray_start_regular):
+    actors = _make_group(2, "g3")
+    s = actors[0].do_send.remote([7, 8], 1)
+    r = actors[1].do_recv.remote(0)
+    assert ray_tpu.get(r) == [7, 8]
+    assert ray_tpu.get(s)
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_declarative_group(ray_start_regular):
+    from ray_tpu import collective as col
+    actors = [Member.remote() for _ in range(2)]
+    col.create_collective_group(actors, 2, backend="host",
+                                group_name="decl1")
+    outs = ray_tpu.get([a.declared_allreduce.remote([2.0], "decl1")
+                        for a in actors])
+    assert outs == [[4.0], [4.0]]
+    ranks = sorted(ray_tpu.get([a.rank_of.remote("decl1")
+                                for a in actors]))
+    assert ranks == [0, 1]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_xla_group_single_process(ray_start_regular):
+    """xla backend on a 1-process world (in-graph trivial paths)."""
+    from ray_tpu import collective as col
+    g = col.init_collective_group(1, 0, backend="xla",
+                                  group_name="xla1")
+    out = g.allreduce(np.ones((4,)))
+    assert np.allclose(np.asarray(out), np.ones((4,)))
+    g.barrier()
+    col.destroy_collective_group("xla1")
+
+
+def test_xla_group_in_two_process_world(ray_start_regular):
+    """XlaCollectiveGroup over a real 2-process jax.distributed world via
+    JaxTrainer (the ICI-tier path; SURVEY.md §2.4)."""
+    from ray_tpu.train import (JaxConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu import collective as col
+        from ray_tpu import train
+        ctx = train.get_context()
+        g = col.init_collective_group(2, ctx.get_world_rank(),
+                                      backend="xla", group_name="xici")
+        out = g.allreduce(np.full((2,), float(ctx.get_world_rank() + 1)))
+        bc = g.broadcast(np.asarray([ctx.get_world_rank()]), src_rank=1)
+        g.barrier()
+        train.report({"sum": float(np.asarray(out)[0]),
+                      "bc": float(np.asarray(bc)[0])})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=False,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="xla_col"))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics == {"sum": 3.0, "bc": 1.0}
