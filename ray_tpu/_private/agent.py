@@ -114,16 +114,26 @@ class NodeAgent:
     async def start(self) -> tuple:
         addr = await self._server.start_tcp(self.host, 0)
         self.address = addr
-        self.gcs = await rpc.connect(self.gcs_address, name="agent->gcs",
-                                     handlers={"pubsub": self._on_pubsub})
-        await self.gcs.call("register_node", {
-            "node_id": self.node_id,
-            "address": list(addr),
-            "resources": self.resources_total,
-            "labels": self.labels,
-            "store_path": self.store_path,
-            "session_dir": self.session_dir,
-        })
+
+        async def _register(conn):
+            # Runs on every (re)connect: a restarted GCS replays its
+            # journal with nodes marked not-alive; re-registering brings
+            # this node back (reference: raylet re-registration after
+            # RayletNotifyGCSRestart, core_worker.proto:467).
+            await conn.call("register_node", {
+                "node_id": self.node_id,
+                "address": list(addr),
+                "resources": self.resources_total,
+                "labels": self.labels,
+                "store_path": self.store_path,
+                "session_dir": self.session_dir,
+            })
+
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, name="agent->gcs",
+            handlers={"pubsub": self._on_pubsub},
+            on_reconnect=_register)
+        await self.gcs.ensure()
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         self._tasks.append(asyncio.ensure_future(self._prestart_workers()))
@@ -418,6 +428,14 @@ class NodeAgent:
         """Lease a dedicated worker and instantiate the actor in it
         (reference: GcsActorScheduler leasing from raylet + PushTask of the
         creation task)."""
+        # Idempotence across GCS restarts: if this actor already has a
+        # live worker here (the previous create's reply was lost with the
+        # GCS), return it instead of leasing a second process.
+        for wh in self.leases.values():
+            if (wh.is_actor and wh.actor_id == p["actor_id"]
+                    and wh.conn and not wh.conn.closed):
+                return {"worker_addr": list(wh.address),
+                        "worker_id": wh.worker_id}
         resources = p.get("resources", {})
         strategy = p.get("scheduling_strategy") or {}
         bundle_key = None

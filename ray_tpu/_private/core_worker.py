@@ -176,7 +176,12 @@ class CoreWorker:
     async def _connect(self):
         self._server = rpc.RpcServer(self._handlers(), name=f"cw-{self.mode}")
         self.address = await self._server.start_tcp("127.0.0.1", 0)
-        self.gcs = await rpc.connect(self.gcs_address, name="cw->gcs")
+        # Reconnecting: calls issued across a GCS restart re-dial and
+        # retry once (mutations are id-keyed upserts, so replays are
+        # idempotent).
+        self.gcs = rpc.ReconnectingConnection(self.gcs_address,
+                                              name="cw->gcs")
+        await self.gcs.ensure()
         self.agent = await rpc.connect(self.agent_address, name="cw->agent")
 
     def _handlers(self):

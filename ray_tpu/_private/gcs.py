@@ -19,12 +19,56 @@ import asyncio
 import json
 import logging
 import os
+import struct
 import time
 from typing import Any, Dict, List, Optional
 
 from . import protocol, rpc
 
 logger = logging.getLogger("ray_tpu.gcs")
+
+_JLEN = struct.Struct("<I")
+
+# KV namespaces excluded from the journal: high-churn ephemeral rendezvous
+# state that is worthless after a restart.
+_EPHEMERAL_NS = {"collective"}
+
+
+class Journal:
+    """Length-prefixed msgpack append log of GCS table mutations — the
+    single-host stand-in for the reference's Redis-backed store_client
+    (reference: gcs/store_client/redis_store_client.h; replay on restart
+    per gcs_init_data.cc)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "ab")
+
+    def append(self, kind: str, payload) -> None:
+        data = rpc._pack([kind, payload])
+        self._f.write(_JLEN.pack(len(data)) + data)
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def read(path: str):
+        out = []
+        try:
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (n,) = _JLEN.unpack(hdr)
+                    body = f.read(n)
+                    if len(body) < n:
+                        break    # torn tail write from a crash; ignore
+                    out.append(rpc._unpack(body))
+        except FileNotFoundError:
+            pass
+        return out
 
 
 class NodeInfo:
@@ -80,9 +124,12 @@ class ActorInfo:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 journal_path: Optional[str] = None):
         self.host = host
         self.port = port
+        self.journal_path = journal_path
+        self.journal: Optional[Journal] = None
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
@@ -123,11 +170,93 @@ class GcsServer:
         }
 
     async def start(self):
+        if self.journal_path:
+            self._replay(Journal.read(self.journal_path))
+            self.journal = Journal(self.journal_path)
         addr = await self._server.start_tcp(self.host, self.port)
         self.address = addr
         self._health_task = asyncio.ensure_future(self._health_loop())
-        logger.info("GCS listening on %s", addr)
+        # Re-kick interrupted placement/scheduling loops (their coroutines
+        # died with the previous process; agents re-register shortly).
+        for pg in self.placement_groups.values():
+            if pg["state"] == "PENDING":
+                asyncio.ensure_future(self._place_pg(pg))
+        for actor in self.actors.values():
+            if actor.state in (protocol.ACTOR_PENDING,
+                               protocol.ACTOR_RESTARTING):
+                asyncio.ensure_future(self._reschedule_replayed(actor))
+        logger.info("GCS listening on %s%s", addr,
+                    " (journal replayed)" if self.journal else "")
         return addr
+
+    def _log(self, kind: str, payload) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, payload)
+
+    def _log_actor(self, actor: ActorInfo, with_spec: bool = False) -> None:
+        # Spec is immutable — journaled once at registration; transitions
+        # journal only the (small) view.
+        if with_spec:
+            self._log("actor_spec", {"actor_id": actor.actor_id,
+                                     "spec": actor.spec})
+        self._log("actor_view", actor.view())
+
+    def _replay(self, records) -> None:
+        """Rebuild tables from the journal (reference: gcs_init_data.cc).
+        Nodes replay as not-alive — live agents re-register over their
+        reconnecting GCS connections within a heartbeat."""
+        for kind, p in records:
+            if kind == "kv_put":
+                self.kv.setdefault(p["ns"], {})[p["key"]] = p["value"]
+            elif kind == "kv_del":
+                ns = self.kv.get(p["ns"], {})
+                if p.get("prefix"):
+                    for k in [k for k in ns if k.startswith(p["key"])]:
+                        del ns[k]
+                else:
+                    ns.pop(p["key"], None)
+            elif kind == "job_counter":
+                self._job_counter = max(self._job_counter, p)
+            elif kind == "job":
+                self.jobs[p["job_id"]] = p
+            elif kind == "node":
+                node = NodeInfo(p["node_id"], p["address"], p["resources"],
+                                p.get("labels", {}), p.get("store_path", ""),
+                                p.get("session_dir", ""))
+                node.alive = False
+                self.nodes[node.node_id] = node
+            elif kind == "actor_spec":
+                if p["actor_id"] not in self.actors:
+                    self.actors[p["actor_id"]] = ActorInfo(p["actor_id"],
+                                                           p["spec"])
+            elif kind == "actor_view":
+                actor = self.actors.get(p["actor_id"])
+                if actor is None:
+                    continue    # spec record lost with a torn tail
+                v = p
+                actor.state = v["state"]
+                actor.address = v["address"]
+                actor.node_id = v["node_id"]
+                actor.restarts = v["restarts"]
+                actor.max_restarts = v["max_restarts"]  # kill() zeroes it
+                actor.death_cause = v["death_cause"]
+                if actor.name:
+                    if actor.state != protocol.ACTOR_DEAD:
+                        self.named_actors[actor.name] = actor.actor_id
+                    elif self.named_actors.get(actor.name) == actor.actor_id:
+                        del self.named_actors[actor.name]
+            elif kind == "pg":
+                self.placement_groups[p["pg_id"]] = p
+            elif kind == "pg_del":
+                self.placement_groups.pop(p, None)
+
+    async def _reschedule_replayed(self, actor: ActorInfo):
+        ok = await self._schedule_actor(actor)
+        if not ok:
+            actor.state = protocol.ACTOR_DEAD
+            actor.death_cause = ("scheduling failed after GCS restart: "
+                                 "no feasible node")
+            self._log_actor(actor)
 
     async def close(self):
         if self._health_task:
@@ -141,6 +270,9 @@ class GcsServer:
         if not p.get("overwrite", True) and key in ns:
             return False
         ns[key] = p["value"]
+        if p.get("ns", "") not in _EPHEMERAL_NS:
+            self._log("kv_put", {"ns": p.get("ns", ""), "key": key,
+                                 "value": p["value"]})
         return True
 
     async def h_kv_get(self, conn, p):
@@ -152,6 +284,9 @@ class GcsServer:
     async def h_kv_del(self, conn, p):
         ns = self.kv.get(p.get("ns", ""), {})
         prefix = p.get("prefix", False)
+        if p.get("ns", "") not in _EPHEMERAL_NS:
+            self._log("kv_del", {"ns": p.get("ns", ""), "key": p["key"],
+                                 "prefix": prefix})
         if prefix:
             n = 0
             for k in [k for k in ns if k.startswith(p["key"])]:
@@ -170,7 +305,21 @@ class GcsServer:
         node = NodeInfo(p["node_id"], p["address"], p["resources"],
                         p.get("labels", {}), p.get("store_path", ""),
                         p.get("session_dir", ""))
+        prev = self.nodes.get(node.node_id)
+        if prev is not None:
+            # Re-registration after a connection blip or GCS restart:
+            # running leases still consume resources, so keep the last
+            # reported availability (the next heartbeat refreshes it) and
+            # retire the stale gcs->agent connection.
+            node.resources_available = dict(prev.resources_available)
+            if prev.conn is not None and not prev.conn.closed:
+                await prev.conn.close()
         self.nodes[node.node_id] = node
+        self._log("node", {
+            "node_id": node.node_id, "address": list(node.address),
+            "resources": node.resources_total, "labels": node.labels,
+            "store_path": node.store_path,
+            "session_dir": node.session_dir})
         asyncio.ensure_future(self._connect_agent(node))
         self._publish(protocol.CH_NODE, {"event": "alive", "node": node.view()})
         return {"cluster_nodes": [n.view() for n in self.nodes.values()]}
@@ -248,12 +397,14 @@ class GcsServer:
     # ----------------------------------------------------------------- jobs --
     async def h_next_job_id(self, conn, p):
         self._job_counter += 1
+        self._log("job_counter", self._job_counter)
         return self._job_counter
 
     async def h_register_job(self, conn, p):
         self.jobs[p["job_id"]] = {"job_id": p["job_id"],
                                   "driver_addr": p.get("driver_addr"),
                                   "start_time": time.time(), "alive": True}
+        self._log("job", self.jobs[p["job_id"]])
         return True
 
     async def h_get_jobs(self, conn, p):
@@ -268,20 +419,27 @@ class GcsServer:
         name = spec.get("name")
         if name:
             existing_id = self.named_actors.get(name)
-            if existing_id is not None:
+            if existing_id is not None and existing_id != actor_id:
                 existing = self.actors.get(existing_id)
                 if existing and existing.state != protocol.ACTOR_DEAD:
                     if spec.get("get_if_exists"):
                         return {"existing": True, "actor": existing.view()}
                     raise ValueError(f"actor name {name!r} already taken")
+        existing = self.actors.get(actor_id)
+        if existing is not None:
+            # Retried register (e.g. driver reconnected after a GCS
+            # restart that already replayed this actor) — idempotent.
+            return {"existing": True, "actor": existing.view()}
         actor = ActorInfo(actor_id, spec)
         self.actors[actor_id] = actor
         if name:
             self.named_actors[name] = actor_id
+        self._log_actor(actor, with_spec=True)
         ok = await self._schedule_actor(actor)
         if not ok:
             actor.state = protocol.ACTOR_DEAD
             actor.death_cause = "scheduling failed: no feasible node"
+            self._log_actor(actor)
             raise RuntimeError(actor.death_cause)
         return {"existing": False, "actor": actor.view()}
 
@@ -354,6 +512,7 @@ class GcsServer:
         actor.state = protocol.ACTOR_ALIVE
         actor.address = result["worker_addr"]
         actor.node_id = node.node_id
+        self._log_actor(actor)
         self._publish(protocol.CH_ACTOR, {"event": "alive", "actor": actor.view()})
         return True
 
@@ -418,6 +577,7 @@ class GcsServer:
         actor.address = None
         if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
             del self.named_actors[actor.name]
+        self._log_actor(actor)
         self._publish(protocol.CH_ACTOR, {"event": "dead", "actor": actor.view()})
 
     # ----------------------------------------------------- placement groups --
@@ -429,6 +589,10 @@ class GcsServer:
         node_manager.proto:471-476).  Returns immediately; clients poll
         get_placement_group / wait on the CH_PG channel."""
         pg_id = p["pg_id"]
+        if pg_id in self.placement_groups:
+            # Retried create (reply lost across a GCS restart): keep the
+            # replayed entry and any bundles already committed.
+            return {"ok": True, "pg_id": pg_id}
         entry = {
             "pg_id": pg_id,
             "strategy": p.get("strategy", "PACK"),
@@ -438,6 +602,7 @@ class GcsServer:
             "state": "PENDING",
         }
         self.placement_groups[pg_id] = entry
+        self._log("pg", entry)
         asyncio.ensure_future(self._place_pg(entry))
         return {"ok": True, "pg_id": pg_id}
 
@@ -503,6 +668,7 @@ class GcsServer:
                  "node_addr": list(n.address)}
                 for b, n in zip(bundles, chosen)]
             entry["state"] = "CREATED"
+            self._log("pg", entry)
             self._publish(protocol.CH_PG,
                           {"event": "created", "pg_id": pg_id})
             return
@@ -564,6 +730,7 @@ class GcsServer:
         if pg is None:
             return False
         pg["state"] = "REMOVED"         # stops a pending _place_pg loop
+        self._log("pg_del", p["pg_id"])
         for idx, bundle in enumerate(pg["bundles"]):
             node = self.nodes.get(bundle["node_id"])
             if node and node.conn and not node.conn.closed:
@@ -589,7 +756,8 @@ class GcsServer:
 
 
 async def _amain(args):
-    server = GcsServer(port=args.port)
+    server = GcsServer(port=args.port,
+                       journal_path=args.journal or None)
     addr = await server.start()
     # Signal readiness to the parent via a file it watches.
     if args.ready_file:
@@ -604,6 +772,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--ready-file", default="")
+    parser.add_argument("--journal", default="")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)

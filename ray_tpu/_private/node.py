@@ -73,10 +73,14 @@ def _spawn(args, session_dir: str, tag: str) -> subprocess.Popen:
 
 
 def start_gcs(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen, tuple]:
+    """Spawn the GCS with its journal in the session dir; restarting it
+    with the same session_dir + port replays the journal (reference:
+    Redis-backed GCS restart, gcs_init_data.cc)."""
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}.json")
     proc = _spawn(
         [sys.executable, "-m", "ray_tpu._private.gcs",
-         "--port", str(port), "--ready-file", ready],
+         "--port", str(port), "--ready-file", ready,
+         "--journal", os.path.join(session_dir, "gcs_journal.msgpack")],
         session_dir, "gcs")
     info = _wait_ready(ready, proc)
     return proc, tuple(info["address"])

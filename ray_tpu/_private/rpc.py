@@ -248,6 +248,76 @@ class RpcServer:
             await c.close()
 
 
+class ReconnectingConnection:
+    """Connection wrapper that transparently re-dials after loss — the
+    client half of GCS fault tolerance (reference: RetryableGrpcClient +
+    RayletNotifyGCSRestart, core_worker.proto:467).  A lost call is
+    retried ONCE after reconnect; GCS mutations are id-keyed upserts, so
+    the replay is idempotent.  `on_reconnect(conn)` runs after every
+    successful (re)dial — registration/subscription goes there."""
+
+    def __init__(self, address, handlers: Dict[str, Callable] | None = None,
+                 name: str = "client",
+                 on_reconnect: Callable | None = None,
+                 dial_retries: int = 75, retry_delay: float = 0.2):
+        self.address = address
+        self.handlers = handlers
+        self.name = name
+        self.on_reconnect = on_reconnect
+        self.dial_retries = dial_retries
+        self.retry_delay = retry_delay
+        self._conn: Connection | None = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def ensure(self) -> Connection:
+        """Eagerly dial (and run on_reconnect) — the supported way to
+        establish the first connection at startup."""
+        return await self._ensure()
+
+    async def _ensure(self) -> Connection:
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        if self._conn is not None and not self._conn.closed:
+            return self._conn
+        async with self._lock:
+            if self._conn is not None and not self._conn.closed:
+                return self._conn
+            self._conn = await connect(
+                self.address, self.handlers, retries=self.dial_retries,
+                retry_delay=self.retry_delay, name=self.name)
+            if self.on_reconnect is not None:
+                res = self.on_reconnect(self._conn)
+                if isinstance(res, Awaitable):
+                    await res
+            return self._conn
+
+    async def call(self, method: str, payload=None,
+                   timeout: float | None = None):
+        for attempt in range(2):
+            conn = await self._ensure()
+            try:
+                return await conn.call(method, payload, timeout)
+            except ConnectionLost:
+                if attempt:
+                    raise
+        raise ConnectionLost(f"connection {self.name} lost")
+
+    def notify(self, method: str, payload=None):
+        if self._conn is None or self._conn.closed:
+            raise ConnectionLost(f"connection {self.name} not established")
+        self._conn.notify(method, payload)
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()
+
+
 # ---------------------------------------------------------------------------
 # Client-side connect with retry
 # ---------------------------------------------------------------------------
@@ -265,5 +335,5 @@ async def connect(address, handlers: Dict[str, Callable] | None = None,
             return Connection(reader, writer, handlers, name=name, on_close=on_close)
         except (ConnectionError, OSError, FileNotFoundError) as e:
             last_err = e
-            await asyncio.sleep(retry_delay * (1.5 ** attempt))
+            await asyncio.sleep(min(retry_delay * (1.5 ** attempt), 2.0))
     raise ConnectionLost(f"cannot connect to {address}: {last_err}")
