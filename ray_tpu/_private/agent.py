@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from . import protocol, rpc
 from .config import Config, get_config, set_config
 from .ids import NodeID, WorkerID
-from .shm_store import ShmStore
+from .shm_store import ObjectExistsError, ShmStore
 
 logger = logging.getLogger("ray_tpu.agent")
 
@@ -44,6 +44,16 @@ LEASE_IDLE_TIMEOUT_S = 2.0
 def _needs_tpu(resources) -> bool:
     return any(k == "TPU" or k.startswith("TPU-") for k, v in
                (resources or {}).items() if v > 0)
+
+
+def _write_file(path: str, data) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class WorkerHandle:
@@ -82,6 +92,22 @@ class NodeAgent:
         self.leases: Dict[bytes, WorkerHandle] = {}
         self.bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self.pinned: Dict[bytes, int] = {}   # object_id -> pin count (owner pins)
+        # Spill manager state (reference: raylet LocalObjectManager,
+        # local_object_manager.h:43 — spills pinned primaries to disk under
+        # memory pressure, restores on demand).
+        cfg = get_config()
+        self.spilled: Dict[bytes, Tuple[str, int]] = {}  # oid -> (path, size)
+        self._spilling: Set[bytes] = set()               # writes in flight
+        self._disk_cached: Dict[bytes, int] = {}         # non-primary copies
+        self._spill_dir = cfg.object_spill_dir or os.path.join(
+            session_dir, "spill", node_id.hex()[:12])
+        self._spill_threshold = cfg.object_spill_threshold
+        self._pull_inflight: Dict[bytes, asyncio.Future] = {}
+        self._pull_waiters: List[Tuple[int, int, asyncio.Future]] = []  # heap
+        self._pull_active = 0
+        self._pull_seq = 0
+        self._chunk_bytes = cfg.object_transfer_chunk_bytes
+        self._max_pulls = cfg.max_concurrent_pulls
         self._server = rpc.RpcServer(self._handlers(), name="agent")
         self.gcs: Optional[rpc.Connection] = None
         self._spawn_lock = asyncio.Lock()
@@ -103,7 +129,13 @@ class NodeAgent:
             "unpin_object": self.h_unpin_object,
             "free_objects": self.h_free_objects,
             "fetch_from_store": self.h_fetch_from_store,
+            "object_info": self.h_object_info,
+            "fetch_chunk": self.h_fetch_chunk,
             "pull_object": self.h_pull_object,
+            "ensure_space": self.h_ensure_space,
+            "spill_path": self.h_spill_path,
+            "spill_register": self.h_spill_register,
+            "restore_object": self.h_restore_object,
             "node_info": self.h_node_info,
             "store_stats": self.h_store_stats,
             "ping": lambda conn, p: "pong",
@@ -512,9 +544,13 @@ class NodeAgent:
         """Owner-requested pin of a primary copy (reference: raylet
         PinObjectIDs keeping plasma objects alive for their owner)."""
         oid = p["object_id"]
+        if oid in self.spilled:
+            self.pinned[oid] = self.pinned.get(oid, 0) + 1
+            return True
         if self.store.get(oid, timeout_ms=0) is None:
             return False
         self.pinned[oid] = self.pinned.get(oid, 0) + 1
+        await self._maybe_spill_to_threshold()
         return True
 
     async def h_unpin_object(self, conn, p):
@@ -524,49 +560,340 @@ class NodeAgent:
             self.pinned.pop(oid, None)
         else:
             self.pinned[oid] = n - 1
-        if n >= 1:
+        if n >= 1 and oid not in self.spilled:
             self.store.release(oid)
         return True
 
     async def h_free_objects(self, conn, p):
         for oid in p["object_ids"]:
-            while self.pinned.pop(oid, 0) > 0:
-                self.store.release(oid)
+            for _ in range(self.pinned.pop(oid, 0)):
+                if oid not in self.spilled:
+                    self.store.release(oid)
+            spill = self.spilled.pop(oid, None)
+            self._disk_cached.pop(oid, None)
+            if spill is not None:
+                try:
+                    os.unlink(spill[0])
+                except FileNotFoundError:
+                    pass
             self.store.delete(oid)
         return True
 
+    # --- spilling (reference: local_object_manager.h:43 + plasma
+    # create_request_queue backpressure) ------------------------------------
+    def _spill_path(self, oid: bytes) -> str:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return os.path.join(self._spill_dir, oid.hex())
+
+    async def _spill_one(self, oid: bytes) -> int:
+        """Move one pinned primary to disk. Returns bytes freed (0 = not
+        spillable right now: unsealed, or a reader outside our pins).
+        The file write runs off-loop; the delete is atomic against readers
+        (release_n_and_delete_if) so a worker that pins mid-write keeps a
+        valid object and the spill aborts."""
+        if oid in self.spilled or oid in self._spilling:
+            return 0
+        npins = self.pinned.get(oid, 0)
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return 0
+        if self.store.refcount(oid) > npins + 1:  # fast-path skip: reader active
+            view.release()
+            self.store.release(oid)
+            return 0
+        self._spilling.add(oid)
+        size = len(view)
+        path = self._spill_path(oid)
+        try:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, _write_file, path, view)
+        except OSError:
+            view.release()
+            self.store.release(oid)
+            return 0
+        finally:
+            self._spilling.discard(oid)
+            view.release()
+        if not self.store.release_n_and_delete_if(oid, npins + 1):
+            # A reader pinned the object mid-write: abort the spill.
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return 0
+        self.spilled[oid] = (path, size)
+        return size
+
+    async def _free_space(self, need: int) -> int:
+        """Spill oldest pinned primaries until `need` bytes could be freed.
+        Unpinned sealed objects are already LRU-evicted by the store itself."""
+        freed = 0
+        for oid in list(self.pinned.keys()):
+            if freed >= need:
+                break
+            freed += await self._spill_one(oid)
+        return freed
+
+    async def _maybe_spill_to_threshold(self):
+        st = self.store.stats()
+        cap = st["capacity"]
+        target = int(cap * self._spill_threshold)
+        if st["bytes_in_use"] > target:
+            await self._free_space(st["bytes_in_use"] - target)
+
+    async def h_ensure_space(self, conn, p):
+        """Create-queue backpressure: a writer that got ENOMEM asks us to
+        spill; it retries its create afterwards."""
+        return {"freed": await self._free_space(int(p["nbytes"]))}
+
+    async def h_spill_path(self, conn, p):
+        """Hand a worker the path for a direct put-to-disk (objects that can
+        never fit the arena). The worker writes the file itself — same host,
+        shared filesystem — so no copy crosses the RPC."""
+        return self._spill_path(p["object_id"])
+
+    async def h_spill_register(self, conn, p):
+        oid = p["object_id"]
+        path = self._spill_path(oid)
+        if not os.path.exists(path):
+            return False
+        self.spilled[oid] = (path, os.path.getsize(path))
+        return True
+
+    async def _restore_object(self, oid: bytes) -> bool:
+        """Bring a spilled object back into shm (reference: raylet
+        RestoreSpilledObject). Re-acquires the agent's pins; deletes the
+        disk copy on success."""
+        spill = self.spilled.get(oid)
+        if spill is None:
+            return self.store.contains(oid)
+        path, size = spill
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(None, _read_file, path)
+        except FileNotFoundError:
+            return False
+        for _ in range(3):
+            try:
+                self.store.put(oid, [data])
+                break
+            except ObjectExistsError:
+                break
+            except Exception:
+                if await self._free_space(size) == 0:
+                    return False
+        else:
+            return False
+        for _ in range(self.pinned.get(oid, 0)):
+            self.store.get(oid, timeout_ms=0)
+        self.spilled.pop(oid, None)
+        self._disk_cached.pop(oid, None)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return True
+
+    async def h_restore_object(self, conn, p):
+        return await self._restore_object(p["object_id"])
+
+    # --- transfer (reference: object_manager.cc chunked push/pull) ----------
     async def h_fetch_from_store(self, conn, p):
-        """Serve object bytes to a remote agent (push side of object
-        transfer; reference: object_manager.cc chunked Push)."""
-        view = self.store.get(p["object_id"], timeout_ms=p.get("timeout_ms", 0))
+        """Whole-object fetch (small objects / compat path)."""
+        oid = p["object_id"]
+        if oid in self.spilled:
+            path, _ = self.spilled[oid]
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None
+        view = self.store.get(oid, timeout_ms=p.get("timeout_ms", 0))
         if view is None:
             return None
         try:
             return bytes(view)
         finally:
-            self.store.release(p["object_id"])
+            view.release()
+            self.store.release(oid)
+
+    async def h_object_info(self, conn, p):
+        """Size + presence probe that precedes a chunked pull."""
+        oid = p["object_id"]
+        if oid in self.spilled:
+            return {"size": self.spilled[oid][1], "spilled": True}
+        view = self.store.get(oid, timeout_ms=p.get("timeout_ms", 0))
+        if view is None:
+            return None
+        try:
+            return {"size": len(view), "spilled": False}
+        finally:
+            view.release()
+            self.store.release(oid)
+
+    async def h_fetch_chunk(self, conn, p):
+        """Serve one chunk of an object's bytes, from shm or the spill file."""
+        oid, off, length = p["object_id"], p["offset"], p["length"]
+        if oid in self.spilled:
+            path, _ = self.spilled[oid]
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                return os.pread(fd, length, off)
+            finally:
+                os.close(fd)
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return None
+        try:
+            return bytes(view[off:off + length])
+        finally:
+            view.release()
+            self.store.release(oid)
+
+    async def _pull_slot(self, priority: int):
+        """Priority-ordered admission to the pull pool (reference:
+        pull_manager.cc bundle priorities: get > wait > task args)."""
+        if self._pull_active < self._max_pulls:
+            self._pull_active += 1
+            return
+        import heapq
+        fut = asyncio.get_running_loop().create_future()
+        self._pull_seq += 1
+        heapq.heappush(self._pull_waiters, (priority, self._pull_seq, fut))
+        await fut
+
+    def _pull_done(self):
+        import heapq
+        if self._pull_waiters:
+            _, _, fut = heapq.heappop(self._pull_waiters)
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._pull_active -= 1
 
     async def h_pull_object(self, conn, p):
-        """Fetch a remote object into the local store (reference:
-        pull_manager.cc). `from_addr` is the agent holding the primary copy."""
+        """Fetch a remote object into the local store — chunked, deduped
+        against concurrent pulls of the same id, admission-controlled by
+        priority (reference: pull_manager.cc, 806 LoC of priority logic;
+        here: owner-directed single-source chunked pull)."""
         oid = p["object_id"]
-        if self.store.contains(oid):
+        if self.store.contains(oid) or oid in self.spilled:
             return True
-        from_addr = tuple(p["from_addr"])
+        inflight = self._pull_inflight.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_running_loop().create_future()
+        self._pull_inflight[oid] = fut
+        try:
+            ok = await self._do_pull(oid, tuple(p["from_addr"]),
+                                     p.get("priority", 0),
+                                     p.get("timeout_ms", 10000))
+            fut.set_result(ok)
+            return ok
+        except Exception as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._pull_inflight.pop(oid, None)
+
+    async def _stream_chunks(self, peer, oid: bytes, size: int,
+                             write) -> bool:
+        """Shared chunk loop for arena- and disk-destined pulls;
+        write(offset, chunk) lands each piece."""
+        pos = 0
+        while pos < size:
+            n = min(self._chunk_bytes, size - pos)
+            chunk = await peer.call(
+                "fetch_chunk",
+                {"object_id": oid, "offset": pos, "length": n},
+                timeout=60)
+            if chunk is None:
+                return False
+            write(pos, chunk)
+            pos += len(chunk)
+        return True
+
+    async def _do_pull(self, oid: bytes, from_addr: tuple, priority: int,
+                       timeout_ms: int) -> bool:
         peer = self._peer_conns.get(from_addr)
         if peer is None or peer.closed:
             peer = await rpc.connect(from_addr, name="agent->agent")
             self._peer_conns[from_addr] = peer
-        data = await peer.call("fetch_from_store",
-                               {"object_id": oid,
-                                "timeout_ms": p.get("timeout_ms", 10000)},
-                               timeout=60)
-        if data is None:
-            return False
+        await self._pull_slot(priority)
         try:
-            self.store.put(oid, [data])
-        except Exception:
-            return self.store.contains(oid)
+            info = await peer.call("object_info",
+                                   {"object_id": oid, "timeout_ms": timeout_ms},
+                                   timeout=60)
+            if info is None:
+                return False
+            size = info["size"]
+            buf = None
+            for attempt in range(3):
+                try:
+                    buf = self.store.create_buffer(oid, size)
+                    break
+                except ObjectExistsError:
+                    return True
+                except Exception:
+                    if await self._free_space(size) == 0 and attempt:
+                        break
+            if buf is None:
+                # No room even after spilling: land the pull on disk.
+                return await self._pull_to_disk(peer, oid, size)
+            ok = False
+            try:
+                def _into_buf(pos, chunk):
+                    buf[pos:pos + len(chunk)] = chunk
+                ok = await self._stream_chunks(peer, oid, size, _into_buf)
+            finally:
+                buf.release()
+                if not ok:
+                    # Covers both chunk==None and a raised timeout/RPC
+                    # error: never leave a permanently-unsealed object
+                    # wedging this id.
+                    self.store.abort(oid)
+            if not ok:
+                return False
+            self.store.seal(oid)
+            self.store.release(oid)
+            return True
+        finally:
+            self._pull_done()
+
+    async def _pull_to_disk(self, peer, oid: bytes, size: int) -> bool:
+        path = self._spill_path(oid)
+        ok = False
+        with open(path, "wb") as f:
+            def _into_file(pos, chunk):
+                f.seek(pos)
+                f.write(chunk)
+            try:
+                ok = await self._stream_chunks(peer, oid, size, _into_file)
+            finally:
+                if not ok:
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        pass
+        if not ok:
+            return False
+        self.spilled[oid] = (path, size)
+        # Non-primary disk copies are a bounded cache, LRU-evicted — the
+        # owner's free only reaches the primary node (reference analogue:
+        # remote copies are evictable, only primaries are pinned).
+        self._disk_cached[oid] = size
+        cap = self.store.stats()["capacity"]
+        while sum(self._disk_cached.values()) > cap and len(self._disk_cached) > 1:
+            old, osz = next(iter(self._disk_cached.items()))
+            if old == oid:
+                break
+            self._disk_cached.pop(old)
+            sp = self.spilled.pop(old, None)
+            if sp is not None:
+                try:
+                    os.unlink(sp[0])
+                except FileNotFoundError:
+                    pass
         return True
 
     async def h_node_info(self, conn, p):

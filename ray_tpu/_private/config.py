@@ -56,7 +56,17 @@ _define("lineage_max_entries", 100_000,
         "owner-side lineage cap (reference: task_manager.h max_lineage_bytes)")
 _define("object_spill_dir", "", "empty = <session_dir>/spill")
 _define("object_spill_threshold", 0.8,
-        "fraction of store capacity above which sealed unpinned objects spill")
+        "fraction of store capacity above which pinned primaries spill "
+        "proactively (reference: local_object_manager.h spill threshold)")
+_define("object_transfer_chunk_bytes", 8 * 1024 * 1024,
+        "inter-node object transfer chunk size "
+        "(reference: object_manager chunked push, default 5MiB chunks)")
+_define("max_concurrent_pulls", 16,
+        "per-node cap on simultaneous inbound object pulls "
+        "(reference: pull_manager.cc bundle admission)")
+_define("create_backpressure_timeout_s", 30.0,
+        "how long a plasma put waits for spill/eviction to make room before "
+        "failing (reference: plasma create_request_queue semantics)")
 _define("rpc_connect_retries", 10)
 _define("rpc_connect_retry_delay_s", 0.2)
 _define("rpc_chaos", "",

@@ -275,12 +275,43 @@ class CoreWorker:
         return ObjectRef(oid, self.address, worker=self)
 
     async def _put_plasma(self, oid: bytes, parts):
-        try:
-            self.store.put(oid, parts)
-        except StoreFullError:
-            # TODO(round2): spill-to-disk path; for now surface the error.
-            raise exc.ObjectStoreFullError(
-                f"object of size {get_context().total_size(parts)} does not fit")
+        """Create-queue backpressure (reference: plasma create_request_queue):
+        on ENOMEM, ask the agent to spill pinned primaries and retry; an
+        object that can never fit the arena spills straight to disk."""
+        size = get_context().total_size(parts)
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.create_backpressure_timeout_s
+        stored = False
+        while True:
+            try:
+                self.store.put(oid, parts)
+                stored = True
+                break
+            except StoreFullError:
+                res = await self.agent.call("ensure_space", {"nbytes": size})
+                if res["freed"] == 0:
+                    if size >= self.store.stats()["capacity"] // 2 or \
+                            time.monotonic() >= deadline:
+                        break  # fall through to the disk path
+                    await asyncio.sleep(0.05)
+                if time.monotonic() >= deadline:
+                    break
+        if not stored:
+            # Worker and agent share the host: write the spill file here
+            # (off-loop) and just register it — no copy crosses the RPC.
+            path = await self.agent.call("spill_path", {"object_id": oid})
+
+            def _write():
+                with open(path, "wb") as f:
+                    for p in parts:
+                        f.write(p)
+
+            await asyncio.get_running_loop().run_in_executor(
+                self.executor, _write)
+            if not await self.agent.call("spill_register",
+                                         {"object_id": oid}, timeout=60):
+                raise exc.ObjectStoreFullError(
+                    f"object of size {size} does not fit and could not spill")
         await self.agent.call("pin_object", {"object_id": oid})
         self.memory_store.put_plasma_location(oid, list(self.agent_address))
 
@@ -361,6 +392,17 @@ class CoreWorker:
         if view is not None:
             return view
         if tuple(agent_addr) == self.agent_address:
+            # Spilled primaries restore on demand (reference: raylet
+            # RestoreSpilledObject on the get path).
+            if await self.agent.call("restore_object", {"object_id": oid},
+                                     timeout=120):
+                view = self.store.get(oid, timeout_ms=0)
+                if view is not None:
+                    return view
+            else:
+                spilled = await self._read_spilled(self.agent, oid)
+                if spilled is not None:
+                    return spilled
             timeout_ms = 30_000 if deadline is None else int(
                 max(0.0, deadline - time.monotonic()) * 1000)
             view = self.store.get(oid, timeout_ms=timeout_ms)
@@ -368,13 +410,45 @@ class CoreWorker:
                 raise exc.ObjectLostError(f"{oid.hex()} not in local store")
             return view
         ok = await self.agent.call("pull_object", {
-            "object_id": oid, "from_addr": list(agent_addr)}, timeout=120)
+            "object_id": oid, "from_addr": list(agent_addr),
+            "priority": 0}, timeout=120)
         if not ok:
             raise exc.ObjectLostError(f"failed to pull {oid.hex()}")
+        if not self.store.contains(oid):
+            # Pull landed on disk (arena pressure): restore, or read the
+            # local spill file directly when it can never fit the arena.
+            if not await self.agent.call("restore_object", {"object_id": oid},
+                                         timeout=120):
+                spilled = await self._read_spilled(self.agent, oid)
+                if spilled is not None:
+                    return spilled
         view = self.store.get(oid, timeout_ms=5000)
         if view is None:
             raise exc.ObjectLostError(f"{oid.hex()} pulled but not sealed")
         return view
+
+    async def _read_spilled(self, agent_conn, oid: bytes):
+        """Chunked read of a spilled object that cannot re-enter the arena
+        (reference: spilled_object_reader.h — readers stream straight from
+        the spill file)."""
+        info = await agent_conn.call("object_info",
+                                     {"object_id": oid, "timeout_ms": 0})
+        if info is None or not info.get("spilled"):
+            return None
+        size = info["size"]
+        chunk = get_config().object_transfer_chunk_bytes
+        out = bytearray(size)
+        pos = 0
+        while pos < size:
+            n = min(chunk, size - pos)
+            data = await agent_conn.call(
+                "fetch_chunk",
+                {"object_id": oid, "offset": pos, "length": n}, timeout=60)
+            if data is None:
+                return None
+            out[pos:pos + len(data)] = data
+            pos += len(data)
+        return memoryview(out)
 
     # Owner-side service: borrowers resolve objects through us.
     async def h_get_object(self, conn, p):

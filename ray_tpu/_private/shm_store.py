@@ -70,6 +70,11 @@ def _load():
     lib.rts_contains.restype = ctypes.c_int
     lib.rts_abort.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.rts_abort.restype = ctypes.c_int
+    lib.rts_refcount.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rts_refcount.restype = ctypes.c_int
+    lib.rts_release_n_and_delete_if.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.rts_release_n_and_delete_if.restype = ctypes.c_int
     lib.rts_stats.argtypes = [ctypes.c_int] + [ctypes.POINTER(ctypes.c_uint64)] * 5
     lib.rts_list_evictable.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
     lib.rts_list_evictable.restype = ctypes.c_int
@@ -185,6 +190,18 @@ class ShmStore:
 
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.rts_contains(self._h, object_id))
+
+    def refcount(self, object_id: bytes) -> int:
+        """Pin count of a sealed object; -1 if absent."""
+        rc = self._lib.rts_refcount(self._h, object_id)
+        return rc if rc >= 0 else -1
+
+    def release_n_and_delete_if(self, object_id: bytes, n: int) -> bool:
+        """Spill commit: release our n pins and delete iff no other reader
+        holds a pin (atomic). False = a reader appeared; only the read pin
+        was dropped and the object stays resident."""
+        return self._lib.rts_release_n_and_delete_if(
+            self._h, object_id, n) == 0
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(5)]

@@ -467,6 +467,41 @@ int rts_contains(int hidx, const uint8_t* id) {
   return (s && s->state == kSealed) ? 1 : 0;
 }
 
+// Atomically release `n` pins and delete iff no other readers remain —
+// the commit point of a spill. The caller holds `n` pins (its long-lived
+// owner pins plus the read pin used to copy the bytes out). Under one lock:
+// if any *other* process pinned the object since the copy began, drop only
+// the read pin and return -EBUSY (spill aborted, object stays); otherwise
+// free the extent. This closes the check-then-delete race a separate
+// refcount()+delete() pair would have.
+int rts_release_n_and_delete_if(int hidx, const uint8_t* id, int n) {
+  Handle& h = g_handles[hidx];
+  Guard g(h.hdr);
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state != kSealed) return -ENOENT;
+  if ((int)s->refcount > n) {
+    if (s->refcount > 0) s->refcount--;  // drop the read pin only
+    return -EBUSY;
+  }
+  uint64_t bsz = align_up(s->size ? s->size : 1, kAlign);
+  free_insert(h, s->offset, bsz);
+  h.hdr->bytes_in_use -= bsz;
+  h.hdr->num_objects--;
+  s->state = kTombstone;
+  return 0;
+}
+
+// Current pin count of a sealed object, or -ENOENT. The spill scanner uses
+// this to skip objects some process is actively reading (spilling only needs
+// the agent's own pins to account for every reader).
+int rts_refcount(int hidx, const uint8_t* id) {
+  Handle& h = g_handles[hidx];
+  Guard g(h.hdr);
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state != kSealed) return -ENOENT;
+  return (int)s->refcount;
+}
+
 // Abort an unsealed create (e.g. writer failed mid-copy).
 int rts_abort(int hidx, const uint8_t* id) {
   Handle& h = g_handles[hidx];
