@@ -64,6 +64,9 @@ _define("object_transfer_chunk_bytes", 8 * 1024 * 1024,
 _define("max_concurrent_pulls", 16,
         "per-node cap on simultaneous inbound object pulls "
         "(reference: pull_manager.cc bundle admission)")
+_define("task_arg_fetch_timeout_s", 600.0,
+        "bound on an executing task's by-reference arg fetch; a freed or "
+        "unrecoverable arg fails the task instead of wedging the worker")
 _define("create_backpressure_timeout_s", 30.0,
         "how long a plasma put waits for spill/eviction to make room before "
         "failing (reference: plasma create_request_queue semantics)")

@@ -55,11 +55,15 @@ PIPELINE_DEPTH = 1
 
 
 class _PendingTask:
-    __slots__ = ("spec", "ref_args")
+    __slots__ = ("spec", "ref_args", "borrowed_args")
 
-    def __init__(self, spec: dict, ref_args: List[bytes]):
+    def __init__(self, spec: dict, ref_args: List[bytes],
+                 borrowed_args: Optional[List[tuple]] = None):
         self.spec = spec
         self.ref_args = ref_args  # owned object ids pinned while in flight
+        # (oid, owner_addr) pairs of borrowed refs nested in arg values:
+        # escape-pinned at the remote owner until the reply lands.
+        self.borrowed_args = borrowed_args or []
 
 
 class _Lease:
@@ -129,6 +133,7 @@ class CoreWorker:
         self._pg_rr: Dict[bytes, int] = {}
         self.current_placement_group: Optional[dict] = None
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
+        self._recovering: Dict[bytes, asyncio.Future] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -188,8 +193,35 @@ class CoreWorker:
         return {
             "get_object": self.h_get_object,
             "free_notify": self.h_free_notify,
+            "borrow_add": self.h_borrow_add,
+            "borrow_release": self.h_borrow_release,
+            "escape_pin": self.h_escape_pin,
+            "escape_release": self.h_escape_release,
+            "recover_object": self.h_recover_object,
             "ping": lambda conn, p: "pong",
         }
+
+    # Owner-side borrower-ledger service (reference: reference counting RPCs
+    # folded into CoreWorkerService).
+    async def h_borrow_add(self, conn, p):
+        self.reference_counter.add_borrower(p["object_id"], p["worker_id"])
+        return True
+
+    async def h_borrow_release(self, conn, p):
+        self.reference_counter.remove_borrower(p["object_id"], p["worker_id"])
+        return True
+
+    async def h_escape_pin(self, conn, p):
+        self.reference_counter.add_escape_pin(p["object_id"])
+        return True
+
+    async def h_escape_release(self, conn, p):
+        self.reference_counter.release_escape_pin(p["object_id"])
+        return True
+
+    async def h_recover_object(self, conn, p):
+        """A borrower lost the primary copy: reconstruct it for them."""
+        return await self._recover_object(p["object_id"])
 
     def shutdown(self):
         if self._shutdown:
@@ -221,16 +253,60 @@ class CoreWorker:
 
     # ------------------------------------------------------- ref plumbing ---
     def _ref_factory(self, object_id: bytes, owner_addr):
-        return ObjectRef(object_id, owner_addr, worker=self)
+        ref = ObjectRef(object_id, owner_addr, worker=self)
+        if owner_addr and tuple(owner_addr) != self.address:
+            # Deserializing someone else's ref makes this process a borrower
+            # (reference: reference_count.cc borrower registration; here an
+            # eager borrow_add to the owner, released on local GC).
+            if self.reference_counter.mark_borrowed(object_id,
+                                                    tuple(owner_addr)):
+                self._notify_owner(tuple(owner_addr), "borrow_add", object_id)
+        return ref
 
     def _ref_serialized_hook(self, ref: ObjectRef):
-        # A ref we own is being serialized into some value that may outlive
-        # this process's knowledge of it: pin conservatively (round-1
-        # borrowing, see reference_counter.py docstring).
-        if ref.owner_address == self.address:
-            self.reference_counter.mark_escaped(ref.binary())
+        ctx = get_context()
+        owner = ref.owner_address
+        remote = None if (owner is None or tuple(owner) == self.address) \
+            else tuple(owner)
+        captured = ctx.capture
+        if captured is not None:
+            # Containment capture: the surrounding put/arg/return records
+            # the pin against the container's lifetime.
+            captured.append((ref.binary(), remote))
+        elif remote is None:
+            # Out-of-band pickle of an owned ref: permanent escape pin.
+            self.reference_counter.add_escape_pin(ref.binary())
+        else:
+            self._notify_owner(remote, "escape_pin", ref.binary())
 
-    def _on_ref_zero(self, object_id: bytes):
+    def _notify_owner(self, owner: tuple, method: str, object_id: bytes):
+        """Fire-and-forget refcount message to an object's owner; safe from
+        any thread (GC runs __del__ wherever it likes)."""
+        if self.loop is None or self._shutdown:
+            return
+
+        async def _go():
+            try:
+                conn = await self._peer_owner(owner)
+                conn.notify(method, {"object_id": object_id,
+                                     "worker_id": self.worker_id})
+            except Exception:
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_go(), self.loop)
+        except RuntimeError:
+            pass
+
+    def _on_ref_zero(self, object_id: bytes, owner_addr=None):
+        if owner_addr is not None:
+            # Borrowed ref fully dropped: release our borrow with the owner.
+            self.memory_store.delete(object_id)
+            self._notify_owner(tuple(owner_addr), "borrow_release", object_id)
+            return
+        # Owned object freed: cascade containment pins, then free the
+        # primary copy.
+        self._release_nested(self.reference_counter.pop_contained(object_id))
         entry = self.memory_store.get(object_id)
         self.memory_store.delete(object_id)
         if entry is not None and entry.plasma_node is not None:
@@ -264,9 +340,14 @@ class CoreWorker:
         oid = ObjectID.for_put(TaskID(self.current_task_id),
                                self._put_counter).binary()
         ctx = get_context()
-        parts = ctx.serialize(value)
+        ctx.capture = captured = []
+        try:
+            parts = ctx.serialize(value)
+        finally:
+            ctx.capture = None
         size = ctx.total_size(parts)
         self.reference_counter.add_owned(oid)
+        self._record_contained(oid, captured)
         cfg = get_config()
         if size <= self._inline_limit and cfg.put_small_object_in_memory_store:
             self.memory_store.put_inline(oid, protocol.concat_parts(parts))
@@ -274,10 +355,35 @@ class CoreWorker:
             await self._put_plasma(oid, parts)
         return ObjectRef(oid, self.address, worker=self)
 
+    def _record_contained(self, container_id: bytes, captured,
+                          take_pins: bool = True):
+        """Pin refs nested inside a value until the container is freed.
+        take_pins=True when THIS process just serialized the value (we take
+        the pins: sync for our own objects — race-free — and an ordered
+        escape_pin notify for remote owners, which lands before any
+        borrow_release we might later send on the same connection).
+        take_pins=False when the pins were already taken by the serializing
+        worker and the reply merely transfers release responsibility."""
+        if not captured:
+            return
+        self.reference_counter.add_contained(container_id, captured)
+        if take_pins:
+            for noid, nowner in captured:
+                if nowner is None:
+                    self.reference_counter.add_escape_pin(noid)
+                else:
+                    self._notify_owner(nowner, "escape_pin", noid)
+
     async def _put_plasma(self, oid: bytes, parts):
+        await self.store_with_backpressure(oid, parts)
+        await self.agent.call("pin_object", {"object_id": oid})
+        self.memory_store.put_plasma_location(oid, list(self.agent_address))
+
+    async def store_with_backpressure(self, oid: bytes, parts):
         """Create-queue backpressure (reference: plasma create_request_queue):
         on ENOMEM, ask the agent to spill pinned primaries and retry; an
-        object that can never fit the arena spills straight to disk."""
+        object that can never fit the arena spills straight to disk. Shared
+        by puts and large task returns."""
         size = get_context().total_size(parts)
         cfg = get_config()
         deadline = time.monotonic() + cfg.create_backpressure_timeout_s
@@ -312,8 +418,6 @@ class CoreWorker:
                                          {"object_id": oid}, timeout=60):
                 raise exc.ObjectStoreFullError(
                     f"object of size {size} does not fit and could not spill")
-        await self.agent.call("pin_object", {"object_id": oid})
-        self.memory_store.put_plasma_location(oid, list(self.agent_address))
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -349,13 +453,37 @@ class CoreWorker:
     async def _fetch_serialized(self, ref: ObjectRef, deadline) -> memoryview:
         oid = ref.binary()
         owner = ref.owner_address or self.address
+        recoveries = 0
         while True:
             # 1. Local memory store (owned objects / cached results).
             entry = self.memory_store.get(oid)
             if entry is not None:
                 if entry.data is not None:
                     return memoryview(entry.data)
-                return await self._read_plasma(oid, entry.plasma_node, deadline)
+                try:
+                    return await self._read_plasma(oid, entry.plasma_node,
+                                                   deadline)
+                except exc.ObjectLostError:
+                    # Primary copy gone (node death / eviction): owners
+                    # re-execute the creating task from lineage (reference:
+                    # object_recovery_manager.h:41). Bounded by the caller's
+                    # get() deadline.
+                    if tuple(owner) == self.address and recoveries < 3:
+                        remaining = None if deadline is None else \
+                            deadline - time.monotonic()
+                        if remaining is not None and remaining <= 0:
+                            raise exc.GetTimeoutError(
+                                f"timed out getting {oid.hex()}") from None
+                        try:
+                            ok = await asyncio.wait_for(
+                                self._recover_object(oid), remaining)
+                        except asyncio.TimeoutError:
+                            raise exc.GetTimeoutError(
+                                f"timed out recovering {oid.hex()}") from None
+                        if ok:
+                            recoveries += 1
+                            continue
+                    raise
             # 2. Local shared memory.
             view = self.store.get(oid, timeout_ms=0)
             if view is not None:
@@ -385,7 +513,100 @@ class CoreWorker:
                 raise exc.GetTimeoutError(f"timed out getting {oid.hex()}")
             if "inline" in res:
                 return memoryview(res["inline"])
-            return await self._read_plasma(oid, res["plasma"], deadline)
+            try:
+                return await self._read_plasma(oid, res["plasma"], deadline)
+            except exc.ObjectLostError:
+                # Borrowers can't reconstruct; ask the owner to. Bounded by
+                # the caller's get() deadline.
+                if recoveries < 3:
+                    recoveries += 1
+                    remaining = 130.0 if deadline is None else \
+                        min(130.0, deadline - time.monotonic())
+                    if remaining <= 0:
+                        raise exc.GetTimeoutError(
+                            f"timed out getting {oid.hex()}") from None
+                    try:
+                        if await conn.call("recover_object",
+                                           {"object_id": oid},
+                                           timeout=remaining):
+                            continue
+                    except (rpc.RpcError, asyncio.TimeoutError):
+                        pass
+                raise
+
+    async def _recover_object(self, oid: bytes) -> bool:
+        """Re-execute the creating task to restore a lost object (reference:
+        task_manager.h:227 ResubmitTask + object_recovery_manager.cc).
+        Deduped across concurrent losses of the same id; actor task returns
+        carry no lineage and are never replayed (side effects)."""
+        existing = self._recovering.get(oid)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        spec = self.reference_counter.get_lineage(oid)
+        if spec is None:
+            return False
+        fut = asyncio.get_running_loop().create_future()
+        self._recovering[oid] = fut
+        try:
+            # Probe first: a transient pull failure must not trigger a
+            # destructive re-execution (tasks may have side effects and a
+            # failed rerun would overwrite healthy sibling returns).
+            entry = self.memory_store.get(oid)
+            if entry is not None and entry.plasma_node is not None and \
+                    await self._primary_alive(oid, tuple(entry.plasma_node)):
+                fut.set_result(True)
+                return True
+            # Resubmission can only succeed if its by-reference args are
+            # still resolvable (live somewhere, or themselves recoverable).
+            for e in spec["args"]:
+                if "ref" not in e:
+                    continue
+                aid = bytes(e["ref"][0])
+                aowner = tuple(e["ref"][1])
+                if aowner == self.address and \
+                        not self.memory_store.contains(aid) and \
+                        not self.store.contains(aid) and \
+                        self.reference_counter.get_lineage(aid) is None:
+                    fut.set_result(False)
+                    return False
+            self.memory_store.delete(oid)  # only the lost return
+            respec = dict(spec)
+            respec["retries_left"] = max(respec.get("retries_left", 0), 1)
+            key = protocol.scheduling_key(respec["fn_id"], respec["resources"],
+                                          respec.get("scheduling_strategy"))
+            state = self._keys.get(key)
+            if state is None:
+                state = self._keys[key] = _KeyState(
+                    respec["resources"], respec.get("scheduling_strategy"))
+            state.queue.append(_PendingTask(respec, []))
+            self._pump(key, state)
+            entry = await self.memory_store.wait_for(oid, 120)
+            ok = entry is not None
+            fut.set_result(ok)
+            return ok
+        except Exception:
+            if not fut.done():
+                fut.set_result(False)
+            raise
+        finally:
+            self._recovering.pop(oid, None)
+
+    async def _primary_alive(self, oid: bytes, agent_addr: tuple) -> bool:
+        """Short-timeout probe of the agent recorded as holding the primary."""
+        if agent_addr == self.agent_address:
+            if self.store.contains(oid):
+                return True
+            try:
+                return bool(await self.agent.call(
+                    "object_info", {"object_id": oid}, timeout=5))
+            except (rpc.RpcError, asyncio.TimeoutError):
+                return False
+        try:
+            conn = await self._peer_owner(agent_addr)
+            return bool(await conn.call("object_info", {"object_id": oid},
+                                        timeout=5))
+        except (rpc.RpcError, asyncio.TimeoutError):
+            return False
 
     async def _read_plasma(self, oid: bytes, agent_addr, deadline) -> memoryview:
         view = self.store.get(oid, timeout_ms=0)
@@ -403,15 +624,18 @@ class CoreWorker:
                 spilled = await self._read_spilled(self.agent, oid)
                 if spilled is not None:
                     return spilled
-            timeout_ms = 30_000 if deadline is None else int(
-                max(0.0, deadline - time.monotonic()) * 1000)
+            timeout_ms = 5_000 if deadline is None else int(
+                min(5.0, max(0.0, deadline - time.monotonic())) * 1000)
             view = self.store.get(oid, timeout_ms=timeout_ms)
             if view is None:
                 raise exc.ObjectLostError(f"{oid.hex()} not in local store")
             return view
-        ok = await self.agent.call("pull_object", {
-            "object_id": oid, "from_addr": list(agent_addr),
-            "priority": 0}, timeout=120)
+        try:
+            ok = await self.agent.call("pull_object", {
+                "object_id": oid, "from_addr": list(agent_addr),
+                "priority": 0}, timeout=120)
+        except (rpc.RpcError, asyncio.TimeoutError):
+            ok = False  # source agent unreachable == primary copy lost
         if not ok:
             raise exc.ObjectLostError(f"failed to pull {oid.hex()}")
         if not self.store.contains(oid):
@@ -550,7 +774,13 @@ class CoreWorker:
                       else getattr(a, "nbytes", 0))
             if approx > self._inline_limit:
                 return None
-            parts = ctx.serialize(a)
+            ctx.capture = captured = []
+            try:
+                parts = ctx.serialize(a)
+            finally:
+                ctx.capture = None
+            if captured:
+                return None          # nested refs need slow-path pinning
             if ctx.total_size(parts) > self._inline_limit:
                 return None          # plasma put needs the loop
             entry = {"v": protocol.concat_parts(parts)}
@@ -591,7 +821,8 @@ class CoreWorker:
             fn_id = await self._export_function(fn, fn_id=fn_id,
                                                 blob=fn_blob)
         task_id = TaskID.for_normal_task(JobID(self.job_id)).binary()
-        arg_entries, ref_args = await self._resolve_args(args, kwargs)
+        arg_entries, ref_args, borrowed_args = await self._resolve_args(
+            args, kwargs)
         spec = protocol.make_task_spec(
             task_id=task_id, job_id=self.job_id, fn_id=fn_id,
             args=arg_entries, nreturns=num_returns, owner_addr=list(self.address),
@@ -609,7 +840,7 @@ class CoreWorker:
         state = self._keys.get(key)
         if state is None:
             state = self._keys[key] = _KeyState(resources, scheduling_strategy)
-        state.queue.append(_PendingTask(spec, ref_args))
+        state.queue.append(_PendingTask(spec, ref_args, borrowed_args))
         self._pump(key, state)
         return refs
 
@@ -625,11 +856,15 @@ class CoreWorker:
             self._fn_cache[fn_id] = fn
         return fn_id
 
-    async def _resolve_args(self, args, kwargs) -> Tuple[list, List[bytes]]:
+    async def _resolve_args(self, args, kwargs
+                            ) -> Tuple[list, List[bytes], List[tuple]]:
         """Inline small/available values; pass big ones by reference
-        (reference: dependency_resolver.cc inlining rules)."""
+        (reference: dependency_resolver.cc inlining rules). Refs nested
+        inside arg values are pinned for the task's flight: owned ones as
+        submitted pins, borrowed ones via escape_pin at their owner."""
         entries = []
         ref_args: List[bytes] = []
+        borrowed_args: List[tuple] = []
         ctx = get_context()
         items = [("", a) for a in args] + list(kwargs.items())
         for kw, a in items:
@@ -639,15 +874,26 @@ class CoreWorker:
                 if "ref" in entry:
                     ref_args.append(a.binary())
             else:
-                parts = ctx.serialize(a)
+                ctx.capture = captured = []
+                try:
+                    parts = ctx.serialize(a)
+                finally:
+                    ctx.capture = None
                 size = ctx.total_size(parts)
                 if size <= self._inline_limit:
                     entry = {"v": protocol.concat_parts(parts)}
+                    for noid, nowner in captured:
+                        if nowner is None:
+                            ref_args.append(noid)  # caller adds submitted pin
+                        else:
+                            self._notify_owner(nowner, "escape_pin", noid)
+                            borrowed_args.append((noid, nowner))
                 else:
                     self._put_counter += 1
                     oid = ObjectID.for_put(TaskID(self.current_task_id),
                                            self._put_counter).binary()
                     self.reference_counter.add_owned(oid)
+                    self._record_contained(oid, captured)
                     await self._put_plasma(oid, parts)
                     entry = {"ref": [oid, list(self.address),
                                      list(self.agent_address)]}
@@ -655,7 +901,7 @@ class CoreWorker:
             if kw:
                 entry["kw"] = kw
             entries.append(entry)
-        return entries, ref_args
+        return entries, ref_args, borrowed_args
 
     async def _resolve_ref_arg(self, ref: ObjectRef) -> dict:
         oid = ref.binary()
@@ -792,6 +1038,7 @@ class CoreWorker:
         while state.queue:
             task = state.queue.popleft()
             self._store_task_exception(task.spec, error)
+            self._release_task_pins(task)
 
     async def _worker_conn(self, addr: tuple) -> rpc.Connection:
         conn = self._worker_conns.get(addr)
@@ -834,6 +1081,7 @@ class CoreWorker:
                     spec, exc.WorkerCrashedError(
                         f"worker at {lease.worker_addr} died running "
                         f"{spec['name']}"))
+                self._release_task_pins(task)
             self._pump(key, state)
             return
         lease.inflight -= 1
@@ -842,12 +1090,27 @@ class CoreWorker:
         self._pump(key, state)
 
     def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
-        for oid in (task.ref_args if task else []):
-            self.reference_counter.remove_submitted(oid)
         task_id = spec["task_id"]
         if reply.get("status") == "ok":
             for i, entry in enumerate(reply["returns"]):
                 oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+                # Refs nested inside this return value: the worker already
+                # escape-pinned each at its owner during serialization; we
+                # record containment so freeing the return releases them
+                # (reference: task replies carry borrowed-ref metadata).
+                nested = [(bytes(noid),
+                           None if tuple(nowner) == self.address
+                           else tuple(nowner))
+                          for noid, nowner in entry.get("nested", [])]
+                if nested and not self.reference_counter.is_tracked(oid):
+                    # Container already freed (caller dropped the return ref
+                    # mid-flight): release the worker-taken pins instead of
+                    # recording them forever. Delayed so in-flight
+                    # escape_pin notifies land first.
+                    self.loop.call_later(
+                        1.0, lambda n=nested: self._release_nested(n))
+                else:
+                    self._record_contained(oid, nested, take_pins=False)
                 if "inline" in entry:
                     self.memory_store.put_inline(oid, entry["inline"])
                 else:
@@ -858,9 +1121,27 @@ class CoreWorker:
                 f"task {spec['name']} failed", cause=err,
                 remote_traceback=reply.get("traceback", ""))
             self._store_task_exception(spec, wrapped)
+        self._release_task_pins(task)
 
     def _store_task_failure(self, spec, error: Exception):
         self._store_task_exception(spec, error)
+
+    def _release_nested(self, nested):
+        for noid, nowner in nested:
+            if nowner is None:
+                self.reference_counter.release_escape_pin(noid)
+            else:
+                self._notify_owner(nowner, "escape_release", noid)
+
+    def _release_task_pins(self, task: Optional[_PendingTask]):
+        if task is None:
+            return
+        for oid in task.ref_args:
+            self.reference_counter.remove_submitted(oid)
+        task.ref_args = []
+        for noid, nowner in task.borrowed_args:
+            self._notify_owner(nowner, "escape_release", noid)
+        task.borrowed_args = []
 
     def _store_task_exception(self, spec, error):
         data = protocol.concat_parts(get_context().serialize(error))
@@ -889,7 +1170,7 @@ class CoreWorker:
         cls_id = protocol.function_id(blob)
         await self.gcs.call("kv_put", {"ns": "actor_cls", "key": cls_id.hex(),
                                        "value": blob, "overwrite": False})
-        arg_entries, _ = await self._resolve_args(args, kwargs)
+        arg_entries, _, _ = await self._resolve_args(args, kwargs)
         spec = {
             "actor_id": actor_id,
             "job_id": self.job_id,
@@ -921,7 +1202,8 @@ class CoreWorker:
         if state is None:
             state = self._actors[actor_id] = _ActorState(actor_id)
         task_id = TaskID.for_actor_task(ActorID(actor_id)).binary()
-        arg_entries, ref_args = await self._resolve_args(args, kwargs)
+        arg_entries, ref_args, borrowed_args = await self._resolve_args(
+            args, kwargs)
         state.seq += 1
         spec = protocol.make_task_spec(
             task_id=task_id, job_id=self.job_id, fn_id=b"", args=arg_entries,
@@ -934,8 +1216,8 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.address, worker=self))
         for oid in ref_args:
             self.reference_counter.add_submitted(oid)
-        asyncio.ensure_future(self._push_actor_task(state, spec,
-                                                    _PendingTask(spec, ref_args)))
+        asyncio.ensure_future(self._push_actor_task(
+            state, spec, _PendingTask(spec, ref_args, borrowed_args)))
         return refs
 
     async def _actor_conn(self, state: _ActorState) -> rpc.Connection:
@@ -976,8 +1258,7 @@ class CoreWorker:
             conn = await self._actor_conn(state)
         except exc.ActorDiedError as e:
             self._store_task_exception(spec, e)
-            for oid in task.ref_args:
-                self.reference_counter.remove_submitted(oid)
+            self._release_task_pins(task)
             return
         try:
             reply = await conn.call("push_actor_task", spec)
@@ -986,8 +1267,7 @@ class CoreWorker:
             self._store_task_exception(spec, exc.ActorDiedError(
                 f"actor {state.actor_id.hex()[:8]} died during "
                 f"{spec['method']}"))
-            for oid in task.ref_args:
-                self.reference_counter.remove_submitted(oid)
+            self._release_task_pins(task)
             return
         self._handle_reply(spec, task, reply)
 

@@ -1,38 +1,62 @@
-"""Owner-side distributed reference counting.
+"""Distributed reference counting: ownership, borrowing, lineage.
 
 Equivalent of the reference's ReferenceCounter (reference:
-src/ray/core_worker/reference_count.cc, 1,831 LoC). Round-1 scope: exact
-local-ref + submitted-task-arg counting for owned objects, with plasma
-primary-copy release when the count hits zero (reference: "owner frees when
-local refs + submitted refs + borrower set are all empty"). Borrower
-registration across workers (the reference's borrowing protocol) is coarse:
-refs serialized into task returns or actor state pin the object permanently
-until the owner exits. TODO(round 2): full borrow ledger with on-GC release
-messages from borrowers.
+src/ray/core_worker/reference_count.cc, 1,831 LoC). An object is freed when
+ALL of these drop to zero (reference: "owner frees when local refs +
+submitted refs + borrower set are all empty"):
+
+- `local`      live Python ObjectRefs in this process
+- `submitted`  in-flight tasks carrying the ref as an argument
+- `escaped`    serialized copies nested inside other live values — pinned by
+               containment: put()/task-return values that pickled this ref
+               hold one escape pin each, released when the container is freed
+- `borrowers`  remote workers holding live deserialized copies, registered
+               via borrow_add / borrow_release RPCs from the borrower's own
+               reference counter
+
+Borrower-side entries carry the owner's address so GC of the last local ref
+sends borrow_release to the owner. A borrower that dies without releasing
+leaks its pin (the reference GCs these through worker-failure pubsub; here
+lineage reconstruction backstops a premature free instead).
+
+`lineage` retains the creating task's spec for owned task returns so lost
+primaries can be re-executed (reference: task_manager.h:227 ResubmitTask).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 
 class _Ref:
-    __slots__ = ("local", "submitted", "escaped", "lineage")
+    __slots__ = ("local", "submitted", "escaped", "borrowers", "lineage",
+                 "owner_addr", "registered")
 
     def __init__(self):
-        self.local = 0        # live Python ObjectRefs in this process
-        self.submitted = 0    # in-flight tasks using this as an arg
-        self.escaped = False  # serialized out of our control → never auto-free
-        self.lineage = None   # task spec that can recreate this object
+        self.local = 0
+        self.submitted = 0
+        self.escaped = 0
+        self.borrowers: Set[bytes] = set()
+        self.lineage = None
+        self.owner_addr = None    # None = owned by this process
+        self.registered = False   # borrower side: borrow_add sent to owner
+
+    def freeable(self) -> bool:
+        return (self.local <= 0 and self.submitted <= 0
+                and self.escaped <= 0 and not self.borrowers)
 
 
 class ReferenceCounter:
-    def __init__(self, on_zero: Callable[[bytes], None]):
+    def __init__(self, on_zero: Callable[[bytes, Optional[tuple]], None],
+                 on_borrow: Callable[[bytes, tuple], None] | None = None):
         self._refs: Dict[bytes, _Ref] = {}
+        self._contained: Dict[bytes, List[Tuple[bytes, Optional[tuple]]]] = {}
         self._lock = threading.Lock()
         self._on_zero = on_zero
+        self._on_borrow = on_borrow  # called once per newly-borrowed oid
 
+    # ------------------------------------------------------------- owned ----
     def add_owned(self, object_id: bytes, lineage=None):
         with self._lock:
             ref = self._refs.setdefault(object_id, _Ref())
@@ -44,38 +68,94 @@ class ReferenceCounter:
             self._refs.setdefault(object_id, _Ref()).local += 1
 
     def remove_local_ref(self, object_id: bytes):
-        self._maybe_free(object_id, "local")
+        self._dec(object_id, "local")
 
     def add_submitted(self, object_id: bytes):
         with self._lock:
             self._refs.setdefault(object_id, _Ref()).submitted += 1
 
     def remove_submitted(self, object_id: bytes):
-        self._maybe_free(object_id, "submitted")
+        self._dec(object_id, "submitted")
 
-    def mark_escaped(self, object_id: bytes):
+    def add_escape_pin(self, object_id: bytes):
         with self._lock:
-            self._refs.setdefault(object_id, _Ref()).escaped = True
+            self._refs.setdefault(object_id, _Ref()).escaped += 1
 
-    def _maybe_free(self, object_id: bytes, field: str):
+    def release_escape_pin(self, object_id: bytes):
+        self._dec(object_id, "escaped")
+
+    def add_borrower(self, object_id: bytes, worker_id: bytes):
+        with self._lock:
+            self._refs.setdefault(object_id, _Ref()).borrowers.add(worker_id)
+
+    def remove_borrower(self, object_id: bytes, worker_id: bytes):
         fire = False
         with self._lock:
             ref = self._refs.get(object_id)
             if ref is None:
                 return
-            if field == "local":
-                ref.local -= 1
-            else:
-                ref.submitted -= 1
-            if ref.local <= 0 and ref.submitted <= 0 and not ref.escaped:
+            ref.borrowers.discard(worker_id)
+            if ref.freeable():
                 del self._refs[object_id]
                 fire = True
         if fire:
-            try:
-                self._on_zero(object_id)
-            except Exception:
-                pass
+            self._fire(object_id, ref)
 
+    # ---------------------------------------------------------- borrowed ----
+    def mark_borrowed(self, object_id: bytes, owner_addr: tuple) -> bool:
+        """Record that this process borrows `object_id` from `owner_addr`.
+        Returns True the first time (caller sends borrow_add to the owner)."""
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.owner_addr = tuple(owner_addr)
+            if not ref.registered:
+                ref.registered = True
+                return True
+            return False
+
+    # ------------------------------------------------------- containment ----
+    def add_contained(self, container_id: bytes,
+                      nested: List[Tuple[bytes, Optional[tuple]]]):
+        """Record that `container_id`'s value embeds serialized refs; the
+        matching escape pins are taken by the SERIALIZING process (sync for
+        its own objects, escape_pin RPC for remote owners) and released via
+        pop_contained when the container is freed (reference:
+        reference_count.cc AddNestedObjectIds)."""
+        if not nested:
+            return
+        with self._lock:
+            self._contained.setdefault(container_id, []).extend(nested)
+
+    def is_tracked(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._refs
+
+    def pop_contained(self, container_id: bytes
+                      ) -> List[Tuple[bytes, Optional[tuple]]]:
+        with self._lock:
+            return self._contained.pop(container_id, [])
+
+    # ------------------------------------------------------------ internal --
+    def _dec(self, object_id: bytes, field: str):
+        fire = False
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            setattr(ref, field, getattr(ref, field) - 1)
+            if ref.freeable():
+                del self._refs[object_id]
+                fire = True
+        if fire:
+            self._fire(object_id, ref)
+
+    def _fire(self, object_id: bytes, ref: _Ref):
+        try:
+            self._on_zero(object_id, ref.owner_addr)
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- query ---
     def get_lineage(self, object_id: bytes):
         with self._lock:
             ref = self._refs.get(object_id)
@@ -84,3 +164,14 @@ class ReferenceCounter:
     def num_tracked(self) -> int:
         with self._lock:
             return len(self._refs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "tracked": len(self._refs),
+                "borrowed": sum(1 for r in self._refs.values()
+                                if r.owner_addr is not None),
+                "with_borrowers": sum(1 for r in self._refs.values()
+                                      if r.borrowers),
+                "contained": len(self._contained),
+            }

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 from typing import Any, Callable, List, Tuple
 
 import cloudpickle
@@ -33,6 +34,19 @@ class SerializationContext:
     def __init__(self):
         self.ref_hook: Callable | None = None
         self.ref_factory: Callable | None = None
+        self._tls = threading.local()
+
+    @property
+    def capture(self):
+        """Per-thread list collecting ObjectRefs seen while pickling one
+        container value (put / arg / return). None = no capture active;
+        the ref_hook then applies a permanent escape pin instead (manual
+        out-of-band pickling of a ref)."""
+        return getattr(self._tls, "capture", None)
+
+    @capture.setter
+    def capture(self, value):
+        self._tls.capture = value
 
     # -- data path -----------------------------------------------------------
     def serialize(self, value: Any) -> List[memoryview | bytes]:

@@ -21,6 +21,7 @@ import logging
 import os
 import signal
 import sys
+import time
 import traceback
 from typing import Any, Dict, Optional
 
@@ -67,25 +68,37 @@ class Executor:
                     raise val
             else:
                 oid, owner_addr, plasma_hint = e["ref"]
-                from ..object_ref import ObjectRef
-                ref = ObjectRef(bytes(oid), tuple(owner_addr), worker=self.core)
+                # Through the ref factory so the worker registers as a
+                # borrower with the owner (kept alive if user code stores
+                # the ref beyond the task).
+                ref = self.core._ref_factory(bytes(oid), tuple(owner_addr))
                 if plasma_hint is not None and not self.core.store.contains(
                         bytes(oid)) and tuple(plasma_hint) != \
                         self.core.agent_address:
-                    await self.core.agent.call("pull_object", {
-                        "object_id": bytes(oid),
-                        "from_addr": list(plasma_hint)}, timeout=120)
-                val = await self.core._get_one(ref, None)
+                    try:
+                        await self.core.agent.call("pull_object", {
+                            "object_id": bytes(oid),
+                            "from_addr": list(plasma_hint),
+                            "priority": 2}, timeout=120)
+                    except (rpc.RpcError, asyncio.TimeoutError):
+                        pass  # owner-mediated fetch below will sort it out
+                # Bounded: a freed/unrecoverable arg fails this task rather
+                # than holding the worker's task lock forever.
+                arg_deadline = time.monotonic() + \
+                    get_config().task_arg_fetch_timeout_s
+                val = await self.core._get_one(ref, arg_deadline)
             if e.get("kw"):
                 kwargs[e["kw"]] = val
             else:
                 args.append(val)
         return args, kwargs
 
-    def _serialize_returns(self, task_id: bytes, nreturns: int, result):
+    async def _serialize_returns(self, task_id: bytes, nreturns: int, result):
         """Small returns inline in the reply; large ones go to the local
-        shared-memory store with the agent pinning the primary copy
-        (reference: core_worker.h:1045 AllocateReturnObject — same split)."""
+        shared-memory store — through the create-backpressure path, so a
+        return that doesn't fit spills like a put would — with the agent
+        pinning the primary copy (reference: core_worker.h:1045
+        AllocateReturnObject — same split)."""
         if nreturns == 1:
             results = [result]
         else:
@@ -97,15 +110,34 @@ class Executor:
         ctx = get_context()
         out = []
         for i, value in enumerate(results):
-            parts = ctx.serialize(value)
+            ctx.capture = captured = []
+            try:
+                parts = ctx.serialize(value)
+            finally:
+                ctx.capture = None
             size = ctx.total_size(parts)
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            # The serializer takes the nested-ref pins NOW — synchronously
+            # for objects this worker owns (no unpinned window between the
+            # reply and the submitter's bookkeeping), ordered escape_pin
+            # notify for remote owners. The reply transfers release
+            # responsibility to the submitter (owner of the return object).
+            for noid, nowner in captured:
+                if nowner is None:
+                    self.core.reference_counter.add_escape_pin(noid)
+                else:
+                    self.core._notify_owner(nowner, "escape_pin", noid)
+            nested = [[noid, list(nowner) if nowner else
+                       list(self.core.address)]
+                      for noid, nowner in captured]
             if size <= self.core._inline_limit:
-                out.append({"inline": protocol.concat_parts(parts)})
+                entry = {"inline": protocol.concat_parts(parts)}
             else:
-                self.core.store.put(oid, parts)
-                out.append({"plasma": list(self.core.agent_address),
-                            "pin": oid})
+                await self.core.store_with_backpressure(oid, parts)
+                entry = {"plasma": list(self.core.agent_address), "pin": oid}
+            if nested:
+                entry["nested"] = nested
+            out.append(entry)
         return out
 
     async def _post_serialize(self, entries):
@@ -155,7 +187,7 @@ class Executor:
                 fn = await self._load_function(spec["fn_id"])
                 result = await loop.run_in_executor(
                     self.core.executor, lambda: fn(*args, **kwargs))
-            returns = self._serialize_returns(
+            returns = await self._serialize_returns(
                 spec["task_id"], spec["nreturns"], result)
             await self._post_serialize(returns)
             return {"status": "ok", "returns": returns}
