@@ -58,8 +58,9 @@ def remote(*args, **kwargs):
         if isinstance(target, type):
             cls_kwargs = {k: v for k, v in kwargs.items() if k in (
                 "num_cpus", "num_tpus", "resources", "max_restarts",
-                "max_concurrency", "name", "namespace", "lifetime",
-                "runtime_env", "scheduling_strategy", "get_if_exists")}
+                "max_task_retries", "max_concurrency", "name", "namespace",
+                "lifetime", "runtime_env", "scheduling_strategy",
+                "get_if_exists")}
             return ActorClass(target, **cls_kwargs)
         fn_kwargs = {k: v for k, v in kwargs.items() if k in (
             "num_returns", "num_cpus", "num_tpus", "resources",
@@ -97,9 +98,19 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    # Round 1: best-effort — queued tasks aren't individually addressable yet.
-    raise NotImplementedError(
-        "cancel is not yet supported; kill the actor or let the task finish")
+    """Cancel the task creating `ref` (reference: ray.cancel). Queued tasks
+    resolve to TaskCancelledError immediately; running async actor methods
+    have their coroutine cancelled; running sync functions get
+    TaskCancelledError raised in their thread (force=True kills the worker
+    process instead — rejected for actor tasks). Child tasks spawned by the
+    cancelled task are not tracked yet, so `recursive` only covers the task
+    itself."""
+    if recursive:
+        import logging
+        logging.getLogger("ray_tpu").debug(
+            "cancel(recursive=True): child-task tracking not implemented; "
+            "cancelling only the target task")
+    return _core().cancel(ref, force=force)
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -134,6 +134,9 @@ class CoreWorker:
         self.current_placement_group: Optional[dict] = None
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
         self._recovering: Dict[bytes, asyncio.Future] = {}
+        self._cancelled: set = set()               # task ids cancelled
+        self._inflight_tasks: Dict[bytes, _Lease] = {}        # normal tasks
+        self._inflight_actor_tasks: Dict[bytes, _ActorState] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -696,39 +699,55 @@ class CoreWorker:
         return self._run(self._wait(refs, num_returns, timeout))
 
     async def _wait(self, refs, num_returns, timeout):
+        """Event-driven wait (reference: raylet WaitManager — no polling):
+        owned refs complete when their memory-store entry lands; borrowed
+        refs long-poll the owner's get_object service once."""
+        waiters = {asyncio.ensure_future(self._wait_one(ref)): i
+                   for i, ref in enumerate(refs)}
+        pending_tasks = set(waiters)
+        ready_idx: set = set()
         deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(refs)
-        ready: List[ObjectRef] = []
-        while len(ready) < num_returns:
-            still = []
-            for ref in pending:
-                if await self._is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            await asyncio.sleep(0.005)
+        try:
+            while pending_tasks and len(ready_idx) < num_returns:
+                t = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                done, pending_tasks = await asyncio.wait(
+                    pending_tasks, timeout=t,
+                    return_when=asyncio.FIRST_COMPLETED)
+                for d in done:
+                    if not d.cancelled() and d.exception() is None and \
+                            d.result():
+                        ready_idx.add(waiters[d])
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+        finally:
+            for p in pending_tasks:
+                p.cancel()
+        ready = [r for i, r in enumerate(refs) if i in ready_idx]
+        pending = [r for i, r in enumerate(refs) if i not in ready_idx]
         return ready, pending
 
-    async def _is_ready(self, ref: ObjectRef) -> bool:
+    async def _wait_one(self, ref: ObjectRef) -> bool:
         oid = ref.binary()
         if self.memory_store.contains(oid) or self.store.contains(oid):
             return True
         owner = ref.owner_address or self.address
         if tuple(owner) == self.address:
-            return False
-        try:
-            conn = await self._peer_owner(owner)
-            res = await conn.call("get_object",
-                                  {"object_id": oid, "timeout_ms": 0},
-                                  timeout=5)
-            return res is not None
-        except (rpc.RpcError, asyncio.TimeoutError):
-            return False
+            await self.memory_store.wait_for(oid, None)
+            return True
+        # Chunked long-poll (30s slices): bounds owner-side waiter lifetime
+        # when this waiter is abandoned, and a transient owner outage is
+        # retried instead of resolving the ref as never-ready.
+        while True:
+            try:
+                conn = await self._peer_owner(owner)
+                res = await conn.call("get_object",
+                                      {"object_id": oid, "timeout_ms": 30_000},
+                                      timeout=35)
+                if res is not None:
+                    return True
+            except (rpc.RpcError, asyncio.TimeoutError):
+                await asyncio.sleep(0.5)
 
     # ------------------------------------------------------- normal tasks ----
     def submit_task(self, *, fn, fn_id: Optional[bytes], args, kwargs,
@@ -1067,13 +1086,30 @@ class CoreWorker:
 
     async def _push_and_track(self, key, state, lease: _Lease, task: _PendingTask):
         spec = task.spec
+        task_id = spec["task_id"]
+        if task_id in self._cancelled:
+            lease.inflight -= 1
+            self._store_task_exception(
+                spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
+            self._release_task_pins(task)
+            self._cancelled.discard(task_id)
+            self._pump(key, state)
+            return
+        self._inflight_tasks[task_id] = lease
         try:
             reply = await lease.conn.call("push_task", spec)
         except rpc.ConnectionLost:
             lease.inflight -= 1
             if lease in state.leases:
                 state.leases.remove(lease)
-            if spec["retries_left"] > 0:
+            if task_id in self._cancelled:
+                # force-cancel killed the worker: resolve as cancelled,
+                # never retry.
+                self._store_task_exception(
+                    spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
+                self._release_task_pins(task)
+                self._cancelled.discard(task_id)
+            elif spec["retries_left"] > 0:
                 spec["retries_left"] -= 1
                 state.queue.append(task)
             else:
@@ -1084,6 +1120,8 @@ class CoreWorker:
                 self._release_task_pins(task)
             self._pump(key, state)
             return
+        finally:
+            self._inflight_tasks.pop(task_id, None)
         lease.inflight -= 1
         lease.idle_since = time.monotonic()
         self._handle_reply(spec, task, reply)
@@ -1115,6 +1153,9 @@ class CoreWorker:
                     self.memory_store.put_inline(oid, entry["inline"])
                 else:
                     self.memory_store.put_plasma_location(oid, entry["plasma"])
+        elif reply.get("status") == "cancelled":
+            self._store_task_exception(
+                spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
         else:
             err = get_context().loads_code(reply["error"])
             wrapped = exc.RayTaskError(
@@ -1122,6 +1163,7 @@ class CoreWorker:
                 remote_traceback=reply.get("traceback", ""))
             self._store_task_exception(spec, wrapped)
         self._release_task_pins(task)
+        self._cancelled.discard(task_id)
 
     def _store_task_failure(self, spec, error: Exception):
         self._store_task_exception(spec, error)
@@ -1149,6 +1191,55 @@ class CoreWorker:
             oid = ObjectID.for_task_return(
                 TaskID(spec["task_id"]), i + 1).binary()
             self.memory_store.put_inline(oid, data, is_exception=True)
+
+    # -------------------------------------------------------------- cancel ---
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        return self._run(self._cancel(ref.binary(), force))
+
+    async def _cancel(self, oid: bytes, force: bool) -> bool:
+        """Cancel the task that creates `oid` (reference: core_worker.h
+        CancelTask / CancelRemoteTask, core_worker.proto:531). Queued tasks
+        resolve immediately to TaskCancelledError; running async actor
+        tasks get their coroutine cancelled; running sync tasks are only
+        interruptible with force=True (worker process kill)."""
+        task_id = ObjectID(oid).task_id().binary()
+        astate = self._inflight_actor_tasks.get(task_id)
+        if force and astate is not None:
+            raise ValueError(
+                "force=True is not supported for actor tasks (it would kill "
+                "the whole actor); use ray_tpu.kill(actor) instead")
+        self._cancelled.add(task_id)
+        # Still queued at the owner: drop it before it ever dispatches.
+        for state in self._keys.values():
+            for t in list(state.queue):
+                if t.spec["task_id"] == task_id:
+                    state.queue.remove(t)
+                    self._store_task_exception(
+                        t.spec,
+                        exc.TaskCancelledError(f"{t.spec['name']} cancelled"))
+                    self._release_task_pins(t)
+                    self._cancelled.discard(task_id)
+                    return True
+        # In flight on a leased worker.
+        lease = self._inflight_tasks.get(task_id)
+        if lease is not None and not lease.conn.closed:
+            try:
+                return bool(await lease.conn.call(
+                    "cancel_task", {"task_id": task_id, "force": force},
+                    timeout=10))
+            except (rpc.RpcError, asyncio.TimeoutError):
+                return True  # worker died mid-cancel: resolves as cancelled
+        # In flight on an actor.
+        if astate is not None and astate.conn and not astate.conn.closed:
+            try:
+                return bool(await astate.conn.call(
+                    "cancel_task", {"task_id": task_id, "force": False},
+                    timeout=10))
+            except (rpc.RpcError, asyncio.TimeoutError):
+                return True
+        # Not visible yet (actor resolving, push racing): the _cancelled
+        # mark is honored at dispatch by _push_and_track/_push_actor_task.
+        return True
 
     # ------------------------------------------------------------- actors ----
     def create_actor(self, *, cls, actor_id: bytes, args, kwargs, resources,
@@ -1194,10 +1285,11 @@ class CoreWorker:
                           ) -> List[ObjectRef]:
         return self._run(self.submit_actor_task_async(
             actor_id=actor_id, method=method, args=args, kwargs=kwargs,
-            num_returns=num_returns))
+            num_returns=num_returns, max_task_retries=max_task_retries))
 
     async def submit_actor_task_async(self, *, actor_id, method, args, kwargs,
-                                      num_returns) -> List[ObjectRef]:
+                                      num_returns, max_task_retries: int = 0
+                                      ) -> List[ObjectRef]:
         state = self._actors.get(actor_id)
         if state is None:
             state = self._actors[actor_id] = _ActorState(actor_id)
@@ -1208,6 +1300,7 @@ class CoreWorker:
         spec = protocol.make_task_spec(
             task_id=task_id, job_id=self.job_id, fn_id=b"", args=arg_entries,
             nreturns=num_returns, owner_addr=list(self.address), resources={},
+            retries_left=max_task_retries,
             actor_id=actor_id, method=method, seq=state.seq, name=method)
         refs = []
         for i in range(num_returns):
@@ -1254,22 +1347,51 @@ class CoreWorker:
             fut.set_result(None)
 
     async def _push_actor_task(self, state: _ActorState, spec, task):
-        try:
-            conn = await self._actor_conn(state)
-        except exc.ActorDiedError as e:
-            self._store_task_exception(spec, e)
-            self._release_task_pins(task)
+        """Push with reconnect-after-restart: a ConnectionLost mid-call
+        retries against the actor's next incarnation while retries_left
+        lasts (reference: actor_task_submitter.cc queueing across restarts
+        per max_task_retries); _actor_conn blocks through RESTARTING and
+        raises once the GCS declares the actor DEAD."""
+        task_id = spec["task_id"]
+        while True:
+            if task_id in self._cancelled:
+                self._store_task_exception(
+                    spec, exc.TaskCancelledError(f"{spec['method']} cancelled"))
+                self._release_task_pins(task)
+                self._cancelled.discard(task_id)
+                return
+            try:
+                conn = await self._actor_conn(state)
+            except exc.ActorDiedError as e:
+                self._store_task_exception(spec, e)
+                self._release_task_pins(task)
+                return
+            if task_id in self._cancelled:
+                continue  # loop top resolves it as cancelled
+            self._inflight_actor_tasks[task_id] = state
+            try:
+                reply = await conn.call("push_actor_task", spec)
+            except rpc.ConnectionLost:
+                state.conn = None
+                if task_id in self._cancelled:
+                    self._store_task_exception(
+                        spec, exc.TaskCancelledError(
+                            f"{spec['method']} cancelled"))
+                    self._release_task_pins(task)
+                    self._cancelled.discard(task_id)
+                    return
+                if spec["retries_left"] > 0:
+                    spec["retries_left"] -= 1
+                    continue
+                self._store_task_exception(spec, exc.ActorDiedError(
+                    f"actor {state.actor_id.hex()[:8]} died during "
+                    f"{spec['method']}"))
+                self._release_task_pins(task)
+                return
+            finally:
+                self._inflight_actor_tasks.pop(task_id, None)
+            self._handle_reply(spec, task, reply)
             return
-        try:
-            reply = await conn.call("push_actor_task", spec)
-        except rpc.ConnectionLost:
-            state.conn = None
-            self._store_task_exception(spec, exc.ActorDiedError(
-                f"actor {state.actor_id.hex()[:8]} died during "
-                f"{spec['method']}"))
-            self._release_task_pins(task)
-            return
-        self._handle_reply(spec, task, reply)
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
         self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))

@@ -21,6 +21,7 @@ import logging
 import os
 import signal
 import sys
+import threading
 import time
 import traceback
 from typing import Any, Dict, Optional
@@ -45,6 +46,9 @@ class Executor:
         self.actor_id: Optional[bytes] = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
         self._actor_is_async = False
+        self._running: Dict[bytes, tuple] = {}  # task_id -> (task, is_async)
+        self._running_threads: Dict[bytes, int] = {}  # sync task -> thread id
+        self._cancel_requested: set = set()   # cancels that arrived early
 
     # ------------------------------------------------------------ helpers ---
     async def _load_function(self, fn_id: bytes):
@@ -160,10 +164,26 @@ class Executor:
         async with self._task_lock:
             return await self._execute(spec)
 
+    def _run_sync(self, task_id: bytes, fn, args, kwargs):
+        """Sync user code on an executor thread; the thread id is recorded so
+        cancel_task can raise TaskCancelledError inside it (the same effect
+        as the reference's SIGINT-to-worker for running tasks — lands at the
+        next Python bytecode, not inside a blocking C call)."""
+        self._running_threads[task_id] = threading.get_ident()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._running_threads.pop(task_id, None)
+
     async def _execute(self, spec):
         loop = asyncio.get_running_loop()
         prev_task_id = self.core.current_task_id
         self.core.current_task_id = spec["task_id"]
+        if spec["task_id"] in self._cancel_requested:
+            # Cancelled while queued behind the task lock / actor semaphore.
+            self._cancel_requested.discard(spec["task_id"])
+            self.core.current_task_id = prev_task_id
+            return {"status": "cancelled"}
         strat = spec.get("scheduling_strategy") or {}
         prev_pg = self.core.current_placement_group
         if strat.get("type") == "placement_group":
@@ -174,23 +194,35 @@ class Executor:
             self.core.current_placement_group = {"pg_id": strat["pg_id"]}
         try:
             args, kwargs = await self._resolve_arg_entries(spec["args"])
+            tid = spec["task_id"]
             if spec.get("actor_id"):
                 if self.actor is None:
                     raise exc.RayError("actor task on non-actor worker")
                 method = getattr(self.actor, spec["method"])
                 if asyncio.iscoroutinefunction(method):
+                    self._running[tid] = (asyncio.current_task(), True)
                     result = await method(*args, **kwargs)
                 else:
+                    self._running[tid] = (asyncio.current_task(), False)
                     result = await loop.run_in_executor(
-                        self.core.executor, lambda: method(*args, **kwargs))
+                        self.core.executor,
+                        lambda: self._run_sync(tid, method, args, kwargs))
             else:
                 fn = await self._load_function(spec["fn_id"])
+                self._running[tid] = (asyncio.current_task(), False)
                 result = await loop.run_in_executor(
-                    self.core.executor, lambda: fn(*args, **kwargs))
+                    self.core.executor,
+                    lambda: self._run_sync(tid, fn, args, kwargs))
             returns = await self._serialize_returns(
                 spec["task_id"], spec["nreturns"], result)
             await self._post_serialize(returns)
             return {"status": "ok", "returns": returns}
+        except asyncio.CancelledError:
+            # cancel_task cancelled an async actor method's coroutine.
+            return {"status": "cancelled"}
+        except exc.TaskCancelledError:
+            # cancel_task raised inside the sync function's thread.
+            return {"status": "cancelled"}
         except Exception as e:  # noqa: BLE001 — every user error is reported
             tb = traceback.format_exc()
             try:
@@ -200,6 +232,7 @@ class Executor:
                     exc.RayError(f"{type(e).__name__}: {e} (unpicklable)"))
             return {"status": "error", "error": blob, "traceback": tb}
         finally:
+            self._running.pop(spec["task_id"], None)
             self.core.current_task_id = prev_task_id
             self.core.current_placement_group = prev_pg
 
@@ -231,6 +264,38 @@ class Executor:
         self._actor_sem = asyncio.Semaphore(max_conc)
         return True
 
+    async def h_cancel_task(self, conn, p):
+        """Cancel a task (reference: CoreWorkerService CancelTask,
+        core_worker.proto:531). Async actor methods: cancel the coroutine.
+        Sync functions: raise TaskCancelledError inside the executing thread
+        (the serial-execution guarantee is preserved — the dispatch
+        coroutine keeps awaiting until the thread actually unwinds).
+        force=True exits the process and is rejected by the owner for actor
+        tasks, so only dedicated lease workers die."""
+        task_id = p["task_id"]
+        if p.get("force"):
+            if task_id in self._running or task_id in self._cancel_requested:
+                asyncio.get_running_loop().call_later(
+                    0.05, lambda: os._exit(1))
+            return True
+        entry = self._running.get(task_id)
+        if entry is None:
+            # Not running yet (queued behind the lock / semaphore, or the
+            # push hasn't arrived): mark for cancellation at dispatch.
+            self._cancel_requested.add(task_id)
+            return True
+        task, is_async = entry
+        if is_async:
+            task.cancel()
+            return True
+        tid = self._running_threads.get(task_id)
+        if tid is not None:
+            import ctypes
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(exc.TaskCancelledError))
+            return True
+        return False
+
     async def h_kill(self, conn, p):
         logger.info("worker exiting on kill request")
         asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
@@ -255,6 +320,7 @@ async def amain():
         "push_task": executor.h_push_task,
         "push_actor_task": executor.h_push_actor_task,
         "actor_init": executor.h_actor_init,
+        "cancel_task": executor.h_cancel_task,
         "kill": executor.h_kill,
     }
     core._server.handlers.update(exec_handlers)

@@ -26,7 +26,8 @@ class ActorMethod:
         core = global_runtime().core
         refs = core.submit_actor_task(
             actor_id=self._handle._actor_id, method=self._method_name,
-            args=args, kwargs=kwargs, num_returns=self._num_returns)
+            args=args, kwargs=kwargs, num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *a, **k):
@@ -36,9 +37,13 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: bytes, class_name: str = "",
-                 owned: bool = False):
+                 owned: bool = False, max_task_retries: int = 0):
         self._actor_id = actor_id
         self._class_name = class_name
+        # Retries of in-flight method calls across actor restarts
+        # (reference: actor.py max_task_retries; requires max_restarts>0
+        # on the actor for a retry to ever find a new incarnation).
+        self._max_task_retries = max_task_retries
         # True only for the creator's original handle: when it is GC'd the
         # actor is terminated (reference: actor.py — non-detached actors die
         # when the original handle goes out of scope). Copies (serialized
@@ -60,7 +65,8 @@ class ActorHandle:
     def __reduce__(self):
         # Handles are freely serializable into tasks/objects (reference:
         # actor handles are first-class serializable values).
-        return (ActorHandle, (self._actor_id, self._class_name))
+        return (ActorHandle, (self._actor_id, self._class_name, False,
+                              self._max_task_retries))
 
     def __del__(self):
         if not getattr(self, "_owned", False):
@@ -75,14 +81,15 @@ class ActorHandle:
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=1, num_tpus=0, resources=None,
-                 max_restarts=0, max_concurrency=1, name=None, namespace=None,
-                 lifetime=None, runtime_env=None, scheduling_strategy=None,
-                 get_if_exists=False):
+                 max_restarts=0, max_task_retries=0, max_concurrency=1,
+                 name=None, namespace=None, lifetime=None, runtime_env=None,
+                 scheduling_strategy=None, get_if_exists=False):
         self._cls = cls
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus
         self._resources = dict(resources or {})
         self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
         self._max_concurrency = max_concurrency
         self._name = name
         self._lifetime = lifetime
@@ -99,6 +106,7 @@ class ActorClass:
         merged = dict(
             num_cpus=self._num_cpus, num_tpus=self._num_tpus,
             resources=self._resources, max_restarts=self._max_restarts,
+            max_task_retries=self._max_task_retries,
             max_concurrency=self._max_concurrency, name=self._name,
             lifetime=self._lifetime, runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
@@ -130,4 +138,5 @@ class ActorClass:
             class_name=self._cls.__name__)
         owned = self._lifetime != "detached"
         return ActorHandle(bytes(info["actor_id"]), self._cls.__name__,
-                           owned=owned)
+                           owned=owned,
+                           max_task_retries=self._max_task_retries)

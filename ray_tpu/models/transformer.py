@@ -226,7 +226,25 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
             return x
         return with_logical_constraint(x, axes, mesh, rules)
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    vocab_sharded = False
+    if mesh is not None:
+        spec = rules.spec(("vocab", "embed"), mesh)
+        vax = spec[0] if len(spec) > 0 else None
+        for ax in ([vax] if isinstance(vax, str) else (vax or [])):
+            if dict(mesh.shape).get(ax, 1) > 1:
+                vocab_sharded = True
+    if vocab_sharded:
+        # One-hot matmul instead of gather: with the table sharded over
+        # vocab a row-gather forces SPMD into involuntary full
+        # rematerialization (replicate-then-reshard); contracting over the
+        # vocab axis instead becomes a clean psum over its mesh axis and
+        # runs on the MXU (the MaxText iota-embed trick). Single-chip (or
+        # unsharded-vocab) keeps the cheaper gather.
+        table = params["embed"].astype(cfg.dtype)
+        one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+        x = jnp.einsum("bsv,ve->bse", one_hot, table)
+    else:
+        x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, ("batch", "seq", "embed"))
     S = tokens.shape[1]
     cos, sin = rope_angles(S, cfg.head_dim_, cfg.rope_theta)

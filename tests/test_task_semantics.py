@@ -1,0 +1,142 @@
+"""cancel(), max_task_retries across actor restarts, event-driven wait().
+
+Reference model: CancelTask (core_worker.proto:531), ActorTaskSubmitter
+retry-across-restart (actor_task_submitter.cc), WaitManager.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_cancel_queued_task(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+        return 1
+
+    # Saturate the 4 CPUs, then queue one more and cancel it.
+    blockers = [slow.options(num_cpus=1).remote() for _ in range(4)]
+    victim = slow.options(num_cpus=1).remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(victim, timeout=10)
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
+
+
+def test_cancel_running_force(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(300)
+
+    ref = hang.remote()
+    time.sleep(1.0)  # let it dispatch
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((exc.TaskCancelledError, exc.WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_running_sync_nonforce(ray_start_regular):
+    """Non-force cancel raises TaskCancelledError inside the running sync
+    function's thread (lands at the next Python bytecode)."""
+    @ray_tpu.remote
+    def spin():
+        import time as t
+        end = t.monotonic() + 60
+        x = 0
+        while t.monotonic() < end:
+            x += 1  # pure-Python loop: async-exc can land
+        return x
+
+    ref = spin.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_cancel_async_actor_task(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        async def hang(self):
+            import asyncio
+            await asyncio.sleep(300)
+            return 1
+
+        async def quick(self):
+            return 2
+
+    a = A.remote()
+    ref = a.hang.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    # actor still healthy
+    assert ray_tpu.get(a.quick.remote(), timeout=10) == 2
+
+
+def test_max_task_retries_across_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def die_once(self, marker_path):
+            import os
+            if not os.path.exists(marker_path):
+                open(marker_path, "w").close()
+                os._exit(1)  # hard-kill mid-call
+            return "survived"
+
+        def ping(self):
+            return "pong"
+
+    import tempfile
+    marker = tempfile.mktemp()
+    f = Flaky.remote()
+    assert ray_tpu.get(f.ping.remote(), timeout=30) == "pong"
+    # The call kills the actor process; the restart + retry must land on the
+    # new incarnation and succeed.
+    assert ray_tpu.get(f.die_once.remote(marker), timeout=60) == "survived"
+
+
+def test_actor_task_no_retry_fails(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def die(self):
+            import os
+            os._exit(1)
+
+    f = Fragile.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(f.die.remote(), timeout=30)
+
+
+def test_wait_event_driven(ray_start_regular):
+    @ray_tpu.remote
+    def delayed(t):
+        time.sleep(t)
+        return t
+
+    refs = [delayed.remote(0.2), delayed.remote(5.0)]
+    t0 = time.monotonic()
+    ready, pending = ray_tpu.wait(refs, num_returns=1, timeout=10)
+    elapsed = time.monotonic() - t0
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray_tpu.get(ready[0]) == 0.2
+    assert elapsed < 4.0
+    # timeout path
+    ready2, pending2 = ray_tpu.wait(pending, num_returns=1, timeout=0.1)
+    assert ready2 == [] and len(pending2) == 1
+
+
+def test_wait_all_ready_immediately(ray_start_regular):
+    refs = [ray_tpu.put(i) for i in range(8)]
+    ready, pending = ray_tpu.wait(refs, num_returns=8, timeout=5)
+    assert len(ready) == 8 and not pending
