@@ -1200,8 +1200,14 @@ class CoreWorker:
         """Cancel the task that creates `oid` (reference: core_worker.h
         CancelTask / CancelRemoteTask, core_worker.proto:531). Queued tasks
         resolve immediately to TaskCancelledError; running async actor
-        tasks get their coroutine cancelled; running sync tasks are only
-        interruptible with force=True (worker process kill)."""
+        tasks get their coroutine cancelled; running sync tasks get an
+        async-exc (or force=True worker kill)."""
+        if ObjectID(oid).is_put():
+            raise TypeError(
+                "cancel() expects a task return ref, not a put() ref "
+                "(reference: ray.cancel only cancels tasks)")
+        if self.memory_store.contains(oid):
+            return False   # already resolved: nothing to cancel
         task_id = ObjectID(oid).task_id().binary()
         astate = self._inflight_actor_tasks.get(task_id)
         if force and astate is not None:

@@ -48,7 +48,9 @@ class Executor:
         self._actor_is_async = False
         self._running: Dict[bytes, tuple] = {}  # task_id -> (task, is_async)
         self._running_threads: Dict[bytes, int] = {}  # sync task -> thread id
+        self._thread_guard = threading.Lock()
         self._cancel_requested: set = set()   # cancels that arrived early
+        self._cancel_intent: set = set()      # async-exc deliveries sent
 
     # ------------------------------------------------------------ helpers ---
     async def _load_function(self, fn_id: bytes):
@@ -169,11 +171,23 @@ class Executor:
         cancel_task can raise TaskCancelledError inside it (the same effect
         as the reference's SIGINT-to-worker for running tasks — lands at the
         next Python bytecode, not inside a blocking C call)."""
-        self._running_threads[task_id] = threading.get_ident()
+        with self._thread_guard:
+            self._running_threads[task_id] = threading.get_ident()
         try:
             return fn(*args, **kwargs)
+        except exc.TaskCancelledError:
+            if task_id in self._cancel_intent:
+                self._cancel_intent.discard(task_id)
+                raise
+            # Async-exc delivery raced task turnover on a pooled thread and
+            # landed on the wrong task: surface an explicit error instead
+            # of a false "cancelled".
+            raise exc.RayError(
+                "cancellation exception delivered to an uncancelled task "
+                "(thread-reuse race)") from None
         finally:
-            self._running_threads.pop(task_id, None)
+            with self._thread_guard:
+                self._running_threads.pop(task_id, None)
 
     async def _execute(self, spec):
         loop = asyncio.get_running_loop()
@@ -184,6 +198,9 @@ class Executor:
             self._cancel_requested.discard(spec["task_id"])
             self.core.current_task_id = prev_task_id
             return {"status": "cancelled"}
+        # Registered from the very start: a cancel arriving during arg
+        # resolution cancels this coroutine (user code hasn't run yet).
+        self._running[spec["task_id"]] = (asyncio.current_task(), True)
         strat = spec.get("scheduling_strategy") or {}
         prev_pg = self.core.current_placement_group
         if strat.get("type") == "placement_group":
@@ -200,7 +217,6 @@ class Executor:
                     raise exc.RayError("actor task on non-actor worker")
                 method = getattr(self.actor, spec["method"])
                 if asyncio.iscoroutinefunction(method):
-                    self._running[tid] = (asyncio.current_task(), True)
                     result = await method(*args, **kwargs)
                 else:
                     self._running[tid] = (asyncio.current_task(), False)
@@ -274,9 +290,13 @@ class Executor:
         tasks, so only dedicated lease workers die."""
         task_id = p["task_id"]
         if p.get("force"):
-            if task_id in self._running or task_id in self._cancel_requested:
+            if task_id in self._running:
                 asyncio.get_running_loop().call_later(
                     0.05, lambda: os._exit(1))
+            else:
+                # Not dispatched yet: honor the cancel at dispatch instead
+                # of letting the task run after cancel() returned True.
+                self._cancel_requested.add(task_id)
             return True
         entry = self._running.get(task_id)
         if entry is None:
@@ -286,15 +306,24 @@ class Executor:
             return True
         task, is_async = entry
         if is_async:
+            # Covers async actor methods AND any task still resolving args
+            # (user code hasn't started; cancelling the coroutine is safe).
             task.cancel()
             return True
-        tid = self._running_threads.get(task_id)
-        if tid is not None:
-            import ctypes
-            ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(tid), ctypes.py_object(exc.TaskCancelledError))
-            return True
-        return False
+        with self._thread_guard:
+            tid = self._running_threads.get(task_id)
+            if tid is not None:
+                import ctypes
+                self._cancel_intent.add(task_id)
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid),
+                    ctypes.py_object(exc.TaskCancelledError))
+                return True
+        # Sync task dispatched to the executor but its thread hasn't begun:
+        # cancelling the awaiting coroutine cancels the not-yet-started
+        # pool callable too.
+        task.cancel()
+        return True
 
     async def h_kill(self, conn, p):
         logger.info("worker exiting on kill request")

@@ -1,0 +1,26 @@
+"""ray_tpu.tune: hyperparameter search over the Train stack.
+
+Reference surface: python/ray/tune/__init__.py — Tuner (tuner.py:43),
+TuneConfig, grid_search + sampling distributions (search/sample.py),
+schedulers (ASHAScheduler), tune.report, ResultGrid.
+"""
+
+from ..train._session import report as _session_report
+from .schedulers import ASHAScheduler, FIFOScheduler
+from .search import (choice, grid_search, loguniform, randint, uniform,
+                     generate_variants)
+from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneController,
+                    Tuner)
+
+
+def report(metrics, checkpoint=None):
+    """Report intermediate trial results (reference: ray.tune.report is an
+    alias of ray.train.report; trials reuse the Train session channel)."""
+    _session_report(metrics, checkpoint=checkpoint)
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "TuneController",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "generate_variants", "ASHAScheduler", "FIFOScheduler", "report",
+]

@@ -1,0 +1,91 @@
+"""Trial schedulers: FIFO + Async Successive Halving (ASHA).
+
+Reference: python/ray/tune/schedulers/async_hyperband.py
+(AsyncHyperBandScheduler/ASHAScheduler) — rungs at
+grace_period * reduction_factor^k; at each rung a trial continues only if
+its metric is in the top 1/reduction_factor of results recorded there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion (reference: FIFOScheduler)."""
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+
+class _Rung:
+    def __init__(self, milestone: float):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}   # trial_id -> metric
+
+    def cutoff(self, rf: float) -> Optional[float]:
+        if not self.recorded:
+            return None
+        vals = sorted(self.recorded.values(), reverse=True)
+        k = max(0, int(len(vals) / rf) - 1)
+        return vals[k] if len(vals) >= rf else None
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving.
+
+    `metric` is read from each reported result; `time_attr` (default
+    "training_iteration") orders rungs. mode="max" keeps the largest.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4.0):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.rungs: List[_Rung] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(_Rung(t))
+            t *= reduction_factor
+        self.rungs.sort(key=lambda r: -r.milestone)   # highest first
+
+    def _value(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        v = float(v)
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        v = self._value(result)
+        if t is None or v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP   # ran its full budget
+        action = CONTINUE
+        for rung in self.rungs:
+            if t < rung.milestone or trial_id in rung.recorded:
+                continue
+            rung.recorded[trial_id] = v
+            cut = rung.cutoff(self.rf)
+            if cut is not None and v < cut:
+                action = STOP
+            break   # only the highest applicable rung (ASHA)
+        return action
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        pass

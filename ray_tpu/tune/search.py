@@ -1,0 +1,125 @@
+"""Search spaces + variant generation.
+
+Reference: python/ray/tune/search/ — sample.py distributions
+(tune.uniform/loguniform/choice/randint), grid_search markers, and
+BasicVariantGenerator (search/basic_variant.py) expanding
+grid x num_samples into concrete trial configs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List, Sequence
+
+
+class Domain:
+    """A sampled hyperparameter dimension."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories: Sequence[Any]) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    """Marker consumed by the variant generator (reference:
+    tune.grid_search)."""
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _flatten(space: Dict[str, Any], prefix=()) -> Dict[tuple, Any]:
+    out: Dict[tuple, Any] = {}
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _unflatten(flat: Dict[tuple, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        d = out
+        for k in path[:-1]:
+            d = d.setdefault(k, {})
+        d[path[-1]] = v
+    return out
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
+                      seed: int | None = None) -> List[Dict[str, Any]]:
+    """Expand a param space into concrete configs: the cartesian product of
+    every grid_search axis (at any nesting depth), each combination
+    repeated num_samples times with Domain values resampled (reference:
+    BasicVariantGenerator semantics — total trials =
+    num_samples * prod(grid sizes))."""
+    rng = random.Random(seed)
+    flat = _flatten(param_space)
+    grid_paths = [p for p, v in flat.items() if _is_grid(v)]
+    grid_values = [flat[p]["grid_search"] for p in grid_paths]
+    variants: List[Dict[str, Any]] = []
+    for combo in (itertools.product(*grid_values) if grid_paths else [()]):
+        for _ in range(num_samples):
+            cfg_flat: Dict[tuple, Any] = {}
+            for p, v in flat.items():
+                if p in grid_paths:
+                    cfg_flat[p] = combo[grid_paths.index(p)]
+                elif isinstance(v, Domain):
+                    cfg_flat[p] = v.sample(rng)
+                else:
+                    cfg_flat[p] = v
+            variants.append(_unflatten(cfg_flat))
+    return variants

@@ -1,0 +1,378 @@
+"""Tuner + trial controller.
+
+Reference: python/ray/tune/tuner.py:43 (Tuner), tune/execution/
+tune_controller.py:68 (trial actor lifecycle: start up to the concurrency
+cap, poll reports, feed the scheduler, early-stop, persist experiment
+state), tune/experiment/trial.py:248 (Trial state machine).
+
+Trials run as actors reusing the Train report channel (TrainSession): the
+trainable runs on a thread inside the trial actor and
+ray_tpu.tune.report(metrics, checkpoint=...) hands intermediate results to
+the controller's poll loop. Trainer-API trials (a DataParallelTrainer as
+the trainable) run fit() inside the trial actor and report the final
+result — ASHA early stopping applies to function trainables, which stream
+intermediate results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ..train._checkpoint import Checkpoint, CheckpointManager
+from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .search import generate_variants
+
+# Trial statuses (reference: trial.py Trial.PENDING/RUNNING/...)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+STOPPED = "STOPPED"      # early-stopped by the scheduler
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """reference: tune/tune_config.py."""
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    seed: Optional[int] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]          # last reported
+    metrics_history: List[Dict[str, Any]]
+    status: str
+    checkpoint: Optional[Checkpoint]
+    best_checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+
+
+class ResultGrid:
+    """reference: tune/result_grid.py."""
+
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given to get_best_result")
+        sign = 1.0 if mode == "max" else -1.0
+        scored = [r for r in self._results if metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return max(scored, key=lambda r: sign * float(r.metrics[metric]))
+
+    def get_dataframe(self):
+        rows = [{"trial_id": r.trial_id, "status": r.status,
+                 **{f"config/{k}": v for k, v in r.config.items()
+                    if isinstance(v, (int, float, str, bool))},
+                 **{k: v for k, v in (r.metrics or {}).items()
+                    if isinstance(v, (int, float, str, bool))}}
+                for r in self._results]
+        try:
+            import pandas as pd
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.metrics_history: List[Dict[str, Any]] = []
+        self.error: Optional[str] = None
+        self.actor = None
+        self.ckpt_mgr: Optional[CheckpointManager] = None
+
+    @property
+    def last_metrics(self) -> Dict[str, Any]:
+        return self.metrics_history[-1] if self.metrics_history else {}
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Runs one trial's trainable on a thread; polled by the controller
+    (reference: trials are actors driven by TuneController events)."""
+
+    def __init__(self, trial_id: str, storage_path: str):
+        from ..train._session import init_session
+        self.session = init_session(world_rank=0, world_size=1,
+                                    local_rank=0, storage_path=storage_path)
+        self.trial_id = trial_id
+        self._thread = None
+
+    def run(self, trainable_blob: bytes, config: Dict[str, Any]) -> bool:
+        import threading
+        trainable = cloudpickle.loads(trainable_blob)
+        session = self.session
+
+        def _go():
+            session.state = "running"
+            try:
+                result = trainable(config)
+                if isinstance(result, dict):
+                    session.report(result)
+                session.state = "finished"
+            except BaseException:  # noqa: BLE001 — reported, not fatal
+                session.error = traceback.format_exc()
+                session.state = "error"
+
+        self._thread = threading.Thread(target=_go, daemon=True,
+                                        name=f"trial-{self.trial_id}")
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        return {"state": self.session.state,
+                "error": self.session.error,
+                "reports": self.session.drain()}
+
+
+def _wrap_trainer(trainer) -> Callable:
+    """Adapt a DataParallelTrainer into a function trainable: param_space
+    overrides land in train_loop_config (reference: Tuner(trainer) with
+    param_space={'train_loop_config': {...}})."""
+    def _fit(config: Dict[str, Any]):
+        import copy
+        t = copy.copy(trainer)
+        overrides = config.get("train_loop_config", config)
+        t.train_loop_config = {**(trainer.train_loop_config or {}),
+                               **overrides}
+        result = t.fit()
+        if result.error:
+            raise RuntimeError(result.error)
+        return result.metrics
+    return _fit
+
+
+class Tuner:
+    """reference: tune/tuner.py:43."""
+
+    def __init__(self, trainable=None, *, param_space: Dict[str, Any] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None, _restore_path: Optional[str] = None):
+        from ..train.trainer import DataParallelTrainer, RunConfig
+        self._raw_trainable = trainable
+        if isinstance(trainable, DataParallelTrainer):
+            self.trainable = _wrap_trainer(trainable)
+        else:
+            self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restore_path = _restore_path
+
+    @classmethod
+    def restore(cls, path: str, trainable=None) -> "Tuner":
+        """Resume an interrupted sweep from its experiment dir (reference:
+        Tuner.restore(path, trainable) — finished trials are kept,
+        unfinished ones re-run)."""
+        return cls(trainable, _restore_path=path)
+
+    def fit(self) -> ResultGrid:
+        name = self.run_config.name or "tune_run"
+        storage = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        exp_dir = self._restore_path or os.path.join(storage, name)
+        controller = TuneController(
+            trainable=self.trainable,
+            param_space=self.param_space,
+            tune_config=self.tune_config,
+            exp_dir=exp_dir,
+            restore=self._restore_path is not None)
+        return controller.run()
+
+
+class TuneController:
+    """reference: tune/execution/tune_controller.py:68."""
+
+    def __init__(self, *, trainable, param_space, tune_config: TuneConfig,
+                 exp_dir: str, restore: bool = False,
+                 poll_interval_s: float = 0.2):
+        self.trainable = trainable
+        self.tc = tune_config
+        self.exp_dir = exp_dir
+        self.poll_interval_s = poll_interval_s
+        self.scheduler = self.tc.scheduler or FIFOScheduler()
+        os.makedirs(exp_dir, exist_ok=True)
+        self.state_file = os.path.join(exp_dir, "experiment_state.json")
+        if restore and os.path.exists(self.state_file):
+            self.trials = self._load_state()
+        else:
+            variants = generate_variants(param_space, self.tc.num_samples,
+                                         seed=self.tc.seed)
+            self.trials = [Trial(f"trial_{i:05d}", cfg)
+                           for i, cfg in enumerate(variants)]
+        if self.trainable is None:
+            raise ValueError("a trainable is required (pass it to Tuner() "
+                             "or Tuner.restore(path, trainable=...))")
+        self._blob = cloudpickle.dumps(self.trainable)
+
+    # ------------------------------------------------------- persistence ---
+    def _save_state(self):
+        data = {"metric": self.tc.metric, "mode": self.tc.mode,
+                "trials": [{
+            "trial_id": t.trial_id,
+            "config": cloudpickle.dumps(t.config).hex(),
+            "status": t.status,
+            "metrics_history": [
+                {k: v for k, v in m.items()
+                 if isinstance(v, (int, float, str, bool))}
+                for m in t.metrics_history],
+            "error": t.error,
+        } for t in self.trials]}
+        tmp = self.state_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.state_file)
+
+    def _load_state(self) -> List[Trial]:
+        with open(self.state_file) as f:
+            data = json.load(f)
+        # Metric/mode travel with the experiment so restore keeps them.
+        if self.tc.metric is None and data.get("metric"):
+            self.tc.metric = data["metric"]
+            self.tc.mode = data.get("mode", "max")
+        trials = []
+        for td in data["trials"]:
+            t = Trial(td["trial_id"],
+                      cloudpickle.loads(bytes.fromhex(td["config"])))
+            t.metrics_history = td["metrics_history"]
+            t.error = td["error"]
+            # Finished trials stay; anything in-flight at the crash re-runs.
+            t.status = (td["status"]
+                        if td["status"] in (TERMINATED, STOPPED) else PENDING)
+            if t.status in (TERMINATED, STOPPED):
+                # Re-attach the trial's persisted checkpoints.
+                trial_dir = os.path.join(self.exp_dir, t.trial_id)
+                if os.path.isdir(trial_dir):
+                    mgr = CheckpointManager(trial_dir)
+                    for d in sorted(os.listdir(trial_dir)):
+                        full = os.path.join(trial_dir, d)
+                        mfile = os.path.join(full, "_metrics.json")
+                        if os.path.isfile(mfile):
+                            with open(mfile) as mf:
+                                mgr.entries.append({
+                                    "path": full, "metrics": json.load(mf),
+                                    "time": os.path.getmtime(full)})
+                    t.ckpt_mgr = mgr
+            trials.append(t)
+        return trials
+
+    # ---------------------------------------------------------- run loop ---
+    def _start_trial(self, trial: Trial):
+        res = dict(self.tc.resources_per_trial or {"CPU": 1})
+        trial_dir = os.path.join(self.exp_dir, trial.trial_id)
+        trial.ckpt_mgr = CheckpointManager(trial_dir)
+        trial.actor = _TrialActor.options(
+            num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
+            resources=res or None).remote(trial.trial_id, trial_dir)
+        ray_tpu.get(trial.actor.run.remote(self._blob, trial.config),
+                    timeout=120)
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str, error: str = None):
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _ingest(self, trial: Trial, poll: Dict[str, Any]):
+        for rep in poll["reports"]:
+            metrics = rep["metrics"]
+            trial.metrics_history.append(metrics)
+            if rep.get("checkpoint_packed") is not None:
+                trial.ckpt_mgr.register_packed(rep["checkpoint_packed"],
+                                               metrics)
+            decision = self.scheduler.on_trial_result(trial.trial_id, metrics)
+            if decision == STOP and trial.status == RUNNING:
+                self._stop_trial(trial, STOPPED)
+                return
+
+    def run(self) -> ResultGrid:
+        max_conc = self.tc.max_concurrent_trials or 4
+        try:
+            while True:
+                running = [t for t in self.trials if t.status == RUNNING]
+                pending = [t for t in self.trials if t.status == PENDING]
+                for t in pending[:max(0, max_conc - len(running))]:
+                    try:
+                        self._start_trial(t)
+                    except Exception as e:
+                        # One unplaceable/broken trial must not abort the
+                        # sweep (reference: TuneController marks it errored
+                        # and proceeds).
+                        self._stop_trial(t, ERROR, f"trial start failed: {e}")
+                running = [t for t in self.trials if t.status == RUNNING]
+                if not running and not pending:
+                    break
+                time.sleep(self.poll_interval_s)
+                for t in running:
+                    try:
+                        poll = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+                    except Exception as e:
+                        self._stop_trial(t, ERROR, f"trial actor died: {e}")
+                        continue
+                    self._ingest(t, poll)
+                    if t.status != RUNNING:
+                        continue
+                    if poll["state"] == "finished":
+                        self.scheduler.on_trial_complete(
+                            t.trial_id, t.last_metrics)
+                        self._stop_trial(t, TERMINATED)
+                    elif poll["state"] == "error":
+                        self._stop_trial(t, ERROR, poll["error"])
+                self._save_state()
+        finally:
+            for t in self.trials:
+                if t.actor is not None:
+                    self._stop_trial(t, t.status)
+            self._save_state()
+        results = [TrialResult(
+            trial_id=t.trial_id, config=t.config, metrics=t.last_metrics,
+            metrics_history=t.metrics_history, status=t.status,
+            checkpoint=t.ckpt_mgr.latest if t.ckpt_mgr else None,
+            best_checkpoint=t.ckpt_mgr.best if t.ckpt_mgr else None,
+            error=t.error) for t in self.trials]
+        return ResultGrid(results, self.tc.metric, self.tc.mode)

@@ -1,0 +1,155 @@
+"""Tune: search spaces, ASHA, sweeps over trials, Tuner.restore.
+
+Reference model: tune/tuner.py:43, tune_controller.py:68 trial lifecycle,
+schedulers/async_hyperband.py ASHA.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def test_generate_variants_grid_and_random():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.loguniform(1e-5, 1e-2),
+        "nested": {"units": tune.grid_search([32, 64])},
+        "fixed": 7,
+    }
+    variants = tune.generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 2 * 2 * 3
+    assert all(v["fixed"] == 7 for v in variants)
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert {v["nested"]["units"] for v in variants} == {32, 64}
+    assert all(1e-5 <= v["wd"] <= 1e-2 for v in variants)
+
+
+def test_asha_stops_bad_trials():
+    sched = tune.ASHAScheduler(metric="score", mode="max", max_t=16,
+                               grace_period=1, reduction_factor=2)
+    # 4 trials report at t=1 with scores 1..4: late low scorers stop.
+    decisions = {}
+    for i, score in enumerate([4.0, 3.0, 2.0, 1.0]):
+        decisions[i] = sched.on_trial_result(
+            f"t{i}", {"training_iteration": 1, "score": score})
+    # The worst trial (reported last, below the rung cutoff) must stop.
+    assert decisions[3] == "STOP"
+    assert decisions[0] == "CONTINUE"
+
+
+def test_lr_sweep_with_early_stopping(ray_start_regular):
+    """Multi-trial LR sweep: good lr converges, bad lrs are ASHA-stopped."""
+
+    def trainable(config):
+        lr = config["lr"]
+        for it in range(1, 9):
+            # toy objective: good lr improves fast
+            score = it * (1.0 if lr == 0.1 else 0.05)
+            tune.report({"training_iteration": it, "score": score})
+            time.sleep(0.05)
+        return {"training_iteration": 8, "score": score}
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0, 10.0, 100.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(
+                metric="score", mode="max", max_t=8, grace_period=2,
+                reduction_factor=2),
+            max_concurrent_trials=4),
+        run_config=RunConfig(name=f"sweep_{time.time_ns():x}"))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.config["lr"] == 0.1
+    stopped = [r for r in grid if r.status == "STOPPED"]
+    assert len(stopped) >= 1   # at least one bad lr early-stopped
+
+
+def test_trial_checkpoints(ray_start_regular):
+    def trainable(config):
+        import tempfile as tf
+        from ray_tpu.train import Checkpoint
+        for it in range(1, 4):
+            d = tf.mkdtemp()
+            with open(os.path.join(d, "state.txt"), "w") as f:
+                f.write(f"iter={it}")
+            tune.report({"training_iteration": it, "loss": 1.0 / it},
+                        checkpoint=Checkpoint.from_directory(d))
+
+    tuner = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name=f"ckpt_{time.time_ns():x}"))
+    grid = tuner.fit()
+    for r in grid:
+        assert r.status == "TERMINATED"
+        assert r.checkpoint is not None
+        with open(os.path.join(r.checkpoint.path, "state.txt")) as f:
+            assert f.read() == "iter=3"
+
+
+def test_tuner_restore(ray_start_regular):
+    """Interrupted experiments resume: finished trials keep results,
+    unfinished re-run."""
+    exp_name = f"restore_{time.time_ns():x}"
+    storage = tempfile.gettempdir()
+    exp_dir = os.path.join(storage, "ray_tpu_results", exp_name)
+
+    def trainable(config):
+        tune.report({"training_iteration": 1, "score": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name=exp_name, storage_path=os.path.join(
+            storage, "ray_tpu_results")))
+    grid = tuner.fit()
+    assert len(grid) == 3 and all(r.status == "TERMINATED" for r in grid)
+
+    # Simulate a crash: mark one trial as still RUNNING in the state file.
+    import json
+    state_file = os.path.join(exp_dir, "experiment_state.json")
+    with open(state_file) as f:
+        state = json.load(f)
+    state["trials"][1]["status"] = "RUNNING"
+    state["trials"][1]["metrics_history"] = []
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+
+    grid2 = tune.Tuner.restore(exp_dir, trainable=trainable).fit()
+    assert len(grid2) == 3
+    assert all(r.status == "TERMINATED" for r in grid2)
+    best = grid2.get_best_result()
+    assert best.metrics["score"] == 3
+
+
+def test_tuner_over_trainer(ray_start_regular):
+    """Trainer-API trials: Tuner(JaxTrainer-like) with param_space
+    overriding train_loop_config."""
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+        train.report({"final": config["value"] * 2})
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"value": 0},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}))
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"value": tune.grid_search([3, 5])}},
+        tune_config=tune.TuneConfig(metric="final", mode="max",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name=f"trainer_{time.time_ns():x}"))
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert grid.get_best_result().metrics["final"] == 10
