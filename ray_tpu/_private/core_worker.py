@@ -94,7 +94,7 @@ class _KeyState:
 
 class _ActorState:
     __slots__ = ("actor_id", "address", "conn", "seq", "dead", "death_cause",
-                 "resolving")
+                 "resolving", "submit_queue", "draining")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
@@ -104,6 +104,11 @@ class _ActorState:
         self.dead = False
         self.death_cause = ""
         self.resolving: Optional[asyncio.Future] = None
+        # Per-actor submission pipeline: oversized-arg plasma puts complete
+        # in order before the push is scheduled, so a later small-arg call
+        # cannot overtake an earlier large-arg one.
+        self.submit_queue: deque = deque()
+        self.draining = False
 
 
 class CoreWorker:
@@ -137,6 +142,10 @@ class CoreWorker:
         self._cancelled: set = set()               # task ids cancelled
         self._inflight_tasks: Dict[bytes, _Lease] = {}        # normal tasks
         self._inflight_actor_tasks: Dict[bytes, _ActorState] = {}
+        # actor_id -> future of an in-flight background registration this
+        # process initiated; _actor_conn awaits it instead of polling GCS.
+        self._registering: Dict[bytes, asyncio.Future] = {}
+        self._seq_lock = threading.Lock()   # seq/put-id minting, any thread
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -207,11 +216,15 @@ class CoreWorker:
     # Owner-side borrower-ledger service (reference: reference counting RPCs
     # folded into CoreWorkerService).
     async def h_borrow_add(self, conn, p):
-        self.reference_counter.add_borrower(p["object_id"], p["worker_id"])
+        # Same staleness/resurrection rules as the reply path: never
+        # recreate a freed ref record, honor release tombstones by epoch.
+        self.reference_counter.add_borrower_from_reply(
+            p["object_id"], p["worker_id"], epoch=p.get("epoch", 0))
         return True
 
     async def h_borrow_release(self, conn, p):
-        self.reference_counter.remove_borrower(p["object_id"], p["worker_id"])
+        self.reference_counter.remove_borrower(
+            p["object_id"], p["worker_id"], epoch=p.get("epoch", 0))
         return True
 
     async def h_escape_pin(self, conn, p):
@@ -240,6 +253,10 @@ class CoreWorker:
         """Synchronous GCS RPC for API modules (placement groups, state)."""
         return self._run(self.gcs.call(method, payload, timeout=timeout))
 
+    def _spawn(self, coro) -> asyncio.Task:
+        """ensure_future with a strong reference held until completion."""
+        return rpc.spawn(coro)
+
     def _run(self, coro, timeout=None):
         """Run a coroutine from a sync caller thread."""
         if self.loop is None:
@@ -261,9 +278,11 @@ class CoreWorker:
             # Deserializing someone else's ref makes this process a borrower
             # (reference: reference_count.cc borrower registration; here an
             # eager borrow_add to the owner, released on local GC).
-            if self.reference_counter.mark_borrowed(object_id,
-                                                    tuple(owner_addr)):
-                self._notify_owner(tuple(owner_addr), "borrow_add", object_id)
+            epoch = self.reference_counter.mark_borrowed(object_id,
+                                                         tuple(owner_addr))
+            if epoch is not None:
+                self._notify_owner(tuple(owner_addr), "borrow_add", object_id,
+                                   epoch=epoch)
         return ref
 
     def _ref_serialized_hook(self, ref: ObjectRef):
@@ -282,7 +301,8 @@ class CoreWorker:
         else:
             self._notify_owner(remote, "escape_pin", ref.binary())
 
-    def _notify_owner(self, owner: tuple, method: str, object_id: bytes):
+    def _notify_owner(self, owner: tuple, method: str, object_id: bytes,
+                      **extra):
         """Fire-and-forget refcount message to an object's owner; safe from
         any thread (GC runs __del__ wherever it likes)."""
         if self.loop is None or self._shutdown:
@@ -292,7 +312,7 @@ class CoreWorker:
             try:
                 conn = await self._peer_owner(owner)
                 conn.notify(method, {"object_id": object_id,
-                                     "worker_id": self.worker_id})
+                                     "worker_id": self.worker_id, **extra})
             except Exception:
                 pass
 
@@ -301,11 +321,13 @@ class CoreWorker:
         except RuntimeError:
             pass
 
-    def _on_ref_zero(self, object_id: bytes, owner_addr=None):
+    def _on_ref_zero(self, object_id: bytes, owner_addr=None,
+                     borrow_epoch: int = 0):
         if owner_addr is not None:
             # Borrowed ref fully dropped: release our borrow with the owner.
             self.memory_store.delete(object_id)
-            self._notify_owner(tuple(owner_addr), "borrow_release", object_id)
+            self._notify_owner(tuple(owner_addr), "borrow_release", object_id,
+                               epoch=borrow_epoch)
             return
         # Owned object freed: cascade containment pins, then free the
         # primary copy.
@@ -338,10 +360,16 @@ class CoreWorker:
     def put(self, value: Any) -> ObjectRef:
         return self._run(self.put_async(value))
 
+    def _next_put_id(self) -> bytes:
+        # Minted from the driver thread (submit_actor_task) and the loop
+        # thread (put/_resolve_args) alike: always under the lock.
+        with self._seq_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        return ObjectID.for_put(TaskID(self.current_task_id), idx).binary()
+
     async def put_async(self, value: Any) -> ObjectRef:
-        self._put_counter += 1
-        oid = ObjectID.for_put(TaskID(self.current_task_id),
-                               self._put_counter).binary()
+        oid = self._next_put_id()
         ctx = get_context()
         ctx.capture = captured = []
         try:
@@ -702,7 +730,7 @@ class CoreWorker:
         """Event-driven wait (reference: raylet WaitManager — no polling):
         owned refs complete when their memory-store entry lands; borrowed
         refs long-poll the owner's get_object service once."""
-        waiters = {asyncio.ensure_future(self._wait_one(ref)): i
+        waiters = {self._spawn(self._wait_one(ref)): i
                    for i, ref in enumerate(refs)}
         pending_tasks = set(waiters)
         ready_idx: set = set()
@@ -908,9 +936,7 @@ class CoreWorker:
                             self._notify_owner(nowner, "escape_pin", noid)
                             borrowed_args.append((noid, nowner))
                 else:
-                    self._put_counter += 1
-                    oid = ObjectID.for_put(TaskID(self.current_task_id),
-                                           self._put_counter).binary()
+                    oid = self._next_put_id()
                     self.reference_counter.add_owned(oid)
                     self._record_contained(oid, captured)
                     await self._put_plasma(oid, parts)
@@ -945,13 +971,13 @@ class CoreWorker:
                     break
                 task = state.queue.popleft()
                 lease.inflight += 1
-                asyncio.ensure_future(self._push_and_track(key, state, lease, task))
+                self._spawn(self._push_and_track(key, state, lease, task))
         max_leases = get_config().max_leases_per_scheduling_key
         want = min(len(state.queue), max_leases - len(state.leases)
                    - state.pending_lease_requests)
         for _ in range(max(0, want)):
             state.pending_lease_requests += 1
-            asyncio.ensure_future(self._request_lease(key, state))
+            self._spawn(self._request_lease(key, state))
 
     async def _request_lease(self, key: bytes, state: _KeyState,
                              agent_conn: Optional[rpc.Connection] = None,
@@ -1017,7 +1043,7 @@ class CoreWorker:
                        agent_conn)
         state.leases.append(lease)
         self._pump(key, state)
-        asyncio.ensure_future(self._lease_reaper(key, state, lease))
+        self._spawn(self._lease_reaper(key, state, lease))
 
     async def _pg_agent_conn(self, strat: dict):
         """Resolve the agent hosting a PG-targeted lease's bundle.
@@ -1130,6 +1156,12 @@ class CoreWorker:
     def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
         task_id = spec["task_id"]
         if reply.get("status") == "ok":
+            # In-band borrow registration (see worker_main: reply["borrows"])
+            # — must precede _release_task_pins below so a stored arg ref
+            # keeps its object pinned across the handoff.
+            for oid, epoch in reply.get("borrows", []):
+                self.reference_counter.add_borrower_from_reply(
+                    bytes(oid), bytes(reply["borrower_id"]), epoch=epoch)
             for i, entry in enumerate(reply["returns"]):
                 oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
                 # Refs nested inside this return value: the worker already
@@ -1140,6 +1172,13 @@ class CoreWorker:
                            None if tuple(nowner) == self.address
                            else tuple(nowner))
                           for noid, nowner in entry.get("nested", [])]
+                # Nested refs WE own arrive unpinned by protocol (the worker
+                # defers to us to avoid the notify-vs-reply socket race);
+                # take their escape pins now, strictly before the submitted
+                # arg pins are released below.
+                for noid, nowner in nested:
+                    if nowner is None:
+                        self.reference_counter.add_escape_pin(noid)
                 if nested and not self.reference_counter.is_tracked(oid):
                     # Container already freed (caller dropped the return ref
                     # mid-flight): release the worker-taken pins instead of
@@ -1248,76 +1287,249 @@ class CoreWorker:
         return True
 
     # ------------------------------------------------------------- actors ----
+    def _on_loop_thread(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
     def create_actor(self, *, cls, actor_id: bytes, args, kwargs, resources,
                      name=None, get_if_exists=False, max_restarts=0,
                      max_concurrency=1, runtime_env=None,
                      scheduling_strategy=None, class_name="") -> dict:
-        return self._run(self._create_actor(
-            cls=cls, actor_id=actor_id, args=args, kwargs=kwargs,
+        # Class + args serialize on the CALLING thread (post-call mutation
+        # of init args is safe; matches submit_actor_task's guarantee).
+        ctx = get_context()
+        blob = ctx.dumps_code(cls)
+        arg_entries, ref_args, borrowed_args, big_puts = \
+            self._build_arg_entries_sync(args, kwargs)
+        coro = self._create_actor(
+            blob=blob, actor_id=actor_id, arg_entries=arg_entries,
+            ref_args=ref_args, borrowed_args=borrowed_args,
+            big_puts=big_puts,
             resources=resources, name=name, get_if_exists=get_if_exists,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             runtime_env=runtime_env, scheduling_strategy=scheduling_strategy,
-            class_name=class_name))
+            class_name=class_name)
+        if self._on_loop_thread():
+            # Called from an async actor method (e.g. a controller creating
+            # replicas): registration proceeds in the background and the
+            # client-minted id is returned immediately. get_if_exists needs
+            # the existing actor's id synchronously, which would block the
+            # loop — disallowed here.
+            if get_if_exists:
+                raise RuntimeError(
+                    "get_if_exists=True cannot be used from an async actor "
+                    "method; create the actor from a sync method")
+            fut = self._spawn(coro)
+            self._registering[actor_id] = fut
 
-    async def _create_actor(self, *, cls, actor_id, args, kwargs, resources,
+            def _done(f, aid=actor_id):
+                self._registering.pop(aid, None)
+                if not f.cancelled() and f.exception():
+                    logger.error(
+                        "background actor registration for %s failed: %s",
+                        class_name, f.exception())
+            fut.add_done_callback(_done)
+            return {"actor_id": actor_id, "class_name": class_name}
+        return self._run(coro)
+
+    async def _create_actor(self, *, blob, actor_id, arg_entries, ref_args,
+                            borrowed_args, big_puts, resources,
                             name, get_if_exists, max_restarts, max_concurrency,
                             runtime_env, scheduling_strategy, class_name):
-        ctx = get_context()
-        blob = ctx.dumps_code(cls)
         cls_id = protocol.function_id(blob)
-        await self.gcs.call("kv_put", {"ns": "actor_cls", "key": cls_id.hex(),
-                                       "value": blob, "overwrite": False})
-        arg_entries, _, _ = await self._resolve_args(args, kwargs)
-        spec = {
-            "actor_id": actor_id,
-            "job_id": self.job_id,
-            "class_id": cls_id,
-            "class_name": class_name,
-            "args": arg_entries,
-            "resources": resources,
-            "name": name,
-            "get_if_exists": get_if_exists,
-            "max_restarts": max_restarts,
-            "max_concurrency": max_concurrency,
-            "runtime_env": runtime_env,
-            "scheduling_strategy": scheduling_strategy,
-            "owner_addr": list(self.address),
-        }
-        res = await self.gcs.call("register_actor", {"spec": spec}, timeout=180)
+        try:
+            await self._store_big_puts(arg_entries, big_puts)
+            await self.gcs.call("kv_put", {"ns": "actor_cls",
+                                           "key": cls_id.hex(),
+                                           "value": blob, "overwrite": False})
+            return await self._register_actor_spec({
+                "actor_id": actor_id,
+                "job_id": self.job_id,
+                "class_id": cls_id,
+                "class_name": class_name,
+                "args": arg_entries,
+                "resources": resources,
+                "name": name,
+                "get_if_exists": get_if_exists,
+                "max_restarts": max_restarts,
+                "max_concurrency": max_concurrency,
+                "runtime_env": runtime_env,
+                "scheduling_strategy": scheduling_strategy,
+                "owner_addr": list(self.address),
+            })
+        finally:
+            # Init-arg pins live until registration settles (the actor's
+            # __init__ runs before register_actor returns).
+            for oid in ref_args:
+                self.reference_counter.remove_submitted(oid)
+            for noid, nowner in borrowed_args:
+                self._notify_owner(nowner, "escape_release", noid)
+
+    async def _register_actor_spec(self, spec):
+        res = await self.gcs.call("register_actor", {"spec": spec},
+                                  timeout=180)
         return res["actor"]
+
+    def _build_arg_entries_sync(self, args, kwargs):
+        """Serialize args on the CALLING thread (so post-call mutation is
+        safe) without touching the event loop: ObjectRefs pass by
+        reference, small values inline, oversized values are assigned a
+        put id whose plasma store happens later on the loop (big_puts).
+        Owned refs get submitted pins here; borrowed nested refs get
+        escape pins at their owners. Returns (entries, ref_args,
+        borrowed_args, big_puts)."""
+        ctx = get_context()
+        entries: List[dict] = []
+        ref_args: List[bytes] = []
+        borrowed_args: List[tuple] = []
+        big_puts: List[tuple] = []   # (oid, parts) — stored by the coroutine
+        items = [("", a) for a in args] + list(kwargs.items())
+        for kw, a in items:
+            if isinstance(a, ObjectRef):
+                oid = a.binary()
+                owner = list(a.owner_address or self.address)
+                hint = None
+                if tuple(owner) == self.address:
+                    entry_ms = self.memory_store.get(oid)
+                    if entry_ms is not None and entry_ms.plasma_node:
+                        hint = list(entry_ms.plasma_node)
+                # Pin EVERY by-ref arg while in flight — for borrowed refs
+                # the submitted pin keeps the local borrow registered (and
+                # thus the owner's borrower entry) until the reply.
+                ref_args.append(oid)
+                self.reference_counter.add_submitted(oid)
+                entry = {"ref": [oid, owner, hint]}
+            else:
+                ctx.capture = captured = []
+                try:
+                    parts = ctx.serialize(a)
+                finally:
+                    ctx.capture = None
+                size = ctx.total_size(parts)
+                for noid, nowner in captured:
+                    if nowner is None:
+                        ref_args.append(noid)
+                        self.reference_counter.add_submitted(noid)
+                    else:
+                        self._notify_owner(nowner, "escape_pin", noid)
+                        borrowed_args.append((noid, nowner))
+                if size <= self._inline_limit:
+                    entry = {"v": protocol.concat_parts(parts)}
+                else:
+                    poid = self._next_put_id()
+                    self.reference_counter.add_owned(poid)
+                    self.reference_counter.add_submitted(poid)
+                    ref_args.append(poid)
+                    big_puts.append((poid, [bytes(p) for p in parts]))
+                    entry = {"ref": [poid, list(self.address), None]}
+            if kw:
+                entry["kw"] = kw
+            entries.append(entry)
+        return entries, ref_args, borrowed_args, big_puts
+
+    async def _store_big_puts(self, spec_args, big_puts):
+        """Plasma-store oversized sync-serialized args and stamp their
+        location hints into the spec entries."""
+        for poid, parts in big_puts:
+            await self._put_plasma(poid, parts)
+            for e in spec_args:
+                if "ref" in e and bytes(e["ref"][0]) == poid:
+                    e["ref"][2] = list(self.agent_address)
 
     def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
                           num_returns: int, max_task_retries: int = 0
                           ) -> List[ObjectRef]:
-        return self._run(self.submit_actor_task_async(
-            actor_id=actor_id, method=method, args=args, kwargs=kwargs,
-            num_returns=num_returns, max_task_retries=max_task_retries))
-
-    async def submit_actor_task_async(self, *, actor_id, method, args, kwargs,
-                                      num_returns, max_task_retries: int = 0
-                                      ) -> List[ObjectRef]:
+        """Sync-safe from ANY thread, including the event loop (async actor
+        methods submitting to other actors — e.g. a Serve controller
+        pinging replicas). Args are serialized synchronously on the calling
+        thread (so post-call mutation of them is safe, matching reference
+        semantics); only plasma puts for oversized values and the push
+        itself run as a scheduled coroutine."""
+        if self.loop is None:
+            raise RuntimeError("core worker not started")
         state = self._actors.get(actor_id)
         if state is None:
-            state = self._actors[actor_id] = _ActorState(actor_id)
+            state = self._actors.setdefault(actor_id, _ActorState(actor_id))
         task_id = TaskID.for_actor_task(ActorID(actor_id)).binary()
-        arg_entries, ref_args, borrowed_args = await self._resolve_args(
-            args, kwargs)
-        state.seq += 1
+        entries, ref_args, borrowed_args, big_puts = \
+            self._build_arg_entries_sync(args, kwargs)
+        with self._seq_lock:
+            state.seq += 1
+            seq = state.seq
         spec = protocol.make_task_spec(
-            task_id=task_id, job_id=self.job_id, fn_id=b"", args=arg_entries,
+            task_id=task_id, job_id=self.job_id, fn_id=b"", args=entries,
             nreturns=num_returns, owner_addr=list(self.address), resources={},
             retries_left=max_task_retries,
-            actor_id=actor_id, method=method, seq=state.seq, name=method)
+            actor_id=actor_id, method=method, seq=seq, name=method)
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             self.reference_counter.add_owned(oid)
             refs.append(ObjectRef(oid, self.address, worker=self))
-        for oid in ref_args:
-            self.reference_counter.add_submitted(oid)
-        asyncio.ensure_future(self._push_actor_task(
-            state, spec, _PendingTask(spec, ref_args, borrowed_args)))
+        task = _PendingTask(spec, ref_args, borrowed_args)
+
+        def _go():
+            self._spawn(
+                self._finish_actor_submit(state, spec, task, big_puts))
+
+        self.loop.call_soon_threadsafe(_go)
         return refs
+
+    async def submit_actor_task_async(self, *, actor_id, method, args, kwargs,
+                                      num_returns, max_task_retries: int = 0
+                                      ) -> List[ObjectRef]:
+        return self.submit_actor_task(
+            actor_id=actor_id, method=method, args=args, kwargs=kwargs,
+            num_returns=num_returns, max_task_retries=max_task_retries)
+
+    async def _finish_actor_submit(self, state, spec, task, big_puts):
+        """Drains the per-actor queue in submission order: awaiting the
+        plasma puts happens inside the drain, and each push is scheduled
+        (not awaited) so concurrent calls still pipeline to async actors."""
+        state.submit_queue.append((spec, task, big_puts))
+        if state.draining:
+            return
+        state.draining = True
+        try:
+            while state.submit_queue:
+                spec, task, big_puts = state.submit_queue.popleft()
+                try:
+                    await self._store_big_puts(spec["args"], big_puts)
+                    # Submitter-side dependency resolution for owned ref
+                    # args (reference: dependency_resolver.cc — the task is
+                    # not pushed until its deps exist): pending results are
+                    # awaited here, small values inlined, plasma locations
+                    # stamped. Keeps the callee's execution slot free while
+                    # deps materialize and removes the callee-side fetch
+                    # timeout from the path.
+                    for e in spec["args"]:
+                        if "ref" not in e:
+                            continue
+                        roid = bytes(e["ref"][0])
+                        if tuple(e["ref"][1]) != self.address:
+                            continue   # borrowed: callee resolves via owner
+                        if e["ref"][2] is not None:
+                            continue   # already has a plasma location
+                        entry = await self.memory_store.wait_for(roid)
+                        if entry.data is not None:
+                            val = {"v": entry.data}
+                            if "kw" in e:
+                                val["kw"] = e["kw"]
+                            e.clear()
+                            e.update(val)
+                        elif entry.plasma_node is not None:
+                            e["ref"][2] = list(entry.plasma_node)
+                except Exception as e:  # put/resolve failed: fail this task
+                    self._store_task_exception(spec, exc.RayError(
+                        f"failed to resolve actor-task arg: {e}"))
+                    self._release_task_pins(task)
+                    continue
+                self._spawn(
+                    self._push_actor_task(state, spec, task))
+        finally:
+            state.draining = False
 
     async def _actor_conn(self, state: _ActorState) -> rpc.Connection:
         if state.conn is not None and not state.conn.closed:
@@ -1328,11 +1540,28 @@ class CoreWorker:
                 return state.conn
         state.resolving = asyncio.get_running_loop().create_future()
         try:
+            reg = self._registering.get(state.actor_id)
+            if reg is not None:
+                # This process kicked off the registration (loop-thread
+                # create_actor): wait for it instead of a bounded GCS poll
+                # — oversized init args can take arbitrarily long to store.
+                try:
+                    await asyncio.shield(reg)
+                except Exception as e:
+                    raise exc.ActorDiedError(
+                        f"actor registration failed: {e}") from None
             for attempt in range(60):
                 info = await self.gcs.call(
                     "get_actor", {"actor_id": state.actor_id,
                                   "wait_alive": True}, timeout=60)
                 if info is None:
+                    # The handle may have been minted before its background
+                    # registration reached the GCS (loop-thread create_actor
+                    # returns immediately); give registration a grace window
+                    # before declaring the actor dead.
+                    if attempt < 59:
+                        await asyncio.sleep(0.25)
+                        continue
                     raise exc.ActorDiedError("actor was never registered")
                 if info["state"] == protocol.ACTOR_DEAD:
                     state.dead = True
@@ -1400,7 +1629,10 @@ class CoreWorker:
             return
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
-        self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
+        if self._on_loop_thread():
+            self.kill_actor_nowait(actor_id)
+        else:
+            self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
         st = self._actors.get(actor_id)
         if st:
             st.dead = True

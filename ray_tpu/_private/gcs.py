@@ -134,6 +134,10 @@ class GcsServer:
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
         self.named_actors: Dict[str, bytes] = {}
+        # Kills that arrived before the (background) registration did:
+        # register_actor consumes these and buries the actor immediately.
+        # Bounded: actor_id -> arrival time, pruned by TTL on insert.
+        self._pending_kills: Dict[bytes, float] = {}
         self.jobs: Dict[bytes, dict] = {}
         self.placement_groups: Dict[bytes, dict] = {}
         self._pg_rr: Dict[bytes, int] = {}   # any-bundle rotation counters
@@ -180,11 +184,11 @@ class GcsServer:
         # died with the previous process; agents re-register shortly).
         for pg in self.placement_groups.values():
             if pg["state"] == "PENDING":
-                asyncio.ensure_future(self._place_pg(pg))
+                rpc.spawn(self._place_pg(pg))
         for actor in self.actors.values():
             if actor.state in (protocol.ACTOR_PENDING,
                                protocol.ACTOR_RESTARTING):
-                asyncio.ensure_future(self._reschedule_replayed(actor))
+                rpc.spawn(self._reschedule_replayed(actor))
         logger.info("GCS listening on %s%s", addr,
                     " (journal replayed)" if self.journal else "")
         return addr
@@ -320,7 +324,7 @@ class GcsServer:
             "resources": node.resources_total, "labels": node.labels,
             "store_path": node.store_path,
             "session_dir": node.session_dir})
-        asyncio.ensure_future(self._connect_agent(node))
+        rpc.spawn(self._connect_agent(node))
         self._publish(protocol.CH_NODE, {"event": "alive", "node": node.view()})
         return {"cluster_nodes": [n.view() for n in self.nodes.values()]}
 
@@ -435,6 +439,13 @@ class GcsServer:
         if name:
             self.named_actors[name] = actor_id
         self._log_actor(actor, with_spec=True)
+        if actor_id in self._pending_kills:
+            self._pending_kills.pop(actor_id, None)
+            actor.max_restarts = 0
+            actor.state = protocol.ACTOR_DEAD
+            actor.death_cause = "killed before registration completed"
+            self._log_actor(actor)
+            return {"existing": False, "actor": actor.view()}
         ok = await self._schedule_actor(actor)
         if not ok:
             actor.state = protocol.ACTOR_DEAD
@@ -538,6 +549,16 @@ class GcsServer:
     async def h_kill_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])
         if actor is None:
+            # Client-minted handles can be killed before their background
+            # registration lands; remember the kill so registration buries
+            # the actor instead of scheduling an unreachable orphan.
+            now = time.monotonic()
+            for aid, ts in list(self._pending_kills.items()):
+                if now - ts > 600.0:
+                    del self._pending_kills[aid]
+                else:
+                    break   # insertion-ordered: rest are fresher
+            self._pending_kills[p["actor_id"]] = now
             return False
         actor.max_restarts = 0  # explicit kill is permanent
         if actor.state == protocol.ACTOR_ALIVE and actor.address:
@@ -603,7 +624,7 @@ class GcsServer:
         }
         self.placement_groups[pg_id] = entry
         self._log("pg", entry)
-        asyncio.ensure_future(self._place_pg(entry))
+        rpc.spawn(self._place_pg(entry))
         return {"ok": True, "pg_id": pg_id}
 
     async def _place_pg(self, entry: dict):

@@ -26,12 +26,13 @@ primaries can be re-executed (reference: task_manager.h:227 ResubmitTask).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 
 class _Ref:
     __slots__ = ("local", "submitted", "escaped", "borrowers", "lineage",
-                 "owner_addr", "registered")
+                 "owner_addr", "registered", "borrow_epoch")
 
     def __init__(self):
         self.local = 0
@@ -41,6 +42,7 @@ class _Ref:
         self.lineage = None
         self.owner_addr = None    # None = owned by this process
         self.registered = False   # borrower side: borrow_add sent to owner
+        self.borrow_epoch = 0     # borrower side: per-registration generation
 
     def freeable(self) -> bool:
         return (self.local <= 0 and self.submitted <= 0
@@ -51,6 +53,11 @@ class ReferenceCounter:
     def __init__(self, on_zero: Callable[[bytes, Optional[tuple]], None],
                  on_borrow: Callable[[bytes, tuple], None] | None = None):
         self._refs: Dict[bytes, _Ref] = {}
+        # (oid, worker_id) -> (release time, max released epoch);
+        # insertion-ordered for pruning.
+        self._release_tombstones: Dict[Tuple[bytes, bytes],
+                                       Tuple[float, int]] = {}
+        self._borrow_epoch = 0
         self._contained: Dict[bytes, List[Tuple[bytes, Optional[tuple]]]] = {}
         self._lock = threading.Lock()
         self._on_zero = on_zero
@@ -88,9 +95,36 @@ class ReferenceCounter:
         with self._lock:
             self._refs.setdefault(object_id, _Ref()).borrowers.add(worker_id)
 
-    def remove_borrower(self, object_id: bytes, worker_id: bytes):
-        fire = False
+    def add_borrower_from_reply(self, object_id: bytes, worker_id: bytes,
+                                epoch: int = 0):
+        """Borrow registration carried in a task reply. Unlike add_borrower
+        this (a) never resurrects an already-freed ref record and (b) skips
+        registrations whose borrow_release already landed — the reply and
+        the release travel different sockets, so a borrow dropped between
+        the worker's snapshot and this call could otherwise re-register
+        forever. Staleness is decided by the borrower-minted epoch: a
+        release with epoch >= the reply's epoch covers it; a NEWER borrow
+        (fresh epoch) of the same pair registers normally."""
         with self._lock:
+            self._prune_tombstones()
+            released = self._release_tombstones.get((object_id, worker_id))
+            if released is not None and released[1] >= epoch:
+                return
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.borrowers.add(worker_id)
+
+    def remove_borrower(self, object_id: bytes, worker_id: bytes,
+                        epoch: int = 0):
+        fire = False
+        key = (object_id, worker_id)
+        with self._lock:
+            self._prune_tombstones()
+            # Delete-then-insert so a refreshed tombstone moves to the dict
+            # tail — pruning walks insertion order from the head.
+            old = self._release_tombstones.pop(key, None)
+            self._release_tombstones[key] = (
+                time.monotonic(), max(epoch, old[1] if old else 0))
             ref = self._refs.get(object_id)
             if ref is None:
                 return
@@ -101,17 +135,30 @@ class ReferenceCounter:
         if fire:
             self._fire(object_id, ref)
 
+    def _prune_tombstones(self, ttl: float = 300.0):
+        # Called under self._lock; insertion order == refresh order.
+        cutoff = time.monotonic() - ttl
+        while self._release_tombstones:
+            key, (ts, _) = next(iter(self._release_tombstones.items()))
+            if ts >= cutoff:
+                break
+            del self._release_tombstones[key]
+
     # ---------------------------------------------------------- borrowed ----
-    def mark_borrowed(self, object_id: bytes, owner_addr: tuple) -> bool:
+    def mark_borrowed(self, object_id: bytes,
+                      owner_addr: tuple) -> Optional[int]:
         """Record that this process borrows `object_id` from `owner_addr`.
-        Returns True the first time (caller sends borrow_add to the owner)."""
+        Returns the freshly minted borrow epoch the first time (caller sends
+        borrow_add carrying it to the owner), None on re-registration."""
         with self._lock:
             ref = self._refs.setdefault(object_id, _Ref())
             ref.owner_addr = tuple(owner_addr)
             if not ref.registered:
                 ref.registered = True
-                return True
-            return False
+                self._borrow_epoch += 1
+                ref.borrow_epoch = self._borrow_epoch
+                return ref.borrow_epoch
+            return None
 
     # ------------------------------------------------------- containment ----
     def add_contained(self, container_id: bytes,
@@ -135,6 +182,17 @@ class ReferenceCounter:
         with self._lock:
             return self._contained.pop(container_id, [])
 
+    def borrowed_from(self, owner_addr: tuple) -> List[Tuple[bytes, int]]:
+        """(object id, borrow epoch) pairs this process currently borrows
+        from `owner_addr`. Piggybacked on task replies so the owner learns
+        of retained borrows in-band, strictly before it releases the task's
+        submitted arg pins (reference: PushTaskReply borrowed-ref
+        metadata)."""
+        owner = tuple(owner_addr)
+        with self._lock:
+            return [(oid, r.borrow_epoch) for oid, r in self._refs.items()
+                    if r.registered and r.owner_addr == owner]
+
     # ------------------------------------------------------------ internal --
     def _dec(self, object_id: bytes, field: str):
         fire = False
@@ -151,7 +209,7 @@ class ReferenceCounter:
 
     def _fire(self, object_id: bytes, ref: _Ref):
         try:
-            self._on_zero(object_id, ref.owner_addr)
+            self._on_zero(object_id, ref.owner_addr, ref.borrow_epoch)
         except Exception:
             pass
 

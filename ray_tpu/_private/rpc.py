@@ -42,6 +42,21 @@ class ConnectionLost(RpcError):
     pass
 
 
+_BG_TASKS: set = set()
+
+
+def spawn(coro) -> asyncio.Task:
+    """ensure_future with a strong reference held until completion.
+
+    The event loop only weakly references its tasks; a bare ensure_future
+    whose Task object isn't stored can be garbage-collected mid-execution,
+    silently dropping the work (dispatches, pushes, registrations)."""
+    t = asyncio.ensure_future(coro)
+    _BG_TASKS.add(t)
+    t.add_done_callback(_BG_TASKS.discard)
+    return t
+
+
 # ---------------------------------------------------------------------------
 # Chaos (deterministic RPC fault injection)
 # ---------------------------------------------------------------------------
@@ -133,7 +148,7 @@ class Connection:
                     continue
                 mid, a, b = msg
                 if isinstance(a, str):  # request [mid, method, payload]
-                    asyncio.ensure_future(self._dispatch(mid, a, b))
+                    spawn(self._dispatch(mid, a, b))
                 else:  # response [mid, status, payload]
                     fut = self._pending.pop(mid, None)
                     if fut is not None and not fut.done():

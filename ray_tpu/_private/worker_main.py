@@ -99,7 +99,8 @@ class Executor:
                 args.append(val)
         return args, kwargs
 
-    async def _serialize_returns(self, task_id: bytes, nreturns: int, result):
+    async def _serialize_returns(self, task_id: bytes, nreturns: int, result,
+                                 caller_addr=None):
         """Small returns inline in the reply; large ones go to the local
         shared-memory store — through the create-backpressure path, so a
         return that doesn't fit spills like a put would — with the agent
@@ -125,13 +126,18 @@ class Executor:
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             # The serializer takes the nested-ref pins NOW — synchronously
             # for objects this worker owns (no unpinned window between the
-            # reply and the submitter's bookkeeping), ordered escape_pin
-            # notify for remote owners. The reply transfers release
-            # responsibility to the submitter (owner of the return object).
+            # reply and the submitter's bookkeeping). Refs owned by the
+            # CALLER are deliberately NOT pinned here: an escape_pin notify
+            # travels a different socket than the push reply and can lose
+            # the race against the caller releasing its submitted arg pins,
+            # freeing the object mid-handoff — instead the caller takes
+            # those pins itself, synchronously, from the reply's `nested`
+            # metadata (see _handle_reply). Third-party owners get the
+            # notify (sent before the reply, tiny residual window).
             for noid, nowner in captured:
                 if nowner is None:
                     self.core.reference_counter.add_escape_pin(noid)
-                else:
+                elif caller_addr is None or tuple(nowner) != tuple(caller_addr):
                     self.core._notify_owner(nowner, "escape_pin", noid)
             nested = [[noid, list(nowner) if nowner else
                        list(self.core.address)]
@@ -230,9 +236,23 @@ class Executor:
                     self.core.executor,
                     lambda: self._run_sync(tid, fn, args, kwargs))
             returns = await self._serialize_returns(
-                spec["task_id"], spec["nreturns"], result)
+                spec["task_id"], spec["nreturns"], result,
+                caller_addr=spec.get("owner_addr"))
             await self._post_serialize(returns)
-            return {"status": "ok", "returns": returns}
+            reply = {"status": "ok", "returns": returns}
+            caller = spec.get("owner_addr")
+            if caller is not None:
+                # Live borrows of caller-owned refs ride the reply so the
+                # caller ledgers them BEFORE dropping its submitted arg pins
+                # — an eager borrow_add notify travels a different socket
+                # than this reply and can arrive after the pins are gone,
+                # letting the owner free an object a stored ref still needs.
+                borrows = self.core.reference_counter.borrowed_from(
+                    tuple(caller))
+                if borrows:
+                    reply["borrows"] = borrows
+                    reply["borrower_id"] = self.core.worker_id
+            return reply
         except asyncio.CancelledError:
             # cancel_task cancelled an async actor method's coroutine.
             return {"status": "cancelled"}

@@ -1,0 +1,19 @@
+"""ray_tpu.serve: model serving — controller/replica/router/proxy.
+
+Reference surface: python/ray/serve/__init__.py — @serve.deployment,
+serve.run/start/shutdown, DeploymentHandle, @serve.batch
+(serve/_private/controller.py:102, router.py:472, pow_2_router.py:27,
+long_poll.py:228, batching.py).
+"""
+
+from ._private.batching import batch
+from ._private.proxy import Request
+from .api import (Application, Deployment, DeploymentHandle,
+                  DeploymentResponse, deployment, get_deployment_handle,
+                  run, shutdown, start)
+
+__all__ = [
+    "deployment", "Deployment", "Application", "DeploymentHandle",
+    "DeploymentResponse", "run", "start", "shutdown",
+    "get_deployment_handle", "batch", "Request",
+]

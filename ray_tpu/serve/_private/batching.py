@@ -1,0 +1,95 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Reference: python/ray/serve/batching.py — concurrent calls to the decorated
+async method are queued and flushed as one list-call when max_batch_size is
+reached or batch_wait_timeout_s elapses; each caller gets its own element
+of the returned list. On TPU replicas this is what turns request streams
+into MXU-sized batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self.queue: List[tuple] = []      # (single_arg, future)
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+
+    async def submit(self, item: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.queue.append((item, fut))
+        if len(self.queue) >= self.max_batch_size:
+            self._do_flush()
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(self.timeout_s,
+                                                 self._do_flush)
+        return await fut
+
+    def _do_flush(self):
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        batch, self.queue = self.queue, []
+        if batch:
+            from ray_tpu._private import rpc
+            rpc.spawn(self._run_batch(batch))
+
+    async def _run_batch(self, batch: List[tuple]):
+        items = [b[0] for b in batch]
+        try:
+            results = await self.fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for a batch of {len(items)}")
+            for (_, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # noqa: BLE001 — propagate to every caller
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn: Callable = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for async methods taking a LIST of inputs (reference:
+    @serve.batch). The wrapped method is called with one element; batching
+    is transparent."""
+
+    def deco(fn):
+        queues = {}   # per bound instance (or None for free functions)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:           # bound method: (self, item)
+                owner, item = args
+                key = id(owner)
+                bound = fn.__get__(owner, type(owner))
+            elif len(args) == 1:
+                (item,) = args
+                key, bound = None, fn
+            else:
+                raise TypeError("@serve.batch methods take exactly one "
+                                "request argument")
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(
+                    bound, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -1,0 +1,243 @@
+"""ServeController: deployment reconciliation + routing-table long-poll.
+
+Reference: python/ray/serve/_private/controller.py:102 (ServeController
+actor; deploy_application :797), deployment_state.py reconcilers, and
+long_poll.py:228 (LongPollHost — routers block on listen() until the
+routing snapshot's version moves).
+
+The controller is a detached named actor. A background coroutine on its
+event loop reconciles desired vs actual replicas (create missing, replace
+dead) and bumps a version that long-polling routers wake on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeControllerImpl:
+    """The controller actor's implementation (wrapped by @remote at
+    creation so tests can also drive it directly)."""
+
+    def __init__(self):
+        # name -> {blob, init_args, init_kwargs, num_replicas, ray_opts,
+        #          replicas: [ActorHandle], version}
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+        self.version = 0
+        self._ticks = 0
+        self._last_error: Optional[str] = None
+        self.startup_timeout_s = 180.0
+        self._born: Dict[bytes, float] = {}       # replica -> first seen
+        self._confirmed: set = set()              # replicas that ponged once
+        self._version_event: Optional[asyncio.Event] = None
+        self._reconcile_lock = asyncio.Lock()
+        self._reconcile_task = None
+        self._shutdown = False
+        # Kick the reconcile loop onto this worker's running event loop
+        # (__init__ runs on an executor thread; the loop is live).
+        core = ray_tpu._core()
+        asyncio.run_coroutine_threadsafe(self._start_loop(), core.loop)
+
+    async def _start_loop(self):
+        self._version_event = asyncio.Event()
+        self._reconcile_task = asyncio.ensure_future(self._reconcile_loop())
+
+    def _forget(self, replica):
+        self._born.pop(replica._actor_id, None)
+        self._confirmed.discard(replica._actor_id)
+
+    def _bump(self):
+        self.version += 1
+        if self._version_event is not None:
+            self._version_event.set()
+            self._version_event = asyncio.Event()
+
+    # ------------------------------------------------------------ deploy ---
+    async def deploy(self, name: str, blob: bytes, init_args: tuple,
+                     init_kwargs: dict, num_replicas: int,
+                     ray_actor_options: Optional[dict] = None) -> bool:
+        import hashlib
+        fingerprint = hashlib.sha1(
+            blob + repr((init_args, init_kwargs)).encode()).hexdigest()
+        prev = self.deployments.get(name)
+        keep = []
+        if prev is not None:
+            if prev["fingerprint"] == fingerprint:
+                keep = prev["replicas"]
+            else:
+                # Code/config changed: roll every replica (reference:
+                # DeploymentState replaces replicas on version change).
+                for r in prev["replicas"]:
+                    self._forget(r)
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+        self.deployments[name] = {
+            "blob": blob, "init_args": init_args, "init_kwargs": init_kwargs,
+            "num_replicas": int(num_replicas),
+            "ray_opts": dict(ray_actor_options or {}),
+            "replicas": keep,
+            "fingerprint": fingerprint,
+        }
+        await self._reconcile_once()
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        dep = self.deployments.pop(name, None)
+        if dep:
+            for r in dep["replicas"]:
+                self._forget(r)
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            self._bump()
+        return True
+
+    # --------------------------------------------------------- reconcile ---
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+            except Exception as e:
+                self._last_error = repr(e)
+                logger.exception("reconcile failed")
+            self._ticks += 1
+            await asyncio.sleep(1.0)
+
+    async def debug_state(self) -> Dict[str, Any]:
+        pings = {}
+        for n, d in self.deployments.items():
+            for r in d["replicas"]:
+                try:
+                    pong = await asyncio.wait_for(r.ping.remote(), 5)
+                    pings[r._actor_id.hex()[:8]] = repr(pong)
+                except Exception as e:
+                    pings[r._actor_id.hex()[:8]] = f"ERR {e!r}"
+        return {"ticks": self._ticks, "last_error": self._last_error,
+                "version": self.version,
+                "confirmed": len(self._confirmed),
+                "last_ping": getattr(self, "_last_ping", None),
+                "pings": pings,
+                "deployments": {n: len(d["replicas"])
+                                for n, d in self.deployments.items()}}
+
+    async def _reconcile_once(self):
+        # Serialized: deploy()/delete and the background tick would
+        # otherwise interleave awaits over the same deployment dict,
+        # clobbering each other's replica lists.
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self):
+        from .replica import ReplicaActor
+        changed = False
+        for name, dep in list(self.deployments.items()):
+            # Health-check current replicas (reference: replica health
+            # checks drive DeploymentState). Fresh replicas get a startup
+            # grace window — model __init__ (e.g. TPU weight loading) can
+            # far exceed one ping timeout.
+            healthy = []
+            for r in dep["replicas"]:
+                born = self._born.setdefault(r._actor_id, time.monotonic())
+                confirmed = r._actor_id in self._confirmed
+                definitely_dead = False
+                try:
+                    pong = await asyncio.wait_for(r.ping.remote(), 10)
+                    if pong == "pong":
+                        self._confirmed.add(r._actor_id)
+                        healthy.append(r)
+                        continue
+                except ray_tpu.exceptions.ActorDiedError as e:
+                    # The worker process is gone — no startup grace applies.
+                    self._last_ping = repr(e)
+                    definitely_dead = True
+                except Exception as e:
+                    self._last_ping = repr(e)
+                if not definitely_dead and not confirmed and \
+                        time.monotonic() - born < self.startup_timeout_s:
+                    healthy.append(r)   # still starting: keep waiting
+                    continue
+                changed = True
+                self._forget(r)
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            if self.deployments.get(name) is not dep:
+                # deploy()/delete ran during the awaits above and swapped
+                # the deployment out; don't scale a stale snapshot (any
+                # replicas it created would be orphaned).
+                continue
+            dep["replicas"] = healthy
+            # Scale up to target.
+            opts = dep["ray_opts"]
+            while len(dep["replicas"]) < dep["num_replicas"]:
+                actor = ReplicaActor.options(
+                    num_cpus=opts.get("num_cpus", 1),
+                    num_tpus=opts.get("num_tpus", 0),
+                    resources=opts.get("resources"),
+                    max_restarts=0,
+                ).remote(name, dep["blob"], dep["init_args"],
+                         dep["init_kwargs"])
+                dep["replicas"].append(actor)
+                changed = True
+            # Scale down.
+            while len(dep["replicas"]) > dep["num_replicas"]:
+                victim = dep["replicas"].pop()
+                changed = True
+                self._forget(victim)
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+        if changed:
+            self._bump()
+
+    # ------------------------------------------------------------ routing --
+    def _table(self, name: str) -> Dict[str, Any]:
+        dep = self.deployments.get(name)
+        return {"version": self.version,
+                "replicas": list(dep["replicas"]) if dep else []}
+
+    async def get_routing_table(self, name: str,
+                                known_version: int = -1,
+                                timeout_s: float = 25.0) -> Dict[str, Any]:
+        """Long-poll (reference: LongPollHost.listen_for_change): returns
+        immediately when the caller is stale, else blocks until the next
+        version bump or timeout."""
+        deadline = time.monotonic() + timeout_s
+        while self.version == known_version:
+            ev = self._version_event
+            if ev is None:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        return self._table(name)
+
+    async def list_deployments(self) -> List[str]:
+        return sorted(self.deployments)
+
+    async def graceful_shutdown(self) -> bool:
+        self._shutdown = True
+        for name in list(self.deployments):
+            await self.delete_deployment(name)
+        return True
+
+
+ServeController = ray_tpu.remote(ServeControllerImpl)

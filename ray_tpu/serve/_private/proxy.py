@@ -1,0 +1,146 @@
+"""HTTP proxy actor: minimal asyncio HTTP/1.1 ingress.
+
+Reference: python/ray/serve/_private/proxy.py (uvicorn/ASGI ingress per
+node). Here a dependency-free asyncio server: parses request line +
+headers + Content-Length body, routes by longest matching route prefix,
+awaits the ingress deployment's handle, and JSON/text/bytes-encodes the
+result.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
+
+
+class Request:
+    """What an ingress deployment's __call__ receives for an HTTP request
+    (a plain object, not ASGI: no starlette dependency)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    @property
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self.routes: Dict[str, Any] = {}   # route_prefix -> deployment name
+        self._routers: Dict[str, Any] = {}
+        self._server = None
+        core = ray_tpu._core()
+        fut = asyncio.run_coroutine_threadsafe(self._start(), core.loop)
+        fut.result(30)
+
+    async def _start(self):
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port)
+
+    def set_routes(self, routes: Dict[str, str]) -> bool:
+        self.routes = dict(routes)
+        return True
+
+    def ready(self) -> int:
+        return self.port
+
+    def _router_for(self, deployment: str):
+        r = self._routers.get(deployment)
+        if r is None:
+            from .controller import CONTROLLER_NAME
+            from .router import Router
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            r = self._routers[deployment] = Router(controller, deployment)
+        return r
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    break
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                clen = int(headers.get("content-length", "0") or 0)
+                if clen:
+                    body = await reader.readexactly(clen)
+                status, payload, ctype = await self._dispatch(
+                    method, target, headers, body)
+                writer.write(
+                    f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n".encode() + payload)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method: str, target: str,
+                        headers: Dict[str, str], body: bytes):
+        parts = urlsplit(target)
+        path = parts.path
+        match: Optional[str] = None
+        for prefix in sorted(self.routes, key=len, reverse=True):
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            return "404 Not Found", b'{"error": "no route"}', \
+                "application/json"
+        req = Request(method, path, dict(parse_qsl(parts.query)), headers,
+                      body)
+        try:
+            dep = self.routes[match]
+            loop = asyncio.get_running_loop()
+            # Router construction + assignment use the sync API: off-loop.
+            ref = await loop.run_in_executor(
+                None,
+                lambda: self._router_for(dep).assign("__call__", (req,), {}))
+            result = await ref
+            if isinstance(result, bytes):
+                return "200 OK", result, "application/octet-stream"
+            if isinstance(result, str):
+                return "200 OK", result.encode(), "text/plain"
+            # Inside the try: a non-JSON-serializable return (numpy arrays
+            # etc.) must surface as a 500, not kill the connection.
+            return ("200 OK", json.dumps(result).encode(),
+                    "application/json")
+        except Exception as e:  # noqa: BLE001 — HTTP surface reports all
+            logger.exception("request failed")
+            return ("500 Internal Server Error",
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json")

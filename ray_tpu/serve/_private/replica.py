@@ -1,0 +1,41 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: python/ray/serve/_private/replica.py — the replica wraps the
+user class/function, executes requests (async methods run concurrently on
+the actor's event loop, which is what lets @serve.batch coalesce them),
+and answers controller health checks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, deployment_name: str, blob: bytes,
+                 init_args: tuple, init_kwargs: dict):
+        self.deployment_name = deployment_name
+        target = cloudpickle.loads(blob)
+        if inspect.isclass(target):
+            self.callable = target(*init_args, **init_kwargs)
+        else:
+            self.callable = target
+
+    async def handle_request(self, method: str, args: tuple,
+                             kwargs: dict) -> Any:
+        fn = (self.callable if method in ("__call__", "")
+              else getattr(self.callable, method))
+        out = fn(*args, **kwargs)
+        if inspect.iscoroutine(out):
+            out = await out
+        return out
+
+    async def ping(self) -> str:
+        return "pong"

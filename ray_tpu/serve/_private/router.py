@@ -1,0 +1,73 @@
+"""Router: replica choice with power-of-two-choices load balancing.
+
+Reference: python/ray/serve/_private/router.py:472 +
+request_router/pow_2_router.py:27 — sample two replicas, send to the one
+with fewer in-flight requests from this router; replica sets refresh from
+the controller (long-poll in async contexts, stale-triggered fetch in sync
+driver contexts).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, deployment: str,
+                 refresh_interval_s: float = 2.0):
+        self._controller = controller
+        self._deployment = deployment
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[bytes, int] = {}
+        self._last_refresh = 0.0
+        self._refresh_interval_s = refresh_interval_s
+
+    def _refresh(self, force: bool = False, wait_nonempty_s: float = 30.0):
+        now = time.monotonic()
+        if (not force and self._replicas
+                and now - self._last_refresh < self._refresh_interval_s):
+            return
+        deadline = now + wait_nonempty_s
+        known = -1 if force else self._version
+        while True:
+            table = ray_tpu.get(
+                self._controller.get_routing_table.remote(
+                    self._deployment, known, 5.0), timeout=35)
+            self._version = table["version"]
+            self._replicas = table["replicas"]
+            self._last_refresh = time.monotonic()
+            if self._replicas or time.monotonic() >= deadline:
+                return
+            known = self._version
+
+    def assign(self, method: str, args: tuple, kwargs: dict):
+        """Pick a replica (pow-2) and dispatch; returns the ObjectRef."""
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"no replicas available for deployment "
+                f"{self._deployment!r}")
+        if len(self._replicas) == 1:
+            replica = self._replicas[0]
+        else:
+            a, b = random.sample(self._replicas, 2)
+            replica = min(
+                (a, b), key=lambda r: self._inflight.get(r._actor_id, 0))
+        rid = replica._actor_id
+        self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        try:
+            ref = replica.handle_request.remote(method, args, kwargs)
+        except Exception:
+            self._inflight[rid] -= 1
+            self._refresh(force=True)
+            raise
+        fut = ref.future()
+        fut.add_done_callback(
+            lambda _: self._inflight.__setitem__(
+                rid, max(0, self._inflight.get(rid, 1) - 1)))
+        return ref

@@ -1,0 +1,199 @@
+"""Public Serve API: @deployment / .bind() / run() / handles.
+
+Reference: python/ray/serve/api.py (serve.run, @serve.deployment),
+deployment.py (Deployment/Application), handle.py:692 (DeploymentHandle,
+.remote :768).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ._private.controller import CONTROLLER_NAME, ServeController
+from ._private.router import Router
+
+_proxy = None          # ProxyActor handle (one per serve.start with http)
+_http_port: Optional[int] = None
+_routes: Dict[str, str] = {}
+
+
+@dataclasses.dataclass
+class Application:
+    """A deployment bound to its init args (reference: Application from
+    Deployment.bind)."""
+    deployment: "Deployment"
+    init_args: tuple
+    init_kwargs: dict
+
+
+class Deployment:
+    def __init__(self, target: Callable, name: str, num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 route_prefix: str = "/"):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.route_prefix = route_prefix
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                ray_actor_options: Optional[dict] = None,
+                route_prefix: Optional[str] = None) -> "Deployment":
+        return Deployment(
+            self._target,
+            name=self.name if name is None else name,
+            num_replicas=(self.num_replicas if num_replicas is None
+                          else num_replicas),
+            ray_actor_options=(self.ray_actor_options
+                               if ray_actor_options is None
+                               else ray_actor_options),
+            route_prefix=(self.route_prefix if route_prefix is None
+                          else route_prefix))
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"deployment {self.name} must be deployed with serve.run("
+            f"{self.name}.bind(...)) and called through a handle")
+
+
+def deployment(_target: Callable = None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               route_prefix: str = "/"):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+    def deco(target):
+        return Deployment(target, name or target.__name__,
+                          num_replicas=num_replicas,
+                          ray_actor_options=ray_actor_options,
+                          route_prefix=route_prefix)
+    if _target is not None:
+        return deco(_target)
+    return deco
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference:
+    handle.DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    """reference: serve/handle.py:692; method access via attribute chaining
+    (handle.method.remote(...)), plain calls via handle.remote(...)."""
+
+    def __init__(self, deployment_name: str, method: str = "__call__"):
+        self._deployment = deployment_name
+        self._method = method
+        self._router: Optional[Router] = None
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentHandle(self._deployment, item)
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._router = Router(controller, self._deployment)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        ref = self._get_router().assign(self._method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._deployment, self._method))
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(
+            name=CONTROLLER_NAME, lifetime="detached",
+            get_if_exists=True, max_restarts=1).remote()
+
+
+def start(http_host: str = "127.0.0.1",
+          http_port: Optional[int] = None) -> None:
+    """Start the Serve control plane (reference: serve.start). HTTP ingress
+    only spins up when a port is given."""
+    global _proxy, _http_port
+    _get_or_create_controller()
+    if http_port is not None and _proxy is None:
+        from ._private.proxy import ProxyActor
+        _proxy = ProxyActor.options(name="SERVE_PROXY",
+                                    get_if_exists=True).remote(
+            http_host, http_port)
+        ray_tpu.get(_proxy.ready.remote(), timeout=60)
+        _http_port = http_port
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application and return its handle (reference: serve.run).
+    Waits for at least one replica to be live."""
+    global _routes
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects Deployment.bind(...)")
+    controller = _get_or_create_controller()
+    dep = app.deployment
+    dep_name = name or dep.name
+    blob = cloudpickle.dumps(dep._target)
+    ray_tpu.get(controller.deploy.remote(
+        dep_name, blob, app.init_args, app.init_kwargs,
+        dep.num_replicas, dep.ray_actor_options), timeout=120)
+    _routes[route_prefix or dep.route_prefix] = dep_name
+    if _proxy is not None:
+        ray_tpu.get(_proxy.set_routes.remote(_routes), timeout=30)
+    handle = DeploymentHandle(dep_name)
+    if _blocking:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            table = ray_tpu.get(controller.get_routing_table.remote(
+                dep_name, -1, 0.0), timeout=30)
+            if table["replicas"]:
+                return handle
+            time.sleep(0.2)
+        raise TimeoutError(f"deployment {dep_name} has no live replicas")
+    return handle
+
+
+def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def shutdown() -> None:
+    """Tear down all deployments, the controller, and the proxy."""
+    global _proxy, _routes
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except ValueError:
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+        _proxy = None
+    _routes = {}

@@ -283,7 +283,9 @@ class TuneController:
                 # Re-attach the trial's persisted checkpoints.
                 trial_dir = os.path.join(self.exp_dir, t.trial_id)
                 if os.path.isdir(trial_dir):
-                    mgr = CheckpointManager(trial_dir)
+                    mgr = CheckpointManager(
+                        trial_dir, score_attribute=self.tc.metric,
+                        score_order=self.tc.mode)
                     for d in sorted(os.listdir(trial_dir)):
                         full = os.path.join(trial_dir, d)
                         mfile = os.path.join(full, "_metrics.json")
@@ -300,7 +302,9 @@ class TuneController:
     def _start_trial(self, trial: Trial):
         res = dict(self.tc.resources_per_trial or {"CPU": 1})
         trial_dir = os.path.join(self.exp_dir, trial.trial_id)
-        trial.ckpt_mgr = CheckpointManager(trial_dir)
+        trial.ckpt_mgr = CheckpointManager(
+            trial_dir, score_attribute=self.tc.metric,
+            score_order=self.tc.mode)
         trial.actor = _TrialActor.options(
             num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
             resources=res or None).remote(trial.trial_id, trial_dir)
