@@ -127,6 +127,9 @@ class CoreWorker:
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
         self.current_task_id: bytes = b""
+        # Owner task for puts made outside any executing task (threads the
+        # user starts inside actors); minted lazily once job_id is known.
+        self._process_task_id_cache: Optional[bytes] = None
         self.current_actor_id: Optional[bytes] = None  # set in actor workers
         self._put_counter = 0
         self._keys: Dict[bytes, _KeyState] = {}
@@ -366,7 +369,16 @@ class CoreWorker:
         with self._seq_lock:
             self._put_counter += 1
             idx = self._put_counter
-        return ObjectID.for_put(TaskID(self.current_task_id), idx).binary()
+        task = self.current_task_id
+        if not task:
+            # put() outside any executing task (e.g. a user thread inside
+            # an actor, like a Tune trial's trainable thread): owned by a
+            # per-process pseudo-task so ids stay well-formed.
+            if self._process_task_id_cache is None:
+                self._process_task_id_cache = TaskID.for_normal_task(
+                    JobID(self.job_id or b"\x00\x00\x00\x00")).binary()
+            task = self._process_task_id_cache
+        return ObjectID.for_put(TaskID(task), idx).binary()
 
     async def put_async(self, value: Any) -> ObjectRef:
         oid = self._next_put_id()

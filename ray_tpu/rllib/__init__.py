@@ -1,0 +1,20 @@
+"""ray_tpu.rllib: reinforcement learning on the JAX learner stack.
+
+Reference surface: python/ray/rllib — AlgorithmConfig/Algorithm
+(algorithms/algorithm.py:212), EnvRunnerGroup
+(env/env_runner_group.py), RLModule (core/rl_module/rl_module.py),
+Learner/LearnerGroup (core/learner/learner.py:112,
+learner_group.py:101), PPO (algorithms/ppo/ppo.py).
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunner, EnvRunnerGroup
+from .learner import Learner, LearnerGroup, compute_gae
+from .ppo import PPO, PPOConfig
+from .rl_module import RLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "EnvRunner", "EnvRunnerGroup",
+    "Learner", "LearnerGroup", "compute_gae", "PPO", "PPOConfig",
+    "RLModule", "RLModuleSpec",
+]

@@ -1,0 +1,186 @@
+"""AlgorithmConfig + Algorithm: the RLlib driver loop.
+
+Reference surface: python/ray/rllib/algorithms/algorithm_config.py (fluent
+builder) and algorithms/algorithm.py:212 (Algorithm(Checkpointable,
+Trainable); step() :1189, training_step() :2273). The Algorithm here is
+Tune-Trainable-compatible: ray_tpu.tune can sweep AlgorithmConfigs by
+passing Algorithm subclasses as the trainable.
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+import ray_tpu
+
+from .env_runner import EnvRunnerGroup
+from .learner import LearnerGroup
+
+
+class AlgorithmConfig:
+    """Fluent config (reference: algorithm_config.py). Sections mirror the
+    reference's: environment() / env_runners() / training() / resources() /
+    debugging(); build_algo() constructs the Algorithm."""
+
+    algo_class: Optional[Type["Algorithm"]] = None
+
+    def __init__(self):
+        self.env: Optional[str] = None
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.num_learners = 0
+        self.learner_resources: Dict[str, Any] = {}
+        self.runner_resources: Dict[str, Any] = {}
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_config: Dict[str, Any] = {}
+        self.hiddens = (64, 64)
+        self.seed = 0
+
+    # ------------------------------------------------------------ sections --
+    def environment(self, env: str) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 model: Optional[dict] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if model:
+            self.hiddens = tuple(model.get("fcnet_hiddens", self.hiddens))
+        self.train_config.update(kwargs)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 learner_resources: Optional[dict] = None
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if learner_resources is not None:
+            self.learner_resources = dict(learner_resources)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build_algo(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("use a concrete config (e.g. PPOConfig)")
+        return self.algo_class(self.copy())
+
+    # Back-compat alias matching the reference's AlgorithmConfig.build().
+    build = build_algo
+
+    def learner_config_dict(self) -> Dict[str, Any]:
+        cfg = {"lr": self.lr, "gamma": self.gamma}
+        cfg.update(self.train_config)
+        return cfg
+
+
+class Algorithm:
+    """Driver-side training loop (reference: algorithm.py; Trainable
+    surface: train()/save()/restore()/stop() so Tune can drive it)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._episode_returns: List[float] = []
+        spec_kwargs = self._module_spec_kwargs(config)
+        self.learner_group = LearnerGroup(
+            spec_kwargs, config.learner_config_dict(),
+            num_learners=config.num_learners,
+            learner_resources=config.learner_resources, seed=config.seed)
+        self.env_runner_group = EnvRunnerGroup(
+            env_name=config.env, spec_kwargs=spec_kwargs,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            seed=config.seed, runner_resources=config.runner_resources,
+            gamma=config.gamma)
+
+    @staticmethod
+    def _module_spec_kwargs(config: AlgorithmConfig) -> Dict[str, Any]:
+        import gymnasium as gym
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        return {"obs_dim": obs_dim, "num_actions": num_actions,
+                "hiddens": config.hiddens}
+
+    # -------------------------------------------------------------- train ---
+    def training_step(self) -> Dict[str, Any]:
+        """sample -> learner update -> (weights broadcast next iteration)
+        (reference: algorithm.py training_step / ppo.py)."""
+        weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        t0 = time.monotonic()
+        samples = self.env_runner_group.sample(
+            weights_ref, self.config.rollout_fragment_length)
+        sample_s = time.monotonic() - t0
+        for s in samples:
+            self._episode_returns.extend(s.pop("episode_returns"))
+        t1 = time.monotonic()
+        metrics = self.learner_group.update(samples)
+        metrics["sample_time_s"] = sample_s
+        metrics["learn_time_s"] = time.monotonic() - t1
+        return metrics
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        metrics = self.training_step()
+        recent = self._episode_returns[-100:]
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "num_episodes": len(self._episode_returns),
+        })
+        return metrics
+
+    # -------------------------------------------------- checkpoint surface --
+    def save(self, path: str) -> str:
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "learner": self.learner_group.get_state(),
+                         "episode_returns": self._episode_returns[-100:]}, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        self._episode_returns = list(state["episode_returns"])
+        self.learner_group.set_state(state["learner"])
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()

@@ -1,0 +1,165 @@
+"""EnvRunner: remote actor collecting vectorized experience.
+
+Reference surface: python/ray/rllib/env/single_agent_env_runner.py — an
+EnvRunner holds a gymnasium vector env plus an inference copy of the
+RLModule and produces sample batches; env_runner_group.py fans sampling out
+over remote runner actors. Weight sync arrives by object-store broadcast
+(reference: algorithm.py syncs via ray.put), which on this runtime is a
+zero-copy shared-memory read per node.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .rl_module import RLModuleSpec
+
+
+def _make_env(env_name: str, seed: int):
+    import gymnasium as gym
+    env = gym.make(env_name)
+    env.reset(seed=seed)
+    return env
+
+
+class _VecEnv:
+    """N independent gymnasium envs stepped lockstep with auto-reset
+    (reference: gymnasium vector envs used by single_agent_env_runner)."""
+
+    def __init__(self, env_name: str, num_envs: int, seed: int):
+        self.envs = [_make_env(env_name, seed + i) for i in range(num_envs)]
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)])
+        # Per-env running episode returns, plus the returns of episodes
+        # completed since the last drain (for metrics).
+        self._ep_ret = np.zeros(num_envs)
+        self.completed_returns: List[float] = []
+
+    def step(self, actions: np.ndarray):
+        next_obs, rewards, dones = [], [], []
+        truncs = np.zeros(len(self.envs), bool)
+        final_obs = [None] * len(self.envs)
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            obs, r, term, trunc, _ = env.step(int(a))
+            done = term or trunc
+            self._ep_ret[i] += r
+            if done:
+                if trunc and not term:
+                    # Time-limit cut, not a real terminal: hand the final
+                    # observation back so the runner can bootstrap V(s_T)
+                    # (reference: env runners bootstrap at truncations).
+                    truncs[i] = True
+                    final_obs[i] = obs
+                self.completed_returns.append(float(self._ep_ret[i]))
+                self._ep_ret[i] = 0.0
+                obs, _ = env.reset()
+            next_obs.append(obs)
+            rewards.append(r)
+            dones.append(done)
+        self.obs = np.stack(next_obs)
+        return (self.obs, np.array(rewards, np.float32), np.array(dones),
+                truncs, final_obs)
+
+    def drain_returns(self) -> List[float]:
+        out, self.completed_returns = self.completed_returns, []
+        return out
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """One remote sampler (reference: SingleAgentEnvRunner).
+
+    sample(weights_ref, rollout_len) steps the vector env with the given
+    policy weights and returns a flat batch of transitions + bootstrap
+    values; GAE happens in the Learner so the runner stays policy-agnostic.
+    """
+
+    def __init__(self, env_name: str, spec_kwargs: Dict[str, Any],
+                 num_envs: int, seed: int, gamma: float = 0.99):
+        import jax
+
+        self.module = RLModuleSpec(**spec_kwargs).build()
+        self.vec = _VecEnv(env_name, num_envs, seed)
+        self.gamma = gamma
+        self.key = jax.random.key(seed)
+        self._explore = jax.jit(self.module.forward_exploration)
+        self._value_only = jax.jit(
+            lambda p, o: self.module.logits_and_value(p, o)[1])
+
+    def sample(self, weights, rollout_len: int) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        obs_l, act_l, logp_l, vf_l, rew_l, done_l = [], [], [], [], [], []
+        obs = self.vec.obs
+        for _ in range(rollout_len):
+            self.key, sub = jax.random.split(self.key)
+            actions, logp, value = self._explore(
+                weights, jnp.asarray(obs, jnp.float32), sub)
+            actions = np.asarray(actions)
+            obs_l.append(obs.astype(np.float32))
+            act_l.append(actions)
+            logp_l.append(np.asarray(logp))
+            vf_l.append(np.asarray(value))
+            obs, rewards, dones, truncs, final_obs = self.vec.step(actions)
+            if truncs.any():
+                # Truncation bootstrap: fold gamma * V(s_T) into the final
+                # reward so GAE's terminal cut doesn't bias value targets
+                # toward zero at time limits.
+                fin = np.stack([final_obs[i] for i in np.where(truncs)[0]])
+                v_fin = np.asarray(self._value_only(
+                    weights, jnp.asarray(fin, jnp.float32)))
+                rewards = rewards.copy()
+                rewards[truncs] += self.gamma * v_fin
+            rew_l.append(rewards)
+            done_l.append(dones)
+        bootstrap = np.asarray(self._value_only(
+            weights, jnp.asarray(obs, jnp.float32)))
+        return {
+            # [T, N, ...] time-major stacks
+            "obs": np.stack(obs_l),
+            "actions": np.stack(act_l),
+            "logp": np.stack(logp_l),
+            "vf": np.stack(vf_l),
+            "rewards": np.stack(rew_l),
+            "dones": np.stack(done_l),
+            "bootstrap_value": bootstrap,
+            "episode_returns": self.vec.drain_returns(),
+        }
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class EnvRunnerGroup:
+    """Fan-out over remote EnvRunner actors (reference:
+    env/env_runner_group.py)."""
+
+    def __init__(self, *, env_name: str, spec_kwargs: Dict[str, Any],
+                 num_env_runners: int, num_envs_per_runner: int, seed: int,
+                 runner_resources: Optional[dict] = None,
+                 gamma: float = 0.99):
+        res = dict(runner_resources or {})
+        self.runners = [
+            EnvRunner.options(
+                num_cpus=res.get("num_cpus", 1),
+                resources=res.get("resources")).remote(
+                env_name, spec_kwargs, num_envs_per_runner,
+                seed + 10_000 * i, gamma)
+            for i in range(num_env_runners)]
+
+    def sample(self, weights_ref, rollout_len: int) -> List[Dict[str, Any]]:
+        refs = [r.sample.remote(weights_ref, rollout_len)
+                for r in self.runners]
+        return ray_tpu.get(refs, timeout=300)
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

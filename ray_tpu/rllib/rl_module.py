@@ -1,0 +1,92 @@
+"""RLModule: the policy/value network abstraction, pure-JAX.
+
+Reference surface: python/ray/rllib/core/rl_module/rl_module.py — an
+RLModule bundles the neural net plus forward_exploration / forward_inference
+/ forward_train views over it. TPU-native design: the module is a pytree of
+params plus jitted pure functions (no framework Module object crossing
+process boundaries — params ship through the object store, functions are
+re-jitted per process, which is exactly how JAX wants it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RLModuleSpec:
+    """Builds concrete modules from (obs_dim, num_actions, hiddens)
+    (reference: core/rl_module/rl_module.py RLModuleSpec.build)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def build(self) -> "RLModule":
+        return RLModule(self)
+
+
+def _init_mlp(key, sizes) -> list:
+    params = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (fan_in, fan_out)) * np.sqrt(2.0 / fan_in)
+        params.append({"w": w, "b": jnp.zeros((fan_out,))})
+    return params
+
+
+def _mlp(params: list, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class RLModule:
+    """Actor-critic module with a categorical policy head.
+
+    forward_* mirror the reference's forward views
+    (rl_module.py forward_exploration/_inference/_train); all are pure in
+    (params, obs) so they jit/vmap/pjit cleanly.
+    """
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, key) -> Dict[str, Any]:
+        kp, kv = jax.random.split(key)
+        sizes = (self.spec.obs_dim,) + self.spec.hiddens
+        return {
+            "pi": _init_mlp(kp, sizes + (self.spec.num_actions,)),
+            "vf": _init_mlp(kv, sizes + (1,)),
+        }
+
+    def logits_and_value(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return _mlp(params["pi"], obs), _mlp(params["vf"], obs)[..., 0]
+
+    def forward_exploration(self, params, obs, key):
+        """Sample actions; returns (actions, logp, value)."""
+        logits, value = self.logits_and_value(params, obs)
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(obs.shape[0]), actions]
+        return actions, logp, value
+
+    def forward_inference(self, params, obs):
+        """Greedy actions (deterministic serving path)."""
+        logits, _ = self.logits_and_value(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_train(self, params, obs, actions):
+        """(logp(actions), entropy, value) for the PPO loss."""
+        logits, value = self.logits_and_value(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(obs.shape[0]), actions]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return logp, entropy, value
