@@ -138,6 +138,7 @@ class NodeAgent:
             "restore_object": self.h_restore_object,
             "node_info": self.h_node_info,
             "store_stats": self.h_store_stats,
+            "list_objects": self.h_list_objects,
             "ping": lambda conn, p: "pong",
             "shutdown": self.h_shutdown,
         }
@@ -908,6 +909,11 @@ class NodeAgent:
 
     async def h_store_stats(self, conn, p):
         return self.store.stats()
+
+    async def h_list_objects(self, conn, p):
+        """Full store index for the state API (reference: raylet
+        GetObjectsInfo, node_manager.proto:521)."""
+        return self.store.list_objects((p or {}).get("limit", 10_000))
 
     async def h_shutdown(self, conn, p):
         asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))

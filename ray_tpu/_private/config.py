@@ -85,6 +85,12 @@ _define("scheduler_spread_threshold", 0.5,
 _define("put_small_object_in_memory_store", True)
 _define("metrics_report_interval_ms", 2000)
 _define("event_buffer_max_events", 10_000)
+_define("task_event_flush_interval_s", 1.0,
+        "task-event + metric buffer flush period "
+        "(reference: task_event_buffer.h report interval)")
+_define("gcs_task_events_max", 100_000,
+        "GCS-side ring buffer cap on retained task events "
+        "(reference: RAY_task_events_max_num_task_in_gcs)")
 _define("log_rotation_bytes", 100 * 1024 * 1024)
 
 # ---- TPU specifics ----------------------------------------------------------

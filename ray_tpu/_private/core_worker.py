@@ -148,6 +148,10 @@ class CoreWorker:
         # actor_id -> future of an in-flight background registration this
         # process initiated; _actor_conn awaits it instead of polling GCS.
         self._registering: Dict[bytes, asyncio.Future] = {}
+        # Task status/profile events, flushed to the GCS sink periodically
+        # (reference: core_worker/task_event_buffer.h:297 AddTaskEvent /
+        # FlushEvents). Bounded: drops oldest under pressure.
+        self._task_events: deque = deque(maxlen=10000)
         self._seq_lock = threading.Lock()   # seq/put-id minting, any thread
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
@@ -203,6 +207,7 @@ class CoreWorker:
                                               name="cw->gcs")
         await self.gcs.ensure()
         self.agent = await rpc.connect(self.agent_address, name="cw->agent")
+        self._spawn(self._telemetry_flush_loop())
 
     def _handlers(self):
         return {
@@ -259,6 +264,55 @@ class CoreWorker:
     def _spawn(self, coro) -> asyncio.Task:
         """ensure_future with a strong reference held until completion."""
         return rpc.spawn(coro)
+
+    async def _agent_list_objects(self, agent_addr: tuple,
+                                  limit: int = 10_000):
+        conn = await rpc.connect(agent_addr, name="cw->agent-state",
+                                 retries=2)
+        try:
+            return await conn.call("list_objects", {"limit": limit},
+                                   timeout=20)
+        finally:
+            await conn.close()
+
+    # ---------------------------------------------------------- telemetry ---
+    def record_task_event(self, task_id: bytes, name: str, event: str,
+                          **extra):
+        """Buffer one task status/profile event; any thread."""
+        rec = {"task_id": task_id, "name": name, "event": event,
+               "ts": time.time(), "worker_id": self.worker_id,
+               "node_id": self.node_id, "job_id": self.job_id or b""}
+        if extra:
+            rec.update(extra)
+        self._task_events.append(rec)
+
+    async def _telemetry_flush_loop(self):
+        """Periodic push of buffered task events + metric deltas to the
+        GCS sinks (reference: TaskEventBuffer::FlushEvents +
+        metrics_agent)."""
+        from ..util import metrics as _metrics
+        interval = get_config().task_event_flush_interval_s
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            if self._task_events:
+                batch = []
+                while self._task_events:
+                    batch.append(self._task_events.popleft())
+                try:
+                    self.gcs.notify("task_events", {"events": batch})
+                except Exception:
+                    # Transient GCS outage: put the batch back for the
+                    # next interval (deque maxlen bounds memory).
+                    self._task_events.extendleft(reversed(batch))
+            snap = _metrics.registry_snapshot()
+            if snap:
+                try:
+                    self.gcs.notify("report_metrics", {
+                        "worker_id": self.worker_id,
+                        "node_id": self.node_id,
+                        "metrics": snap})
+                except Exception:
+                    pass
 
     def _run(self, coro, timeout=None):
         """Run a coroutine from a sync caller thread."""
@@ -861,6 +915,8 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.address, worker=self))
         key = protocol.scheduling_key(fn_id, resources, scheduling_strategy)
 
+        self.record_task_event(task_id, spec["name"], "SUBMITTED")
+
         def _enqueue():
             state = self._keys.get(key)
             if state is None:
@@ -901,6 +957,7 @@ class CoreWorker:
             state = self._keys[key] = _KeyState(resources, scheduling_strategy)
         state.queue.append(_PendingTask(spec, ref_args, borrowed_args))
         self._pump(key, state)
+        self.record_task_event(task_id, spec["name"], "SUBMITTED")
         return refs
 
     async def _export_function(self, fn, fn_id=None, blob=None) -> bytes:
@@ -1167,6 +1224,10 @@ class CoreWorker:
 
     def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
         task_id = spec["task_id"]
+        self.record_task_event(
+            task_id, spec.get("name") or spec.get("method", ""),
+            {"ok": "FINISHED", "cancelled": "CANCELLED"}.get(
+                reply.get("status"), "FAILED"))
         if reply.get("status") == "ok":
             # In-band borrow registration (see worker_main: reply["borrows"])
             # — must precede _release_task_pins below so a stored arg ref
@@ -1481,6 +1542,7 @@ class CoreWorker:
             self.reference_counter.add_owned(oid)
             refs.append(ObjectRef(oid, self.address, worker=self))
         task = _PendingTask(spec, ref_args, borrowed_args)
+        self.record_task_event(task_id, method, "SUBMITTED")
 
         def _go():
             self._spawn(

@@ -143,6 +143,12 @@ class GcsServer:
         self._pg_rr: Dict[bytes, int] = {}   # any-bundle rotation counters
         self._job_counter = 0
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
+        # Task-event sink (reference: gcs_task_manager.cc — bounded ring).
+        from collections import deque as _deque
+        from .config import get_config as _gc
+        self.task_events: _deque = _deque(maxlen=_gc().gcs_task_events_max)
+        # (name, labels_tuple) -> {"type", "value"/"sum"/"buckets", ...}
+        self.metrics: Dict[tuple, dict] = {}
         self._server = rpc.RpcServer(self._handlers(), name="gcs")
         self._health_task: Optional[asyncio.Task] = None
 
@@ -169,9 +175,68 @@ class GcsServer:
             "remove_placement_group": self.h_remove_placement_group,
             "get_placement_group": self.h_get_placement_group,
             "list_placement_groups": self.h_list_placement_groups,
+            "task_events": self.h_task_events,
+            "get_task_events": self.h_get_task_events,
+            "report_metrics": self.h_report_metrics,
+            "get_metrics": self.h_get_metrics,
             "ping": lambda conn, p: "pong",
             "get_cluster_info": self.h_get_cluster_info,
         }
+
+    # ----------------------------------------------------------- telemetry --
+    async def h_task_events(self, conn, p):
+        self.task_events.extend(p["events"])
+        return True
+
+    async def h_get_task_events(self, conn, p):
+        out = list(self.task_events)
+        if p.get("job_id"):
+            out = [e for e in out if e.get("job_id") == p["job_id"]]
+        if p.get("task_id"):
+            out = [e for e in out if e.get("task_id") == p["task_id"]]
+        limit = p.get("limit", 10_000)
+        return out[-limit:]
+
+    async def h_report_metrics(self, conn, p):
+        """Merge a per-process metric snapshot (reference: per-node
+        metrics agents pushing to the head aggregator). Counters arrive as
+        monotonic per-process totals keyed by worker, so aggregation sums
+        the latest value per worker."""
+        wid = p["worker_id"]
+        for m in p["metrics"]:
+            key = (m["name"], tuple(sorted(m.get("labels", {}).items())))
+            entry = self.metrics.setdefault(key, {
+                "name": m["name"], "labels": m.get("labels", {}),
+                "type": m["type"], "help": m.get("help", ""),
+                "per_worker": {}})
+            entry["type"] = m["type"]
+            entry["per_worker"][wid] = (m["value"], m.get("ts", 0.0))
+        return True
+
+    async def h_get_metrics(self, conn, p):
+        out = []
+        for entry in self.metrics.values():
+            vals = list(entry["per_worker"].values())   # [(value, ts)]
+            if entry["type"] == "gauge":
+                # Most recently REPORTED value wins, not dict order.
+                value = max(vals, key=lambda v: v[1])[0] if vals else 0.0
+            elif entry["type"] == "histogram":
+                value = {"count": sum(v[0]["count"] for v in vals),
+                         "sum": sum(v[0]["sum"] for v in vals)}
+                sets = [v[0] for v in vals
+                        if v[0].get("buckets") and v[0].get("boundaries")]
+                if sets and all(s["boundaries"] == sets[0]["boundaries"]
+                                for s in sets):
+                    value["boundaries"] = sets[0]["boundaries"]
+                    value["buckets"] = [
+                        sum(s["buckets"][i] for s in sets)
+                        for i in range(len(sets[0]["buckets"]))]
+            else:
+                value = sum(v[0] for v in vals)
+            out.append({"name": entry["name"], "labels": entry["labels"],
+                        "type": entry["type"], "help": entry["help"],
+                        "value": value})
+        return out
 
     async def start(self):
         if self.journal_path:

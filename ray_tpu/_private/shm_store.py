@@ -78,6 +78,8 @@ def _load():
     lib.rts_stats.argtypes = [ctypes.c_int] + [ctypes.POINTER(ctypes.c_uint64)] * 5
     lib.rts_list_evictable.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
     lib.rts_list_evictable.restype = ctypes.c_int
+    lib.rts_list_objects.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.rts_list_objects.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -213,6 +215,20 @@ class ShmStore:
             "bytes_evicted": vals[3].value,
             "capacity": vals[4].value,
         }
+
+    def list_objects(self, max_ids: int = 4096) -> list[tuple]:
+        """(object_id, size, refcount) snapshot of every sealed object —
+        feeds the state API's `list objects`."""
+        rec = 20 + 12
+        buf = ctypes.create_string_buffer(rec * max_ids)
+        n = self._lib.rts_list_objects(self._h, buf, max_ids)
+        raw = buf.raw
+        out = []
+        for i in range(n):
+            p = raw[i * rec:(i + 1) * rec]
+            out.append((p[:20], int.from_bytes(p[20:28], "little"),
+                        int.from_bytes(p[28:32], "little")))
+        return out
 
     def list_evictable(self, max_ids: int = 1024) -> list[bytes]:
         buf = ctypes.create_string_buffer(20 * max_ids)

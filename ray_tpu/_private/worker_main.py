@@ -207,6 +207,9 @@ class Executor:
         # Registered from the very start: a cancel arriving during arg
         # resolution cancels this coroutine (user code hasn't run yet).
         self._running[spec["task_id"]] = (asyncio.current_task(), True)
+        self.core.record_task_event(
+            spec["task_id"], spec.get("name") or spec.get("method", ""),
+            "RUNNING")
         strat = spec.get("scheduling_strategy") or {}
         prev_pg = self.core.current_placement_group
         if strat.get("type") == "placement_group":

@@ -1,8 +1,10 @@
-"""ray_tpu.util: scheduling strategies, placement groups, collective API.
+"""ray_tpu.util: scheduling strategies, placement groups, state API,
+metrics, collective API.
 
 Reference: python/ray/util/__init__.py surface.
 """
 
+from . import metrics, state
 from .placement_group import (PlacementGroup, get_current_placement_group,
                               placement_group, placement_group_table,
                               remove_placement_group)
@@ -14,5 +16,5 @@ __all__ = [
     "PlacementGroup", "placement_group", "placement_group_table",
     "remove_placement_group", "get_current_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "NodeLabelSchedulingStrategy",
+    "NodeLabelSchedulingStrategy", "metrics", "state",
 ]

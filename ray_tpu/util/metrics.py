@@ -1,0 +1,173 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference surface: python/ray/util/metrics.py (Counter/Gauge/Histogram →
+Cython metric.pxi → C++ registry, exported via per-node metrics agents).
+TPU-native design: a per-process registry snapshotted by the core worker's
+telemetry flush loop and merged in the GCS (the single-host stand-in for
+the reference's Prometheus export path); `prometheus_text()` renders the
+standard text exposition format for scraping or dashboards.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[Tuple[str, str], "_Metric"] = {}
+_LOCK = threading.Lock()
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0)
+
+
+class _Metric:
+    TYPE = ""
+
+    def __new__(cls, name: str, *args, **kwargs):
+        # Interned by (type, name): re-constructing a metric (natural in
+        # remote-function bodies) returns the SAME series instead of
+        # resetting it and leaking instances (reference: metric registry
+        # is name-keyed).
+        with _LOCK:
+            existing = _REGISTRY.get((cls.TYPE, name))
+            if existing is not None and type(existing) is cls:
+                return existing
+            inst = super().__new__(cls)
+            _REGISTRY[(cls.TYPE, name)] = inst
+            return inst
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if getattr(self, "_initialized", False):
+            return
+        self._initialized = True
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _snapshot(self) -> List[dict]:
+        import time
+        with self._lock:
+            return [{"name": self.name, "type": self.TYPE,
+                     "help": self.description, "ts": time.time(),
+                     "labels": dict(k), "value": v}
+                    for k, v in self._values.items()]
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (reference: util/metrics.py:Counter)."""
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    """Point-in-time value (reference: util/metrics.py:Gauge)."""
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (reference: util/metrics.py:Histogram)."""
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if getattr(self, "_initialized", False):
+            return
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries)
+        self._hists: Dict[tuple, dict] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = {
+                    "count": 0, "sum": 0.0,
+                    "buckets": [0] * (len(self.boundaries) + 1)}
+            h["count"] += 1
+            h["sum"] += value
+            h["buckets"][bisect.bisect_left(self.boundaries, value)] += 1
+
+    def _snapshot(self) -> List[dict]:
+        import time
+        with self._lock:
+            return [{"name": self.name, "type": self.TYPE,
+                     "help": self.description, "labels": dict(k),
+                     "ts": time.time(),
+                     "value": {"count": h["count"], "sum": h["sum"],
+                               "buckets": list(h["buckets"]),
+                               "boundaries": list(self.boundaries)}}
+                    for k, h in self._hists.items()]
+
+
+def registry_snapshot() -> List[dict]:
+    """All metric series in this process (flushed by the core worker's
+    telemetry loop)."""
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    out: List[dict] = []
+    for m in metrics:
+        out.extend(m._snapshot())
+    return out
+
+
+def get_metrics() -> List[dict]:
+    """Cluster-wide aggregated metrics from the GCS sink."""
+    import ray_tpu
+    return ray_tpu._core().gcs_call("get_metrics", {})
+
+
+def prometheus_text() -> str:
+    """Render aggregated metrics in the Prometheus text exposition format
+    (reference: _private/prometheus_exporter.py)."""
+    lines = []
+    seen_headers = set()
+    for m in get_metrics():
+        if m["name"] not in seen_headers:
+            seen_headers.add(m["name"])
+            if m["help"]:
+                lines.append(f"# HELP {m['name']} {m['help']}")
+            lines.append(f"# TYPE {m['name']} {m['type']}")
+        pairs = [f'{k}="{v}"' for k, v in sorted(m["labels"].items())]
+        label_s = "{" + ",".join(pairs) + "}" if pairs else ""
+        if m["type"] == "histogram":
+            v = m["value"]
+            cum = 0
+            for b, cnt in zip(v.get("boundaries", []),
+                              v.get("buckets", [])):
+                cum += cnt
+                le = "{" + ",".join(pairs + [f'le="{b}"']) + "}"
+                lines.append(f"{m['name']}_bucket{le} {cum}")
+            inf = "{" + ",".join(pairs + ['le="+Inf"']) + "}"
+            lines.append(f"{m['name']}_bucket{inf} {v['count']}")
+            lines.append(f"{m['name']}_count{label_s} {v['count']}")
+            lines.append(f"{m['name']}_sum{label_s} {v['sum']}")
+        else:
+            lines.append(f"{m['name']}{label_s} {m['value']}")
+    return "\n".join(lines) + "\n"

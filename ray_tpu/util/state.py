@@ -1,0 +1,128 @@
+"""State API: list cluster entities (reference: python/ray/util/state —
+`ray list tasks/actors/objects/nodes/...` served by the dashboard's
+StateHead + state_aggregator.py). Here the aggregation queries the GCS
+tables and per-node agents directly — no dashboard process needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+def _gcs(method: str, payload: dict | None = None):
+    return ray_tpu._core().gcs_call(method, payload or {})
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in _gcs("get_nodes"):
+        out.append({
+            "node_id": n["node_id"].hex(),
+            "state": "ALIVE" if n["alive"] else "DEAD",
+            "address": tuple(n["address"]),
+            "resources_total": n["resources_total"],
+            "resources_available": n["resources_available"],
+            "labels": n["labels"],
+        })
+    return out
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    out = []
+    for a in _gcs("list_actors"):
+        out.append({
+            "actor_id": a["actor_id"].hex(),
+            "class_name": a.get("class_name", ""),
+            "name": a.get("name") or "",
+            "state": a["state"],
+            "node_id": (a.get("node_id") or b"").hex(),
+            "pid": a.get("pid"),
+            "restarts": a.get("restarts", 0),
+            "death_cause": a.get("death_cause") or "",
+        })
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    out = []
+    for pg in _gcs("list_placement_groups"):
+        out.append({
+            "placement_group_id": pg["pg_id"].hex(),
+            "state": pg["state"],
+            "strategy": pg.get("strategy", ""),
+            "bundles": [{k: v for k, v in b.items() if k != "node_id"}
+                        | {"node_id": (b.get("node_id") or b"").hex()}
+                        for b in pg.get("bundles", [])],
+        })
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return [{"job_id": j["job_id"].hex(),
+             "driver_address": tuple(j.get("driver_addr") or ()),
+             "start_time": j.get("start_time")}
+            for j in _gcs("get_jobs")]
+
+
+def list_tasks(job_id: Optional[bytes] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest status per task, derived from the GCS task-event sink
+    (reference: state API tasks view over GcsTaskManager)."""
+    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100_000})
+    _RANK = {"SUBMITTED": 0, "RUNNING": 1,
+             "FINISHED": 2, "FAILED": 2, "CANCELLED": 2}
+    tasks: Dict[bytes, Dict[str, Any]] = {}
+    for e in events:
+        t = tasks.setdefault(e["task_id"], {
+            "task_id": e["task_id"].hex(),
+            "name": e.get("name", ""),
+            "job_id": (e.get("job_id") or b"").hex(),
+            "state": "SUBMITTED",
+            "events": []})
+        if e.get("name"):
+            t["name"] = e["name"]
+        # Events from the submitter and the executor flush on independent
+        # clocks and can interleave out of order; a terminal state always
+        # wins over RUNNING/SUBMITTED regardless of arrival order.
+        if _RANK.get(e["event"], 0) >= _RANK.get(t["state"], 0):
+            t["state"] = e["event"]
+        t["events"].append((e["event"], e["ts"]))
+        # The execution-side RUNNING event is the one that knows where the
+        # task actually ran; submit/terminal events carry the caller's node.
+        if e["event"] == "RUNNING" or "node_id" not in t:
+            t["node_id"] = (e.get("node_id") or b"").hex()
+    for t in tasks.values():
+        t["events"].sort(key=lambda ev: ev[1])
+    out = list(tasks.values())[-limit:]
+    return out
+
+
+def list_objects(limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Shared-memory objects across all live nodes, via each agent's store
+    index (reference: GetObjectsInfo node_manager.proto:521)."""
+    core = ray_tpu._core()
+    out: List[Dict[str, Any]] = []
+    for n in _gcs("get_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            objs = core._run(
+                core._agent_list_objects(tuple(n["address"]), limit=limit),
+                timeout=30)
+        except Exception:
+            continue
+        for oid, size, refcount in objs:
+            out.append({"object_id": oid.hex(), "size_bytes": size,
+                        "pins": refcount, "node_id": n["node_id"].hex()})
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for t in list_tasks(limit=100_000):
+        counts[t.get("state", "?")] = counts.get(t.get("state", "?"), 0) + 1
+    return counts

@@ -544,4 +544,27 @@ int rts_list_evictable(int hidx, uint8_t* out, int max) {
   return n;
 }
 
+// Full object index snapshot (for the state API / `list objects`): writes
+// records of [20-byte id][8-byte size][4-byte refcount] for every sealed
+// slot, up to `max`. Returns the record count.
+int rts_list_objects(int hidx, uint8_t* out, int max) {
+  Handle& h = g_handles[hidx];
+  Guard g(h.hdr);
+  int n = 0;
+  const int rec = kIdSize + 12;
+  for (uint64_t i = 0; i < h.hdr->table_slots && n < max; i++) {
+    Slot* s = &h.table[i];
+    if (s->state == kSealed) {
+      uint8_t* p = out + n * rec;
+      memcpy(p, s->key, kIdSize);
+      uint64_t sz = s->size;
+      memcpy(p + kIdSize, &sz, 8);
+      uint32_t rc = s->refcount;
+      memcpy(p + kIdSize + 8, &rc, 4);
+      n++;
+    }
+  }
+  return n;
+}
+
 }  // extern "C"
