@@ -68,6 +68,8 @@ class WorkerHandle:
         self.lease_bundle: Optional[Tuple[bytes, int]] = None  # PG bundle key
         self.needs_tpu = False        # pooled separately: TPU workers keep
         self.is_actor = False         # the accelerator client initialized
+        self.has_env = False          # runtime-env workers never pool
+        self.lease_owner_conn = None  # server conn that requested the lease
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
 
@@ -78,6 +80,9 @@ class NodeAgent:
                  store_capacity: int, host: str = "127.0.0.1"):
         self.gcs_address = tuple(gcs_address)
         self.session_dir = session_dir
+        from .runtime_env import UriCache
+        self.uri_cache = UriCache(
+            os.path.join(session_dir, "runtime_resources"))
         self.node_id = node_id
         self.host = host
         self.labels = labels
@@ -108,7 +113,8 @@ class NodeAgent:
         self._pull_seq = 0
         self._chunk_bytes = cfg.object_transfer_chunk_bytes
         self._max_pulls = cfg.max_concurrent_pulls
-        self._server = rpc.RpcServer(self._handlers(), name="agent")
+        self._server = rpc.RpcServer(self._handlers(), name="agent",
+                                     on_client_close=self._on_client_close)
         self.gcs: Optional[rpc.Connection] = None
         self._spawn_lock = asyncio.Lock()
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
@@ -199,7 +205,10 @@ class NodeAgent:
                         "node_id": self.node_id,
                         "available": self.resources_available,
                     })
-            except rpc.RpcError:
+            except Exception:
+                # One slow/failed report (GCS busy, reconnecting, ...) must
+                # never kill the loop: a dead report loop freezes this
+                # node's resource view at the GCS and starves scheduling.
                 pass
 
     async def _reap_loop(self):
@@ -210,7 +219,12 @@ class NodeAgent:
             await asyncio.sleep(0.5)
             for wid, wh in list(self.workers.items()):
                 if wh.proc.poll() is not None:
-                    await self._on_worker_death(wh)
+                    try:
+                        await self._on_worker_death(wh)
+                    except Exception:
+                        logger.exception(
+                            "worker death handling failed; lease state may "
+                            "need the next reap pass")
 
     async def _on_worker_death(self, wh: WorkerHandle):
         self.workers.pop(wh.worker_id, None)
@@ -231,7 +245,7 @@ class NodeAgent:
                     "actor_id": wh.actor_id,
                     "reason": f"worker process {wh.proc.pid} exited with "
                               f"code {wh.proc.returncode}"})
-            except rpc.RpcError:
+            except (rpc.RpcError, asyncio.TimeoutError):
                 pass
 
     def _on_pubsub(self, conn, p):
@@ -255,7 +269,8 @@ class NodeAgent:
 
     # ------------------------------------------------------------- workers --
     async def _spawn_worker(self, env_extra: Dict[str, str] | None = None,
-                            needs_tpu: bool = False) -> WorkerHandle:
+                            needs_tpu: bool = False,
+                            cwd: str | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
         from .node import child_env
         env = child_env(env_extra)
@@ -287,9 +302,10 @@ class NodeAgent:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=out, stderr=err,
-            cwd=os.getcwd(), start_new_session=True)
+            cwd=cwd or os.getcwd(), start_new_session=True)
         wh = WorkerHandle(worker_id, proc)
         wh.needs_tpu = needs_tpu
+        wh.has_env = bool(env_extra) or cwd is not None
         self.workers[worker_id] = wh
         return wh
 
@@ -304,19 +320,21 @@ class NodeAgent:
         return {"node_id": self.node_id}
 
     async def _pop_worker(self, env_extra=None,
-                          needs_tpu: bool = False) -> WorkerHandle:
+                          needs_tpu: bool = False,
+                          cwd: str | None = None) -> WorkerHandle:
         """Reuse an idle pooled worker or spawn one (reference:
         WorkerPool::PopWorker, worker_pool.h:55; reuse keyed by runtime env —
         round 1 pools only default-env workers).  CPU and TPU workers pool
         separately: CPU workers spawn without the accelerator client (fast
         startup, no chip state); TPU workers keep it."""
-        if not env_extra:
+        if not env_extra and cwd is None:
             pool = self.idle_tpu_workers if needs_tpu else self.idle_workers
             while pool:
                 wh = pool.pop()
                 if wh.proc.poll() is None and wh.conn and not wh.conn.closed:
                     return wh
-        wh = await self._spawn_worker(env_extra, needs_tpu=needs_tpu)
+        wh = await self._spawn_worker(env_extra, needs_tpu=needs_tpu,
+                                      cwd=cwd)
         cfg = get_config()
         try:
             await asyncio.wait_for(wh.registered.wait(),
@@ -391,15 +409,22 @@ class NodeAgent:
             return {"granted": False, "reason": "infeasible",
                     "retry_after_ms": 100}
         try:
+            env_extra, cwd = await self.uri_cache.setup(
+                self.gcs, p.get("runtime_env"))
+            if p.get("env"):
+                env_extra.update(p["env"])
             wh = await self._pop_worker(
-                p.get("env"), needs_tpu=_needs_tpu(resources))
-        except rpc.RpcError as e:
+                env_extra or None, needs_tpu=_needs_tpu(resources), cwd=cwd)
+        except Exception as e:
+            # Anything (env materialization TimeoutError, corrupt package,
+            # spawn failure) must release the acquired resources.
             self._release_resources(resources, bundle_key)
             return {"granted": False, "reason": str(e), "retry_after_ms": 200}
         lease_id = os.urandom(16)
         wh.lease_id = lease_id
         wh.lease_resources = resources
         wh.lease_bundle = bundle_key
+        wh.lease_owner_conn = conn
         self.leases[lease_id] = wh
         return {"granted": True, "lease_id": lease_id,
                 "worker_addr": list(wh.address),
@@ -439,6 +464,28 @@ class NodeAgent:
                     best, best_avail = n, s
         return list(best["address"]) if best else None
 
+    def _on_client_close(self, conn):
+        """A lease client (driver/worker) disconnected: reclaim every
+        lease it still holds — a driver exiting mid-lease must not leak
+        node resources (reference: raylet lease cleanup on disconnect)."""
+        for lease_id, wh in list(self.leases.items()):
+            if wh.lease_owner_conn is conn:
+                self.leases.pop(lease_id, None)
+                self._release_resources(wh.lease_resources, wh.lease_bundle)
+                wh.lease_id = None
+                wh.lease_resources = {}
+                wh.lease_bundle = None
+                wh.lease_owner_conn = None
+                if wh.proc.poll() is None and not wh.is_actor \
+                        and not wh.has_env:
+                    pool = (self.idle_tpu_workers if wh.needs_tpu
+                            else self.idle_workers)
+                    if len(pool) < IDLE_WORKER_KEEP:
+                        pool.append(wh)
+                        continue
+                if not wh.is_actor:
+                    wh.proc.terminate()
+
     async def h_return_lease(self, conn, p):
         wh = self.leases.pop(p["lease_id"], None)
         if wh is None:
@@ -449,10 +496,12 @@ class NodeAgent:
         wh.lease_bundle = None
         wh.last_idle = time.monotonic()
         pool = self.idle_tpu_workers if wh.needs_tpu else self.idle_workers
-        if (wh.proc.poll() is None and not wh.is_actor
+        if (wh.proc.poll() is None and not wh.is_actor and not wh.has_env
                 and len(pool) < IDLE_WORKER_KEEP):
             pool.append(wh)
         elif not wh.is_actor:
+            # Runtime-env workers are never pooled: their env_vars /
+            # PYTHONPATH / cwd would leak into default-env tasks.
             wh.proc.terminate()
         return True
 
@@ -483,14 +532,13 @@ class NodeAgent:
             acquired = self._try_acquire(resources)
         if not acquired:
             raise rpc.RpcError("insufficient resources for actor")
-        env_extra = {}
-        renv = p.get("runtime_env") or {}
-        for k, v in (renv.get("env_vars") or {}).items():
-            env_extra[k] = str(v)
         try:
+            env_extra, cwd = await self.uri_cache.setup(
+                self.gcs, p.get("runtime_env"))
             wh = await self._pop_worker(env_extra or None,
-                                        needs_tpu=_needs_tpu(resources))
-        except rpc.RpcError:
+                                        needs_tpu=_needs_tpu(resources),
+                                        cwd=cwd)
+        except Exception:
             self._release_resources(resources, bundle_key)
             raise
         wh.is_actor = True

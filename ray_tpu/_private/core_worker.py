@@ -82,14 +82,15 @@ class _Lease:
 
 class _KeyState:
     __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
-                 "strategy")
+                 "strategy", "runtime_env")
 
-    def __init__(self, resources, strategy):
+    def __init__(self, resources, strategy, runtime_env=None):
         self.queue: deque[_PendingTask] = deque()
         self.leases: List[_Lease] = []
         self.pending_lease_requests = 0
         self.resources = resources
         self.strategy = strategy
+        self.runtime_env = runtime_env
 
 
 class _ActorState:
@@ -138,6 +139,7 @@ class CoreWorker:
         self._owner_conns: Dict[tuple, rpc.Connection] = {}
         self._fn_cache: Dict[bytes, Any] = {}
         self._pg_cache: Dict[bytes, dict] = {}
+        self._packaged_envs: Dict[str, dict] = {}
         self._pg_rr: Dict[bytes, int] = {}
         self.current_placement_group: Optional[dict] = None
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
@@ -252,7 +254,14 @@ class CoreWorker:
             return
         self._shutdown = True
         if self.loop and self._loop_thread:
-            self.loop.call_soon_threadsafe(self.loop.stop)
+            def _drain_and_stop():
+                # Cancel background tasks before stopping so teardown is
+                # quiet (no 'Task was destroyed but it is pending').
+                for t in asyncio.all_tasks():
+                    if t is not asyncio.current_task():
+                        t.cancel()
+                self.loop.call_soon(self.loop.stop)
+            self.loop.call_soon_threadsafe(_drain_and_stop)
             self._loop_thread.join(timeout=5)
         self.executor.shutdown(wait=False)
         self.store.close()
@@ -670,11 +679,13 @@ class CoreWorker:
             respec = dict(spec)
             respec["retries_left"] = max(respec.get("retries_left", 0), 1)
             key = protocol.scheduling_key(respec["fn_id"], respec["resources"],
-                                          respec.get("scheduling_strategy"))
+                                          respec.get("scheduling_strategy"),
+                                          respec.get("runtime_env"))
             state = self._keys.get(key)
             if state is None:
                 state = self._keys[key] = _KeyState(
-                    respec["resources"], respec.get("scheduling_strategy"))
+                    respec["resources"], respec.get("scheduling_strategy"),
+                    respec.get("runtime_env"))
             state.queue.append(_PendingTask(respec, []))
             self._pump(key, state)
             entry = await self.memory_store.wait_for(oid, 120)
@@ -844,11 +855,33 @@ class CoreWorker:
                 await asyncio.sleep(0.5)
 
     # ------------------------------------------------------- normal tasks ----
+    def package_runtime_env_cached(self, runtime_env):
+        """Driver-side packaging (working_dir/py_modules -> uploaded
+        URIs), memoized by content so repeated submissions don't re-zip."""
+        if not runtime_env:
+            return runtime_env
+        import json as _json
+        from .runtime_env import package_runtime_env
+        key = _json.dumps(runtime_env, sort_keys=True, default=str)
+        cached = self._packaged_envs.get(key)
+        if cached is None:
+            if self._on_loop_thread() and (
+                    runtime_env.get("working_dir")
+                    or runtime_env.get("py_modules")):
+                raise RuntimeError(
+                    "working_dir/py_modules packaging uploads to the GCS "
+                    "and cannot run on the event loop; package this "
+                    "runtime_env from a sync context first")
+            cached = self._packaged_envs[key] = package_runtime_env(
+                self, runtime_env)
+        return cached
+
     def submit_task(self, *, fn, fn_id: Optional[bytes], args, kwargs,
                     num_returns: int, resources: Dict[str, float],
                     max_retries: int, scheduling_strategy=None,
                     runtime_env=None, name="",
                     fn_blob: Optional[bytes] = None) -> List[ObjectRef]:
+        runtime_env = self.package_runtime_env_cached(runtime_env)
         refs = self._try_submit_fast(
             fn_id=fn_id, args=args, kwargs=kwargs, num_returns=num_returns,
             resources=resources, max_retries=max_retries,
@@ -913,7 +946,8 @@ class CoreWorker:
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             self.reference_counter.add_owned(oid, lineage=spec)
             refs.append(ObjectRef(oid, self.address, worker=self))
-        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy)
+        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
+                                      runtime_env)
 
         self.record_task_event(task_id, spec["name"], "SUBMITTED")
 
@@ -921,7 +955,8 @@ class CoreWorker:
             state = self._keys.get(key)
             if state is None:
                 state = self._keys[key] = _KeyState(resources,
-                                                    scheduling_strategy)
+                                                    scheduling_strategy,
+                                                    runtime_env)
             state.queue.append(_PendingTask(spec, []))
             self._pump(key, state)
 
@@ -951,10 +986,12 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.address, worker=self))
         for oid in ref_args:
             self.reference_counter.add_submitted(oid)
-        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy)
+        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
+                                      runtime_env)
         state = self._keys.get(key)
         if state is None:
-            state = self._keys[key] = _KeyState(resources, scheduling_strategy)
+            state = self._keys[key] = _KeyState(resources, scheduling_strategy,
+                                                runtime_env)
         state.queue.append(_PendingTask(spec, ref_args, borrowed_args))
         self._pump(key, state)
         self.record_task_event(task_id, spec["name"], "SUBMITTED")
@@ -1075,6 +1112,7 @@ class CoreWorker:
         try:
             res = await agent_conn.call("request_lease", {
                 "resources": state.resources,
+                "runtime_env": state.runtime_env,
                 "placement_group": ({"pg_id": strat["pg_id"],
                                      "bundle_index":
                                      strat.get("bundle_index", 0)}
@@ -1372,6 +1410,14 @@ class CoreWorker:
                      scheduling_strategy=None, class_name="") -> dict:
         # Class + args serialize on the CALLING thread (post-call mutation
         # of init args is safe; matches submit_actor_task's guarantee).
+        if not self._on_loop_thread():
+            runtime_env = self.package_runtime_env_cached(runtime_env)
+        elif runtime_env and (runtime_env.get("working_dir")
+                              or runtime_env.get("py_modules")):
+            raise RuntimeError(
+                "working_dir/py_modules packaging uploads to the GCS and "
+                "cannot run on the event loop; create this actor from a "
+                "sync context (or pre-package the runtime_env)")
         ctx = get_context()
         blob = ctx.dumps_code(cls)
         arg_entries, ref_args, borrowed_args, big_puts = \

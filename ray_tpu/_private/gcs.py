@@ -366,7 +366,7 @@ class GcsServer:
 
     async def h_kv_keys(self, conn, p):
         ns = self.kv.get(p.get("ns", ""), {})
-        pref = p.get("prefix", b"")
+        pref = p.get("prefix") or ""
         return [k for k in ns if k.startswith(pref)]
 
     # ---------------------------------------------------------------- nodes --
@@ -422,10 +422,15 @@ class GcsServer:
         threshold = cfg.health_check_failure_threshold
         while True:
             await asyncio.sleep(period)
-            now = time.monotonic()
-            for node in list(self.nodes.values()):
-                if node.alive and now - node.last_heartbeat > period * threshold:
-                    await self._mark_node_dead(node.node_id, "health check failed")
+            try:
+                now = time.monotonic()
+                for node in list(self.nodes.values()):
+                    if node.alive and \
+                            now - node.last_heartbeat > period * threshold:
+                        await self._mark_node_dead(node.node_id,
+                                                   "health check failed")
+            except Exception:
+                logger.exception("health check pass failed")
 
     async def _mark_node_dead(self, node_id: bytes, reason: str):
         node = self.nodes.get(node_id)
@@ -584,6 +589,13 @@ class GcsServer:
                                    node.node_id.hex()[:8], str(e).split("\n")[0])
             await asyncio.sleep(0.2)
         else:
+            logger.warning(
+                "actor scheduling timed out: resources=%s node view=%s",
+                spec.get("resources"),
+                [(n.node_id.hex()[:8], n.alive,
+                  n.resources_available,
+                  n.conn is not None and not n.conn.closed)
+                 for n in self.nodes.values()])
             return False
         actor.state = protocol.ACTOR_ALIVE
         actor.address = result["worker_addr"]
@@ -861,7 +873,7 @@ def main():
     parser.add_argument("--journal", default="")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
-    logging.basicConfig(level=args.log_level)
+    logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -1,0 +1,157 @@
+"""JobSupervisor: detached actor driving one submitted job's entrypoint.
+
+Reference surface: python/ray/dashboard/modules/job/job_supervisor.py:56 —
+a per-job actor that execs the entrypoint command as a child process, tails
+its output into a log file, and publishes status transitions. Job metadata
+lives in the GCS KV under the "job_submission" namespace so it outlives the
+supervisor (reference: JobInfoStorageClient over internal KV).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, Optional
+
+JOB_KV_NS = "job_submission"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+def _kv_put_info(core, submission_id: str, info: dict):
+    core.gcs_call("kv_put", {"ns": JOB_KV_NS, "key": submission_id,
+                             "value": json.dumps(info).encode(),
+                             "overwrite": True})
+
+
+def kv_get_info(core, submission_id: str) -> Optional[dict]:
+    raw = core.gcs_call("kv_get", {"ns": JOB_KV_NS, "key": submission_id})
+    return json.loads(bytes(raw)) if raw else None
+
+
+class JobSupervisorImpl:
+    """Runs inside a detached actor named JOB_SUPERVISOR_<id>."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        import ray_tpu
+        self._core = ray_tpu._core()
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_path = os.path.join(
+            self._core.session_dir, "logs", f"job-{submission_id}.log")
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        self.info = {
+            "submission_id": submission_id,
+            "entrypoint": entrypoint,
+            "status": JobStatus.PENDING,
+            "start_time": time.time(),
+            "end_time": None,
+            "message": "",
+            "log_path": self.log_path,
+        }
+        _kv_put_info(self._core, submission_id, self.info)
+
+        env = dict(os.environ)
+        # The child driver joins THIS cluster instead of starting its own.
+        gcs = self._core.gcs_address
+        env["RAY_TPU_ADDRESS"] = f"{gcs[0]}:{gcs[1]}"
+        env.update(env_vars or {})
+        log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            ["/bin/sh", "-c", entrypoint], stdout=log_f, stderr=log_f,
+            env=env, cwd=os.getcwd(),   # = materialized working_dir, if any
+            start_new_session=True)
+        # The entrypoint runs in its own session so stop() can killpg it
+        # without taking this worker down — which also detaches it from
+        # normal teardown, so kill the group when this process exits.
+        atexit.register(self._kill_child_group)
+        self._set_status(JobStatus.RUNNING)
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _kill_child_group(self):
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def _set_status(self, status: str, message: str = ""):
+        self.info["status"] = status
+        self.info["message"] = message
+        if status in JobStatus.TERMINAL:
+            self.info["end_time"] = time.time()
+        _kv_put_info(self._core, self.submission_id, self.info)
+
+    def _wait(self):
+        rc = self.proc.wait()
+        if self.info["status"] != JobStatus.STOPPED:
+            if rc == 0:
+                self._set_status(JobStatus.SUCCEEDED)
+            else:
+                self._set_status(JobStatus.FAILED,
+                                 f"entrypoint exited with code {rc}")
+        # The supervisor's work is done: exit after a log-serving grace
+        # window so it doesn't hold a worker process + CPU slice forever
+        # (clients fall back to the KV record + log file afterwards).
+        threading.Thread(target=self._retire, daemon=True).start()
+
+    def _retire(self, grace_s: float = 120.0):
+        time.sleep(grace_s)
+        try:
+            import ray_tpu
+            core = ray_tpu._core()
+            core.kill_actor(core.current_actor_id, no_restart=True)
+        except Exception:
+            os._exit(0)
+
+    # ------------------------------------------------------------- actor API -
+    def status(self) -> dict:
+        return dict(self.info)
+
+    def logs(self, offset: int = 0, max_bytes: int = 4 << 20) -> bytes:
+        """Log content from byte `offset` (tail streaming reads
+        incrementally; offset 0 + large max_bytes = whole log)."""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                return f.read(max_bytes)
+        except FileNotFoundError:
+            return b""
+
+    def stop(self) -> bool:
+        """SIGTERM the entrypoint's process group; SIGKILL after a grace
+        period (reference: JobSupervisor.stop)."""
+        if self.info["status"] in JobStatus.TERMINAL:
+            return False
+        self._set_status(JobStatus.STOPPED, "stopped by user")
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            return True
+
+        def _escalate():
+            time.sleep(5)
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        threading.Thread(target=_escalate, daemon=True).start()
+        return True
+
+    def ping(self) -> str:
+        return "pong"

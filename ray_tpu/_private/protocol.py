@@ -67,15 +67,20 @@ def make_task_spec(
 
 
 def scheduling_key(fn_id: bytes, resources: Dict[str, float],
-                   strategy: Optional[dict]) -> bytes:
+                   strategy: Optional[dict],
+                   runtime_env: Optional[dict] = None) -> bytes:
     """Tasks with the same key can share leased workers (reference:
-    NormalTaskSubmitter lease caching by SchedulingKey)."""
+    NormalTaskSubmitter lease caching by SchedulingKey — which includes
+    the runtime env, since envs shape the worker process)."""
     h = hashlib.sha1(fn_id)
     for k in sorted(resources):
         h.update(k.encode())
         h.update(str(resources[k]).encode())
     if strategy:
         h.update(repr(sorted(strategy.items())).encode())
+    if runtime_env:
+        from .runtime_env import runtime_env_hash
+        h.update(runtime_env_hash(runtime_env))
     return h.digest()[:16]
 
 

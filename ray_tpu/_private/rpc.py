@@ -236,11 +236,16 @@ class Connection:
 # Server
 # ---------------------------------------------------------------------------
 class RpcServer:
-    def __init__(self, handlers: Dict[str, Callable], name: str = "server"):
+    def __init__(self, handlers: Dict[str, Callable], name: str = "server",
+                 on_client_close: Callable | None = None):
         self.handlers = handlers
         self.name = name
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
+        # Called with the Connection when a client disconnects — lets the
+        # agent reclaim leases whose owner died (reference: raylet
+        # returning leases on client disconnect).
+        self.on_client_close = on_client_close
 
     async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._on_conn, host, port)
@@ -251,8 +256,15 @@ class RpcServer:
         return path
 
     async def _on_conn(self, reader, writer):
+        def _closed(c):
+            self.connections.discard(c)
+            if self.on_client_close is not None:
+                try:
+                    self.on_client_close(c)
+                except Exception:
+                    logger.exception("on_client_close failed")
         conn = Connection(reader, writer, self.handlers, name=self.name,
-                          on_close=self.connections.discard)
+                          on_close=_closed)
         self.connections.add(conn)
 
     async def close(self):

@@ -1,0 +1,174 @@
+"""Runtime environments: working_dir / py_modules / env_vars.
+
+Reference surface: python/ray/_private/runtime_env/ — the driver packages
+local directories into content-addressed zips uploaded to the GCS KV
+(reference: runtime_env/packaging.py gcs:// URIs), and each node's agent
+materializes URIs into a per-session cache before spawning workers
+(reference: runtime_env agent creating env on each node, URI caching).
+Unsupported plugins (pip/conda/container) raise up front rather than
+silently no-op.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+PKG_KV_NS = "runtime_env_pkg"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_PKG_BYTES = 512 * 1024 * 1024
+
+_SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules",
+                   "working_dir_uri", "py_modules_uris", "config"}
+
+
+def _zip_dir(path: str) -> bytes:
+    import io
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in sorted(dirs) if d not in _EXCLUDE_DIRS]
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, base)
+                zf.write(full, rel)
+    data = buf.getvalue()
+    if len(data) > _MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PKG_BYTES}); exclude large data directories")
+    return data
+
+
+def _upload_dir(core, path: str) -> str:
+    """Zip + content-address + upload once; returns the gcs:// URI."""
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    data = _zip_dir(path)
+    digest = hashlib.sha1(data).hexdigest()
+    uri = f"gcs://{digest}"
+    if not core.gcs_call("kv_exists", {"ns": PKG_KV_NS, "key": digest}):
+        core.gcs_call("kv_put", {"ns": PKG_KV_NS, "key": digest,
+                                 "value": data, "overwrite": False})
+    return uri
+
+
+def package_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
+    """Driver-side: validate + rewrite local paths to uploaded URIs."""
+    if not runtime_env:
+        return runtime_env
+    unknown = set(runtime_env) - _SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env key(s) {sorted(unknown)}; supported: "
+            f"{sorted(_SUPPORTED_KEYS)} (pip/conda/container are not "
+            "available in this runtime)")
+    out = dict(runtime_env)
+    wd = out.pop("working_dir", None)
+    if wd:
+        if isinstance(wd, str) and wd.startswith("gcs://"):
+            out["working_dir_uri"] = wd
+        else:
+            out["working_dir_uri"] = _upload_dir(core, wd)
+    mods = out.pop("py_modules", None)
+    if mods:
+        uris = []
+        for m in mods:
+            if isinstance(m, str) and m.startswith("gcs://"):
+                uris.append(m)
+            else:
+                uris.append(_upload_dir(core, m))
+        out["py_modules_uris"] = uris
+    return out
+
+
+def runtime_env_hash(runtime_env: Optional[dict]) -> bytes:
+    """Stable digest for scheduling keys: tasks with different runtime
+    envs must not share leased workers."""
+    if not runtime_env:
+        return b""
+    return hashlib.sha1(
+        json.dumps(runtime_env, sort_keys=True, default=str).encode()
+    ).digest()[:8]
+
+
+class UriCache:
+    """Agent-side URI materialization with a per-session extract cache
+    (reference: runtime_env URI cache + refcounting; here cache entries
+    live for the session)."""
+
+    def __init__(self, cache_root: str):
+        self.cache_root = cache_root
+        self._inflight: Dict[str, "asyncio.Future"] = {}
+
+    async def ensure(self, gcs_conn, uri: str) -> str:
+        """Download+extract `gcs://<digest>` once; concurrent callers for
+        the same digest share one in-flight fetch (a 512MB package must
+        not be pulled N times by a task fan-out)."""
+        import asyncio
+        assert uri.startswith("gcs://"), uri
+        digest = uri[len("gcs://"):]
+        dest = os.path.join(self.cache_root, digest)
+        if os.path.isdir(dest):
+            return dest
+        fut = self._inflight.get(digest)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[digest] = fut
+        try:
+            data = await gcs_conn.call(
+                "kv_get", {"ns": PKG_KV_NS, "key": digest}, timeout=120)
+            if data is None:
+                raise RuntimeError(
+                    f"runtime_env package {uri} not found in GCS")
+
+            def _extract():
+                if os.path.isdir(dest):
+                    return
+                tmp = dest + f".tmp{os.getpid()}"
+                os.makedirs(tmp, exist_ok=True)
+                import io
+                with zipfile.ZipFile(io.BytesIO(bytes(data))) as zf:
+                    zf.extractall(tmp)
+                try:
+                    os.rename(tmp, dest)
+                except OSError:
+                    import shutil
+                    shutil.rmtree(tmp, ignore_errors=True)
+            await asyncio.get_running_loop().run_in_executor(None, _extract)
+            fut.set_result(dest)
+            return dest
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._inflight.pop(digest, None)
+            if not fut.done():
+                fut.cancel()
+
+    async def setup(self, gcs_conn, runtime_env: Optional[dict]
+                    ) -> Tuple[Dict[str, str], Optional[str]]:
+        """Materialize a worker's runtime env. Returns (env_extra, cwd)."""
+        env_extra: Dict[str, str] = {}
+        cwd: Optional[str] = None
+        renv = runtime_env or {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            env_extra[k] = str(v)
+        py_paths: List[str] = []
+        wd_uri = renv.get("working_dir_uri")
+        if wd_uri:
+            cwd = await self.ensure(gcs_conn, wd_uri)
+            py_paths.append(cwd)
+        for uri in renv.get("py_modules_uris") or []:
+            py_paths.append(await self.ensure(gcs_conn, uri))
+        if py_paths:
+            existing = env_extra.get("PYTHONPATH",
+                                     os.environ.get("PYTHONPATH", ""))
+            env_extra["PYTHONPATH"] = os.pathsep.join(
+                py_paths + ([existing] if existing else []))
+        return env_extra, cwd

@@ -20,6 +20,23 @@ from .core_worker import CoreWorker
 
 logger = logging.getLogger("ray_tpu.worker")
 
+# Well-known head-node address drop (reference: /tmp/ray/ray_current_cluster).
+CLUSTER_ADDRESS_FILE = "/tmp/ray_tpu/ray_current_cluster"
+
+
+def write_cluster_address_file(address: tuple):
+    os.makedirs(os.path.dirname(CLUSTER_ADDRESS_FILE), exist_ok=True)
+    with open(CLUSTER_ADDRESS_FILE, "w") as f:
+        f.write(f"{address[0]}:{address[1]}")
+
+
+def read_cluster_address_file():
+    try:
+        with open(CLUSTER_ADDRESS_FILE) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
 
 class Runtime:
     def __init__(self):
@@ -79,6 +96,17 @@ def init(address: Optional[str] = None, *,
     set_config(Config(_system_config))
     cfg = get_config()
     rt = Runtime()
+    if address is None and os.environ.get("RAY_TPU_ADDRESS"):
+        # Driver spawned under a submitted job (or user exported the
+        # address): join that cluster (reference: RAY_ADDRESS).
+        address = os.environ["RAY_TPU_ADDRESS"]
+    if address == "auto":
+        address = read_cluster_address_file()
+        if address is None:
+            raise ConnectionError(
+                "address='auto' but no running cluster was found "
+                f"({CLUSTER_ADDRESS_FILE} missing); start one with "
+                "`python -m ray_tpu start --head`")
     if address is None:
         rt.session_dir = node_mod.new_session_dir()
         gcs_proc, gcs_addr = node_mod.start_gcs(rt.session_dir)

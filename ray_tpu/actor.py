@@ -30,6 +30,12 @@ class ActorMethod:
             max_task_retries=self._handle._max_task_retries)
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Author a compiled-graph node (reference: dag/class_node.py
+        actor_method.bind)."""
+        from .dag import ClassMethodNode
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *a, **k):
         raise TypeError(
             f"actor method {self._method_name} must be called with .remote()")

@@ -1,0 +1,143 @@
+"""Compiled graphs (aDAG): pre-compiled actor pipelines.
+
+Reference surface: python/ray/dag — DAG authoring via `.bind()`
+(dag/dag_node.py, class_node.py, input_node.py), `experimental_compile` →
+CompiledDAG (dag/compiled_dag_node.py:805) executing over channels
+(experimental/channel/shared_memory_channel.py).
+
+TPU-native design: compilation walks the bound graph ONCE into a static
+execution plan (topological stage order + argument wiring). `execute()`
+replays the plan by chaining actor tasks through object references — each
+stage's return ref feeds the next stage's submission without waiting, so
+consecutive `execute()` calls pipeline naturally across the actor set
+(stage k of item i runs concurrently with stage k-1 of item i+1, the same
+overlap the reference gets from its resident exec loops). Intermediate
+values move driver-free through the shared-memory store on one host and
+the chunked object plane across hosts; device tensors ride the normal
+serialization path. A bounded in-flight window provides the reference's
+channel backpressure (compiled_dag_node.py _max_inflight_executions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
+           "CompiledDAG"]
+
+
+class DAGNode:
+    """Base authoring node (reference: dag/dag_node.py)."""
+
+    def experimental_compile(self, _max_inflight_executions: int = 10
+                             ) -> "CompiledDAG":
+        return CompiledDAG(self, max_inflight=_max_inflight_executions)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (reference: dag/input_node.py); used as
+    a context manager: `with InputNode() as inp: ...`."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    """One actor-method invocation bound into the graph (reference:
+    dag/class_node.py ClassMethodNode)."""
+
+    def __init__(self, actor_method, args: tuple, kwargs: dict):
+        self.actor_method = actor_method
+        self.args = args
+        self.kwargs = kwargs
+
+
+class MultiOutputNode(DAGNode):
+    """Aggregates several leaves into one output list (reference:
+    dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+
+class CompiledDAG:
+    """The static execution plan (reference: compiled_dag_node.py:805)."""
+
+    def __init__(self, root: DAGNode, max_inflight: int = 10):
+        self._lock = threading.Lock()
+        self._sem = threading.Semaphore(max_inflight)
+        self._torn_down = False
+        # Topological plan: list of (node, arg_spec) where arg_spec mirrors
+        # the bound args with placeholders for input/upstream refs.
+        self._plan: List[ClassMethodNode] = []
+        self._root = root
+        self._outputs: List[DAGNode] = (
+            root.outputs if isinstance(root, MultiOutputNode) else [root])
+        seen: Dict[int, bool] = {}
+
+        def _walk(node: DAGNode):
+            if isinstance(node, InputNode):
+                return
+            if not isinstance(node, ClassMethodNode):
+                raise TypeError(
+                    f"unsupported DAG node {type(node).__name__}; compiled "
+                    "graphs are built from actor-method .bind() calls and "
+                    "InputNode")
+            if id(node) in seen:
+                return
+            seen[id(node)] = True
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, DAGNode):
+                    _walk(a)
+            self._plan.append(node)
+
+        for out in self._outputs:
+            _walk(out)
+        if not self._plan:
+            raise ValueError("empty DAG: nothing was bound")
+
+    def execute(self, *input_args):
+        """Run one item through the pipeline; returns the final ObjectRef
+        (list of refs for MultiOutputNode). Does NOT wait — call
+        ray_tpu.get on the result; successive execute() calls overlap
+        across stages (per-actor FIFO queues provide stage ordering)."""
+        if self._torn_down:
+            raise RuntimeError("this compiled DAG was torn down")
+        inp = input_args[0] if len(input_args) == 1 else input_args
+        self._sem.acquire()
+        try:
+            with self._lock:
+                produced: Dict[int, Any] = {}
+                for node in self._plan:
+                    def _resolve(a):
+                        if isinstance(a, InputNode):
+                            return inp
+                        if isinstance(a, DAGNode):
+                            return produced[id(a)]
+                        return a
+                    args = tuple(_resolve(a) for a in node.args)
+                    kwargs = {k: _resolve(v)
+                              for k, v in node.kwargs.items()}
+                    produced[id(node)] = node.actor_method.remote(
+                        *args, **kwargs)
+                refs = [produced[id(o)] for o in self._outputs]
+        except BaseException:
+            self._sem.release()
+            raise
+        # Backpressure window counts in-flight items, released when the
+        # final ref resolves (reference: _max_inflight_executions).
+        try:
+            refs[-1].future().add_done_callback(
+                lambda _: self._sem.release())
+        except Exception:
+            self._sem.release()
+        if isinstance(self._root, MultiOutputNode):
+            return refs
+        return refs[0]
+
+    def teardown(self):
+        self._torn_down = True

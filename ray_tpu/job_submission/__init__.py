@@ -1,0 +1,160 @@
+"""Job submission SDK (reference: python/ray/job_submission —
+JobSubmissionClient backed by dashboard/modules/job/job_manager.py:60;
+here the manager's role is played by a detached JobSupervisor actor per
+job plus job metadata in the GCS KV, no dashboard process required).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private.job_supervisor import (JOB_KV_NS, JobStatus,
+                                             JobSupervisorImpl, kv_get_info)
+
+__all__ = ["JobSubmissionClient", "JobStatus"]
+
+_SUPERVISOR_PREFIX = "JOB_SUPERVISOR_"
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs on a running cluster.
+
+    `address` is "host:port" of the cluster GCS, "auto" for the address
+    file, or None to use the already-initialized driver connection
+    (reference: JobSubmissionClient(address)).
+    """
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or "auto")
+        self._core = ray_tpu._core()
+
+    # ---------------------------------------------------------------- submit -
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   entrypoint_num_cpus: float = 0) -> str:
+        if submission_id and kv_get_info(self._core, submission_id):
+            raise ValueError(
+                f"job {submission_id!r} was already submitted")
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        env_vars = dict((runtime_env or {}).get("env_vars", {}))
+        sup_renv = None
+        if (runtime_env or {}).get("working_dir"):
+            # The supervisor's own runtime env carries the packaged
+            # working_dir, so on a multi-node cluster the entrypoint runs
+            # in the materialized copy wherever the supervisor lands (the
+            # worker's cwd IS the extracted package).
+            sup_renv = {"working_dir": runtime_env["working_dir"]}
+        sup_cls = ray_tpu.remote(JobSupervisorImpl)
+        sup_cls.options(
+            name=_SUPERVISOR_PREFIX + submission_id,
+            lifetime="detached",
+            num_cpus=entrypoint_num_cpus or 0.1,
+            runtime_env=sup_renv,
+        ).remote(submission_id, entrypoint, env_vars)
+        # Submission is acknowledged once the supervisor has registered the
+        # job record (PENDING/RUNNING) in the KV.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if kv_get_info(self._core, submission_id) is not None:
+                return submission_id
+            time.sleep(0.1)
+        raise TimeoutError("job supervisor failed to register the job")
+
+    # ---------------------------------------------------------------- query --
+    def get_job_status(self, submission_id: str) -> str:
+        info = self.get_job_info(submission_id)
+        if info["status"] == JobStatus.RUNNING:
+            # Watchdog: a RUNNING record whose supervisor is gone means the
+            # supervisor (or its node) died — repair to FAILED so clients
+            # don't wait forever (reference: JobManager failure detection).
+            try:
+                sup = ray_tpu.get_actor(_SUPERVISOR_PREFIX + submission_id)
+                ray_tpu.get(sup.ping.remote(), timeout=15)
+            except Exception:
+                info["status"] = JobStatus.FAILED
+                info["message"] = "job supervisor died"
+                info["end_time"] = time.time()
+                import json as _json
+                self._core.gcs_call("kv_put", {
+                    "ns": JOB_KV_NS, "key": submission_id,
+                    "value": _json.dumps(info).encode(), "overwrite": True})
+        return info["status"]
+
+    def get_job_info(self, submission_id: str) -> dict:
+        info = kv_get_info(self._core, submission_id)
+        if info is None:
+            raise ValueError(f"job {submission_id!r} does not exist")
+        return info
+
+    def list_jobs(self) -> List[dict]:
+        keys = self._core.gcs_call("kv_keys", {"ns": JOB_KV_NS})
+        out = []
+        for k in keys:
+            info = kv_get_info(self._core,
+                               k.decode() if isinstance(k, bytes) else k)
+            if info:
+                out.append(info)
+        return out
+
+    def _job_logs_bytes(self, submission_id: str, offset: int = 0) -> bytes:
+        self.get_job_info(submission_id)   # existence check
+        try:
+            sup = ray_tpu.get_actor(_SUPERVISOR_PREFIX + submission_id)
+            return bytes(ray_tpu.get(sup.logs.remote(offset), timeout=30))
+        except Exception:
+            # Supervisor gone (job long finished): read the log file if on
+            # this host.
+            info = self.get_job_info(submission_id)
+            try:
+                with open(info["log_path"], "rb") as f:
+                    f.seek(offset)
+                    return f.read()
+            except OSError:
+                return b""
+
+    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
+        return self._job_logs_bytes(submission_id, offset).decode(
+            errors="replace")
+
+    # ---------------------------------------------------------------- stop ---
+    def stop_job(self, submission_id: str) -> bool:
+        sup = ray_tpu.get_actor(_SUPERVISOR_PREFIX + submission_id)
+        return ray_tpu.get(sup.stop.remote(), timeout=30)
+
+    def delete_job(self, submission_id: str) -> bool:
+        info = kv_get_info(self._core, submission_id)
+        if info is None:
+            return False
+        if info["status"] not in JobStatus.TERMINAL:
+            raise RuntimeError("cannot delete a non-terminal job")
+        # Reap the supervisor immediately (it would otherwise idle through
+        # its log-serving grace window holding a worker + CPU slice).
+        try:
+            sup = ray_tpu.get_actor(_SUPERVISOR_PREFIX + submission_id)
+            ray_tpu.kill(sup)
+        except Exception:
+            pass
+        self._core.gcs_call("kv_del", {"ns": JOB_KV_NS, "key": submission_id})
+        return True
+
+    def tail_job_logs(self, submission_id: str, poll_s: float = 0.5):
+        """Generator yielding log increments until the job terminates.
+        Increments are fetched by byte offset, so streaming keeps up with
+        logs of any size."""
+        offset = 0
+        while True:
+            raw = self._job_logs_bytes(submission_id, offset=offset)
+            if raw:
+                yield raw.decode(errors="replace")
+                offset += len(raw)     # offsets track RAW bytes
+            if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+                raw = self._job_logs_bytes(submission_id, offset=offset)
+                if raw:
+                    yield raw.decode(errors="replace")
+                return
+            time.sleep(poll_s)

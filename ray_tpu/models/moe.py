@@ -1,0 +1,121 @@
+"""Mixture-of-Experts layer with expert parallelism over the `ep` axes.
+
+The reference ships EP only as a vLLM serving pattern
+(llm/_internal/serve/serving_patterns/ data-parallel attention + EP);
+there is no native MoE compute layer. TPU-native design: capacity-based
+top-k routing with DENSE one-hot dispatch/combine einsums — the
+Switch/GShard recipe — so the whole layer is three einsums XLA can
+partition. The expert dimension carries the "expert" logical axis
+(mapped to EP_AXES = fsdp×sp by default, parallel/mesh.py): with it
+sharded, XLA inserts the ragged all-to-alls; no hand-written routing
+collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int = 8
+    num_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_z_loss_coef: float = 1e-3
+    load_balance_coef: float = 1e-2
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> Dict[str, Any]:
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    E, D, F = cfg.num_experts, cfg.d_model, cfg.d_ff
+    scale_in = D ** -0.5
+    scale_out = F ** -0.5
+    return {
+        "router": jax.random.normal(kr, (D, E)) * scale_in,
+        "w_gate": jax.random.normal(kg, (E, D, F)) * scale_in,
+        "w_up": jax.random.normal(ku, (E, D, F)) * scale_in,
+        "w_down": jax.random.normal(kd, (E, F, D)) * scale_out,
+    }
+
+
+def moe_logical_axes() -> Dict[str, tuple]:
+    """Logical axis names per param (feed into LogicalAxisRules)."""
+    return {
+        "router": ("embed", "expert_unsharded"),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoEConfig
+              ) -> tuple:
+    """x: [B, S, D] → ([B, S, D], aux_losses dict).
+
+    Dispatch: tokens → per-expert capacity slots via one-hot einsum
+    (dense dispatch, MXU-friendly, static shapes); combine symmetric.
+    Aux losses follow Switch Transformer (load-balance) + ST-MoE (router
+    z-loss).
+    """
+    B, S, D = x.shape
+    E = cfg.num_experts
+    K = cfg.num_experts_per_token
+    N = B * S
+    C = max(1, int(cfg.capacity_factor * N * K / E))     # slots per expert
+
+    xf = x.reshape(N, D)
+    router_logits = (xf.astype(jnp.float32)
+                     @ params["router"].astype(jnp.float32))   # [N, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+
+    # Top-k expert choice per token.
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)            # [N, K]
+    gate_vals = gate_vals / jnp.clip(gate_vals.sum(-1, keepdims=True),
+                                     1e-9)
+
+    # Capacity assignment: position of each (token, k) within its expert's
+    # queue, dropped if beyond capacity (Switch position-in-expert).
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)    # [N, K, E]
+    flat = onehot.reshape(N * K, E)
+    pos = jnp.cumsum(flat, axis=0) - flat                      # [N*K, E]
+    pos_in_expert = (pos * flat).sum(-1).reshape(N, K)         # [N, K]
+    keep = (pos_in_expert < C)
+    gate_vals = gate_vals * keep
+
+    # Dispatch tensor [N, E, C]: token n → expert e at slot c.
+    slot_onehot = jax.nn.one_hot(
+        jnp.where(keep, pos_in_expert, C), C, dtype=cfg.dtype)  # [N, K, C]
+    disp = jnp.einsum("nke,nkc->nec",
+                      onehot.astype(cfg.dtype), slot_onehot)    # [N, E, C]
+    comb = jnp.einsum("nke,nkc,nk->nec", onehot.astype(jnp.float32),
+                      slot_onehot.astype(jnp.float32),
+                      gate_vals.astype(jnp.float32))            # [N, E, C]
+
+    # Expert compute on [E, C, D] — the expert dim is what EP shards.
+    xe = jnp.einsum("nd,nec->ecd", xf.astype(cfg.dtype), disp)  # [E, C, D]
+    g = jnp.einsum("ecd,edf->ecf", xe, params["w_gate"].astype(cfg.dtype))
+    u = jnp.einsum("ecd,edf->ecf", xe, params["w_up"].astype(cfg.dtype))
+    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u,
+                    params["w_down"].astype(cfg.dtype))         # [E, C, D]
+
+    y = jnp.einsum("ecd,nec->nd", ye.astype(jnp.float32), comb)
+    y = y.reshape(B, S, D).astype(x.dtype)
+
+    # Aux losses.
+    me = probs.mean(axis=0)                                     # [E]
+    ce = (onehot.sum(axis=1).astype(jnp.float32)).mean(axis=0)  # [E]
+    load_balance = E * jnp.sum(me * ce) / K
+    z_loss = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    aux = {
+        "moe_load_balance_loss": cfg.load_balance_coef * load_balance,
+        "moe_router_z_loss": cfg.router_z_loss_coef * z_loss,
+        "moe_fraction_dropped": 1.0 - (keep.sum() / (N * K)),
+    }
+    return y, aux

@@ -214,11 +214,14 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh: Optional[Mesh]):
 
 def forward(params: Dict[str, Any], tokens: jax.Array,
             cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-            rules: Optional[LogicalAxisRules] = None) -> jax.Array:
+            rules: Optional[LogicalAxisRules] = None,
+            num_microbatches: Optional[int] = None) -> jax.Array:
     """tokens (B, S) int32 → logits (B, S, V) float32.
 
     `rules` must match the table used to shard the params
-    (train_step.make_train_step threads its rules through here)."""
+    (train_step.make_train_step threads its rules through here). With a
+    pp>1 mesh axis the layer stack runs as a collective pipeline
+    (parallel/pipeline.py) over `num_microbatches` (default: pp)."""
     rules = rules or LogicalAxisRules.default()
 
     def constrain(x, axes):
@@ -249,36 +252,73 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     S = tokens.shape[1]
     cos, sin = rope_angles(S, cfg.head_dim_, cfg.rope_theta)
 
-    def layer_body(x, lp):
-        h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, lp["attn"]["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bse,ekd->bskd", h, lp["attn"]["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bse,ekd->bskd", h, lp["attn"]["wv"].astype(cfg.dtype))
-        q = constrain(q, ("batch", "seq", "heads", "head_dim"))
-        k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        o = _attention(cfg, q, k, v, mesh)
-        o = constrain(o, ("batch", "seq", "heads", "head_dim"))
-        o = jnp.einsum("bshd,hde->bse", o, lp["attn"]["wo"].astype(cfg.dtype))
-        x = x + constrain(o, ("batch", "seq", "embed"))
+    def _make_layer_body(constrain):
+        def layer_body(x, lp):
+            h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h,
+                           lp["attn"]["wq"].astype(cfg.dtype))
+            k = jnp.einsum("bse,ekd->bskd", h,
+                           lp["attn"]["wk"].astype(cfg.dtype))
+            v = jnp.einsum("bse,ekd->bskd", h,
+                           lp["attn"]["wv"].astype(cfg.dtype))
+            q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+            k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            o = _attention(cfg, q, k, v,
+                           mesh if constrain is not _no_constrain else None)
+            o = constrain(o, ("batch", "seq", "heads", "head_dim"))
+            o = jnp.einsum("bshd,hde->bse", o,
+                           lp["attn"]["wo"].astype(cfg.dtype))
+            x = x + constrain(o, ("batch", "seq", "embed"))
 
-        h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        g = jnp.einsum("bse,em->bsm", h, lp["mlp"]["w_gate"].astype(cfg.dtype))
-        u = jnp.einsum("bse,em->bsm", h, lp["mlp"]["w_up"].astype(cfg.dtype))
-        g = constrain(g, ("batch", "seq", "mlp"))
-        d = jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
-                       lp["mlp"]["w_down"].astype(cfg.dtype))
-        x = x + constrain(d, ("batch", "seq", "embed"))
-        return x, None
+            h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+            g = jnp.einsum("bse,em->bsm", h,
+                           lp["mlp"]["w_gate"].astype(cfg.dtype))
+            u = jnp.einsum("bse,em->bsm", h,
+                           lp["mlp"]["w_up"].astype(cfg.dtype))
+            g = constrain(g, ("batch", "seq", "mlp"))
+            d = jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                           lp["mlp"]["w_down"].astype(cfg.dtype))
+            x = x + constrain(d, ("batch", "seq", "embed"))
+            return x, None
+        return layer_body
 
-    body = layer_body
-    if cfg.remat:
-        body = jax.checkpoint(
-            layer_body,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    def _no_constrain(v, axes):
+        return v
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        # Collective pipelining over the pp axis: each rank applies its
+        # stage's layer slice; activations rotate via ppermute
+        # (parallel/pipeline.py). Sharding constraints (and the mesh-bound
+        # attention variants) are elided inside the manual region — XLA
+        # propagates shardings through the auto axes.
+        from ..parallel.pipeline import pipeline_spmd, split_stages
+
+        sbody = _make_layer_body(_no_constrain)
+        if cfg.remat:
+            sbody = jax.checkpoint(
+                sbody,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+
+        def apply_stage(stage_layers, xmb):
+            out, _ = jax.lax.scan(sbody, xmb, stage_layers)
+            return out
+
+        x = pipeline_spmd(
+            apply_stage, split_stages(params["layers"], pp), x,
+            mesh=mesh, num_microbatches=num_microbatches or pp)
+    else:
+        body = _make_layer_body(constrain)
+        if cfg.remat:
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
     logits = jnp.einsum("bse,ev->bsv", x,
                         params["lm_head"].astype(cfg.dtype),

@@ -1,0 +1,106 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the `pp` axis.
+
+The reference has no native PP executor — it provides scaffolding (compiled
+actor pipelines with NCCL p2p channels, dag/compiled_dag_node.py:805;
+vLLM PP via placement groups). TPU-native design: *collective pipelining*
+expressed entirely in the automatic GSPMD world: stage params and the
+activation buffer carry a leading [pp] dim sharded over the pp mesh axis,
+every tick applies the stage function vmapped over that dim (each pp rank
+computes its stage), and `jnp.roll` along it — which GSPMD lowers to a
+collective-permute over ICI — hands each stage's output to its neighbor.
+A fori_loop runs num_microbatches + pp - 1 ticks, the canonical schedule.
+Staying in the auto-sharding world (no shard_map manual region) lets the
+same code compose with dp/fsdp/tp axes untouched and differentiate through
+(roll/dynamic-slice both have transposes), so it serves training too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def split_stages(stacked_params: Any, pp: int) -> Any:
+    """[L, ...] layer-stacked params → [pp, L/pp, ...] stage-stacked.
+    The leading stage dim is what gets sharded over the pp axis."""
+
+    def _split(x):
+        L = x.shape[0]
+        if L % pp:
+            raise ValueError(f"{L} layers not divisible by pp={pp}")
+        return x.reshape((pp, L // pp) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, stacked_params)
+
+
+def merge_stages(stage_params: Any) -> Any:
+    """Inverse of split_stages."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), stage_params)
+
+
+def pipeline_spmd(apply_stage: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any,
+                  x: jax.Array,
+                  *,
+                  mesh: Mesh,
+                  num_microbatches: int,
+                  axis: str = "pp") -> jax.Array:
+    """Run activations through pp stages with microbatch rotation.
+
+    apply_stage(stage_local_params, x_mb) -> x_mb applies ONE stage's
+    layers (stage_local_params has the [L/pp, ...] layer-stack shape).
+    stage_params carries a leading [pp, ...] dim (see split_stages).
+    x: [B, ...] activations; B must divide by num_microbatches.
+    """
+    pp = dict(mesh.shape).get(axis, 1)
+    if pp == 1:
+        return apply_stage(
+            jax.tree_util.tree_map(lambda p: p[0], stage_params), x)
+    B = x.shape[0]
+    if B % num_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by num_microbatches={num_microbatches}")
+    if num_microbatches < pp:
+        raise ValueError(
+            f"num_microbatches ({num_microbatches}) must be >= pp ({pp}) "
+            "or the bubble dominates and ranks idle")
+    xs = x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+
+    def stage_spec(v):
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(axis)))
+
+    stage_params = jax.tree_util.tree_map(stage_spec, stage_params)
+    # Activation buffer [pp, mb, ...]: slot i is stage i's current input.
+    buf = stage_spec(jnp.zeros((pp,) + xs.shape[1:], xs.dtype))
+    outs = jnp.zeros_like(xs)
+    T = num_microbatches + pp - 1
+
+    vstage = jax.vmap(apply_stage, in_axes=(0, 0))
+
+    def tick(t, carry):
+        buf, outs = carry
+        # Stage 0 ingests microbatch t (clipped garbage after the last
+        # one; the write-window below masks it out).
+        inject = jnp.clip(t, 0, num_microbatches - 1)
+        buf = buf.at[0].set(xs[inject])
+        buf = stage_spec(buf)
+        y = vstage(stage_params, buf)        # each pp rank: its stage
+        y = stage_spec(y)
+        # The last stage emits microbatch t-(pp-1) once warmed up.
+        out_t = t - (pp - 1)
+        idx = jnp.clip(out_t, 0, num_microbatches - 1)
+        valid = jnp.logical_and(out_t >= 0, out_t < num_microbatches)
+        outs = outs.at[idx].set(
+            jnp.where(valid, y[pp - 1].astype(outs.dtype), outs[idx]))
+        # Rotate: stage i's output becomes stage i+1's input — GSPMD turns
+        # the sharded-dim roll into a collective-permute over ICI.
+        buf = jnp.roll(y, 1, axis=0)
+        return buf, outs
+
+    buf, outs = jax.lax.fori_loop(0, T, tick, (buf, outs))
+    return outs.reshape((B,) + outs.shape[2:])

@@ -1,0 +1,255 @@
+"""`python -m ray_tpu` — cluster CLI.
+
+Reference surface: python/ray/scripts/scripts.py (`ray start` :683, plus
+stop/status/submit/job/list/timeline/memory). Daemons (GCS + node agent)
+are spawned detached into a session dir and recorded in a pidfile so
+`stop` can tear them down; the head address lands in the well-known
+cluster-address file consumed by init(address="auto").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _write_pidfile(session_dir: str, pids):
+    with open(os.path.join(session_dir, "daemon_pids.json"), "w") as f:
+        json.dump(pids, f)
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private import worker as worker_mod
+
+    session_dir = node_mod.new_session_dir()
+    pids = []
+    if args.head:
+        gcs_proc, gcs_addr = node_mod.start_gcs(session_dir, port=args.port)
+        pids.append(gcs_proc.pid)
+        worker_mod.write_cluster_address_file(gcs_addr)
+        print(f"GCS started at {gcs_addr[0]}:{gcs_addr[1]}")
+    else:
+        if not args.address:
+            print("--address required to join an existing cluster",
+                  file=sys.stderr)
+            return 2
+        host, port = args.address.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+    res = node_mod.default_resources(args.num_cpus, args.num_tpus, None)
+    agent_proc, agent_addr, store_path, node_id = node_mod.start_agent(
+        session_dir, gcs_addr, res,
+        store_capacity=args.object_store_memory or 1 << 30)
+    pids.append(agent_proc.pid)
+    _write_pidfile(session_dir, pids)
+    print(f"node {node_id.hex()[:8]} up (agent {agent_addr[0]}:"
+          f"{agent_addr[1]}, session {session_dir})")
+    if args.head:
+        print(f"connect with ray_tpu.init(address="
+              f"'{gcs_addr[0]}:{gcs_addr[1]}') or address='auto'")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """Kill every daemon recorded in any session pidfile (reference:
+    `ray stop` kills all local ray processes)."""
+    import glob
+    import signal
+    import tempfile
+    # Best-effort: stop RUNNING jobs first so their entrypoint process
+    # groups die with their supervisors rather than being orphaned.
+    try:
+        from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+        client = JobSubmissionClient(args.address or "auto")
+        for info in client.list_jobs():
+            if info["status"] == JobStatus.RUNNING:
+                try:
+                    client.stop_job(info["submission_id"])
+                except Exception:
+                    pass
+    except Exception:
+        pass
+    killed = 0
+    session_root = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    for pf in glob.glob(os.path.join(session_root,
+                                     "session_*/daemon_pids.json")):
+        try:
+            pids = json.load(open(pf))
+        except Exception:
+            continue
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+            except ProcessLookupError:
+                pass
+        os.unlink(pf)
+    from ray_tpu._private.worker import CLUSTER_ADDRESS_FILE
+    try:
+        os.unlink(CLUSTER_ADDRESS_FILE)
+    except OSError:
+        pass
+    print(f"stopped {killed} daemon(s)")
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+    ray_tpu.init(address=args.address or "auto", log_level="ERROR")
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _connect(args)
+    info = ray_tpu._core().gcs_call("get_cluster_info", {})
+    nodes = ray_tpu._core().gcs_call("get_nodes", {})
+    alive = [n for n in nodes if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    total, avail = {}, {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    if isinstance(info, dict):
+        for k, v in info.items():
+            if isinstance(v, (int, float, str)):
+                print(f"  {k}: {v}")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    import shlex
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    if not args.entrypoint or args.entrypoint == ["--"]:
+        print("no entrypoint given (usage: submit -- <command...>)",
+              file=sys.stderr)
+        return 2
+    client = JobSubmissionClient(args.address or "auto")
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    sid = client.submit_job(entrypoint=shlex.join(args.entrypoint),
+                            runtime_env=runtime_env or None)
+    print(f"submitted {sid}")
+    if args.no_wait:
+        return 0
+    for chunk in client.tail_job_logs(sid):
+        sys.stdout.write(chunk)
+        sys.stdout.flush()
+    status = client.get_job_status(sid)
+    print(f"\njob {sid}: {status}")
+    return 0 if status == JobStatus.SUCCEEDED else 1
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(args.address or "auto")
+    if args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info['submission_id']}  {info['status']:10s}  "
+                  f"{info['entrypoint']}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.id) else "already terminal")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state
+    kind = args.kind
+    rows = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    ray_tpu = _connect(args)
+    out = args.output or f"/tmp/ray_tpu/timeline-{int(time.time())}.json"
+    events = ray_tpu.timeline(out)
+    print(f"wrote {len(events)} events to {out}")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state
+    objs = state.list_objects()
+    total = sum(o["size_bytes"] for o in objs)
+    print(f"{len(objs)} objects, {total / (1 << 20):.1f} MiB total")
+    for o in objs[:args.limit]:
+        print(f"  {o['object_id'][:16]}...  {o['size_bytes']:>12}B  "
+              f"pins={o['pins']}  node={o['node_id'][:8]}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    parser.add_argument("--address", default=None,
+                        help="GCS host:port (default: the address file)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start cluster daemons on this host")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local daemons")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster resource summary")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job and stream its logs")
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command to run (after --)")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("job", help="job operations")
+    p.add_argument("job_cmd", choices=["list", "status", "logs", "stop"])
+    p.add_argument("id", nargs="?")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("list", help="state API listings")
+    p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",
+                                    "placement-groups", "jobs"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="dump a chrome trace")
+    p.add_argument("--output", "-o", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("memory", help="object store contents")
+    p.add_argument("--limit", type=int, default=50)
+    p.set_defaults(fn=cmd_memory)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "submit" and args.entrypoint[:1] == ["--"]:
+        args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,17 @@ if os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu") == "cpu":
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _collect_previous_test_garbage():
+    """pytest machinery keeps the previous test's frame reachable into
+    the next test; actors whose handles live in that frame then hold
+    their CPUs. Collecting up front releases them before this test
+    competes for resources."""
+    import gc
+    gc.collect()
+    yield
+
+
 @pytest.fixture
 def ray_start_regular():
     """Shared cluster: initialized on first use, reused across tests, torn

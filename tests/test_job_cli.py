@@ -1,0 +1,132 @@
+"""Job submission + CLI.
+
+Reference model: dashboard/modules/job/job_manager.py:60 (JobManager),
+job_supervisor.py:56 (JobSupervisor actor), job_submission SDK, and
+scripts/scripts.py (`ray start/stop/status/submit/...`).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+def _wait_status(client, sid, want, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = client.get_job_status(sid)
+        if st == want:
+            return st
+        if st in JobStatus.TERMINAL and want not in JobStatus.TERMINAL:
+            return st
+        if st in JobStatus.TERMINAL and st != want:
+            raise AssertionError(
+                f"job ended {st}, wanted {want}: "
+                + client.get_job_logs(sid)[-2000:])
+        time.sleep(0.5)
+    raise AssertionError(f"job never reached {want} (last={st})")
+
+
+def _cleanup(client, sid):
+    """Delete the job so its supervisor (0.1 CPU + a worker) doesn't idle
+    through the grace window into later tests' resource math."""
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get_job_status(sid) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.3)
+    client.delete_job(sid)
+
+
+def test_job_submission_end_to_end(ray_start_regular):
+    client = JobSubmissionClient()
+    entry = (f"{sys.executable} -c \""
+             "import ray_tpu\n"
+             "ray_tpu.init()\n"           # joins via RAY_TPU_ADDRESS
+             "@ray_tpu.remote\n"
+             "def f(x): return x + 2\n"
+             "print('job-result', ray_tpu.get(f.remote(40), timeout=60))\n"
+             "ray_tpu.shutdown()\"")
+    sid = client.submit_job(entrypoint=entry)
+    assert sid.startswith("raysubmit_")
+    _wait_status(client, sid, JobStatus.SUCCEEDED, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert "job-result 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+    _cleanup(client, sid)
+
+
+def test_job_failure_reported(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if client.get_job_status(sid) == JobStatus.FAILED:
+            break
+        time.sleep(0.3)
+    info = client.get_job_info(sid)
+    assert info["status"] == JobStatus.FAILED
+    assert "code 3" in info["message"]
+    _cleanup(client, sid)
+
+
+def test_job_stop(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    _wait_status(client, sid, JobStatus.RUNNING)
+    assert client.stop_job(sid)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get_job_status(sid) == JobStatus.STOPPED:
+            _cleanup(client, sid)
+            return
+        time.sleep(0.3)
+    raise AssertionError("job never reached STOPPED")
+
+
+def test_cli_cluster_lifecycle(tmp_path):
+    """`start --head` -> status/submit/job list -> stop, all through the
+    module CLI as a user would run it."""
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+
+    def cli(*args, timeout=120):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd="/root/repo")
+
+    r = cli("start", "--head", "--num-cpus", "4")
+    try:
+        assert r.returncode == 0, r.stderr
+        assert "GCS started" in r.stdout
+
+        r = cli("status")
+        assert r.returncode == 0, r.stderr
+        assert "alive" in r.stdout and "CPU" in r.stdout
+
+        r = cli("submit", "--", sys.executable, "-c",
+                "print('hello-from-cli-job')")
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "hello-from-cli-job" in r.stdout
+        assert "SUCCEEDED" in r.stdout
+
+        r = cli("job", "list")
+        assert r.returncode == 0, r.stderr
+        assert "raysubmit_" in r.stdout
+
+        r = cli("list", "nodes")
+        assert r.returncode == 0, r.stderr
+        assert "ALIVE" in r.stdout
+    finally:
+        r = cli("stop")
+        assert r.returncode == 0, r.stderr
+        assert "stopped" in r.stdout

@@ -1,0 +1,92 @@
+"""Runtime environments: env_vars, working_dir, py_modules.
+
+Reference model: _private/runtime_env/ plugins (packaging.py gcs:// URIs,
+per-node agent materialization with URI caching, working_dir as worker
+cwd, py_modules on sys.path).
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+def test_env_vars_task(ray_start_regular):
+    @ray_tpu.remote
+    def read_env():
+        import os
+        return os.environ.get("RENV_TEST_VAR")
+
+    val = ray_tpu.get(
+        read_env.options(
+            runtime_env={"env_vars": {"RENV_TEST_VAR": "hello"}}).remote(),
+        timeout=60)
+    assert val == "hello"
+    # A plain task (no env) must not see the variable: envs don't leak
+    # across scheduling keys.
+    assert ray_tpu.get(read_env.remote(), timeout=60) is None
+
+
+def test_working_dir_task(ray_start_regular, tmp_path):
+    pkg = tmp_path / "app"
+    pkg.mkdir()
+    (pkg / "data.txt").write_text("working-dir-payload")
+    (pkg / "applib.py").write_text("VALUE = 37\n")
+
+    @ray_tpu.remote
+    def read_working_dir():
+        import os
+        import applib                      # importable from working_dir
+        with open("data.txt") as f:        # cwd == working_dir
+            return f.read(), applib.VALUE, os.getcwd()
+
+    data, value, cwd = ray_tpu.get(
+        read_working_dir.options(
+            runtime_env={"working_dir": str(pkg)}).remote(),
+        timeout=60)
+    assert data == "working-dir-payload"
+    assert value == 37
+    assert "runtime_resources" in cwd
+
+
+def test_py_modules_actor(ray_start_regular, tmp_path):
+    mod = tmp_path / "mymod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("def f():\n    return 'from-mymod'\n")
+
+    @ray_tpu.remote
+    class Uses:
+        def call(self):
+            import mymod
+            return mymod.f()
+
+    a = Uses.options(
+        runtime_env={"py_modules": [str(tmp_path)]}).remote()
+    assert ray_tpu.get(a.call.remote(), timeout=60) == "from-mymod"
+
+
+def test_unsupported_plugin_rejected(ray_start_regular):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        noop.options(runtime_env={"pip": ["requests"]}).remote()
+
+
+def test_uri_cache_reuses_package(ray_start_regular, tmp_path):
+    pkg = tmp_path / "cached"
+    pkg.mkdir()
+    (pkg / "marker.txt").write_text("x")
+
+    @ray_tpu.remote
+    def whereami():
+        import os
+        return os.getcwd()
+
+    renv = {"working_dir": str(pkg)}
+    c1 = ray_tpu.get(whereami.options(runtime_env=renv).remote(), timeout=60)
+    c2 = ray_tpu.get(whereami.options(runtime_env=renv).remote(), timeout=60)
+    assert c1 == c2   # same content digest -> same cache dir
