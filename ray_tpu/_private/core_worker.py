@@ -82,7 +82,7 @@ class _Lease:
 
 class _KeyState:
     __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
-                 "strategy", "runtime_env")
+                 "strategy", "runtime_env", "last_demand_report")
 
     def __init__(self, resources, strategy, runtime_env=None):
         self.queue: deque[_PendingTask] = deque()
@@ -91,6 +91,7 @@ class _KeyState:
         self.resources = resources
         self.strategy = strategy
         self.runtime_env = runtime_env
+        self.last_demand_report = 0.0
 
 
 class _ActorState:
@@ -1085,6 +1086,21 @@ class CoreWorker:
             state.pending_lease_requests += 1
             self._spawn(self._request_lease(key, state))
 
+    def _report_demand(self, key: bytes, state: _KeyState):
+        """Tell the GCS this scheduling key has unschedulable tasks so the
+        autoscaler can launch capacity (rate-limited per key; reference:
+        backlog size in lease requests feeding autoscaler demand)."""
+        now = time.monotonic()
+        last = getattr(state, "last_demand_report", 0.0)
+        if now - last < 2.0:
+            return
+        state.last_demand_report = now
+        shapes = [{"resources": state.resources,
+                   "count": max(1, len(state.queue))}]
+        self._spawn(self.gcs.call("report_demand", {
+            "reporter": self.worker_id + key,
+            "shapes": shapes}))
+
     async def _request_lease(self, key: bytes, state: _KeyState,
                              agent_conn: Optional[rpc.Connection] = None,
                              hops: int = 0):
@@ -1140,6 +1156,8 @@ class CoreWorker:
                     pass
             state.pending_lease_requests -= 1
             if state.queue:
+                if "infeasible" in (res.get("reason") or ""):
+                    self._report_demand(key, state)
                 await asyncio.sleep(res.get("retry_after_ms", 100) / 1000)
                 self._pump(key, state)
             return
@@ -1774,3 +1792,10 @@ class CoreWorker:
         return self._run(self.gcs.call(
             "get_actor", {"actor_id": actor_id, "name": name,
                           "wait_alive": False}))
+
+    async def get_actor_info_async(self, *, actor_id=None, name=None):
+        """Loop-thread-safe variant for async actor methods (e.g. a Serve
+        handle resolving its controller from inside a deployment)."""
+        return await self.gcs.call(
+            "get_actor", {"actor_id": actor_id, "name": name,
+                          "wait_alive": False})

@@ -149,6 +149,10 @@ class GcsServer:
         self.task_events: _deque = _deque(maxlen=_gc().gcs_task_events_max)
         # (name, labels_tuple) -> {"type", "value"/"sum"/"buckets", ...}
         self.metrics: Dict[tuple, dict] = {}
+        # Resource demand reported by core workers whose lease requests
+        # came back infeasible (reference: autoscaler.proto resource
+        # demand in GcsAutoscalerStateManager).  reporter -> shapes+ts.
+        self.demand: Dict[bytes, dict] = {}
         self._server = rpc.RpcServer(self._handlers(), name="gcs")
         self._health_task: Optional[asyncio.Task] = None
 
@@ -181,6 +185,8 @@ class GcsServer:
             "get_metrics": self.h_get_metrics,
             "ping": lambda conn, p: "pong",
             "get_cluster_info": self.h_get_cluster_info,
+            "report_demand": self.h_report_demand,
+            "get_demand": self.h_get_demand,
         }
 
     # ----------------------------------------------------------- telemetry --
@@ -413,6 +419,42 @@ class GcsServer:
         await self._mark_node_dead(p["node_id"], "drained")
         return True
 
+    async def h_report_demand(self, conn, p):
+        """Core workers report unfulfilled lease shapes so the autoscaler
+        can see cluster-wide pending demand (reference: autoscaler state
+        aggregation in gcs_autoscaler_state_manager.cc)."""
+        shapes = p.get("shapes") or []
+        if shapes:
+            self.demand[p["reporter"]] = {"shapes": shapes,
+                                          "ts": time.monotonic()}
+        else:
+            self.demand.pop(p["reporter"], None)
+        return True
+
+    async def h_get_demand(self, conn, p):
+        """Aggregate non-expired demand: task shapes from workers, plus
+        pending actors and pending placement-group bundles."""
+        ttl = p.get("ttl_s", 15.0)
+        now = time.monotonic()
+        shapes: list = []
+        for reporter, entry in list(self.demand.items()):
+            if now - entry["ts"] > ttl:
+                del self.demand[reporter]
+                continue
+            shapes.extend(entry["shapes"])
+        pending_actors = [
+            a.spec.get("resources", {}) for a in self.actors.values()
+            if a.state in (protocol.ACTOR_PENDING,
+                           protocol.ACTOR_RESTARTING)]
+        pending_bundles: list = []
+        for pg in self.placement_groups.values():
+            if pg["state"] == "PENDING":
+                pending_bundles.append({"strategy": pg["strategy"],
+                                        "bundles": pg["bundle_specs"]})
+        return {"task_shapes": shapes,
+                "pending_actors": [r for r in pending_actors if r],
+                "pending_pgs": pending_bundles}
+
     async def _health_loop(self):
         """Active health checking (reference: gcs_health_check_manager.h —
         FailNode after `health_check_failure_threshold` missed periods)."""
@@ -516,13 +558,23 @@ class GcsServer:
             actor.death_cause = "killed before registration completed"
             self._log_actor(actor)
             return {"existing": False, "actor": actor.view()}
+        # Placement runs in the background: RegisterActor replies once the
+        # actor is recorded, the creation task proceeds asynchronously
+        # (reference: gcs_actor_manager.cc RegisterActor vs CreateActor —
+        # clients poll/get with wait_alive).  Keeping PENDING visible also
+        # lets the autoscaler see the actor as demand and bring capacity
+        # before the scheduling deadline.
+        rpc.spawn(self._schedule_or_bury(actor))
+        return {"existing": False, "actor": actor.view()}
+
+    async def _schedule_or_bury(self, actor: ActorInfo):
         ok = await self._schedule_actor(actor)
-        if not ok:
+        if not ok and actor.state == protocol.ACTOR_PENDING:
             actor.state = protocol.ACTOR_DEAD
             actor.death_cause = "scheduling failed: no feasible node"
             self._log_actor(actor)
-            raise RuntimeError(actor.death_cause)
-        return {"existing": False, "actor": actor.view()}
+            self._publish(protocol.CH_ACTOR,
+                          {"event": "dead", "actor": actor.view()})
 
     def _pick_node(self, resources: Dict[str, float],
                    strategy: Optional[dict]) -> Optional[NodeInfo]:
@@ -577,6 +629,9 @@ class GcsServer:
         deadline = time.monotonic() + timeout_s
         node = None
         while time.monotonic() < deadline:
+            if actor.state not in (protocol.ACTOR_PENDING,
+                                   protocol.ACTOR_RESTARTING):
+                return False        # killed while pending/restarting
             node = self._pick_node(spec.get("resources", {}),
                                    spec.get("scheduling_strategy"))
             if node is not None and node.conn is not None and not node.conn.closed:

@@ -1,0 +1,18 @@
+"""Autoscaler: demand-driven cluster resize (reference:
+python/ray/autoscaler/v2/autoscaler.py:47 Autoscaler, v2/scheduler.py:88
+ResourceDemandScheduler, _private/fake_multi_node/node_provider.py:237
+FakeMultiNodeProvider).
+
+TPU-native stance: node types are whole TPU hosts (or whole slices via a
+`TPU-{pod}-head` resource), so scale-up is gang-shaped by construction —
+a pending STRICT_SPREAD placement group for a v5e-16 slice demands 4
+hosts at once, not 1-by-1.
+"""
+
+from .autoscaler import Autoscaler, AutoscalerConfig
+from .node_provider import FakeMultiNodeProvider, NodeProvider
+from .scheduler import NodeTypeConfig, ResourceDemandScheduler
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
+           "FakeMultiNodeProvider", "NodeTypeConfig",
+           "ResourceDemandScheduler"]

@@ -1,0 +1,190 @@
+"""The reconciler (reference: python/ray/autoscaler/v2/autoscaler.py:47
+Autoscaler.update_autoscaling_state — read cluster state + demand from
+GCS, plan with ResourceDemandScheduler, instruct the provider; v1
+StandardAutoscaler idle-termination semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .node_provider import NodeProvider, ProviderNode
+from .scheduler import NodeTypeConfig, ResourceDemandScheduler, _fits
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeTypeConfig] = field(default_factory=list)
+    max_workers: int = 20
+    idle_timeout_s: float = 60.0
+    update_period_s: float = 5.0
+
+
+class Autoscaler:
+    """One instance per cluster, typically run beside the GCS
+    (`ray_tpu up`-style deployments would run it on the head node)."""
+
+    def __init__(self, gcs_address: tuple, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        self.gcs_address = tuple(gcs_address)
+        self.provider = provider
+        self.config = config
+        self.scheduler = ResourceDemandScheduler(
+            config.node_types, max_workers=config.max_workers)
+        self._idle_since: Dict[bytes, float] = {}
+        self._launched: List[ProviderNode] = []
+        self._conn = None
+
+    # ------------------------------------------------------------ state IO --
+    async def _gcs(self):
+        from .._private import rpc
+        loop = asyncio.get_running_loop()
+        conn, conn_loop = self._conn or (None, None)
+        if conn is None or conn.closed or conn_loop is not loop:
+            # A fresh asyncio.run() per update (how tests drive reconciles)
+            # gets a fresh connection; the resident run() loop reuses one.
+            conn = await rpc.connect(self.gcs_address, name="autoscaler")
+            self._conn = (conn, loop)
+        return conn
+
+    async def _read_state(self) -> dict:
+        gcs = await self._gcs()
+        nodes = await gcs.call("get_nodes", {})
+        demand = await gcs.call("get_demand", {})
+        return {"nodes": nodes, "demand": demand}
+
+    # ----------------------------------------------------------- reconcile --
+    async def update(self) -> dict:
+        """One reconcile pass; returns {"launched": {type: n},
+        "terminated": [provider ids]} for observability/tests."""
+        state = await self._read_state()
+        alive = [n for n in state["nodes"] if n["alive"]]
+        free = [dict(n["resources_available"]) for n in alive]
+
+        demands: List[Dict[str, float]] = []
+        for shape in state["demand"]["task_shapes"]:
+            demands.extend([dict(shape["resources"])]
+                           * int(shape.get("count", 1)))
+        demands.extend(dict(r) for r in state["demand"]["pending_actors"])
+
+        # Pending placement groups: STRICT_SPREAD bundles each need a
+        # distinct node, so they bypass free-capacity packing and demand
+        # whole fresh nodes (TPU slices scale host-at-a-time by design).
+        strict_nodes: Dict[str, int] = {}
+        for pg in state["demand"]["pending_pgs"]:
+            if pg["strategy"] == "STRICT_SPREAD":
+                for bundle in pg["bundles"]:
+                    t = self._smallest_feasible_type(bundle)
+                    if t is not None:
+                        strict_nodes[t.name] = strict_nodes.get(t.name, 0) + 1
+            else:
+                demands.extend(dict(b) for b in pg["bundles"])
+
+        existing_counts: Dict[str, int] = {}
+        for pn in self.provider.non_terminated_nodes():
+            existing_counts[pn.node_type] = \
+                existing_counts.get(pn.node_type, 0) + 1
+
+        to_launch = self.scheduler.get_nodes_to_launch(
+            free, demands, existing_counts)
+        for t, n in strict_nodes.items():
+            cfg = self._type(t)
+            have = existing_counts.get(t, 0) + to_launch.get(t, 0)
+            room = max(0, cfg.max_workers - have)
+            # STRICT_SPREAD bundles pending means current nodes can't hold
+            # them; launch one node per bundle up to the caps.
+            to_launch[t] = to_launch.get(t, 0) + min(n, room)
+
+        launched: Dict[str, int] = {}
+        for type_name, count in to_launch.items():
+            cfg = self._type(type_name)
+            for _ in range(count):
+                if len(self.provider.non_terminated_nodes()) >= \
+                        self.config.max_workers:
+                    break
+                node = self.provider.create_node(
+                    type_name, cfg.resources, cfg.labels)
+                self._launched.append(node)
+                launched[type_name] = launched.get(type_name, 0) + 1
+        if launched:
+            logger.info("autoscaler launched %s", launched)
+
+        terminated = await self._terminate_idle(alive, demands)
+        return {"launched": launched, "terminated": terminated}
+
+    async def _terminate_idle(self, alive_nodes: List[dict],
+                              demands: List[dict]) -> List[str]:
+        """Terminate provider-managed nodes that have been fully idle for
+        idle_timeout_s, keeping min_workers per type (reference: v1
+        idle_timeout_minutes)."""
+        now = time.monotonic()
+        by_node_id = {pn.node_id: pn
+                      for pn in self.provider.non_terminated_nodes()
+                      if pn.node_id is not None}
+        out: List[str] = []
+        per_type = {}
+        for pn in self.provider.non_terminated_nodes():
+            per_type[pn.node_type] = per_type.get(pn.node_type, 0) + 1
+        for n in alive_nodes:
+            nid = bytes(n["node_id"])
+            pn = by_node_id.get(nid)
+            if pn is None:
+                continue            # not ours (e.g. the head node)
+            total = n["resources_total"]
+            avail = n["resources_available"]
+            busy = any(avail.get(k, 0.0) < v - 1e-9
+                       for k, v in total.items())
+            if busy or demands:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            cfg = self._type(pn.node_type)
+            if now - first >= self.config.idle_timeout_s and \
+                    per_type.get(pn.node_type, 0) > cfg.min_workers:
+                gcs = await self._gcs()
+                try:
+                    await gcs.call("drain_node", {"node_id": nid})
+                except Exception:
+                    pass
+                self.provider.terminate_node(pn)
+                per_type[pn.node_type] -= 1
+                self._idle_since.pop(nid, None)
+                out.append(pn.provider_id)
+                logger.info("autoscaler terminated idle node %s",
+                            pn.provider_id)
+        return out
+
+    # ------------------------------------------------------------- helpers --
+    def _type(self, name: str) -> NodeTypeConfig:
+        for t in self.config.node_types:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def _smallest_feasible_type(self, demand: Dict[str, float]
+                                ) -> Optional[NodeTypeConfig]:
+        feas = [t for t in self.config.node_types
+                if _fits(t.resources, demand)]
+        return min(feas, key=lambda t: sum(t.resources.values())) \
+            if feas else None
+
+    # ------------------------------------------------------------ run loop --
+    async def run(self, stop: Optional[asyncio.Event] = None):
+        """Monitor loop (reference: autoscaler/_private/monitor.py)."""
+        stop = stop or asyncio.Event()
+        while not stop.is_set():
+            try:
+                await self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            try:
+                await asyncio.wait_for(stop.wait(),
+                                       self.config.update_period_s)
+            except asyncio.TimeoutError:
+                pass

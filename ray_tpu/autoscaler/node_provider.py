@@ -1,0 +1,88 @@
+"""Node providers: the cloud-side of the autoscaler (reference:
+python/ray/autoscaler/node_provider.py NodeProvider ABC;
+_private/fake_multi_node/node_provider.py:237 FakeMultiNodeProvider —
+real raylet processes on one machine, which is what makes autoscaler
+tests possible without a cloud).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ProviderNode:
+    provider_id: str
+    node_type: str
+    node_id: Optional[bytes] = None     # framework node id once registered
+    meta: dict = field(default_factory=dict)
+
+
+class NodeProvider:
+    """ABC. A real deployment would implement this against GCE/GKE TPU
+    APIs (queued resources for slices); tests use FakeMultiNodeProvider."""
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> ProviderNode:
+        raise NotImplementedError
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches real node agents on this machine, each with its own
+    /dev/shm object store — autoscaling tests run against the true stack
+    (reference: fake_multi_node/node_provider.py:237)."""
+
+    def __init__(self, session_dir: str, gcs_address: tuple,
+                 store_capacity: int = 128 << 20):
+        self.session_dir = session_dir
+        self.gcs_address = tuple(gcs_address)
+        self.store_capacity = store_capacity
+        self._nodes: Dict[str, ProviderNode] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> ProviderNode:
+        from .._private import node as node_mod
+        proc, addr, store_path, node_id = node_mod.start_agent(
+            self.session_dir, self.gcs_address, dict(resources),
+            labels=dict(labels or {}),
+            store_capacity=self.store_capacity)
+        pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+        node = ProviderNode(pid, node_type, node_id,
+                            {"address": addr, "store_path": store_path})
+        with self._lock:
+            self._nodes[pid] = node
+            self._procs[pid] = proc
+        return node
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        with self._lock:
+            proc = self._procs.pop(node.provider_id, None)
+            self._nodes.pop(node.provider_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def shutdown(self) -> None:
+        for node in self.non_terminated_nodes():
+            self.terminate_node(node)

@@ -1,0 +1,103 @@
+"""Bin-packing resource demand scheduler (reference:
+python/ray/autoscaler/v2/scheduler.py:88 ResourceDemandScheduler — pack
+pending demands onto existing free capacity first, then onto copies of
+launchable node types; resource_demand_scheduler.py v1 semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+EPS = 1e-9
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launchable node shape (reference: available_node_types in the
+    cluster YAML, autoscaler/ray-schema.json)."""
+    name: str
+    resources: Dict[str, float]
+    max_workers: int = 10
+    min_workers: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v - EPS
+               for k, v in demand.items() if v > 0)
+
+
+def _consume(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    """Stateless planner: given free capacity + demand shapes, decide how
+    many copies of each node type to launch."""
+
+    def __init__(self, node_types: List[NodeTypeConfig],
+                 max_workers: int = 20):
+        self.node_types = list(node_types)
+        self.max_workers = max_workers
+
+    def get_nodes_to_launch(
+            self,
+            free_capacity: List[Dict[str, float]],
+            demands: List[Dict[str, float]],
+            existing_counts: Optional[Dict[str, int]] = None,
+    ) -> Dict[str, int]:
+        """First-fit-decreasing: sort demands big-first, pack onto copies
+        of existing free capacity, then onto virtual new nodes (cheapest
+        feasible type = fewest total resources), respecting per-type
+        max_workers and the global cap."""
+        existing_counts = dict(existing_counts or {})
+        free = [dict(a) for a in free_capacity]
+        virtual: List[tuple] = []   # (type_name, avail_dict)
+        to_launch: Dict[str, int] = {}
+        total_existing = sum(existing_counts.values())
+
+        def _n_launched() -> int:
+            return sum(to_launch.values())
+
+        for demand in sorted(demands,
+                             key=lambda d: -sum(v for v in d.values())):
+            placed = False
+            for avail in free:
+                if _fits(avail, demand):
+                    _consume(avail, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for _, avail in virtual:
+                if _fits(avail, demand):
+                    _consume(avail, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # Launch a new node: smallest feasible type.
+            candidates = [
+                t for t in self.node_types
+                if _fits(t.resources, demand)
+                and (existing_counts.get(t.name, 0)
+                     + to_launch.get(t.name, 0)) < t.max_workers]
+            if not candidates or \
+                    total_existing + _n_launched() >= self.max_workers:
+                continue        # infeasible demand: skip (stays pending)
+            best = min(candidates, key=lambda t: sum(t.resources.values()))
+            to_launch[best.name] = to_launch.get(best.name, 0) + 1
+            avail = dict(best.resources)
+            _consume(avail, demand)
+            virtual.append((best.name, avail))
+
+        # min_workers floor.
+        for t in self.node_types:
+            have = existing_counts.get(t.name, 0) + to_launch.get(t.name, 0)
+            if have < t.min_workers:
+                to_launch[t.name] = (to_launch.get(t.name, 0)
+                                     + t.min_workers - have)
+        return to_launch

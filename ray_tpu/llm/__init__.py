@@ -1,0 +1,17 @@
+"""ray_tpu.llm: LLM batch inference + serving patterns.
+
+Reference surface: python/ray/llm/ (~28k LoC) — vLLM-backed batch
+pipeline (_internal/batch/), serving patterns (data-parallel
+dp_server.py, prefill/decode disaggregation pd_server.py).  The TPU
+build replaces the vLLM engine with a native JAX continuous-batching
+engine (engine.py) on the in-tree flagship transformer; the patterns
+(DP replicas, P/D disaggregation, engine-actor batch stages) carry over
+structurally.
+"""
+
+from .batch import ProcessorConfig, build_llm_processor
+from .engine import LLMEngine, SamplingParams
+from .serve_patterns import build_dp_deployment, run_pd_app
+
+__all__ = ["LLMEngine", "SamplingParams", "ProcessorConfig",
+           "build_llm_processor", "build_dp_deployment", "run_pd_app"]

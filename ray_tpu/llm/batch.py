@@ -1,0 +1,85 @@
+"""Batch LLM inference over Data pipelines.
+
+Reference: python/ray/llm/_internal/batch/ — build_llm_processor maps a
+Dataset through engine-actor stages (vllm_engine_stage.py).  Here the
+stage is an actor-pool map_batches whose actors each hold a JAX engine;
+TPU replicas pin one engine per chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ProcessorConfig:
+    """Engine shape for the batch stage (reference:
+    vLLMEngineProcessorConfig)."""
+    preset: str = "tiny"
+    max_batch: int = 4
+    max_len: int = 128
+    max_tokens: int = 16
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    concurrency: int = 1
+    batch_size: int = 8
+    seed: int = 0
+    prompt_column: str = "prompt_tokens"
+    length_column: str = "prompt_len"
+    output_column: str = "generated_tokens"
+
+
+class _EngineStage:
+    """Actor-pool callable: one engine per actor, reused across batches."""
+
+    def __init__(self, cfg_blob: dict):
+        from ..models import PRESETS
+        from .engine import LLMEngine, SamplingParams
+        self.cfg = ProcessorConfig(**cfg_blob)
+        self.engine = LLMEngine(PRESETS[self.cfg.preset],
+                                max_batch=self.cfg.max_batch,
+                                max_len=self.cfg.max_len,
+                                seed=self.cfg.seed)
+        self.sampling = SamplingParams(max_tokens=self.cfg.max_tokens,
+                                       temperature=self.cfg.temperature,
+                                       eos_id=self.cfg.eos_id)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        prompts_padded = batch[self.cfg.prompt_column]
+        lens = batch[self.cfg.length_column].astype(np.int64)
+        prompts = [list(map(int, prompts_padded[i, :lens[i]]))
+                   for i in range(len(lens))]
+        outs = self.engine.generate(prompts, self.sampling)
+        width = max((len(o) for o in outs), default=0)
+        padded = np.zeros((len(outs), max(width, 1)), np.int32)
+        out_lens = np.zeros(len(outs), np.int32)
+        for i, o in enumerate(outs):
+            padded[i, :len(o)] = o
+            out_lens[i] = len(o)
+        out = dict(batch)
+        out[self.cfg.output_column] = padded
+        out[self.cfg.output_column + "_len"] = out_lens
+        return out
+
+
+def build_llm_processor(config: ProcessorConfig):
+    """Returns Dataset -> Dataset (reference: ray.data.llm
+    build_llm_processor).  Usage:
+
+        proc = build_llm_processor(ProcessorConfig(preset="tiny"))
+        ds = proc(ray_tpu.data.from_items(rows))
+    """
+    blob = dataclasses.asdict(config)
+
+    def apply(ds):
+        return ds.map_batches(
+            _EngineStage,
+            batch_size=config.batch_size,
+            fn_constructor_args=(blob,),
+            concurrency=config.concurrency,
+            num_cpus=1.0)
+
+    return apply

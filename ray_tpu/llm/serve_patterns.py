@@ -1,0 +1,128 @@
+"""LLM serving patterns on Serve: data-parallel replicas and
+prefill/decode disaggregation.
+
+Reference: python/ray/llm/_internal/serve/serving_patterns/ —
+data_parallel/dp_server.py (N identical engine replicas behind the
+router) and prefill_decode/pd_server.py (prefill nodes compute the KV
+cache, ship it, decode nodes stream tokens).  TPU-native: the KV blob
+rides the shared-memory object plane between replicas (zero-copy on one
+host, chunked transfer across hosts); each replica owns its chip(s) via
+the TPU resource.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .. import serve
+from ..models import PRESETS
+from .engine import LLMEngine, SamplingParams
+
+
+class _LLMServer:
+    """One engine behind @serve.batch: single-prompt requests coalesce
+    into one continuous-batching generate call (reference:
+    dp_server.py + serve/batching.py)."""
+
+    def __init__(self, preset: str = "tiny", max_batch: int = 4,
+                 max_len: int = 128, max_tokens: int = 16,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: int = 0):
+        self.engine = LLMEngine(PRESETS[preset], max_batch=max_batch,
+                                max_len=max_len, seed=seed)
+        self.sampling = SamplingParams(max_tokens=max_tokens,
+                                       temperature=temperature,
+                                       eos_id=eos_id)
+        self._batched = serve.batch(
+            self._generate_batch, max_batch_size=max_batch,
+            batch_wait_timeout_s=0.01)
+
+    async def _generate_batch(self, prompts: List[Sequence[int]]
+                              ) -> List[List[int]]:
+        return self.engine.generate(prompts, self.sampling)
+
+    async def __call__(self, prompt_tokens: Sequence[int]) -> List[int]:
+        return await self._batched(list(prompt_tokens))
+
+
+def build_dp_deployment(preset: str = "tiny", *, num_replicas: int = 1,
+                        max_batch: int = 4, max_len: int = 128,
+                        max_tokens: int = 16, temperature: float = 0.0,
+                        eos_id: Optional[int] = None, seed: int = 0,
+                        num_cpus: float = 1.0, num_tpus: float = 0.0):
+    """Data-parallel LLM app: `serve.run(build_dp_deployment(...))`."""
+    opts = {"num_cpus": num_cpus}
+    if num_tpus:
+        opts["resources"] = {"TPU": num_tpus}
+    dep = serve.deployment(
+        _LLMServer, name=f"llm-{preset}", num_replicas=num_replicas,
+        ray_actor_options=opts)
+    return dep.bind(preset=preset, max_batch=max_batch, max_len=max_len,
+                    max_tokens=max_tokens, temperature=temperature,
+                    eos_id=eos_id, seed=seed)
+
+
+class _PrefillServer:
+    """Prefill half of P/D disaggregation: returns (kv_blob, first_token)
+    as one value — Serve ships it through the object plane."""
+
+    def __init__(self, preset: str, max_len: int, seed: int):
+        self.engine = LLMEngine(PRESETS[preset], max_batch=1,
+                                max_len=max_len, seed=seed)
+
+    async def __call__(self, prompt_tokens: Sequence[int],
+                       max_tokens: int = 16,
+                       temperature: float = 0.0):
+        sp = SamplingParams(max_tokens=max_tokens, temperature=temperature)
+        return self.engine.prefill_only(list(prompt_tokens), sp)
+
+
+class _DecodeServer:
+    def __init__(self, preset: str, max_batch: int, max_len: int,
+                 seed: int):
+        self.engine = LLMEngine(PRESETS[preset], max_batch=max_batch,
+                                max_len=max_len, seed=seed)
+
+    async def __call__(self, kv_blob: dict, first_token: int,
+                       max_tokens: int = 16, temperature: float = 0.0,
+                       eos_id: Optional[int] = None) -> List[int]:
+        sp = SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                            eos_id=eos_id)
+        return self.engine.decode_from(kv_blob, first_token, sp)
+
+
+class _PDIngress:
+    """Front door chaining prefill → decode handles (reference:
+    pd_server.py PDProxyServer)."""
+
+    def __init__(self, prefill_name: str, decode_name: str):
+        self.prefill = serve.get_deployment_handle(prefill_name)
+        self.decode = serve.get_deployment_handle(decode_name)
+
+    async def __call__(self, prompt_tokens: Sequence[int],
+                       max_tokens: int = 16, temperature: float = 0.0,
+                       eos_id: Optional[int] = None) -> List[int]:
+        kv_blob, first = await self.prefill.remote(
+            list(prompt_tokens), max_tokens, temperature)
+        return await self.decode.remote(
+            kv_blob, first, max_tokens, temperature, eos_id)
+
+
+def run_pd_app(preset: str = "tiny", *, prefill_replicas: int = 1,
+               decode_replicas: int = 1, max_batch: int = 4,
+               max_len: int = 128, seed: int = 0):
+    """Deploy the three-deployment P/D app; returns the ingress handle.
+    Prefill and decode scale independently — the point of the pattern."""
+    serve.run(serve.deployment(
+        _PrefillServer, name=f"pd-prefill-{preset}",
+        num_replicas=prefill_replicas).bind(preset, max_len, seed),
+        name=f"pd-prefill-{preset}")
+    serve.run(serve.deployment(
+        _DecodeServer, name=f"pd-decode-{preset}",
+        num_replicas=decode_replicas).bind(preset, max_batch, max_len,
+                                           seed),
+        name=f"pd-decode-{preset}")
+    return serve.run(serve.deployment(
+        _PDIngress, name=f"pd-ingress-{preset}").bind(
+            f"pd-prefill-{preset}", f"pd-decode-{preset}"),
+        name=f"pd-ingress-{preset}")

@@ -45,9 +45,39 @@ class Router:
                 return
             known = self._version
 
+    async def _refresh_async(self, force: bool = False,
+                             wait_nonempty_s: float = 30.0):
+        """Loop-thread-safe refresh (awaits the controller ref directly)
+        for handles used inside deployments/async actors."""
+        now = time.monotonic()
+        if (not force and self._replicas
+                and now - self._last_refresh < self._refresh_interval_s):
+            return
+        deadline = now + wait_nonempty_s
+        known = -1 if force else self._version
+        while True:
+            table = await self._controller.get_routing_table.remote(
+                self._deployment, known, 5.0)
+            self._version = table["version"]
+            self._replicas = table["replicas"]
+            self._last_refresh = time.monotonic()
+            if self._replicas or time.monotonic() >= deadline:
+                return
+            known = self._version
+
+    async def assign_async(self, method: str, args: tuple, kwargs: dict):
+        """assign() for async contexts (model composition: a deployment
+        calling another deployment's handle — reference: handle.py async
+        dispatch path)."""
+        await self._refresh_async()
+        return self._dispatch(method, args, kwargs)
+
     def assign(self, method: str, args: tuple, kwargs: dict):
         """Pick a replica (pow-2) and dispatch; returns the ObjectRef."""
         self._refresh()
+        return self._dispatch(method, args, kwargs)
+
+    def _dispatch(self, method: str, args: tuple, kwargs: dict):
         if not self._replicas:
             raise RuntimeError(
                 f"no replicas available for deployment "
@@ -64,7 +94,8 @@ class Router:
             ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             self._inflight[rid] -= 1
-            self._refresh(force=True)
+            # Invalidate so the next assign (sync or async) refetches.
+            self._replicas, self._version = [], -1
             raise
         fut = ref.future()
         fut.add_done_callback(

@@ -82,15 +82,24 @@ def deployment(_target: Callable = None, *, name: Optional[str] = None,
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference:
-    handle.DeploymentResponse)."""
+    handle.DeploymentResponse).  Sync contexts wrap an ObjectRef;
+    async contexts (a deployment calling another deployment) wrap an
+    eagerly-scheduled asyncio.Task that resolves to the final value."""
 
-    def __init__(self, ref):
+    def __init__(self, ref=None, task=None):
         self._ref = ref
+        self._task = task
 
     def result(self, timeout_s: Optional[float] = None):
+        if self._ref is None:
+            raise RuntimeError(
+                "DeploymentResponse.result() is not available inside the "
+                "event loop; use `await response` instead")
         return ray_tpu.get(self._ref, timeout=timeout_s)
 
     def __await__(self):
+        if self._task is not None:
+            return self._task.__await__()
         return self._ref.__await__()
 
 
@@ -115,8 +124,30 @@ class DeploymentHandle:
         return self._router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref = self._get_router().assign(self._method, args, kwargs)
-        return DeploymentResponse(ref)
+        import asyncio
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            ref = self._get_router().assign(self._method, args, kwargs)
+            return DeploymentResponse(ref=ref)
+        # Called from inside the event loop (an async actor / another
+        # deployment): dispatch eagerly on the loop, fully async.
+        return DeploymentResponse(
+            task=asyncio.ensure_future(self._remote_async(args, kwargs)))
+
+    async def _remote_async(self, args, kwargs):
+        if self._router is None:
+            from ray_tpu._private.worker import global_runtime
+            from ray_tpu.actor import ActorHandle
+            core = global_runtime().core
+            info = await core.get_actor_info_async(name=CONTROLLER_NAME)
+            if info is None:
+                raise ValueError(f"no actor named {CONTROLLER_NAME!r}")
+            controller = ActorHandle(bytes(info["actor_id"]),
+                                     info.get("class_name", ""))
+            self._router = Router(controller, self._deployment)
+        ref = await self._router.assign_async(self._method, args, kwargs)
+        return await ref
 
     def __reduce__(self):
         return (DeploymentHandle, (self._deployment, self._method))
