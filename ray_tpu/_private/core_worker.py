@@ -1162,6 +1162,12 @@ class CoreWorker:
                 self._pump(key, state)
             return
         state.pending_lease_requests -= 1
+        if state.last_demand_report:
+            # Demand satisfied: retract the report instead of letting it
+            # age out over the TTL (stale shapes over-provision).
+            state.last_demand_report = 0.0
+            self._spawn(self.gcs.call("report_demand", {
+                "reporter": self.worker_id + key, "shapes": []}))
         worker_addr = tuple(res["worker_addr"])
         conn = await self._worker_conn(worker_addr)
         lease = _Lease(res["lease_id"], worker_addr, res["worker_id"], conn,

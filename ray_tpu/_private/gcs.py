@@ -572,6 +572,9 @@ class GcsServer:
         if not ok and actor.state == protocol.ACTOR_PENDING:
             actor.state = protocol.ACTOR_DEAD
             actor.death_cause = "scheduling failed: no feasible node"
+            if actor.name and \
+                    self.named_actors.get(actor.name) == actor.actor_id:
+                del self.named_actors[actor.name]
             self._log_actor(actor)
             self._publish(protocol.CH_ACTOR,
                           {"event": "dead", "actor": actor.view()})

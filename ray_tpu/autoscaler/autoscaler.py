@@ -49,6 +49,13 @@ class Autoscaler:
         if conn is None or conn.closed or conn_loop is not loop:
             # A fresh asyncio.run() per update (how tests drive reconciles)
             # gets a fresh connection; the resident run() loop reuses one.
+            if conn is not None and not conn.closed:
+                # The old connection's loop is gone; drop the socket
+                # directly so neither side accumulates dead connections.
+                try:
+                    conn.writer.transport.abort()
+                except Exception:
+                    pass
             conn = await rpc.connect(self.gcs_address, name="autoscaler")
             self._conn = (conn, loop)
         return conn
@@ -66,6 +73,19 @@ class Autoscaler:
         state = await self._read_state()
         alive = [n for n in state["nodes"] if n["alive"]]
         free = [dict(n["resources_available"]) for n in alive]
+        # Launched-but-not-yet-registered nodes count as incoming capacity,
+        # else every reconcile during a node's boot window re-launches for
+        # the same demand (reference: v1 autoscaler counts pending nodes).
+        alive_ids = {bytes(n["node_id"]) for n in alive}
+        booting_by_type: Dict[str, int] = {}
+        for pn in self.provider.non_terminated_nodes():
+            if pn.node_id is None or pn.node_id not in alive_ids:
+                booting_by_type[pn.node_type] = \
+                    booting_by_type.get(pn.node_type, 0) + 1
+                try:
+                    free.append(dict(self._type(pn.node_type).resources))
+                except KeyError:
+                    pass
 
         demands: List[Dict[str, float]] = []
         for shape in state["demand"]["task_shapes"]:
@@ -98,7 +118,9 @@ class Autoscaler:
             have = existing_counts.get(t, 0) + to_launch.get(t, 0)
             room = max(0, cfg.max_workers - have)
             # STRICT_SPREAD bundles pending means current nodes can't hold
-            # them; launch one node per bundle up to the caps.
+            # them; launch one node per bundle up to the caps, minus nodes
+            # of this type still booting (they'll satisfy bundles soon).
+            n = max(0, n - booting_by_type.get(t, 0))
             to_launch[t] = to_launch.get(t, 0) + min(n, room)
 
         launched: Dict[str, int] = {}

@@ -240,21 +240,26 @@ class LLMEngine:
             b *= 2
         return min(b, self.max_len)
 
+    def _run_prefill(self, prompt: Sequence[int]):
+        """Bucketed, jit-cached prefill shared by admission and the P/D
+        prefill half; returns (last_logits, ks, vs)."""
+        S = len(prompt)
+        Sb = self._bucket(S)
+        if Sb not in self._prefill_jit:
+            cfg = self.cfg
+            self._prefill_jit[Sb] = jax.jit(
+                lambda p, t, n: _prefill_fn(p, t, n, cfg))
+        toks = np.zeros((1, Sb), np.int32)
+        toks[0, :S] = prompt
+        return self._prefill_jit[Sb](self.params, jnp.asarray(toks), S)
+
     def _admit(self):
         while self._waiting and self._free:
             req = self._waiting.pop(0)
             slot = self._free.pop(0)
             req.slot = slot
             S = len(req.prompt)
-            Sb = self._bucket(S)
-            if Sb not in self._prefill_jit:
-                cfg = self.cfg
-                self._prefill_jit[Sb] = jax.jit(
-                    lambda p, t, n: _prefill_fn(p, t, n, cfg))
-            toks = np.zeros((1, Sb), np.int32)
-            toks[0, :S] = req.prompt
-            logits, ks, vs = self._prefill_jit[Sb](
-                self.params, jnp.asarray(toks), S)
+            logits, ks, vs = self._run_prefill(req.prompt)
             self._ck, self._cv = self._install_jit(
                 self._ck, self._cv, ks, vs, slot)
             first = self._sample_host(logits, req.params)
@@ -335,15 +340,7 @@ class LLMEngine:
         object store."""
         params = params or SamplingParams()
         S = len(prompt_tokens)
-        Sb = self._bucket(S)
-        if Sb not in self._prefill_jit:
-            cfg = self.cfg
-            self._prefill_jit[Sb] = jax.jit(
-                lambda p, t, n: _prefill_fn(p, t, n, cfg))
-        toks = np.zeros((1, Sb), np.int32)
-        toks[0, :S] = prompt_tokens
-        logits, ks, vs = self._prefill_jit[Sb](
-            self.params, jnp.asarray(toks), S)
+        logits, ks, vs = self._run_prefill(prompt_tokens)
         first = self._sample_host(logits, params)
         return {"k": np.asarray(ks[:, :S]), "v": np.asarray(vs[:, :S]),
                 "len": S}, int(first)
