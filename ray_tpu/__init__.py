@@ -173,38 +173,9 @@ def timeline(filename: Optional[str] = None):
     _private/state.py:441 chrome_tracing_dump over GCS task events).
     Load the result in chrome://tracing or Perfetto."""
     import json
+    from ._private.timeline import chrome_trace_events
     raw = _core().gcs_call("get_task_events", {"limit": 100_000})
-    # Submitter and executor flush on independent clocks, so sink order is
-    # not event order — recorded timestamps are (same-host clocks).
-    raw.sort(key=lambda e: e["ts"])
-    # Pair RUNNING → FINISHED/FAILED/CANCELLED per task into duration
-    # events; submit times become flow-ish instant events.
-    starts: dict = {}
-    events: list = []
-    for e in raw:
-        tid = e["task_id"]
-        pid = e.get("node_id", b"").hex()[:8]
-        wid = e.get("worker_id", b"").hex()[:8]
-        if e["event"] == "RUNNING":
-            starts[tid] = e
-        elif e["event"] in ("FINISHED", "FAILED", "CANCELLED") \
-                and tid in starts:
-            s0 = starts.pop(tid)
-            events.append({
-                "name": s0.get("name") or tid.hex()[:8],
-                "cat": "task", "ph": "X",
-                "ts": s0["ts"] * 1e6,
-                "dur": max(0.0, (e["ts"] - s0["ts"]) * 1e6),
-                "pid": s0.get("node_id", b"").hex()[:8],
-                "tid": s0.get("worker_id", b"").hex()[:8],
-                "args": {"task_id": tid.hex(), "outcome": e["event"]},
-            })
-        elif e["event"] == "SUBMITTED":
-            events.append({
-                "name": f"submit:{e.get('name') or tid.hex()[:8]}",
-                "cat": "submit", "ph": "i", "s": "t",
-                "ts": e["ts"] * 1e6, "pid": pid, "tid": wid,
-            })
+    events = chrome_trace_events(raw)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

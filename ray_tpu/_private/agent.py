@@ -72,6 +72,7 @@ class WorkerHandle:
         self.lease_owner_conn = None  # server conn that requested the lease
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
+        self.spawned_at = time.monotonic()
 
 
 class NodeAgent:
@@ -120,6 +121,9 @@ class NodeAgent:
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._shutdown = False
+        # worker_id -> {"reason", "ts"}: deaths caused by the OOM monitor,
+        # queried by owners via h_worker_fate for typed errors.
+        self._oom_kills: Dict[bytes, dict] = {}
 
     def _handlers(self):
         return {
@@ -146,6 +150,7 @@ class NodeAgent:
             "store_stats": self.h_store_stats,
             "list_objects": self.h_list_objects,
             "ping": lambda conn, p: "pong",
+            "worker_fate": self.h_worker_fate,
             "shutdown": self.h_shutdown,
         }
 
@@ -176,6 +181,7 @@ class NodeAgent:
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         self._tasks.append(asyncio.ensure_future(self._prestart_workers()))
+        self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info("agent %s on %s, store %s",
                     self.node_id.hex()[:8], addr, self.store_path)
         return addr
@@ -226,6 +232,57 @@ class NodeAgent:
                             "worker death handling failed; lease state may "
                             "need the next reap pass")
 
+    async def _memory_monitor_loop(self):
+        """Kill-by-policy when node memory crosses the threshold
+        (reference: raylet MemoryMonitor + GroupByOwnerIdWorkerKillingPolicy,
+        node_manager.cc:229-230)."""
+        from .config import get_config
+        from .memory_monitor import (GroupByOwnerPolicy, kill_worker,
+                                     node_memory_usage)
+        cfg = get_config()
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        threshold = cfg.memory_usage_threshold
+        if period <= 0 or threshold >= 1.0:
+            return
+        policy = GroupByOwnerPolicy()
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                used, total = node_memory_usage()
+                frac = used / max(total, 1)
+                if frac <= threshold:
+                    continue
+                victim = policy.pick(list(self.workers.values()))
+                if victim is None:
+                    continue
+                reason = (
+                    f"node memory usage {frac:.1%} above threshold "
+                    f"{threshold:.1%}; killed worker pid={victim.proc.pid} "
+                    f"(group-by-owner policy)")
+                logger.warning("OOM monitor: %s", reason)
+                # Prune stale fate records (owners query within seconds of
+                # the crash; 10 min is a generous triage window).
+                cutoff = time.monotonic() - 600.0
+                for wid in [w for w, i in self._oom_kills.items()
+                            if i["ts"] < cutoff]:
+                    del self._oom_kills[wid]
+                self._oom_kills[victim.worker_id] = {
+                    "reason": reason, "ts": time.monotonic()}
+                kill_worker(victim, reason)
+                # Let the kill land + the reaper release resources before
+                # re-evaluating, so one spike doesn't massacre the pool.
+                await asyncio.sleep(max(period, 1.0))
+            except Exception:
+                logger.exception("memory monitor pass failed")
+
+    async def h_worker_fate(self, conn, p):
+        """Owner-side crash triage: was this worker OOM-killed?
+        (reference: the raylet annotates worker death with
+        OOM-kill details so owners raise OutOfMemoryError)."""
+        info = self._oom_kills.get(p["worker_id"])
+        return {"oom_killed": info is not None,
+                "reason": (info or {}).get("reason", "")}
+
     async def _on_worker_death(self, wh: WorkerHandle):
         self.workers.pop(wh.worker_id, None)
         if wh in self.idle_workers:
@@ -240,11 +297,13 @@ class NodeAgent:
         if wh.is_actor and wh.actor_id and self.gcs and not self.gcs.closed:
             # Report actor death so the GCS can restart-or-bury (reference:
             # ReportWorkerFailure → GcsActorManager::OnWorkerDead).
+            oom = self._oom_kills.get(wh.worker_id)
             try:
                 await self.gcs.call("actor_failed", {
                     "actor_id": wh.actor_id,
-                    "reason": f"worker process {wh.proc.pid} exited with "
-                              f"code {wh.proc.returncode}"})
+                    "reason": (oom["reason"] if oom else
+                               f"worker process {wh.proc.pid} exited with "
+                               f"code {wh.proc.returncode}")})
             except (rpc.RpcError, asyncio.TimeoutError):
                 pass
 

@@ -31,6 +31,12 @@ _define("max_direct_call_object_size", 100 * 1024,
         "(reference: RAY_CONFIG max_direct_call_object_size, 100KB)")
 _define("memory_store_max_bytes", 512 * 1024 * 1024)
 _define("worker_register_timeout_s", 60.0)
+_define("memory_usage_threshold", 0.95,
+        "node memory fraction above which the agent's memory monitor kills "
+        "a worker (reference: RAY_memory_usage_threshold); >=1 disables")
+_define("memory_monitor_refresh_ms", 250,
+        "memory monitor poll period (reference: "
+        "RAY_memory_monitor_refresh_ms); 0 disables the monitor")
 _define("worker_lease_timeout_s", 30.0)
 _define("num_workers_soft_limit", 0, "0 = num_cpus")
 _define("max_leases_per_scheduling_key", 64,

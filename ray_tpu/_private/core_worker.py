@@ -1270,10 +1270,25 @@ class CoreWorker:
                 spec["retries_left"] -= 1
                 state.queue.append(task)
             else:
-                self._store_task_failure(
-                    spec, exc.WorkerCrashedError(
+                # Triage the crash with the worker's agent: an OOM kill
+                # surfaces as a typed error (reference: raylet annotates
+                # worker death so owners raise OutOfMemoryError).
+                fate = None
+                try:
+                    fate = await lease.agent_conn.call(
+                        "worker_fate", {"worker_id": lease.worker_id},
+                        timeout=5)
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    pass
+                if fate and fate.get("oom_killed"):
+                    err = exc.OutOfMemoryError(fate.get("reason") or (
+                        f"worker at {lease.worker_addr} was OOM-killed "
+                        f"running {spec['name']}"))
+                else:
+                    err = exc.WorkerCrashedError(
                         f"worker at {lease.worker_addr} died running "
-                        f"{spec['name']}"))
+                        f"{spec['name']}")
+                self._store_task_failure(spec, err)
                 self._release_task_pins(task)
             self._pump(key, state)
             return
@@ -1762,15 +1777,35 @@ class CoreWorker:
                 if spec["retries_left"] > 0:
                     spec["retries_left"] -= 1
                     continue
+                cause = await self._actor_death_cause(state.actor_id)
                 self._store_task_exception(spec, exc.ActorDiedError(
                     f"actor {state.actor_id.hex()[:8]} died during "
-                    f"{spec['method']}"))
+                    f"{spec['method']}"
+                    + (f": {cause}" if cause else "")))
                 self._release_task_pins(task)
                 return
             finally:
                 self._inflight_actor_tasks.pop(task_id, None)
             self._handle_reply(spec, task, reply)
             return
+
+    async def _actor_death_cause(self, actor_id: bytes) -> str:
+        """Fetch the GCS-recorded death cause (e.g. the OOM monitor's
+        reason) for a crashed actor.  The agent's reaper reports the death
+        within its 0.5 s poll, so give the record a short grace window."""
+        for _ in range(6):
+            try:
+                info = await self.gcs.call(
+                    "get_actor", {"actor_id": actor_id,
+                                  "wait_alive": False}, timeout=5)
+            except (rpc.RpcError, asyncio.TimeoutError):
+                return ""
+            if info and info.get("death_cause"):
+                return info["death_cause"]
+            if info and info["state"] == protocol.ACTOR_ALIVE:
+                return ""        # restarted; not a terminal death
+            await asyncio.sleep(0.5)
+        return ""
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
         if self._on_loop_thread():

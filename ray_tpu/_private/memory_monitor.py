@@ -1,0 +1,83 @@
+"""Node memory monitor + OOM worker-killing policy.
+
+Reference: src/ray/common/memory_monitor.h (node usage polling),
+raylet worker_killing_policy_group_by_owner.h
+(GroupByOwnerIdWorkerKillingPolicy — group candidate workers by the
+submitter, kill the newest worker in the largest group so one greedy
+caller loses progress instead of everyone), node_manager.cc:229-230
+(policy wiring), python _private/memory_monitor.py:97.
+
+The agent runs the loop (agent.py _memory_monitor_loop): when node
+memory crosses `memory_usage_threshold`, the chosen victim is SIGKILLed
+and its fate recorded so the owner's ConnectionLost resolves to a typed
+OutOfMemoryError instead of a generic crash.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+
+def node_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) for this node; /proc fallback keeps the
+    monitor working without psutil."""
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        return vm.total - vm.available, vm.total
+    except Exception:
+        pass
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        total = info["MemTotal"]
+        avail = info.get("MemAvailable",
+                         info.get("MemFree", 0) + info.get("Cached", 0))
+        return total - avail, total
+    except Exception:
+        return 0, 1
+
+
+class GroupByOwnerPolicy:
+    """Pick the newest worker from the largest same-owner group.
+
+    Candidates are BUSY workers only (holding a lease or hosting an
+    actor) — idle pooled workers sit near baseline RSS and are reclaimed
+    by pool trimming, not OOM kills.  Each actor forms its own group
+    (restart semantics are owner-visible), so bursty task submitters are
+    preferred victims over long-lived actors, matching the retriable-
+    first ordering of the reference policy."""
+
+    def pick(self, workers: List) -> Optional[object]:
+        groups: dict = {}
+        for wh in workers:
+            if getattr(wh, "is_actor", False):
+                key = ("actor", wh.worker_id)
+            elif getattr(wh, "lease_id", None) is not None:
+                owner = getattr(wh, "lease_owner_conn", None)
+                key = ("task", id(owner))
+            else:
+                continue
+            groups.setdefault(key, []).append(wh)
+        if not groups:
+            return None
+        # Largest group first; prefer task groups over single-actor groups
+        # on ties (retriable work loses less).
+        def group_rank(item):
+            key, members = item
+            return (len(members), 1 if key[0] == "task" else 0)
+        _, members = max(groups.items(), key=group_rank)
+        return max(members, key=lambda wh: getattr(wh, "spawned_at", 0.0))
+
+
+def kill_worker(wh, reason: str) -> None:
+    """SIGKILL (no grace: the node is out of memory NOW)."""
+    try:
+        os.kill(wh.proc.pid, 9)
+    except (ProcessLookupError, PermissionError):
+        pass

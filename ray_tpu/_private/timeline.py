@@ -1,0 +1,43 @@
+"""Chrome-trace construction from GCS task events — shared by
+ray_tpu.timeline() and the dashboard's /api/timeline (reference:
+python/ray/_private/state.py:441 chrome_tracing_dump)."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def chrome_trace_events(raw: List[dict]) -> List[dict]:
+    """Pair RUNNING → FINISHED/FAILED/CANCELLED per task into duration
+    events; submit times become instant events. Load the result in
+    chrome://tracing or Perfetto."""
+    # Submitter and executor flush on independent clocks, so sink order is
+    # not event order — recorded timestamps are (same-host clocks).
+    raw = sorted(raw, key=lambda e: e["ts"])
+    starts: dict = {}
+    events: list = []
+    for e in raw:
+        tid = e["task_id"]
+        pid = e.get("node_id", b"").hex()[:8]
+        wid = e.get("worker_id", b"").hex()[:8]
+        if e["event"] == "RUNNING":
+            starts[tid] = e
+        elif e["event"] in ("FINISHED", "FAILED", "CANCELLED") \
+                and tid in starts:
+            s0 = starts.pop(tid)
+            events.append({
+                "name": s0.get("name") or tid.hex()[:8],
+                "cat": "task", "ph": "X",
+                "ts": s0["ts"] * 1e6,
+                "dur": max(0.0, (e["ts"] - s0["ts"]) * 1e6),
+                "pid": s0.get("node_id", b"").hex()[:8],
+                "tid": s0.get("worker_id", b"").hex()[:8],
+                "args": {"task_id": tid.hex(), "outcome": e["event"]},
+            })
+        elif e["event"] == "SUBMITTED":
+            events.append({
+                "name": f"submit:{e.get('name') or tid.hex()[:8]}",
+                "cat": "submit", "ph": "i", "s": "t",
+                "ts": e["ts"] * 1e6, "pid": pid, "tid": wid,
+            })
+    return events

@@ -77,15 +77,25 @@ class Autoscaler:
         # else every reconcile during a node's boot window re-launches for
         # the same demand (reference: v1 autoscaler counts pending nodes).
         alive_ids = {bytes(n["node_id"]) for n in alive}
+        known_ids = {bytes(n["node_id"]) for n in state["nodes"]}
         booting_by_type: Dict[str, int] = {}
         for pn in self.provider.non_terminated_nodes():
-            if pn.node_id is None or pn.node_id not in alive_ids:
-                booting_by_type[pn.node_type] = \
-                    booting_by_type.get(pn.node_type, 0) + 1
-                try:
-                    free.append(dict(self._type(pn.node_type).resources))
-                except KeyError:
-                    pass
+            if pn.node_id in alive_ids:
+                continue
+            if pn.node_id is not None and pn.node_id in known_ids:
+                # Registered then died: reclaim the instance so counts and
+                # capacity reflect reality and a replacement can launch.
+                logger.warning("autoscaler reclaiming dead node %s",
+                               pn.provider_id)
+                self.provider.terminate_node(pn)
+                continue
+            # Never registered yet: booting — counts as incoming capacity.
+            booting_by_type[pn.node_type] = \
+                booting_by_type.get(pn.node_type, 0) + 1
+            try:
+                free.append(dict(self._type(pn.node_type).resources))
+            except KeyError:
+                pass
 
         demands: List[Dict[str, float]] = []
         for shape in state["demand"]["task_shapes"]:

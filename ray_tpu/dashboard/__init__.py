@@ -1,0 +1,250 @@
+"""Dashboard-lite: HTTP head exposing cluster state, metrics, and the
+task timeline.
+
+Reference: python/ray/dashboard/ — head server (head.py,
+http_server_head.py) + state aggregation (state_aggregator.py), metrics
+module exporting Prometheus (modules/metrics/,
+_private/prometheus_exporter.py), job module.  The TPU build keeps the
+surface (JSON state endpoints, /metrics Prometheus exposition,
+/api/timeline chrome trace) but serves it from one dependency-free
+asyncio process talking straight to the GCS — no React client, no
+per-node agents; `ray_tpu status`-style CLIs and external Prometheus/
+Grafana scrape these endpoints.
+
+Endpoints:
+    GET /            tiny HTML index
+    GET /api/cluster  {nodes, resources_total, resources_available, ...}
+    GET /api/nodes /api/actors /api/jobs /api/placement_groups
+    GET /api/tasks    recent task events
+    GET /api/demand   autoscaler demand view
+    GET /api/timeline chrome://tracing JSON
+    GET /metrics      Prometheus text exposition
+    GET /healthz      200 once connected to the GCS
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.dashboard")
+
+
+def _hexify(obj):
+    """bytes → hex strings, recursively (JSON-safe GCS views)."""
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {(_hexify(k) if isinstance(k, bytes) else k): _hexify(v)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_hexify(v) for v in obj]
+    return obj
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def prometheus_text(metrics) -> str:
+    """GCS metric snapshots → Prometheus exposition format (reference:
+    _private/prometheus_exporter.py)."""
+    lines = []
+    seen_help = set()
+    for m in metrics:
+        name = _prom_name(m["name"])
+        if name not in seen_help:
+            if m.get("help"):
+                lines.append(f"# HELP {name} {m['help']}")
+            kind = {"counter": "counter", "gauge": "gauge",
+                    "histogram": "histogram"}.get(m["type"], "untyped")
+            lines.append(f"# TYPE {name} {kind}")
+            seen_help.add(name)
+        labels = m.get("labels") or {}
+        lab = ",".join(f'{_prom_name(str(k))}="{v}"'
+                       for k, v in sorted(labels.items()))
+        lab = "{" + lab + "}" if lab else ""
+        v = m["value"]
+        if m["type"] == "histogram" and isinstance(v, dict):
+            cum = 0
+            bounds = v.get("boundaries") or []
+            buckets = v.get("buckets") or []
+            # The recorder keeps len(boundaries)+1 counts (last = overflow);
+            # Prometheus requires a final le="+Inf" bucket equal to _count.
+            for b, c in zip(list(bounds) + ["+Inf"], buckets):
+                cum += c
+                sep = "," if labels else ""
+                lines.append(
+                    f'{name}_bucket{{{lab[1:-1]}{sep}le="{b}"}} {cum}'
+                    if lab else f'{name}_bucket{{le="{b}"}} {cum}')
+            lines.append(f"{name}_sum{lab} {v.get('sum', 0)}")
+            lines.append(f"{name}_count{lab} {v.get('count', 0)}")
+        else:
+            lines.append(f"{name}{lab} {v}")
+    return "\n".join(lines) + "\n"
+
+
+_INDEX = """<!doctype html><title>ray_tpu dashboard</title>
+<h1>ray_tpu dashboard</h1><ul>
+<li><a href=/api/cluster>/api/cluster</a></li>
+<li><a href=/api/nodes>/api/nodes</a> <a href=/api/actors>/api/actors</a>
+    <a href=/api/jobs>/api/jobs</a>
+    <a href=/api/placement_groups>/api/placement_groups</a></li>
+<li><a href=/api/tasks>/api/tasks</a>
+    <a href=/api/timeline>/api/timeline</a> (load in Perfetto)</li>
+<li><a href=/api/demand>/api/demand</a></li>
+<li><a href=/metrics>/metrics</a> (Prometheus)</li></ul>"""
+
+
+class DashboardHead:
+    """One process per cluster, typically beside the GCS (reference:
+    dashboard/head.py)."""
+
+    def __init__(self, gcs_address: Tuple[str, int],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.gcs_address = tuple(gcs_address)
+        self.host, self.port = host, port
+        self.address: Optional[Tuple[str, int]] = None
+        self._conn = None
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def _gcs(self):
+        from .._private import rpc
+        if self._conn is None or self._conn.closed:
+            self._conn = await rpc.connect(self.gcs_address,
+                                           name="dashboard")
+        return self._conn
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port)
+        sock = self._server.sockets[0].getsockname()
+        self.address = (sock[0], sock[1])
+        logger.info("dashboard on http://%s:%s", *self.address)
+        return self.address
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._conn is not None and not self._conn.closed:
+            await self._conn.close()
+
+    # ------------------------------------------------------------- serving --
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        try:
+            line = await asyncio.wait_for(reader.readline(), 30)
+            if not line:
+                return
+            parts = line.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0], parts[1]
+            while True:     # drain headers (all endpoints are GET)
+                h = await asyncio.wait_for(reader.readline(), 30)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            path = target.split("?", 1)[0]
+            status, ctype, body = await self._route(method, path)
+        except (asyncio.TimeoutError, ConnectionError):
+            return
+        except Exception as e:
+            logger.exception("dashboard request failed")
+            status, ctype, body = 500, "text/plain", str(e).encode()
+        try:
+            writer.write(
+                b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                b"Content-Length: %d\r\nConnection: close\r\n\r\n"
+                % (status, {200: b"OK", 404: b"Not Found",
+                            500: b"Internal Server Error"}.get(status, b"?"),
+                   ctype.encode(), len(body)))
+            writer.write(body)
+            await writer.drain()
+        except ConnectionError:
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, path: str):
+        if method != "GET":
+            return 404, "text/plain", b"only GET"
+        if path in ("/", "/index.html"):
+            return 200, "text/html", _INDEX.encode()
+        if path == "/healthz":
+            gcs = await self._gcs()
+            await gcs.call("ping", {})
+            return 200, "text/plain", b"ok"
+        if path == "/metrics":
+            gcs = await self._gcs()
+            metrics = await gcs.call("get_metrics", {})
+            return (200, "text/plain; version=0.0.4",
+                    prometheus_text(metrics).encode())
+        if path == "/api/timeline":
+            from .._private.timeline import chrome_trace_events
+            gcs = await self._gcs()
+            raw = await gcs.call("get_task_events", {"limit": 100_000})
+            return (200, "application/json",
+                    json.dumps(chrome_trace_events(raw)).encode())
+        table = {
+            "/api/nodes": ("get_nodes", {}),
+            "/api/actors": ("list_actors", {}),
+            "/api/jobs": ("get_jobs", {}),
+            "/api/placement_groups": ("list_placement_groups", {}),
+            "/api/tasks": ("get_task_events", {"limit": 1000}),
+            "/api/demand": ("get_demand", {}),
+            "/api/cluster": ("get_cluster_info", {}),
+        }
+        if path in table:
+            gcs = await self._gcs()
+            payload = await gcs.call(*table[path])
+            if path == "/api/cluster":
+                payload = self._cluster_summary(payload)
+            return (200, "application/json",
+                    json.dumps(_hexify(payload)).encode())
+        return 404, "text/plain", b"not found"
+
+    @staticmethod
+    def _cluster_summary(info: Dict[str, Any]) -> Dict[str, Any]:
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        alive = 0
+        for n in info["nodes"]:
+            if not n["alive"]:
+                continue
+            alive += 1
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n["resources_available"].items():
+                avail[k] = avail.get(k, 0.0) + v
+        info["alive_nodes"] = alive
+        info["resources_total"] = total
+        info["resources_available"] = avail
+        return info
+
+
+async def _amain(argv=None):
+    ap = argparse.ArgumentParser(prog="ray_tpu.dashboard")
+    ap.add_argument("--gcs-address", required=True,
+                    help="host:port of the cluster GCS")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8265)
+    args = ap.parse_args(argv)
+    host, port = args.gcs_address.rsplit(":", 1)
+    head = DashboardHead((host, int(port)), args.host, args.port)
+    await head.start()
+    print(f"dashboard listening on http://{head.address[0]}:"
+          f"{head.address[1]}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,11 @@ class WorkerCrashedError(RayError):
     pass
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The memory monitor killed the worker running this task (reference:
+    ray.exceptions.OutOfMemoryError raised by the raylet's OOM killer)."""
+
+
 class ObjectStoreFullError(RayError):
     pass
 

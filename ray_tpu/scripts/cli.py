@@ -199,6 +199,20 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """Serve the HTTP dashboard against a running cluster (reference:
+    the dashboard head process started by `ray start --head`)."""
+    from ray_tpu._private.worker import read_cluster_address_file
+    from ray_tpu.dashboard import main as dash_main
+    gcs = args.address or read_cluster_address_file()   # "host:port" string
+    if not gcs:
+        print("no running cluster found; pass --address host:port")
+        return 1
+    dash_main(["--gcs-address", gcs,
+               "--host", args.host, "--port", str(args.port)])
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI")
@@ -244,6 +258,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("memory", help="object store contents")
     p.add_argument("--limit", type=int, default=50)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     args = parser.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint[:1] == ["--"]:

@@ -1,0 +1,124 @@
+"""Dashboard-lite HTTP head (reference model: python/ray/dashboard tests
+— state endpoints, Prometheus metrics, timeline)."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import DashboardHead, prometheus_text
+
+
+@pytest.fixture
+def dashboard(ray_start_regular):
+    core = ray_tpu._core()
+    box = {}
+    started = threading.Event()
+    stop = {}
+
+    def run():
+        async def go():
+            head = DashboardHead(core.gcs_address)
+            box["addr"] = await head.start()
+            stop["ev"] = asyncio.Event()
+            started.set()
+            await stop["ev"].wait()
+            await head.close()
+        asyncio.run(go())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(15)
+    yield box["addr"]
+    # daemon thread dies with the interpreter; no teardown needed
+
+
+def _get(addr, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr[0]}:{addr[1]}{path}", timeout=30) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def test_state_endpoints(dashboard):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    assert ray_tpu.get(f.remote()) == 1
+    time.sleep(1.0)     # task-event flush
+
+    st, ct, body = _get(dashboard, "/api/cluster")
+    assert st == 200 and "json" in ct
+    cluster = json.loads(body)
+    assert cluster["alive_nodes"] >= 1
+    assert cluster["resources_total"].get("CPU", 0) > 0
+
+    st, _, body = _get(dashboard, "/api/nodes")
+    nodes = json.loads(body)
+    assert any(n["alive"] for n in nodes)
+
+    st, _, body = _get(dashboard, "/api/actors")
+    actors = json.loads(body)
+    assert any(x["class_name"] == "A" for x in actors)
+
+    st, _, body = _get(dashboard, "/api/tasks")
+    assert st == 200 and isinstance(json.loads(body), list)
+
+    st, _, body = _get(dashboard, "/api/timeline")
+    trace = json.loads(body)
+    assert any(ev.get("cat") == "task" for ev in trace)
+
+    st, _, body = _get(dashboard, "/healthz")
+    assert st == 200 and body == b"ok"
+
+    st, _, body = _get(dashboard, "/")
+    assert st == 200 and b"dashboard" in body
+
+    st, _, _ = _get(dashboard, "/api/nope")
+    assert st == 404
+
+
+def test_metrics_prometheus_endpoint(dashboard):
+    from ray_tpu.util.metrics import Counter, Gauge
+    c = Counter("dash_reqs", description="requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "/x"})
+    g = Gauge("dash_gauge", description="a gauge")
+    g.set(7.5)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        _, ct, body = _get(dashboard, "/metrics")
+        if b"dash_reqs" in body and b"dash_gauge" in body:
+            break
+        time.sleep(0.5)
+    text = body.decode()
+    assert "text/plain" in ct
+    assert "# TYPE dash_reqs counter" in text
+    assert 'dash_reqs{route="/x"} 3' in text
+    assert "dash_gauge 7.5" in text
+
+
+def test_prometheus_text_histogram_rendering():
+    # Recorder shape: len(boundaries)+1 buckets, last = overflow.
+    out = prometheus_text([{
+        "name": "lat", "labels": {}, "type": "histogram", "help": "h",
+        "value": {"count": 4, "sum": 16.0, "boundaries": [1, 5],
+                  "buckets": [2, 1, 1]}}])
+    assert 'lat_bucket{le="1"} 2' in out
+    assert 'lat_bucket{le="5"} 3' in out      # cumulative
+    assert 'lat_bucket{le="+Inf"} 4' in out   # overflow == _count
+    assert "lat_sum 16.0" in out
+    assert "lat_count 4" in out

@@ -1,0 +1,93 @@
+"""Memory monitor / OOM defense (reference model:
+python/ray/tests/test_memory_pressure.py over the raylet MemoryMonitor +
+GroupByOwnerIdWorkerKillingPolicy)."""
+
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private.memory_monitor import (GroupByOwnerPolicy,
+                                             node_memory_usage)
+
+
+def _wh(worker_id=b"w", is_actor=False, lease=None, owner=None, t=0.0):
+    wh = types.SimpleNamespace()
+    wh.worker_id = worker_id
+    wh.is_actor = is_actor
+    wh.lease_id = lease
+    wh.lease_owner_conn = owner
+    wh.spawned_at = t
+    return wh
+
+
+def test_node_memory_usage_reads_something():
+    used, total = node_memory_usage()
+    assert total > 0
+    assert 0 <= used <= total
+
+
+def test_policy_prefers_largest_owner_group_newest_member():
+    owner_a, owner_b = object(), object()
+    workers = [
+        _wh(b"a1", lease=b"l1", owner=owner_a, t=1.0),
+        _wh(b"a2", lease=b"l2", owner=owner_a, t=3.0),
+        _wh(b"a3", lease=b"l3", owner=owner_a, t=2.0),
+        _wh(b"b1", lease=b"l4", owner=owner_b, t=9.0),
+    ]
+    victim = GroupByOwnerPolicy().pick(workers)
+    assert victim.worker_id == b"a2"    # newest of the biggest group
+
+
+def test_policy_prefers_tasks_over_actors_on_ties_and_skips_idle():
+    workers = [
+        _wh(b"idle"),                                   # no lease, no actor
+        _wh(b"act", is_actor=True, t=99.0),
+        _wh(b"tsk", lease=b"l", owner=object(), t=1.0),
+    ]
+    victim = GroupByOwnerPolicy().pick(workers)
+    assert victim.worker_id == b"tsk"
+    assert GroupByOwnerPolicy().pick([_wh(b"idle")]) is None
+
+
+def test_oom_kill_surfaces_typed_error():
+    """With the threshold forced to ~0 every busy worker is 'over budget';
+    a no-retry task must fail with OutOfMemoryError, not a generic crash."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": 0.01,
+        "memory_monitor_refresh_ms": 100})
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        with pytest.raises(exc.OutOfMemoryError):
+            ray_tpu.get(hog.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_killed_actor_death_cause_mentions_memory():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": 0.01,
+        "memory_monitor_refresh_ms": 100})
+    try:
+        @ray_tpu.remote
+        class A:
+            def spin(self):
+                time.sleep(30)
+
+        a = A.remote()
+        ref = a.spin.remote()
+        with pytest.raises(exc.RayActorError) as ei:
+            ray_tpu.get(ref, timeout=60)
+        assert "memory" in str(ei.value).lower()
+    finally:
+        ray_tpu.shutdown()
