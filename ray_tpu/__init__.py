@@ -120,6 +120,13 @@ def get_actor(name: str) -> ActorHandle:
     return ActorHandle(bytes(info["actor_id"]), info.get("class_name", ""))
 
 
+def usage_stats() -> dict:
+    """Session/library usage recorded in the cluster KV (reference:
+    `ray usage-stats`; this build has no egress — data stays local)."""
+    from ._private.usage import usage_stats as _us
+    return _us(_core())
+
+
 def nodes() -> List[dict]:
     core = _core()
     return core._run(core.gcs.call("get_nodes", {}))

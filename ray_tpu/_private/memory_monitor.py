@@ -56,6 +56,11 @@ class GroupByOwnerPolicy:
     def pick(self, workers: List) -> Optional[object]:
         groups: dict = {}
         for wh in workers:
+            proc = getattr(wh, "proc", None)
+            if proc is not None and proc.poll() is not None:
+                # Already exited (reaper just hasn't swept it): killing it
+                # frees nothing and would mislabel its crash as an OOM.
+                continue
             if getattr(wh, "is_actor", False):
                 key = ("actor", wh.worker_id)
             elif getattr(wh, "lease_id", None) is not None:

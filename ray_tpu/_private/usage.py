@@ -1,0 +1,76 @@
+"""Usage telemetry, opt-out, no egress.
+
+Reference: python/ray/_common/usage + _private/telemetry — collects
+library usage + cluster shape per session and reports to a collector
+endpoint unless RAY_USAGE_STATS_ENABLED=0.  This build has no network
+egress by design, so the record lands in the GCS KV (`usage_stats` ns)
+where operators can read it (`ray_tpu.usage_stats()`); the env toggle is
+RAY_TPU_USAGE_STATS_ENABLED.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+KV_NS = "usage_stats"
+_SCHEMA_VERSION = 1
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in (
+        "0", "false", "False")
+
+
+def record_library_usage(library: str) -> None:
+    """Called by library entry points (Train/Tune/Serve/Data/RLlib/LLM)."""
+    if not enabled():
+        return
+    try:
+        from .worker import global_runtime
+        rt = global_runtime()
+        if rt is None:
+            return
+        core = rt.core
+        key = f"lib:{library}"
+        core.gcs_call("kv_put", {"ns": KV_NS, "key": key,
+                                 "value": b"1", "overwrite": True})
+    except Exception:
+        pass        # telemetry must never break user code
+
+
+def record_session(core) -> None:
+    if not enabled():
+        return
+    try:
+        rec = {
+            "schema_version": _SCHEMA_VERSION,
+            "session_start": time.time(),
+            "python": os.sys.version.split()[0],
+            "mode": core.mode,
+        }
+        core.gcs_call("kv_put", {
+            "ns": KV_NS, "key": f"session:{core.worker_id.hex()[:12]}",
+            "value": json.dumps(rec).encode(), "overwrite": True})
+    except Exception:
+        pass
+
+
+def usage_stats(core) -> dict:
+    """Operator-facing read (reference: `ray usage-stats` surface)."""
+    out = {"enabled": enabled(), "libraries": [], "sessions": []}
+    try:
+        keys = core.gcs_call("kv_keys", {"ns": KV_NS, "prefix": ""})
+        for k in keys:
+            k = k.decode() if isinstance(k, bytes) else k
+            if k.startswith("lib:"):
+                out["libraries"].append(k[4:])
+            elif k.startswith("session:"):
+                raw = core.gcs_call("kv_get", {"ns": KV_NS, "key": k})
+                if raw:
+                    out["sessions"].append(json.loads(bytes(raw)))
+    except Exception:
+        pass
+    return out

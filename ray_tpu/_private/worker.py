@@ -148,6 +148,8 @@ def init(address: Optional[str] = None, *,
     core.start_driver()
     rt.core = core
     _runtime = rt
+    from .usage import record_session
+    record_session(core)
     atexit.register(shutdown)
     return rt
 

@@ -1,0 +1,18 @@
+"""AIR commons: the shared config/session surface (reference:
+python/ray/air/ — ScalingConfig/RunConfig/FailureConfig/CheckpointConfig
+in air/config.py, session helpers, Checkpoint/Result plumbing shared by
+Train and Tune).
+
+In this build the canonical definitions live in ray_tpu.train (Train and
+Tune already share them); ray_tpu.air re-exports the reference's import
+surface so `from ray.air import ScalingConfig`-style code ports 1:1.
+"""
+
+from ..train import (Checkpoint, CheckpointConfig, FailureConfig, Result,
+                     RunConfig, ScalingConfig)
+from ..train._session import get_context, report
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
+    "RunConfig", "ScalingConfig", "get_context", "report",
+]

@@ -24,6 +24,7 @@ class AutoscalerConfig:
     max_workers: int = 20
     idle_timeout_s: float = 60.0
     update_period_s: float = 5.0
+    dead_node_reclaim_s: float = 30.0
 
 
 class Autoscaler:
@@ -38,6 +39,7 @@ class Autoscaler:
         self.scheduler = ResourceDemandScheduler(
             config.node_types, max_workers=config.max_workers)
         self._idle_since: Dict[bytes, float] = {}
+        self._dead_since: Dict[bytes, float] = {}
         self._launched: List[ProviderNode] = []
         self._conn = None
 
@@ -79,15 +81,22 @@ class Autoscaler:
         alive_ids = {bytes(n["node_id"]) for n in alive}
         known_ids = {bytes(n["node_id"]) for n in state["nodes"]}
         booting_by_type: Dict[str, int] = {}
+        now_dead = time.monotonic
         for pn in self.provider.non_terminated_nodes():
             if pn.node_id in alive_ids:
+                self._dead_since.pop(pn.node_id, None)
                 continue
             if pn.node_id is not None and pn.node_id in known_ids:
-                # Registered then died: reclaim the instance so counts and
-                # capacity reflect reality and a replacement can launch.
-                logger.warning("autoscaler reclaiming dead node %s",
-                               pn.provider_id)
-                self.provider.terminate_node(pn)
+                # Registered then died.  A GCS restart replays every node
+                # as not-alive until its agent re-registers (within a
+                # heartbeat), so require the node to stay dead across a
+                # grace window before reclaiming the instance.
+                first = self._dead_since.setdefault(pn.node_id, now_dead())
+                if now_dead() - first >= self.config.dead_node_reclaim_s:
+                    logger.warning("autoscaler reclaiming dead node %s",
+                                   pn.provider_id)
+                    self._dead_since.pop(pn.node_id, None)
+                    self.provider.terminate_node(pn)
                 continue
             # Never registered yet: booting — counts as incoming capacity.
             booting_by_type[pn.node_type] = \

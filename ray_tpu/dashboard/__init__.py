@@ -49,22 +49,30 @@ def _prom_name(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _prom_escape(value: str) -> str:
+    """Label-value escaping per the exposition format: \\ , \" , newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(metrics) -> str:
     """GCS metric snapshots → Prometheus exposition format (reference:
     _private/prometheus_exporter.py)."""
     lines = []
     seen_help = set()
+    # All samples of one family must form a single uninterrupted group.
+    metrics = sorted(metrics, key=lambda m: m["name"])
     for m in metrics:
         name = _prom_name(m["name"])
         if name not in seen_help:
             if m.get("help"):
-                lines.append(f"# HELP {name} {m['help']}")
+                lines.append(f"# HELP {name} {_prom_escape(m['help'])}")
             kind = {"counter": "counter", "gauge": "gauge",
                     "histogram": "histogram"}.get(m["type"], "untyped")
             lines.append(f"# TYPE {name} {kind}")
             seen_help.add(name)
         labels = m.get("labels") or {}
-        lab = ",".join(f'{_prom_name(str(k))}="{v}"'
+        lab = ",".join(f'{_prom_name(str(k))}="{_prom_escape(v)}"'
                        for k, v in sorted(labels.items()))
         lab = "{" + lab + "}" if lab else ""
         v = m["value"]
@@ -109,13 +117,17 @@ class DashboardHead:
         self.host, self.port = host, port
         self.address: Optional[Tuple[str, int]] = None
         self._conn = None
+        self._conn_lock: Optional[asyncio.Lock] = None
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def _gcs(self):
         from .._private import rpc
-        if self._conn is None or self._conn.closed:
-            self._conn = await rpc.connect(self.gcs_address,
-                                           name="dashboard")
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:     # one connection, even under races
+            if self._conn is None or self._conn.closed:
+                self._conn = await rpc.connect(self.gcs_address,
+                                               name="dashboard")
         return self._conn
 
     async def start(self) -> Tuple[str, int]:

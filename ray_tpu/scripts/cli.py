@@ -213,6 +213,12 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_client_server(args) -> int:
+    from ray_tpu.util.client import serve_forever
+    serve_forever(args.address, host=args.host, port=args.port)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI")
@@ -263,6 +269,12 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("client-server",
+                       help="serve client-mode drivers (ray:// equivalent)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=10001)
+    p.set_defaults(fn=cmd_client_server)
 
     args = parser.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint[:1] == ["--"]:

@@ -185,6 +185,8 @@ def run(app: Application, *, name: Optional[str] = None,
     global _routes
     if not isinstance(app, Application):
         raise TypeError("serve.run expects Deployment.bind(...)")
+    from ray_tpu._private.usage import record_library_usage
+    record_library_usage("serve")
     controller = _get_or_create_controller()
     dep = app.deployment
     dep_name = name or dep.name

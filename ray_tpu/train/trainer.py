@@ -84,6 +84,8 @@ class DataParallelTrainer:
         self.datasets = datasets or {}
 
     def fit(self) -> Result:
+        from .._private.usage import record_library_usage
+        record_library_usage("train")
         run_name = self.run_config.name or "train_run"
         storage = self.run_config.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")

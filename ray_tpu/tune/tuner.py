@@ -207,6 +207,8 @@ class Tuner:
         return cls(trainable, _restore_path=path)
 
     def fit(self) -> ResultGrid:
+        from .._private.usage import record_library_usage
+        record_library_usage("tune")
         name = self.run_config.name or "tune_run"
         storage = self.run_config.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")

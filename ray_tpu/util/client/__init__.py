@@ -1,0 +1,26 @@
+"""Client mode: drive a cluster from a process with no local node agent.
+
+Reference: python/ray/util/client (ray:// gRPC proxy driver; architecture
+in util/client/ARCHITECTURE.md) — a thin client ships pickled calls to a
+server-side driver living in the cluster; the client never touches the
+object store or scheduler directly.
+
+TPU build: same split over the framework's msgpack RPC.  A ClientServer
+process (started with `ray_tpu client-server` or embedded via
+serve_forever()) owns a real driver runtime; ClientContext.connect()
+gives remote(), put/get, and actor handles whose calls round-trip
+through the server.  Laptops submitting to a TPU pod head never need
+/dev/shm arenas or chip visibility.
+
+    ctx = ray_tpu.util.client.connect("head:10001")
+    @ctx.remote
+    def f(x): return x * 2
+    assert ctx.get(f.remote(21)) == 42
+    ctx.disconnect()
+"""
+
+from .client import ClientActorHandle, ClientContext, ClientObjectRef, connect
+from .server import ClientServer, serve_forever
+
+__all__ = ["connect", "ClientContext", "ClientObjectRef",
+           "ClientActorHandle", "ClientServer", "serve_forever"]

@@ -1,0 +1,199 @@
+"""Client half of client mode (reference:
+python/ray/util/client/__init__.py RayAPIStub + worker.py Worker — the
+thin driver that ships calls to the cluster-side server).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+
+class ClientObjectRef:
+    """Wire handle to a server-held ObjectRef; GC notifies the server."""
+
+    def __init__(self, ctx: "ClientContext", rid: str):
+        self._ctx = ctx
+        self._rid = rid
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._rid[:12]})"
+
+    def __del__(self):
+        ctx = self._ctx
+        if ctx is not None and not ctx._closed:
+            ctx._release(ref=self._rid)
+
+
+class _ClientRemoteMethod:
+    def __init__(self, actor: "ClientActorHandle", name: str):
+        self._actor = actor
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        ctx = self._actor._ctx
+        return ctx._actor_call(self._actor._key, self._name, args, kwargs)
+
+
+class ClientActorHandle:
+    def __init__(self, ctx: "ClientContext", key: str):
+        self._ctx = ctx
+        self._key = key
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _ClientRemoteMethod(self, item)
+
+    def __del__(self):
+        ctx = self.__dict__.get("_ctx")
+        if ctx is not None and not ctx._closed:
+            ctx._release(actor=self.__dict__.get("_key"))
+
+
+class _ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn, options: Optional[dict]):
+        self._ctx = ctx
+        self._blob = cloudpickle.dumps(fn)
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "_ClientRemoteFunction":
+        out = _ClientRemoteFunction.__new__(_ClientRemoteFunction)
+        out._ctx, out._blob = self._ctx, self._blob
+        out._options = {**self._options, **opts}
+        return out
+
+    def remote(self, *args, **kwargs):
+        res = self._ctx._call("client_call", {
+            "fn": self._blob, "options": self._options,
+            "args": self._ctx._pack_args(args, kwargs)})
+        refs = [ClientObjectRef(self._ctx, r) for r in res["refs"]]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class _ClientRemoteClass:
+    def __init__(self, ctx: "ClientContext", cls, options: Optional[dict]):
+        self._ctx = ctx
+        self._blob = cloudpickle.dumps(cls)
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "_ClientRemoteClass":
+        out = _ClientRemoteClass.__new__(_ClientRemoteClass)
+        out._ctx, out._blob = self._ctx, self._blob
+        out._options = {**self._options, **opts}
+        return out
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        res = self._ctx._call("client_create_actor", {
+            "cls": self._blob, "options": self._options,
+            "args": self._ctx._pack_args(args, kwargs)})
+        return ClientActorHandle(self._ctx, res["actor"])
+
+
+class ClientContext:
+    """A connected thin driver.  Runs its own RPC loop thread so plain
+    scripts (no asyncio) can use it (reference: client worker's channel
+    thread)."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._closed = False
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        daemon=True, name="client-io")
+        self._thread.start()
+        self._conn = self._run(self._connect())
+
+    async def _connect(self):
+        from ..._private import rpc
+        return await rpc.connect(self._addr, name="client")
+
+    def _run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def _call(self, method: str, payload: dict, timeout: float = 300):
+        if self._closed:
+            raise RuntimeError("client is disconnected")
+        return self._run(self._conn.call(method, payload, timeout=timeout))
+
+    # --------------------------------------------------------------- API ----
+    def remote(self, obj=None, **options):
+        """@ctx.remote decorator for functions and classes."""
+        def wrap(o):
+            if isinstance(o, type):
+                return _ClientRemoteClass(self, o, options)
+            return _ClientRemoteFunction(self, o, options)
+        if obj is None:
+            return wrap
+        return wrap(obj)
+
+    def put(self, value: Any) -> ClientObjectRef:
+        res = self._call("client_put", {"blob": cloudpickle.dumps(value)})
+        return ClientObjectRef(self, res["ref"])
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        reflist = [refs] if single else list(refs)
+        res = self._call("client_get", {
+            "refs": [r._rid for r in reflist], "timeout": timeout},
+            timeout=(timeout or 300) + 30)
+        if "error" in res:
+            raise cloudpickle.loads(res["error"])
+        values = [cloudpickle.loads(b) for b in res["values"]]
+        return values[0] if single else values
+
+    def kill(self, actor: ClientActorHandle):
+        self._call("client_kill", {"actor": actor._key})
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._call("client_cluster_info", {})["resources"]
+
+    def disconnect(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._run(self._conn.close(), timeout=10)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ plumbing --
+    def _pack_args(self, args, kwargs) -> bytes:
+        def enc(a):
+            if isinstance(a, ClientObjectRef):
+                return {"__client_ref__": a._rid}
+            return a
+        return cloudpickle.dumps(
+            (tuple(enc(a) for a in args),
+             {k: enc(v) for k, v in kwargs.items()}))
+
+    def _actor_call(self, key: str, method: str, args, kwargs
+                    ) -> ClientObjectRef:
+        res = self._call("client_actor_call", {
+            "actor": key, "method": method,
+            "args": self._pack_args(args, kwargs)})
+        return ClientObjectRef(self, res["refs"][0])
+
+    def _release(self, ref: Optional[str] = None,
+                 actor: Optional[str] = None):
+        """Best-effort async release from __del__ (any thread)."""
+        try:
+            payload = {"refs": [ref] if ref else [],
+                       "actors": [actor] if actor else []}
+            asyncio.run_coroutine_threadsafe(
+                self._conn.call("client_release", payload), self._loop)
+        except Exception:
+            pass
+
+
+def connect(address: str) -> ClientContext:
+    """Connect to a `ray_tpu client-server` (reference:
+    ray.util.connect / ray.init('ray://...'))."""
+    return ClientContext(address)

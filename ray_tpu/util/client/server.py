@@ -1,0 +1,176 @@
+"""Server half of client mode: a real driver that executes shipped calls
+(reference: python/ray/util/client/server/server.py RayletServicer —
+put/get/schedule/actor RPCs over gRPC; here over the framework RPC).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+logger = logging.getLogger("ray_tpu.client")
+
+
+class ClientServer:
+    """Holds real refs/handles on behalf of remote clients; every client
+    object is pinned here until the client releases it (the client's GC
+    drives release — reference: client reference counting)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        import ray_tpu
+        from ..._private import rpc
+        self._ray = ray_tpu
+        self._rpc = rpc
+        self.host, self.port = host, port
+        self.address: Optional[tuple] = None
+        self._refs: Dict[str, Any] = {}        # ref_id -> ObjectRef
+        self._actors: Dict[str, Any] = {}      # actor_key -> ActorHandle
+        self._fns: Dict[bytes, Any] = {}       # fn blob hash -> RemoteFunction
+        self._server = rpc.RpcServer({
+            "client_put": self.h_put,
+            "client_get": self.h_get,
+            "client_call": self.h_call,
+            "client_create_actor": self.h_create_actor,
+            "client_actor_call": self.h_actor_call,
+            "client_kill": self.h_kill,
+            "client_release": self.h_release,
+            "client_cluster_info": self.h_cluster_info,
+            "ping": lambda conn, p: "pong",
+        }, name="client-server")
+
+    async def start(self) -> tuple:
+        self.address = await self._server.start_tcp(self.host, self.port)
+        logger.info("client server on %s", self.address)
+        return self.address
+
+    async def close(self):
+        await self._server.close()
+
+    # -------------------------------------------------------------- helpers --
+    async def _on_core(self, coro):
+        """Core-worker coroutines are bound to the core's loop thread;
+        bridge them from this server's loop."""
+        core = self._ray._core()
+        return await asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(coro, core.loop))
+
+    def _track(self, ref) -> str:
+        rid = uuid.uuid4().hex
+        self._refs[rid] = ref
+        return rid
+
+    def _decode_arg(self, a):
+        if isinstance(a, dict) and "__client_ref__" in a:
+            return self._refs[a["__client_ref__"]]
+        return a
+
+    def _decode_args(self, blob: bytes):
+        args, kwargs = cloudpickle.loads(blob)
+        return ([self._decode_arg(a) for a in args],
+                {k: self._decode_arg(v) for k, v in kwargs.items()})
+
+    def _remote_fn(self, fn_blob: bytes, options: dict):
+        from ..._private import protocol
+        key = protocol.function_id(fn_blob) + repr(
+            sorted(options.items())).encode()
+        rf = self._fns.get(key)
+        if rf is None:
+            fn = cloudpickle.loads(fn_blob)
+            rf = self._ray.remote(fn)
+            if options:
+                rf = rf.options(**options)
+            self._fns[key] = rf
+        return rf
+
+    # ------------------------------------------------------------- handlers --
+    async def h_put(self, conn, p):
+        value = cloudpickle.loads(p["blob"])
+        core = self._ray._core()
+        ref = await self._on_core(core.put_async(value))
+        return {"ref": self._track(ref)}
+
+    async def h_get(self, conn, p):
+        refs = [self._refs[r] for r in p["refs"]]
+        core = self._ray._core()
+        out = []
+        for ref in refs:
+            try:
+                val = await asyncio.wait_for(
+                    self._on_core(core.get_async(ref)),
+                    p.get("timeout") or 300)
+            except Exception as e:       # ship the error, typed by repr
+                return {"error": cloudpickle.dumps(e)}
+            out.append(cloudpickle.dumps(val))
+        return {"values": out}
+
+    async def h_call(self, conn, p):
+        rf = self._remote_fn(p["fn"], p.get("options") or {})
+        args, kwargs = self._decode_args(p["args"])
+        refs = rf.remote(*args, **kwargs)
+        refs = refs if isinstance(refs, list) else [refs]
+        return {"refs": [self._track(r) for r in refs]}
+
+    async def h_create_actor(self, conn, p):
+        cls = cloudpickle.loads(p["cls"])
+        rc = self._ray.remote(cls)
+        if p.get("options"):
+            rc = rc.options(**p["options"])
+        args, kwargs = self._decode_args(p["args"])
+        handle = rc.remote(*args, **kwargs)
+        key = uuid.uuid4().hex
+        self._actors[key] = handle
+        return {"actor": key}
+
+    async def h_actor_call(self, conn, p):
+        handle = self._actors[p["actor"]]
+        args, kwargs = self._decode_args(p["args"])
+        ref = getattr(handle, p["method"]).remote(*args, **kwargs)
+        return {"refs": [self._track(ref)]}
+
+    async def h_kill(self, conn, p):
+        handle = self._actors.pop(p["actor"], None)
+        if handle is not None:
+            self._ray.kill(handle)
+        return True
+
+    async def h_release(self, conn, p):
+        for rid in p.get("refs", []):
+            self._refs.pop(rid, None)
+        for key in p.get("actors", []):
+            self._actors.pop(key, None)
+        return True
+
+    async def h_cluster_info(self, conn, p):
+        core = self._ray._core()
+        nodes = await self._on_core(core.gcs.call("get_nodes", {}))
+        total: Dict[str, float] = {}
+        for n in nodes:
+            if n["alive"]:
+                for k, v in n["resources_total"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return {"num_nodes": sum(1 for n in nodes if n["alive"]),
+                "resources": total}
+
+
+def serve_forever(cluster_address: Optional[str] = None,
+                  host: str = "0.0.0.0", port: int = 10001,
+                  ready_cb=None):
+    """Run a client server against a cluster (blocks).  `ray_tpu
+    client-server` CLI entry; tests pass ready_cb to learn the port."""
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=cluster_address or "auto")
+
+    async def _main():
+        srv = ClientServer(host, port)
+        addr = await srv.start()
+        if ready_cb:
+            ready_cb(addr)
+        await asyncio.Event().wait()
+
+    # The driver core runs its own loop thread; the server gets this one.
+    asyncio.run(_main())

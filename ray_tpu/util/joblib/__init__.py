@@ -1,0 +1,70 @@
+"""joblib backend: scikit-learn `n_jobs` work on the cluster.
+
+Reference: python/ray/util/joblib/ — register_ray() +
+ray_backend.RayBackend subclassing joblib's MultiprocessingBackend; here
+a ThreadingBackend-style backend that ships each joblib batch as a
+framework task.
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+__all__ = ["register_ray"]
+
+
+def register_ray() -> None:
+    from joblib import register_parallel_backend
+    from joblib.parallel import ParallelBackendBase
+
+    import ray_tpu
+
+    class _TaskFuture:
+        def __init__(self, ref):
+            self._ref = ref
+
+        def get(self, timeout=None):
+            return ray_tpu.get(self._ref, timeout=timeout)
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each apply_async call ships one joblib batch as a task
+        (reference: util/joblib/ray_backend.py)."""
+
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs is None or n_jobs < 0:
+                return cpus
+            return min(n_jobs, max(cpus, 1))
+
+        def apply_async(self, func, callback=None):
+            @ray_tpu.remote
+            def _run_batch(f):
+                return f()
+
+            ref = _run_batch.remote(func)
+            fut = _TaskFuture(ref)
+            if callback is not None:
+                ref.future().add_done_callback(
+                    lambda f: (callback(f.result())
+                               if f.exception() is None else None))
+            return fut
+
+        def abort_everything(self, ensure_ready=True):
+            pass    # tasks run to completion; nothing to reap
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,169 @@
+"""Drop-in multiprocessing.Pool over cluster actors.
+
+Reference: python/ray/util/multiprocessing/pool.py — Pool keeps
+`processes` PoolActor actors and chunks map work across them, so pools
+span machines and survive driver-local GIL pressure.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+__all__ = ["Pool"]
+
+
+@ray_tpu.remote
+class _PoolActor:
+    """One pool worker (reference: pool.py PoolActor)."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run(self, fn, args, kwargs):
+        return fn(*args, **(kwargs or {}))
+
+    def run_chunk(self, fn, chunk, star: bool):
+        if star:
+            return [fn(*item) for item in chunk]
+        return [fn(item) for item in chunk]
+
+    def ping(self):
+        return True
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult parity."""
+
+    def __init__(self, refs: List, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return out[0]
+        return list(itertools.chain.from_iterable(out))
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """`from ray_tpu.util.multiprocessing import Pool` — the stdlib Pool
+    surface on cluster actors."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs: tuple = (), ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._n = processes
+        cls = _PoolActor
+        if ray_remote_args:
+            cls = _PoolActor.options(**ray_remote_args)
+        self._actors = [cls.remote(initializer, tuple(initargs))
+                        for _ in range(processes)]
+        self._rr = 0
+        self._closed = False
+
+    # -------------------------------------------------------------- dispatch --
+    def _next_actor(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        a = self._actors[self._rr % self._n]
+        self._rr += 1
+        return a
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    # ------------------------------------------------------------------ API --
+    def apply(self, func: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (), kwds: dict = None
+                    ) -> AsyncResult:
+        ref = self._next_actor().run.remote(func, tuple(args), kwds)
+        return AsyncResult([ref], single=True)
+
+    def map(self, func, iterable, chunksize: Optional[int] = None):
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable, chunksize: Optional[int] = None
+                  ) -> AsyncResult:
+        refs = [self._next_actor().run_chunk.remote(func, chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False)
+
+    def starmap(self, func, iterable, chunksize: Optional[int] = None):
+        refs = [self._next_actor().run_chunk.remote(func, chunk, True)
+                for chunk in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False).get()
+
+    def imap(self, func, iterable, chunksize: int = 1):
+        refs = [self._next_actor().run_chunk.remote(func, chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable, chunksize: int = 1):
+        refs = [self._next_actor().run_chunk.remote(func, chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        pending = list(refs)
+        while pending:
+            # wait may surface several simultaneously-ready refs even with
+            # num_returns=1; consume all of them.
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    # ------------------------------------------------------------ lifecycle --
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            ray_tpu.kill(a)
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+        for a in self._actors:
+            try:
+                ray_tpu.get(a.ping.remote(), timeout=30)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -1,0 +1,88 @@
+"""Client mode (reference model: python/ray/util/client tests — thin
+driver proxying through a cluster-side server)."""
+
+import asyncio
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.client import ClientServer, connect
+
+
+@pytest.fixture
+def client_server(ray_start_regular):
+    box = {}
+    started = threading.Event()
+
+    def run():
+        async def go():
+            srv = ClientServer("127.0.0.1", 0)
+            box["addr"] = await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        asyncio.run(go())
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(15)
+    ctx = connect(f"{box['addr'][0]}:{box['addr'][1]}")
+    yield ctx
+    ctx.disconnect()
+
+
+def test_client_remote_function_and_put_get(client_server):
+    ctx = client_server
+
+    @ctx.remote
+    def add(a, b):
+        return a + b
+
+    assert ctx.get(add.remote(1, 2)) == 3
+
+    ref = ctx.put({"x": [1, 2, 3]})
+    assert ctx.get(ref) == {"x": [1, 2, 3]}
+
+    # Client refs pass as args without round-tripping the value.
+    assert ctx.get(add.remote(ctx.put(40), 2)) == 42
+
+
+def test_client_options_and_errors(client_server):
+    ctx = client_server
+
+    @ctx.remote
+    def whoami():
+        import os
+        return os.getpid()
+
+    pid = ctx.get(whoami.options(num_cpus=1).remote())
+    assert isinstance(pid, int)
+
+    @ctx.remote
+    def boom():
+        raise ValueError("client boom")
+
+    with pytest.raises(Exception, match="client boom"):
+        ctx.get(boom.remote())
+
+
+def test_client_actor_lifecycle(client_server):
+    ctx = client_server
+
+    @ctx.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert ctx.get(c.incr.remote()) == 11
+    assert ctx.get(c.incr.remote(5)) == 16
+    ctx.kill(c)
+
+
+def test_client_cluster_resources(client_server):
+    res = client_server.cluster_resources()
+    assert res.get("CPU", 0) > 0

@@ -1,0 +1,83 @@
+"""multiprocessing.Pool / joblib shims + usage telemetry (reference
+model: python/ray/tests/test_multiprocessing.py, util/joblib tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _make_fns():
+    def sq(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+    return sq, add
+
+
+def test_pool_map_apply_starmap(ray_start_regular):
+    sq, add = _make_fns()
+    with Pool(processes=2) as p:
+        assert p.map(sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(add, (2, 3)) == 5
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        r = p.map_async(sq, [5, 6])
+        assert r.get(timeout=60) == [25, 36]
+
+
+def test_pool_imap_and_unordered(ray_start_regular):
+    sq, _ = _make_fns()
+    with Pool(processes=2) as p:
+        assert list(p.imap(sq, range(6), chunksize=2)) == \
+            [x * x for x in range(6)]
+        assert sorted(p.imap_unordered(sq, range(6), chunksize=2)) == \
+            sorted(x * x for x in range(6))
+
+
+def test_pool_initializer_and_lifecycle(ray_start_regular):
+    def init_env(v):
+        import os
+        os.environ["POOL_TEST_V"] = str(v)
+
+    def read_env(_):
+        import os
+        return os.environ.get("POOL_TEST_V")
+
+    p = Pool(processes=2, initializer=init_env, initargs=(7,))
+    assert p.map(read_env, [0, 1]) == ["7", "7"]
+    p.close()
+    p.join()
+    sq, _ = _make_fns()
+    with pytest.raises(ValueError):
+        p.apply(sq, (1,))
+
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    from joblib import Parallel, delayed
+    sq, _ = _make_fns()
+    with joblib.parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=2)(delayed(sq)(i) for i in range(8))
+    assert out == [x * x for x in range(8)]
+
+
+def test_air_reexports():
+    from ray_tpu.air import (Checkpoint, CheckpointConfig, FailureConfig,
+                             Result, RunConfig, ScalingConfig)
+    assert ScalingConfig(num_workers=2).num_workers == 2
+    assert RunConfig is not None and Checkpoint is not None
+    assert FailureConfig is not None and CheckpointConfig is not None
+    assert Result is not None
+
+
+def test_usage_stats_records_sessions_and_libraries(ray_start_regular):
+    stats = ray_tpu.usage_stats()
+    assert stats["enabled"] is True
+    assert isinstance(stats["sessions"], list)
+    from ray_tpu._private.usage import record_library_usage
+    record_library_usage("testlib")
+    stats = ray_tpu.usage_stats()
+    assert "testlib" in stats["libraries"]
