@@ -391,6 +391,18 @@ async def amain():
     import ray_tpu
     ray_tpu._set_runtime_for_worker(core)
 
+    # Die with the agent (reference: a core worker exits when its raylet
+    # IPC socket closes — node death must take its workers down, or dead
+    # nodes keep computing and failure handling never engages).
+    async def _agent_watch():
+        while not agent_conn.closed:
+            await asyncio.sleep(0.5)
+        logging.getLogger("ray_tpu").warning(
+            "agent connection lost; worker exiting")
+        os._exit(1)
+
+    rpc.spawn(_agent_watch())    # strong ref: bare tasks can be GC'd
+
     await asyncio.Event().wait()
 
 

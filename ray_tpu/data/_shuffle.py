@@ -1,0 +1,203 @@
+"""All-to-all ops: sort, groupby/aggregate, join.
+
+Reference: python/ray/data/_internal/execution/operators/hash_shuffle.py
+(+ sort.py, join.py planners) — partition every input block by key hash
+or range, then reduce each partition independently.  Here the partition
+pass runs on the driver (blocks stream through it anyway — this is the
+same barrier the reference's shuffle takes) and the reduce pass fans out
+as remote tasks, one per partition, so the heavy work (sorting,
+grouping, joining) runs cluster-parallel.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+from .block import Block, block_num_rows, concat_blocks
+
+# ---------------------------------------------------------------------------
+# Partitioning (driver-side, deterministic)
+# ---------------------------------------------------------------------------
+
+
+def _hash_column(col: np.ndarray) -> np.ndarray:
+    """Stable per-row uint64 hashes (process-independent — no str hash
+    randomization)."""
+    if col.dtype.kind in "iub":
+        return col.astype(np.uint64, copy=False) * np.uint64(0x9E3779B97F4A7C15)
+    if col.dtype.kind == "f":
+        return col.astype(np.float64).view(np.uint64) \
+            * np.uint64(0x9E3779B97F4A7C15)
+    out = np.empty(len(col), np.uint64)
+    for i, v in enumerate(col):
+        b = v if isinstance(v, bytes) else str(v).encode()
+        out[i] = zlib.crc32(b)
+    return out
+
+
+def hash_partition(block: Block, keys: Sequence[str], p: int) -> List[Block]:
+    n = block_num_rows(block)
+    if n == 0:
+        return [dict() for _ in range(p)]
+    h = np.zeros(n, np.uint64)
+    for k in keys:
+        h = h * np.uint64(1000003) + _hash_column(np.asarray(block[k]))
+    idx = (h % np.uint64(p)).astype(np.int64)
+    return [{c: v[idx == i] for c, v in block.items()} for i in range(p)]
+
+
+def range_bounds(blocks: List[Block], key: str, p: int,
+                 sample_per_block: int = 64) -> np.ndarray:
+    """Sampled quantile boundaries (reference: sort sample stage)."""
+    samples = []
+    rng = np.random.default_rng(0)
+    for b in blocks:
+        col = np.asarray(b.get(key, []))
+        if len(col) == 0:
+            continue
+        take = min(sample_per_block, len(col))
+        samples.append(rng.choice(col, take, replace=False))
+    if not samples:
+        return np.asarray([])
+    allv = np.sort(np.concatenate(samples))
+    qs = [int(len(allv) * (i + 1) / p) for i in range(p - 1)]
+    return allv[np.clip(qs, 0, len(allv) - 1)]
+
+
+def range_partition(block: Block, key: str, bounds: np.ndarray,
+                    descending: bool) -> List[Block]:
+    p = len(bounds) + 1
+    n = block_num_rows(block)
+    if n == 0:
+        return [dict() for _ in range(p)]
+    idx = np.searchsorted(bounds, np.asarray(block[key]), side="right")
+    parts = [{c: v[idx == i] for c, v in block.items()} for i in range(p)]
+    return parts[::-1] if descending else parts
+
+
+# ---------------------------------------------------------------------------
+# Remote reducers (one task per partition)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+def _reduce_sort(key: str, descending: bool, *parts: Block) -> Block:
+    merged = concat_blocks([p for p in parts if p])
+    if not merged:
+        return {}
+    order = np.argsort(np.asarray(merged[key]), kind="stable")
+    if descending:
+        order = order[::-1]
+    return {c: v[order] for c, v in merged.items()}
+
+
+_AGG_FNS: Dict[str, Callable] = {
+    "count": lambda v: len(v),
+    "sum": np.sum, "min": np.min, "max": np.max,
+    "mean": np.mean, "std": lambda v: float(np.std(v, ddof=1))
+    if len(v) > 1 else 0.0,
+}
+
+
+@ray_tpu.remote
+def _reduce_groupby(keys: List[str], aggs: List[tuple], *parts: Block
+                    ) -> Block:
+    """aggs: [(op, col, out_name)]; one output row per distinct key."""
+    merged = concat_blocks([p for p in parts if p])
+    if not merged:
+        return {}
+    kcols = [np.asarray(merged[k]) for k in keys]
+    # 1-D object array of key tuples (np.array would build a 2-D array
+    # out of the tuples and break unique()).
+    combo = np.empty(len(kcols[0]), dtype=object)
+    for i in range(len(kcols[0])):
+        combo[i] = tuple(kc[i] for kc in kcols)
+    uniq, inv = np.unique(combo, return_inverse=True)
+    out: Dict[str, list] = {k: [] for k in keys}
+    for op, col, name in aggs:
+        out[name] = []
+    for gi, keyvals in enumerate(uniq):
+        mask = inv == gi
+        for k, kv in zip(keys, keyvals):
+            out[k].append(kv)
+        for op, col, name in aggs:
+            vals = np.asarray(merged[col])[mask] if col else mask
+            out[name].append(_AGG_FNS[op](vals if col else
+                                          np.asarray(merged[keys[0]])[mask]))
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+@ray_tpu.remote
+def _reduce_map_groups(keys: List[str], fn: Callable, *parts: Block
+                       ) -> List[Block]:
+    from .block import block_from_rows
+    merged = concat_blocks([p for p in parts if p])
+    if not merged:
+        return []
+    kcols = [np.asarray(merged[k]) for k in keys]
+    # 1-D object array of key tuples (np.array would build a 2-D array
+    # out of the tuples and break unique()).
+    combo = np.empty(len(kcols[0]), dtype=object)
+    for i in range(len(kcols[0])):
+        combo[i] = tuple(kc[i] for kc in kcols)
+    uniq, inv = np.unique(combo, return_inverse=True)
+    out: List[Block] = []
+    for gi in range(len(uniq)):
+        mask = inv == gi
+        group = {c: np.asarray(v)[mask] for c, v in merged.items()}
+        res = fn(group)
+        if isinstance(res, dict):
+            res = {c: np.asarray(v) for c, v in res.items()}
+            out.append(res)
+        elif isinstance(res, list):
+            out.append(block_from_rows(res))
+        else:
+            raise TypeError("map_groups fn must return a dict of columns "
+                            "or a list of row dicts")
+    return out
+
+
+@ray_tpu.remote
+def _reduce_join(on: List[str], how: str, rcols: List[str],
+                 left_parts: List[Block], right_parts: List[Block]
+                 ) -> Block:
+    """rcols: right-side value columns, passed explicitly so partitions
+    with an empty right side still emit a consistent schema."""
+    left = concat_blocks([p for p in left_parts if p])
+    right = concat_blocks([p for p in right_parts if p])
+    if not left:
+        return {}
+    lcols = {c: np.asarray(v) for c, v in left.items()}
+    rvals = {c: np.asarray(right[c]) for c in rcols} if right else {}
+    lkey_cols = [lcols[k] for k in on]
+    n_left = block_num_rows(left)
+    lkeys = [tuple(kc[i] for kc in lkey_cols)
+             for i in range(n_left)]
+    rindex: Dict[tuple, List[int]] = {}
+    if right:
+        rkey_cols = [np.asarray(right[k]) for k in on]
+        for i in range(block_num_rows(right)):
+            kv = tuple(kc[i] for kc in rkey_cols)
+            rindex.setdefault(kv, []).append(i)
+    out: Dict[str, list] = {c: [] for c in lcols}
+    for c in rcols:
+        out[c] = []
+    for li, kv in enumerate(lkeys):
+        matches = rindex.get(kv, [])
+        if matches:
+            for ri in matches:
+                for c, col in lcols.items():
+                    out[c].append(col[li])
+                for c in rcols:
+                    out[c].append(rvals[c][ri])
+        elif how == "left":
+            for c, col in lcols.items():
+                out[c].append(col[li])
+            for c in rcols:
+                out[c].append(None)
+    return {c: np.asarray(v) for c, v in out.items()}

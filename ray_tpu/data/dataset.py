@@ -16,6 +16,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+import ray_tpu
+
 from . import _plan
 from ._executor import execute_local, execute_streaming
 from ._plan import Operator, Plan
@@ -140,6 +142,98 @@ class Dataset:
                     "first")
             tasks += o._plan.read_tasks
         return Dataset(Plan(tasks, ops))
+
+    # ------------------------------------------------------------ all-to-all
+
+    def sort(self, key: str, *, descending: bool = False,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed sample-partition sort (reference: Dataset.sort →
+        _internal sort planner: sample bounds → range partition →
+        per-partition sort tasks)."""
+        from . import _shuffle
+        blocks = [b for b in self.iter_internal_blocks() if b]
+        if not blocks:
+            return from_blocks([])
+        p = num_partitions or max(1, len(blocks))
+        bounds = _shuffle.range_bounds(blocks, key, p)
+        parts: List[List[Block]] = [[] for _ in builtins.range(len(bounds) + 1)]
+        for b in blocks:
+            for i, piece in enumerate(
+                    _shuffle.range_partition(b, key, bounds, descending)):
+                parts[i].append(piece)
+        refs = [_shuffle._reduce_sort.remote(key, descending, *ps)
+                for ps in parts if ps]
+        out = [b for b in ray_tpu.get(refs) if b]
+        return from_blocks(out)
+
+    def groupby(self, key) -> "GroupedData":
+        """reference: Dataset.groupby -> GroupedData (grouped_data.py)."""
+        keys = [key] if isinstance(key, str) else list(key)
+        return GroupedData(self, keys)
+
+    def join(self, other: "Dataset", on, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join (reference: Dataset.join →
+        operators/join.py). `how`: inner | left."""
+        if how not in ("inner", "left"):
+            raise ValueError("how must be 'inner' or 'left'")
+        from . import _shuffle
+        on = [on] if isinstance(on, str) else list(on)
+        lblocks = [b for b in self.iter_internal_blocks() if b]
+        rblocks = [b for b in other.iter_internal_blocks() if b]
+        p = num_partitions or max(1, len(lblocks))
+        lparts: List[List[Block]] = [[] for _ in builtins.range(p)]
+        rparts: List[List[Block]] = [[] for _ in builtins.range(p)]
+        for b in lblocks:
+            for i, piece in enumerate(_shuffle.hash_partition(b, on, p)):
+                lparts[i].append(piece)
+        for b in rblocks:
+            for i, piece in enumerate(_shuffle.hash_partition(b, on, p)):
+                rparts[i].append(piece)
+        rcols = [c for c in (rblocks[0] if rblocks else {}) if c not in on]
+        refs = [_shuffle._reduce_join.remote(on, how, rcols, lp, rp)
+                for lp, rp in zip(lparts, rparts)]
+        return from_blocks([b for b in ray_tpu.get(refs) if b])
+
+    def unique(self, column: str) -> List[Any]:
+        vals = set()
+        for b in self.iter_internal_blocks():
+            if b:
+                vals.update(np.asarray(b[column]).tolist())
+        return sorted(vals)
+
+    # global aggregates (reference: Dataset.sum/min/max/mean/std)
+    def sum(self, column: str):
+        return self._agg(column, np.sum, 0)
+
+    def min(self, column: str):
+        return self._agg(column, np.min, None)
+
+    def max(self, column: str):
+        return self._agg(column, np.max, None)
+
+    def mean(self, column: str):
+        tot, n = 0.0, 0
+        for b in self.iter_internal_blocks():
+            if b:
+                col = np.asarray(b[column])
+                tot += float(np.sum(col))
+                n += len(col)
+        return tot / n if n else None
+
+    def std(self, column: str, ddof: int = 1):
+        vals = [np.asarray(b[column]) for b in self.iter_internal_blocks()
+                if b]
+        if not vals:
+            return None
+        return float(np.std(np.concatenate(vals), ddof=ddof))
+
+    def _agg(self, column: str, fn, empty):
+        parts = [fn(np.asarray(b[column]))
+                 for b in self.iter_internal_blocks() if b]
+        if not parts:
+            return empty
+        return fn(np.asarray(parts)).item()
 
     def limit(self, n: int) -> "Dataset":
         import dataclasses
@@ -369,3 +463,61 @@ def read_csv(paths) -> Dataset:
 
 def read_parquet(paths) -> Dataset:
     return Dataset(Plan(_plan.parquet_read_tasks(_expand(paths)), []))
+
+
+class GroupedData:
+    """Result of Dataset.groupby (reference:
+    python/ray/data/grouped_data.py) — aggregations fan out as one
+    remote reduce task per hash partition."""
+
+    def __init__(self, ds: Dataset, keys: List[str]):
+        self._ds = ds
+        self._keys = keys
+
+    def _partitions(self, num_partitions: Optional[int]):
+        from . import _shuffle
+        blocks = [b for b in self._ds.iter_internal_blocks() if b]
+        p = num_partitions or max(1, len(blocks))
+        parts: List[List[Block]] = [[] for _ in builtins.range(p)]
+        for b in blocks:
+            for i, piece in enumerate(
+                    _shuffle.hash_partition(b, self._keys, p)):
+                parts[i].append(piece)
+        return [ps for ps in parts if ps]
+
+    def _aggregate(self, aggs: List[tuple],
+                   num_partitions: Optional[int] = None) -> Dataset:
+        from . import _shuffle
+        refs = [_shuffle._reduce_groupby.remote(self._keys, aggs, *ps)
+                for ps in self._partitions(num_partitions)]
+        return from_blocks([b for b in ray_tpu.get(refs) if b])
+
+    def count(self) -> Dataset:
+        return self._aggregate([("count", None, "count()")])
+
+    def sum(self, column: str) -> Dataset:
+        return self._aggregate([("sum", column, f"sum({column})")])
+
+    def min(self, column: str) -> Dataset:
+        return self._aggregate([("min", column, f"min({column})")])
+
+    def max(self, column: str) -> Dataset:
+        return self._aggregate([("max", column, f"max({column})")])
+
+    def mean(self, column: str) -> Dataset:
+        return self._aggregate([("mean", column, f"mean({column})")])
+
+    def std(self, column: str) -> Dataset:
+        return self._aggregate([("std", column, f"std({column})")])
+
+    def map_groups(self, fn: Callable,
+                   num_partitions: Optional[int] = None) -> Dataset:
+        """fn(group_block) -> block or list of row dicts (reference:
+        GroupedData.map_groups)."""
+        from . import _shuffle
+        refs = [_shuffle._reduce_map_groups.remote(self._keys, fn, *ps)
+                for ps in self._partitions(num_partitions)]
+        out: List[Block] = []
+        for blocks in ray_tpu.get(refs):
+            out.extend(b for b in blocks if b)
+        return from_blocks(out)

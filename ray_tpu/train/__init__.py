@@ -10,6 +10,7 @@ from ._checkpoint import Checkpoint, CheckpointManager
 from ._session import (TrainContext, get_context, get_dataset_shard,
                        report)
 from .backend import Backend, BackendConfig, JaxConfig
+from .callbacks import UserCallback
 from .trainer import (CheckpointConfig, DataParallelTrainer, FailureConfig,
                       JaxTrainer, Result, RunConfig, ScalingConfig)
 from .worker_group import WorkerGroup
@@ -20,4 +21,5 @@ __all__ = [
     "CheckpointManager", "Backend", "BackendConfig", "JaxConfig",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",
+    "UserCallback",
 ]

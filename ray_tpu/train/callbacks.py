@@ -1,0 +1,47 @@
+"""User callbacks for training runs.
+
+Reference: python/ray/train/v2/api/callback.py UserCallback
+(after_report / after_exception) + the controller-internal callback
+hooks; RunConfig(callbacks=[...]) attaches them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class UserCallback:
+    """Subclass and override; every hook is optional.  Hooks run on the
+    controller (driver side), never inside workers."""
+
+    def on_start(self, *, world_size: int, attempt: int) -> None:
+        """Worker group (re)started with `world_size` workers."""
+
+    def on_report(self, *, metrics: Dict[str, Any],
+                  checkpoint=None) -> None:
+        """A rank-0 train.report() arrived (reference:
+        UserCallback.after_report)."""
+
+    def on_failure(self, *, error: str, failure_count: int) -> None:
+        """The worker group failed (reference:
+        UserCallback.after_exception)."""
+
+    def on_resize(self, *, old_world_size: int, new_world_size: int,
+                  reason: str) -> None:
+        """Elastic resize decision took effect."""
+
+    def on_shutdown(self, *, result) -> None:
+        """The run finished; `result` is the ray_tpu.train.Result."""
+
+
+def invoke(callbacks: Optional[List[UserCallback]], hook: str,
+           **kwargs) -> None:
+    """Best-effort dispatch: a broken callback must never kill the run."""
+    import logging
+    for cb in callbacks or []:
+        try:
+            getattr(cb, hook)(**kwargs)
+        except Exception:
+            logging.getLogger("ray_tpu.train").exception(
+                "user callback %s.%s failed",
+                type(cb).__name__, hook)

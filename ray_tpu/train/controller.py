@@ -29,10 +29,18 @@ class TrainController:
                  checkpoint_score_attribute: Optional[str] = None,
                  checkpoint_score_order: str = "max",
                  poll_interval_s: float = 0.2,
-                 pg=None):
+                 pg=None,
+                 min_workers: Optional[int] = None,
+                 callbacks: Optional[list] = None,
+                 elastic_upscale_check_s: float = 5.0):
         self.train_fn = train_fn
         self.config = config
         self.num_workers = num_workers
+        self.min_workers = min_workers            # None = fixed-size group
+        self.current_workers = num_workers
+        self.callbacks = callbacks or []
+        self.elastic_upscale_check_s = elastic_upscale_check_s
+        self._last_upscale_check = time.monotonic()
         self.resources_per_worker = resources_per_worker
         self.backend_config = backend_config
         self.storage_path = storage_path
@@ -48,27 +56,122 @@ class TrainController:
         self.failures = 0
 
     def _start_group(self) -> WorkerGroup:
-        wg = WorkerGroup(num_workers=self.num_workers,
+        from . import callbacks as cbs
+        if self.current_workers != self.num_workers:
+            # Resized group cannot reuse a PG sized for num_workers.
+            self.pg = None
+        wg = WorkerGroup(num_workers=self.current_workers,
                          resources_per_worker=self.resources_per_worker,
                          storage_path=self.storage_path,
                          placement_strategy=self.placement_strategy,
                          pg=self.pg)
         wg.start(self.backend_config)
         wg.run(self.train_fn, self.config)
+        cbs.invoke(self.callbacks, "on_start",
+                   world_size=self.current_workers,
+                   attempt=self.failures)
         return wg
 
+    # ------------------------------------------------------------- elastic --
+    def _feasible_extra_workers(self) -> int:
+        """How many more resources_per_worker bundles fit the cluster's
+        FREE capacity right now (reference: scaling policy reading the
+        resource view)."""
+        import ray_tpu
+        fit = 0
+        for n in ray_tpu.nodes():
+            if not n["alive"]:
+                continue
+            avail = dict(n["resources_available"])
+            while all(avail.get(k, 0.0) >= v - 1e-9
+                      for k, v in self.resources_per_worker.items()
+                      if v > 0):
+                for k, v in self.resources_per_worker.items():
+                    if v > 0:
+                        avail[k] = avail.get(k, 0.0) - v
+                fit += 1
+                if fit >= self.num_workers:
+                    return fit
+        return fit
+
+    def _pick_restart_size(self, deadline_s: float = 30.0) -> int:
+        """After a failure, wait for released/replaced capacity and pick
+        the largest feasible world size in [min_workers, num_workers]
+        (reference: v2 resize decision on restart; a jax.distributed
+        world is static so the whole group re-forms at the new size)."""
+        deadline = time.monotonic() + deadline_s
+        best = 0
+        while time.monotonic() < deadline:
+            best = self._feasible_extra_workers()
+            if best >= self.num_workers:
+                return self.num_workers
+            if best >= (self.min_workers or self.num_workers) \
+                    and time.monotonic() > deadline - deadline_s / 2:
+                # Half the window elapsed without full capacity: settle.
+                break
+            time.sleep(0.5)
+        return min(self.num_workers,
+                   max(best, 0))
+
+    def _maybe_upscale(self, wg: WorkerGroup) -> Optional[WorkerGroup]:
+        """Elastic up: if capacity recovered and we run below target,
+        restart the group at a larger size from the latest checkpoint."""
+        from . import callbacks as cbs
+        if self.min_workers is None \
+                or self.current_workers >= self.num_workers:
+            return None
+        now = time.monotonic()
+        if now - self._last_upscale_check < self.elastic_upscale_check_s:
+            return None
+        self._last_upscale_check = now
+        if self.checkpoint_manager.latest is None:
+            return None        # nothing to resume from: not worth losing work
+        extra = self._feasible_extra_workers()
+        if extra < 1:
+            return None
+        new_size = min(self.num_workers, self.current_workers + extra)
+        logger.info("elastic resize up: %d -> %d workers",
+                    self.current_workers, new_size)
+        cbs.invoke(self.callbacks, "on_resize",
+                   old_world_size=self.current_workers,
+                   new_world_size=new_size, reason="capacity recovered")
+        wg.shutdown()
+        self.current_workers = new_size
+        self.config = dict(self.config)
+        self.config["_resume_ckpt_packed"] = \
+            self.checkpoint_manager.latest.pack()
+        return self._start_group()
+
     def _ingest(self, polls: List[Dict[str, Any]]):
+        from . import callbacks as cbs
         for poll in polls:
             for rep in poll["reports"]:
                 if rep.get("rank") != 0:
                     continue
                 self.metrics_history.append(rep["metrics"])
+                ckpt = None
                 if rep.get("checkpoint_packed") is not None:
                     self.checkpoint_manager.register_packed(
                         rep["checkpoint_packed"], rep["metrics"])
+                    ckpt = self.checkpoint_manager.latest
+                cbs.invoke(self.callbacks, "on_report",
+                           metrics=rep["metrics"], checkpoint=ckpt)
+
+    def _result(self, error: Optional[str]) -> "Result":
+        from . import callbacks as cbs
+        from .trainer import Result
+        res = Result(
+            metrics=(self.metrics_history[-1]
+                     if self.metrics_history else {}),
+            metrics_history=self.metrics_history,
+            checkpoint=self.checkpoint_manager.latest,
+            best_checkpoint=self.checkpoint_manager.best,
+            error=error)
+        cbs.invoke(self.callbacks, "on_shutdown", result=res)
+        return res
 
     def run(self) -> "Result":
-        from .trainer import Result
+        from . import callbacks as cbs
         wg = self._start_group()
         try:
             while True:
@@ -85,31 +188,43 @@ class TrainController:
                         error = "\n".join(p["error"] or "" for p in polls
                                           if p["state"] == "error")
                     elif all(s == "finished" for s in states):
-                        return Result(
-                            metrics=(self.metrics_history[-1]
-                                     if self.metrics_history else {}),
-                            metrics_history=self.metrics_history,
-                            checkpoint=self.checkpoint_manager.latest,
-                            best_checkpoint=self.checkpoint_manager.best,
-                            error=None)
+                        return self._result(None)
                     else:
+                        if not any(s == "finished" for s in states):
+                            # Never resize a group that is partially done —
+                            # tearing it down would re-run finished work.
+                            new_wg = self._maybe_upscale(wg)
+                            if new_wg is not None:
+                                wg = new_wg
                         continue
                 # Failure path (reference: controller.py:225
                 # _execute_failure_decision → restart the whole group; a
-                # jax.distributed world cannot shrink, SURVEY.md §7 hard
-                # part 4).
+                # jax.distributed world cannot shrink in place, SURVEY.md §7
+                # hard part 4 — elastic runs re-form at a feasible size).
                 self.failures += 1
+                cbs.invoke(self.callbacks, "on_failure", error=error,
+                           failure_count=self.failures)
                 wg.shutdown()
                 if self.failures > self.max_failures:
-                    return Result(
-                        metrics=(self.metrics_history[-1]
-                                 if self.metrics_history else {}),
-                        metrics_history=self.metrics_history,
-                        checkpoint=self.checkpoint_manager.latest,
-                        best_checkpoint=self.checkpoint_manager.best,
-                        error=error)
-                logger.warning("restarting worker group (failure %d/%d): %s",
+                    return self._result(error)
+                if self.min_workers is not None:
+                    size = self._pick_restart_size()
+                    if size < self.min_workers:
+                        return self._result(
+                            (error or "") +
+                            f"\nelastic restart impossible: only {size} "
+                            f"worker slots available, min_workers="
+                            f"{self.min_workers}")
+                    if size != self.current_workers:
+                        cbs.invoke(self.callbacks, "on_resize",
+                                   old_world_size=self.current_workers,
+                                   new_world_size=size,
+                                   reason="restart after failure")
+                        self.current_workers = size
+                logger.warning("restarting worker group (failure %d/%d, "
+                               "world=%d): %s",
                                self.failures, self.max_failures,
+                               self.current_workers,
                                error.splitlines()[-1] if error else "?")
                 latest = self.checkpoint_manager.latest
                 if latest is not None:

@@ -26,6 +26,17 @@ class ScalingConfig:
     use_tpu: bool = False
     topology: Optional[str] = None
     placement_strategy: str = "SPREAD"
+    # Elastic training (reference: v2 scaling policy): when set, a failed
+    # group restarts at the largest feasible world size in
+    # [min_workers, num_workers] and upsizes again when capacity returns.
+    min_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_workers is not None and not (
+                1 <= self.min_workers <= self.num_workers):
+            raise ValueError(
+                f"min_workers={self.min_workers} must be in "
+                f"[1, num_workers={self.num_workers}]")
 
     def _resources(self) -> Dict[str, float]:
         if self.resources_per_worker:
@@ -55,6 +66,7 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    callbacks: Optional[List["UserCallback"]] = None
 
 
 @dataclasses.dataclass
@@ -108,7 +120,9 @@ class DataParallelTrainer:
             placement_strategy=self.scaling_config.placement_strategy,
             checkpoint_num_to_keep=ckpt.num_to_keep,
             checkpoint_score_attribute=ckpt.checkpoint_score_attribute,
-            checkpoint_score_order=ckpt.checkpoint_score_order)
+            checkpoint_score_order=ckpt.checkpoint_score_order,
+            min_workers=self.scaling_config.min_workers,
+            callbacks=self.run_config.callbacks)
         return controller.run()
 
 

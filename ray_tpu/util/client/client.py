@@ -141,7 +141,7 @@ class ClientContext:
         reflist = [refs] if single else list(refs)
         res = self._call("client_get", {
             "refs": [r._rid for r in reflist], "timeout": timeout},
-            timeout=(timeout or 300) + 30)
+            timeout=(300 if timeout is None else timeout) + 30)
         if "error" in res:
             raise cloudpickle.loads(res["error"])
         values = [cloudpickle.loads(b) for b in res["values"]]

@@ -30,6 +30,10 @@ class ClientServer:
         self._refs: Dict[str, Any] = {}        # ref_id -> ObjectRef
         self._actors: Dict[str, Any] = {}      # actor_key -> ActorHandle
         self._fns: Dict[bytes, Any] = {}       # fn blob hash -> RemoteFunction
+        # conn -> owned ids: an unclean client disconnect must release its
+        # refs and kill its actors, or a long-lived server leaks pinned
+        # objects (reference: client server per-client state cleanup).
+        self._owned: Dict[Any, Dict[str, set]] = {}
         self._server = rpc.RpcServer({
             "client_put": self.h_put,
             "client_get": self.h_get,
@@ -40,7 +44,7 @@ class ClientServer:
             "client_release": self.h_release,
             "client_cluster_info": self.h_cluster_info,
             "ping": lambda conn, p: "pong",
-        }, name="client-server")
+        }, name="client-server", on_client_close=self._on_client_close)
 
     async def start(self) -> tuple:
         self.address = await self._server.start_tcp(self.host, self.port)
@@ -58,10 +62,37 @@ class ClientServer:
         return await asyncio.wrap_future(
             asyncio.run_coroutine_threadsafe(coro, core.loop))
 
-    def _track(self, ref) -> str:
+    def _track(self, ref, conn=None) -> str:
         rid = uuid.uuid4().hex
         self._refs[rid] = ref
+        if conn is not None:
+            self._owned.setdefault(conn, {"refs": set(), "actors": set()})[
+                "refs"].add(rid)
         return rid
+
+    def _track_actor(self, handle, conn) -> str:
+        key = uuid.uuid4().hex
+        self._actors[key] = handle
+        if conn is not None:
+            self._owned.setdefault(conn, {"refs": set(), "actors": set()})[
+                "actors"].add(key)
+        return key
+
+    def _on_client_close(self, conn):
+        owned = self._owned.pop(conn, None)
+        if not owned:
+            return
+        for rid in owned["refs"]:
+            self._refs.pop(rid, None)
+        for key in owned["actors"]:
+            handle = self._actors.pop(key, None)
+            if handle is not None:
+                try:
+                    self._ray.kill(handle)
+                except Exception:
+                    pass
+        logger.info("client disconnected: released %d refs, %d actors",
+                    len(owned["refs"]), len(owned["actors"]))
 
     def _decode_arg(self, a):
         if isinstance(a, dict) and "__client_ref__" in a:
@@ -91,17 +122,18 @@ class ClientServer:
         value = cloudpickle.loads(p["blob"])
         core = self._ray._core()
         ref = await self._on_core(core.put_async(value))
-        return {"ref": self._track(ref)}
+        return {"ref": self._track(ref, conn)}
 
     async def h_get(self, conn, p):
         refs = [self._refs[r] for r in p["refs"]]
         core = self._ray._core()
         out = []
         for ref in refs:
+            timeout = p.get("timeout")
             try:
                 val = await asyncio.wait_for(
                     self._on_core(core.get_async(ref)),
-                    p.get("timeout") or 300)
+                    300 if timeout is None else timeout)
             except Exception as e:       # ship the error, typed by repr
                 return {"error": cloudpickle.dumps(e)}
             out.append(cloudpickle.dumps(val))
@@ -112,7 +144,7 @@ class ClientServer:
         args, kwargs = self._decode_args(p["args"])
         refs = rf.remote(*args, **kwargs)
         refs = refs if isinstance(refs, list) else [refs]
-        return {"refs": [self._track(r) for r in refs]}
+        return {"refs": [self._track(r, conn) for r in refs]}
 
     async def h_create_actor(self, conn, p):
         cls = cloudpickle.loads(p["cls"])
@@ -121,15 +153,13 @@ class ClientServer:
             rc = rc.options(**p["options"])
         args, kwargs = self._decode_args(p["args"])
         handle = rc.remote(*args, **kwargs)
-        key = uuid.uuid4().hex
-        self._actors[key] = handle
-        return {"actor": key}
+        return {"actor": self._track_actor(handle, conn)}
 
     async def h_actor_call(self, conn, p):
         handle = self._actors[p["actor"]]
         args, kwargs = self._decode_args(p["args"])
         ref = getattr(handle, p["method"]).remote(*args, **kwargs)
-        return {"refs": [self._track(ref)]}
+        return {"refs": [self._track(ref, conn)]}
 
     async def h_kill(self, conn, p):
         handle = self._actors.pop(p["actor"], None)

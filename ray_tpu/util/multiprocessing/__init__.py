@@ -125,20 +125,55 @@ class Pool:
                 for chunk in self._chunks(iterable, chunksize)]
         return AsyncResult(refs, single=False).get()
 
+    def _iter_chunks(self, iterable: Iterable, chunksize: int):
+        """Lazily chunk the input (stdlib imap streams its iterable —
+        a generator larger than RAM must not be materialized)."""
+        chunk: list = []
+        for item in iterable:
+            chunk.append(item)
+            if len(chunk) >= chunksize:
+                yield chunk
+                chunk = []
+        if chunk:
+            yield chunk
+
     def imap(self, func, iterable, chunksize: int = 1):
-        refs = [self._next_actor().run_chunk.remote(func, chunk, False)
-                for chunk in self._chunks(iterable, chunksize)]
-        for ref in refs:
+        max_inflight = self._n * 2
+        chunks = self._iter_chunks(iterable, chunksize)
+        inflight: List = []
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < max_inflight:
+                chunk = next(chunks, None)
+                if chunk is None:
+                    exhausted = True
+                    break
+                inflight.append(self._next_actor().run_chunk.remote(
+                    func, chunk, False))
+            if not inflight:
+                return
+            ref = inflight.pop(0)       # ordered: consume head first
             yield from ray_tpu.get(ref)
 
     def imap_unordered(self, func, iterable, chunksize: int = 1):
-        refs = [self._next_actor().run_chunk.remote(func, chunk, False)
-                for chunk in self._chunks(iterable, chunksize)]
-        pending = list(refs)
-        while pending:
+        max_inflight = self._n * 2
+        chunks = self._iter_chunks(iterable, chunksize)
+        pending: List = []
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < max_inflight:
+                chunk = next(chunks, None)
+                if chunk is None:
+                    exhausted = True
+                    break
+                pending.append(self._next_actor().run_chunk.remote(
+                    func, chunk, False))
+            if not pending:
+                return
             # wait may surface several simultaneously-ready refs even with
             # num_returns=1; consume all of them.
             done, pending = ray_tpu.wait(pending, num_returns=1)
+            pending = list(pending)
             for ref in done:
                 yield from ray_tpu.get(ref)
 

@@ -1,0 +1,96 @@
+"""All-to-all Data ops: sort, groupby/aggregate, join, global aggregates
+(reference model: python/ray/data/tests/test_sort.py, test_groupby.py,
+test_join.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture
+def rows(ray_start_regular):
+    rng = np.random.default_rng(7)
+    return [{"k": int(rng.integers(0, 5)), "v": float(i), "tag": f"t{i % 3}"}
+            for i in range(40)]
+
+
+def test_sort_ascending_descending(rows):
+    ds = rdata.from_items(rows, parallelism=4)
+    got = [r["v"] for r in ds.sort("v").take_all()]
+    assert got == sorted(r["v"] for r in rows)
+    got = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert got == sorted((r["v"] for r in rows), reverse=True)
+
+
+def test_sort_preserves_row_alignment(rows):
+    ds = rdata.from_items(rows, parallelism=4)
+    for r in ds.sort("v").take(5):
+        orig = rows[int(r["v"])]
+        assert r["k"] == orig["k"] and r["tag"] == orig["tag"]
+
+
+def test_groupby_aggregates(rows):
+    ds = rdata.from_items(rows, parallelism=4)
+    out = {r["k"]: r for r in ds.groupby("k").count().take_all()}
+    want: dict = {}
+    for r in rows:
+        want[r["k"]] = want.get(r["k"], 0) + 1
+    assert {k: r["count()"] for k, r in out.items()} == want
+
+    sums = {r["k"]: r["sum(v)"]
+            for r in ds.groupby("k").sum("v").take_all()}
+    for k, s in sums.items():
+        assert s == pytest.approx(
+            sum(r["v"] for r in rows if r["k"] == k))
+
+    means = {r["k"]: r["mean(v)"]
+             for r in ds.groupby("k").mean("v").take_all()}
+    for k, m in means.items():
+        vals = [r["v"] for r in rows if r["k"] == k]
+        assert m == pytest.approx(sum(vals) / len(vals))
+
+
+def test_groupby_multi_key_and_map_groups(rows):
+    ds = rdata.from_items(rows, parallelism=4)
+    out = ds.groupby(["k", "tag"]).count().take_all()
+    want = {}
+    for r in rows:
+        want[(r["k"], r["tag"])] = want.get((r["k"], r["tag"]), 0) + 1
+    assert {(r["k"], r["tag"]): r["count()"] for r in out} == want
+
+    normed = ds.groupby("k").map_groups(
+        lambda g: {"k": g["k"][:1], "spread": [g["v"].max() - g["v"].min()]})
+    got = {r["k"]: r["spread"] for r in normed.take_all()}
+    for k, s in got.items():
+        vals = [r["v"] for r in rows if r["k"] == k]
+        assert s == pytest.approx(max(vals) - min(vals))
+
+
+def test_join_inner_and_left(ray_start_regular):
+    left = rdata.from_items(
+        [{"id": i, "a": i * 10} for i in range(6)], parallelism=2)
+    right = rdata.from_items(
+        [{"id": i, "b": i * 100} for i in range(3, 9)], parallelism=2)
+    inner = sorted(left.join(right, "id").take_all(),
+                   key=lambda r: r["id"])
+    assert [r["id"] for r in inner] == [3, 4, 5]
+    assert all(r["b"] == r["id"] * 100 and r["a"] == r["id"] * 10
+               for r in inner)
+
+    lj = sorted(left.join(right, "id", how="left").take_all(),
+                key=lambda r: r["id"])
+    assert [r["id"] for r in lj] == list(range(6))
+    assert lj[0]["b"] is None and lj[5]["b"] == 500
+
+
+def test_global_aggregates_and_unique(rows):
+    ds = rdata.from_items(rows, parallelism=4)
+    vs = [r["v"] for r in rows]
+    assert ds.sum("v") == pytest.approx(sum(vs))
+    assert ds.min("v") == min(vs)
+    assert ds.max("v") == max(vs)
+    assert ds.mean("v") == pytest.approx(sum(vs) / len(vs))
+    assert ds.std("v") == pytest.approx(float(np.std(vs, ddof=1)))
+    assert ds.unique("tag") == ["t0", "t1", "t2"]

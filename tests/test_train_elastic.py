@@ -1,0 +1,154 @@
+"""Train callbacks + elastic resize (reference model: train v2
+UserCallback and scaling-policy resize tests)."""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import (DataParallelTrainer, FailureConfig, RunConfig,
+                           ScalingConfig, UserCallback)
+
+
+class Recorder(UserCallback):
+    def __init__(self):
+        self.events = []
+        self.lock = threading.Lock()
+
+    def _rec(self, kind, payload):
+        with self.lock:
+            self.events.append((kind, payload))
+
+    def on_start(self, *, world_size, attempt):
+        self._rec("start", {"world_size": world_size, "attempt": attempt})
+
+    def on_report(self, *, metrics, checkpoint=None):
+        self._rec("report", {"metrics": metrics,
+                             "has_ckpt": checkpoint is not None})
+
+    def on_failure(self, *, error, failure_count):
+        self._rec("failure", {"count": failure_count})
+
+    def on_resize(self, *, old_world_size, new_world_size, reason):
+        self._rec("resize", {"old": old_world_size, "new": new_world_size,
+                             "reason": reason})
+
+    def on_shutdown(self, *, result):
+        self._rec("shutdown", {"error": result.error})
+
+    def kinds(self):
+        with self.lock:
+            return [k for k, _ in self.events]
+
+
+def test_callbacks_fire_in_order(ray_start_regular):
+    rec = Recorder()
+
+    def loop(config):
+        from ray_tpu import train
+        for step in range(3):
+            train.report({"step": step})
+
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="cbs", storage_path=tempfile.mkdtemp(),
+                             callbacks=[rec])).fit()
+    assert result.error is None
+    kinds = rec.kinds()
+    assert kinds[0] == "start"
+    assert kinds.count("report") == 3
+    assert kinds[-1] == "shutdown"
+    reports = [p["metrics"]["step"] for k, p in rec.events
+               if k == "report"]
+    assert reports == [0, 1, 2]
+
+
+def test_broken_callback_does_not_kill_run(ray_start_regular):
+    class Broken(UserCallback):
+        def on_report(self, **kw):
+            raise RuntimeError("callback bug")
+
+    def loop(config):
+        from ray_tpu import train
+        train.report({"ok": 1})
+
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="broken",
+                             storage_path=tempfile.mkdtemp(),
+                             callbacks=[Broken()])).fit()
+    assert result.error is None
+    assert result.metrics["ok"] == 1
+
+
+def test_elastic_downsize_after_node_loss():
+    """Lose a node mid-run: the group must re-form at min_workers and
+    finish from the latest checkpoint."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    node2 = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    rec = Recorder()
+
+    def loop(config):
+        import os, tempfile, time
+        from ray_tpu import train
+        ctx = train.get_context()
+        resume = config.get("resume_from_checkpoint")
+        start = 0
+        if resume:
+            with open(os.path.join(resume, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 6):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            train.report(
+                {"step": step, "world": ctx.get_world_size()},
+                checkpoint=train.Checkpoint.from_directory(d))
+            time.sleep(0.4)
+
+    result_box = {}
+
+    def run_fit():
+        result_box["result"] = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1,
+                resources_per_worker={"CPU": 2.0}),
+            run_config=RunConfig(
+                name="elastic", storage_path=tempfile.mkdtemp(),
+                failure_config=FailureConfig(max_failures=2),
+                callbacks=[rec])).fit()
+
+    t = threading.Thread(target=run_fit)
+    t.start()
+    # Wait for the 2-worker world to make progress + checkpoint.
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        if any(k == "report" and p["metrics"]["step"] >= 1
+               for k, p in rec.events):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("no progress before node kill")
+    cluster.remove_node(node2)          # hard kill: half the capacity gone
+    t.join(timeout=180)
+    assert not t.is_alive(), "fit() hung after node loss"
+    result = result_box["result"]
+    try:
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 5
+        # The run finished in a 1-worker world after the resize.
+        assert result.metrics["world"] == 1
+        resizes = [p for k, p in rec.events if k == "resize"]
+        assert any(r["new"] == 1 for r in resizes)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
