@@ -60,7 +60,7 @@ def remote(*args, **kwargs):
                 "num_cpus", "num_tpus", "resources", "max_restarts",
                 "max_task_retries", "max_concurrency", "name", "namespace",
                 "lifetime", "runtime_env", "scheduling_strategy",
-                "get_if_exists")}
+                "get_if_exists", "concurrency_groups")}
             return ActorClass(target, **cls_kwargs)
         fn_kwargs = {k: v for k, v in kwargs.items() if k in (
             "num_returns", "num_cpus", "num_tpus", "resources",
@@ -70,10 +70,13 @@ def remote(*args, **kwargs):
     return deco
 
 
-def method(num_returns: int = 1):
-    """Per-method options for actor methods (reference: ray.method)."""
+def method(num_returns: int = 1, concurrency_group: Optional[str] = None):
+    """Per-method options for actor methods (reference: ray.method —
+    num_returns and concurrency-group assignment)."""
     def deco(m):
         m.__ray_num_returns__ = num_returns
+        if concurrency_group is not None:
+            m.__ray_concurrency_group__ = concurrency_group
         return m
     return deco
 

@@ -124,6 +124,15 @@ class NodeAgent:
         # worker_id -> {"reason", "ts"}: deaths caused by the OOM monitor,
         # queried by owners via h_worker_fate for typed errors.
         self._oom_kills: Dict[bytes, dict] = {}
+        # Optional kernel-level worker isolation (reference: cgroup2
+        # system/application split; config `cgroup_enabled`).
+        self._worker_cgroup = None
+        if cfg.cgroup_enabled:
+            from .cgroup import WorkerCgroup
+            mem = cfg.cgroup_memory_max_bytes or None
+            grp = WorkerCgroup(f"ray_tpu_{self.node_id.hex()[:8]}",
+                               memory_max=mem)
+            self._worker_cgroup = grp if grp.active else None
 
     def _handlers(self):
         return {
@@ -321,6 +330,8 @@ class NodeAgent:
                 pass
         await self._server.close()
         self.store.close()
+        if self._worker_cgroup is not None:
+            self._worker_cgroup.close()
         try:
             os.unlink(self.store_path)
         except FileNotFoundError:
@@ -362,6 +373,8 @@ class NodeAgent:
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=out, stderr=err,
             cwd=cwd or os.getcwd(), start_new_session=True)
+        if self._worker_cgroup is not None:
+            self._worker_cgroup.add(proc.pid)
         wh = WorkerHandle(worker_id, proc)
         wh.needs_tpu = needs_tpu
         wh.has_env = bool(env_extra) or cwd is not None

@@ -31,6 +31,11 @@ _define("max_direct_call_object_size", 100 * 1024,
         "(reference: RAY_CONFIG max_direct_call_object_size, 100KB)")
 _define("memory_store_max_bytes", 512 * 1024 * 1024)
 _define("worker_register_timeout_s", 60.0)
+_define("cgroup_enabled", False,
+        "place worker processes in a cgroup v2 group (reference: "
+        "common/cgroup2 system/application split); no-op when cgroup2 "
+        "is unavailable or read-only")
+_define("cgroup_memory_max_bytes", 0, "0 = no kernel memory cap")
 _define("memory_usage_threshold", 0.95,
         "node memory fraction above which the agent's memory monitor kills "
         "a worker (reference: RAY_memory_usage_threshold); >=1 disables")

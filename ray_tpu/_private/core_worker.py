@@ -1446,7 +1446,8 @@ class CoreWorker:
     def create_actor(self, *, cls, actor_id: bytes, args, kwargs, resources,
                      name=None, get_if_exists=False, max_restarts=0,
                      max_concurrency=1, runtime_env=None,
-                     scheduling_strategy=None, class_name="") -> dict:
+                     scheduling_strategy=None, class_name="",
+                     concurrency_groups=None) -> dict:
         # Class + args serialize on the CALLING thread (post-call mutation
         # of init args is safe; matches submit_actor_task's guarantee).
         if not self._on_loop_thread():
@@ -1467,6 +1468,7 @@ class CoreWorker:
             big_puts=big_puts,
             resources=resources, name=name, get_if_exists=get_if_exists,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
+            concurrency_groups=concurrency_groups,
             runtime_env=runtime_env, scheduling_strategy=scheduling_strategy,
             class_name=class_name)
         if self._on_loop_thread():
@@ -1495,7 +1497,8 @@ class CoreWorker:
     async def _create_actor(self, *, blob, actor_id, arg_entries, ref_args,
                             borrowed_args, big_puts, resources,
                             name, get_if_exists, max_restarts, max_concurrency,
-                            runtime_env, scheduling_strategy, class_name):
+                            runtime_env, scheduling_strategy, class_name,
+                            concurrency_groups=None):
         cls_id = protocol.function_id(blob)
         try:
             await self._store_big_puts(arg_entries, big_puts)
@@ -1513,6 +1516,7 @@ class CoreWorker:
                 "get_if_exists": get_if_exists,
                 "max_restarts": max_restarts,
                 "max_concurrency": max_concurrency,
+                "concurrency_groups": concurrency_groups or {},
                 "runtime_env": runtime_env,
                 "scheduling_strategy": scheduling_strategy,
                 "owner_addr": list(self.address),

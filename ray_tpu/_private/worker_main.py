@@ -45,6 +45,8 @@ class Executor:
         self.actor: Any = None
         self.actor_id: Optional[bytes] = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._group_sems: dict = {}
+        self._max_concurrency = 1
         self._actor_is_async = False
         self._running: Dict[bytes, tuple] = {}  # task_id -> (task, is_async)
         self._running_threads: Dict[bytes, int] = {}  # sync task -> thread id
@@ -164,13 +166,49 @@ class Executor:
             return await self._execute(spec)
 
     async def h_push_actor_task(self, conn, spec):
+        # Concurrency groups (reference: ConcurrencyGroupManager — each
+        # named group has its own concurrency budget; untagged methods
+        # share the default group).  A sync actor's default group is a
+        # Semaphore(1), preserving serial execution order; tagged groups
+        # run concurrently with it on the thread pool.
+        try:
+            sem = self._sem_for_method(spec["method"])
+        except exc.RayError as e:
+            # Misconfiguration must resolve the return ref, not poison the
+            # RPC channel: ship it like any task error.
+            return {"status": "error",
+                    "error": get_context().dumps_code(e),
+                    "traceback": str(e)}
         if self._actor_is_async:
             method = getattr(self.actor, spec["method"], None)
             if method is not None and asyncio.iscoroutinefunction(method):
-                async with self._actor_sem:
+                async with sem:
                     return await self._execute(spec)
+        if self._group_sems and sem is not self._actor_sem:
+            async with sem:
+                return await self._execute(spec)
+        if self._actor_sem is not None and not self._actor_is_async \
+                and self._max_concurrency > 1:
+            # Threaded actor (reference: max_concurrency>1 on a sync
+            # actor): bounded parallel execution on the thread pool.
+            async with self._actor_sem:
+                return await self._execute(spec)
         async with self._task_lock:
             return await self._execute(spec)
+
+    def _sem_for_method(self, method_name: str):
+        if self._group_sems:
+            m = getattr(type(self.actor), method_name, None)
+            group = getattr(m, "__ray_concurrency_group__", None)
+            if group is not None:
+                sem = self._group_sems.get(group)
+                if sem is None:
+                    raise exc.RayError(
+                        f"method {method_name!r} declares concurrency "
+                        f"group {group!r} which is not in this actor's "
+                        f"concurrency_groups")
+                return sem
+        return self._actor_sem
 
     def _run_sync(self, task_id: bytes, fn, args, kwargs):
         """Sync user code on an executor thread; the thread id is recorded so
@@ -300,7 +338,11 @@ class Executor:
             for m in dir(type(self.actor)) if not m.startswith("__"))
         if self._actor_is_async and max_conc == 1:
             max_conc = 1000  # async actors default to high concurrency
+        self._max_concurrency = max_conc
         self._actor_sem = asyncio.Semaphore(max_conc)
+        self._group_sems = {
+            name: asyncio.Semaphore(int(n))
+            for name, n in (spec.get("concurrency_groups") or {}).items()}
         return True
 
     async def h_cancel_task(self, conn, p):

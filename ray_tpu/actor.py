@@ -89,7 +89,8 @@ class ActorClass:
     def __init__(self, cls, *, num_cpus=1, num_tpus=0, resources=None,
                  max_restarts=0, max_task_retries=0, max_concurrency=1,
                  name=None, namespace=None, lifetime=None, runtime_env=None,
-                 scheduling_strategy=None, get_if_exists=False):
+                 scheduling_strategy=None, get_if_exists=False,
+                 concurrency_groups=None):
         self._cls = cls
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus
@@ -97,6 +98,7 @@ class ActorClass:
         self._max_restarts = max_restarts
         self._max_task_retries = max_task_retries
         self._max_concurrency = max_concurrency
+        self._concurrency_groups = dict(concurrency_groups or {})
         self._name = name
         self._lifetime = lifetime
         self._runtime_env = runtime_env
@@ -116,7 +118,8 @@ class ActorClass:
             max_concurrency=self._max_concurrency, name=self._name,
             lifetime=self._lifetime, runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
-            get_if_exists=self._get_if_exists)
+            get_if_exists=self._get_if_exists,
+            concurrency_groups=self._concurrency_groups)
         merged.update(overrides)
         return ActorClass(self._cls, **merged)
 
@@ -139,6 +142,7 @@ class ActorClass:
             get_if_exists=self._get_if_exists,
             max_restarts=self._max_restarts,
             max_concurrency=self._max_concurrency,
+            concurrency_groups=self._concurrency_groups,
             runtime_env=self._runtime_env,
             scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
             class_name=self._cls.__name__)

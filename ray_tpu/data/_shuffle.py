@@ -31,8 +31,11 @@ def _hash_column(col: np.ndarray) -> np.ndarray:
     if col.dtype.kind in "iub":
         return col.astype(np.uint64, copy=False) * np.uint64(0x9E3779B97F4A7C15)
     if col.dtype.kind == "f":
-        return col.astype(np.float64).view(np.uint64) \
-            * np.uint64(0x9E3779B97F4A7C15)
+        # Normalize values that compare equal but differ in bits (-0.0 vs
+        # 0.0; NaN payloads), else equal keys split across partitions.
+        c = col.astype(np.float64) + 0.0
+        c = np.where(np.isnan(c), np.float64("nan"), c)
+        return c.view(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
     out = np.empty(len(col), np.uint64)
     for i, v in enumerate(col):
         b = v if isinstance(v, bytes) else str(v).encode()
@@ -104,6 +107,17 @@ _AGG_FNS: Dict[str, Callable] = {
 }
 
 
+def _group_indices(merged: Block, keys: Sequence[str]):
+    """(unique key tuples, per-row group index).  Keys go through a 1-D
+    object array of tuples — np.array would build a 2-D array out of the
+    tuples and break unique()."""
+    kcols = [np.asarray(merged[k]) for k in keys]
+    combo = np.empty(len(kcols[0]), dtype=object)
+    for i in range(len(kcols[0])):
+        combo[i] = tuple(kc[i] for kc in kcols)
+    return np.unique(combo, return_inverse=True)
+
+
 @ray_tpu.remote
 def _reduce_groupby(keys: List[str], aggs: List[tuple], *parts: Block
                     ) -> Block:
@@ -111,13 +125,7 @@ def _reduce_groupby(keys: List[str], aggs: List[tuple], *parts: Block
     merged = concat_blocks([p for p in parts if p])
     if not merged:
         return {}
-    kcols = [np.asarray(merged[k]) for k in keys]
-    # 1-D object array of key tuples (np.array would build a 2-D array
-    # out of the tuples and break unique()).
-    combo = np.empty(len(kcols[0]), dtype=object)
-    for i in range(len(kcols[0])):
-        combo[i] = tuple(kc[i] for kc in kcols)
-    uniq, inv = np.unique(combo, return_inverse=True)
+    uniq, inv = _group_indices(merged, keys)
     out: Dict[str, list] = {k: [] for k in keys}
     for op, col, name in aggs:
         out[name] = []
@@ -139,13 +147,7 @@ def _reduce_map_groups(keys: List[str], fn: Callable, *parts: Block
     merged = concat_blocks([p for p in parts if p])
     if not merged:
         return []
-    kcols = [np.asarray(merged[k]) for k in keys]
-    # 1-D object array of key tuples (np.array would build a 2-D array
-    # out of the tuples and break unique()).
-    combo = np.empty(len(kcols[0]), dtype=object)
-    for i in range(len(kcols[0])):
-        combo[i] = tuple(kc[i] for kc in kcols)
-    uniq, inv = np.unique(combo, return_inverse=True)
+    uniq, inv = _group_indices(merged, keys)
     out: List[Block] = []
     for gi in range(len(uniq)):
         mask = inv == gi

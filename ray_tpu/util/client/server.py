@@ -125,15 +125,19 @@ class ClientServer:
         return {"ref": self._track(ref, conn)}
 
     async def h_get(self, conn, p):
+        import time as _time
         refs = [self._refs[r] for r in p["refs"]]
         core = self._ray._core()
+        timeout = p.get("timeout")
+        # One budget for the whole batch, matching the client's single
+        # RPC deadline (client.py bounds the call at timeout+30).
+        deadline = _time.monotonic() + (300 if timeout is None else timeout)
         out = []
         for ref in refs:
-            timeout = p.get("timeout")
             try:
                 val = await asyncio.wait_for(
                     self._on_core(core.get_async(ref)),
-                    300 if timeout is None else timeout)
+                    max(0.0, deadline - _time.monotonic()))
             except Exception as e:       # ship the error, typed by repr
                 return {"error": cloudpickle.dumps(e)}
             out.append(cloudpickle.dumps(val))
@@ -149,11 +153,15 @@ class ClientServer:
     async def h_create_actor(self, conn, p):
         cls = cloudpickle.loads(p["cls"])
         rc = self._ray.remote(cls)
-        if p.get("options"):
-            rc = rc.options(**p["options"])
+        opts = p.get("options") or {}
+        if opts:
+            rc = rc.options(**opts)
         args, kwargs = self._decode_args(p["args"])
         handle = rc.remote(*args, **kwargs)
-        return {"actor": self._track_actor(handle, conn)}
+        # Detached actors exist precisely to outlive their creator — never
+        # reap them on disconnect (reference: detached lifetime).
+        owner = None if opts.get("lifetime") == "detached" else conn
+        return {"actor": self._track_actor(handle, owner)}
 
     async def h_actor_call(self, conn, p):
         handle = self._actors[p["actor"]]
@@ -168,10 +176,15 @@ class ClientServer:
         return True
 
     async def h_release(self, conn, p):
+        owned = self._owned.get(conn)
         for rid in p.get("refs", []):
             self._refs.pop(rid, None)
+            if owned:
+                owned["refs"].discard(rid)
         for key in p.get("actors", []):
             self._actors.pop(key, None)
+            if owned:
+                owned["actors"].discard(key)
         return True
 
     async def h_cluster_info(self, conn, p):

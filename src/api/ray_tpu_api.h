@@ -1,0 +1,114 @@
+// C++ public API for the ray_tpu framework (reference: cpp/include/ray/api
+// — ray::Init/Put/Get/ObjectRef for C++ programs).
+//
+// TPU-first scope: the compute path on TPU is XLA (driven from Python/JAX),
+// so the C++ surface targets what native code actually does in this
+// framework — the data plane and the control-plane KV:
+//
+//   * ObjectStoreClient: zero-copy create/seal/get against a node's
+//     daemonless /dev/shm arena (the same library the Python workers use;
+//     reference: plasma client.h).  Native data loaders and pre/post-
+//     processing pipelines write blocks here and hand refs to Python.
+//   * GcsClient: msgpack-RPC client for the GCS — KV (function/metadata
+//     store), ping, and node table reads (reference:
+//     gcs_rpc_client/ typed wrappers).
+//
+// Link: g++ -std=c++17 your.cc src/api/ray_tpu_client.cc \
+//          src/object_store/store.cc -lpthread
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------- msgpack --
+// Minimal msgpack value model — enough for the framework's wire protocol
+// (nil/bool/int/float/str/bin/array/map).
+struct MsgVal {
+  enum Type { NIL, BOOL, INT, FLOAT, STR, BIN, ARRAY, MAP } type = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                       // STR and BIN both land here
+  std::vector<MsgVal> arr;
+  std::vector<std::pair<MsgVal, MsgVal>> map;
+
+  static MsgVal Nil();
+  static MsgVal Bool(bool v);
+  static MsgVal Int(int64_t v);
+  static MsgVal Str(const std::string& v);
+  static MsgVal Bin(const std::string& v);
+  static MsgVal Arr(std::vector<MsgVal> v);
+  static MsgVal Map();
+
+  void Set(const std::string& key, MsgVal v);
+  const MsgVal* Get(const std::string& key) const;  // MAP lookup (str keys)
+};
+
+std::string MsgPackEncode(const MsgVal& v);
+// Returns false on malformed input.
+bool MsgPackDecode(const uint8_t* data, size_t len, MsgVal* out);
+
+// -------------------------------------------------------------- GcsClient --
+class GcsClient {
+ public:
+  GcsClient();
+  ~GcsClient();
+  // "host", port — the GCS address from ray_tpu's address file / init().
+  bool Connect(const std::string& host, int port);
+  bool Connected() const;
+  void Close();
+
+  // Generic call: method + payload(MAP) -> response (or NIL on error).
+  bool Call(const std::string& method, const MsgVal& payload, MsgVal* out,
+            std::string* err = nullptr);
+
+  bool Ping();
+  bool KvPut(const std::string& ns, const std::string& key,
+             const std::string& value, bool overwrite = true);
+  // Returns false when the key is absent.
+  bool KvGet(const std::string& ns, const std::string& key,
+             std::string* value);
+  bool KvDel(const std::string& ns, const std::string& key);
+  bool KvKeys(const std::string& ns, const std::string& prefix,
+              std::vector<std::string>* keys);
+  // Alive-node count + summed resources (reference: cluster_resources()).
+  bool ClusterResources(int* alive_nodes,
+                        std::map<std::string, double>* total);
+
+ private:
+  int fd_ = -1;
+  uint32_t next_id_ = 1;
+};
+
+// ------------------------------------------------------- ObjectStoreClient --
+// 20-byte object ids, matching the Python side (ids.py ObjectID).
+class ObjectStoreClient {
+ public:
+  ObjectStoreClient();
+  ~ObjectStoreClient();
+  // store_path: the node's arena (NodeInfo.store_path / agent ready file).
+  bool Attach(const std::string& store_path);
+  // Zero-copy create: returns a writable pointer into the arena; call
+  // Seal() when the bytes are in place.
+  uint8_t* Create(const uint8_t id[20], uint64_t size);
+  bool Seal(const uint8_t id[20]);
+  // Zero-copy read; caller must Release(id) when done with the pointer.
+  const uint8_t* Get(const uint8_t id[20], uint64_t* size,
+                     int timeout_ms = 0);
+  bool Release(const uint8_t id[20]);
+  bool Contains(const uint8_t id[20]);
+  bool Delete(const uint8_t id[20]);
+  void Stats(uint64_t* bytes_in_use, uint64_t* num_objects);
+
+ private:
+  int hidx_ = -1;
+  uint8_t* base_ = nullptr;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,447 @@
+// Implementation of the C++ public API (see ray_tpu_api.h).
+// Wire protocol: 4-byte little-endian length + msgpack [id, method,
+// payload] requests, [id, status, payload] responses — the same frames
+// ray_tpu/_private/rpc.py speaks.
+
+#include "ray_tpu_api.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+// store.cc exports (link src/object_store/store.cc alongside).
+extern "C" {
+int rts_attach(const char* path);
+void rts_detach(int hidx);
+uint8_t* rts_base(int hidx);
+int64_t rts_create_object(int hidx, const uint8_t* id, uint64_t size);
+int rts_seal(int hidx, const uint8_t* id);
+int64_t rts_get(int hidx, const uint8_t* id, uint64_t* size, int timeout_ms);
+int rts_release(int hidx, const uint8_t* id);
+int rts_contains(int hidx, const uint8_t* id);
+int rts_delete(int hidx, const uint8_t* id);
+void rts_stats(int hidx, uint64_t* bytes_in_use, uint64_t* num_objects,
+               uint64_t* capacity);
+}
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------- msgpack --
+
+MsgVal MsgVal::Nil() { return MsgVal{}; }
+MsgVal MsgVal::Bool(bool v) {
+  MsgVal m; m.type = BOOL; m.b = v; return m;
+}
+MsgVal MsgVal::Int(int64_t v) {
+  MsgVal m; m.type = INT; m.i = v; return m;
+}
+MsgVal MsgVal::Str(const std::string& v) {
+  MsgVal m; m.type = STR; m.s = v; return m;
+}
+MsgVal MsgVal::Bin(const std::string& v) {
+  MsgVal m; m.type = BIN; m.s = v; return m;
+}
+MsgVal MsgVal::Arr(std::vector<MsgVal> v) {
+  MsgVal m; m.type = ARRAY; m.arr = std::move(v); return m;
+}
+MsgVal MsgVal::Map() {
+  MsgVal m; m.type = MAP; return m;
+}
+
+void MsgVal::Set(const std::string& key, MsgVal v) {
+  map.emplace_back(Str(key), std::move(v));
+  type = MAP;
+}
+
+const MsgVal* MsgVal::Get(const std::string& key) const {
+  for (auto& kv : map)
+    if ((kv.first.type == STR || kv.first.type == BIN) && kv.first.s == key)
+      return &kv.second;
+  return nullptr;
+}
+
+namespace {
+
+void put_u8(std::string* o, uint8_t v) { o->push_back((char)v); }
+void put_be16(std::string* o, uint16_t v) {
+  put_u8(o, v >> 8); put_u8(o, v & 0xff);
+}
+void put_be32(std::string* o, uint32_t v) {
+  put_be16(o, v >> 16); put_be16(o, v & 0xffff);
+}
+void put_be64(std::string* o, uint64_t v) {
+  put_be32(o, (uint32_t)(v >> 32)); put_be32(o, (uint32_t)v);
+}
+
+void encode(const MsgVal& v, std::string* o) {
+  switch (v.type) {
+    case MsgVal::NIL: put_u8(o, 0xc0); break;
+    case MsgVal::BOOL: put_u8(o, v.b ? 0xc3 : 0xc2); break;
+    case MsgVal::INT: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) put_u8(o, (uint8_t)x);
+      else if (x < 0 && x >= -32) put_u8(o, (uint8_t)(0xe0 | (x + 32)));
+      else { put_u8(o, 0xd3); put_be64(o, (uint64_t)x); }
+      break;
+    }
+    case MsgVal::FLOAT: {
+      put_u8(o, 0xcb);
+      uint64_t bits; memcpy(&bits, &v.f, 8); put_be64(o, bits);
+      break;
+    }
+    case MsgVal::STR: {
+      size_t n = v.s.size();
+      if (n < 32) put_u8(o, 0xa0 | (uint8_t)n);
+      else if (n < 256) { put_u8(o, 0xd9); put_u8(o, (uint8_t)n); }
+      else { put_u8(o, 0xda); put_be16(o, (uint16_t)n); }
+      o->append(v.s);
+      break;
+    }
+    case MsgVal::BIN: {
+      size_t n = v.s.size();
+      if (n < 256) { put_u8(o, 0xc4); put_u8(o, (uint8_t)n); }
+      else if (n < (1u << 16)) { put_u8(o, 0xc5); put_be16(o, (uint16_t)n); }
+      else { put_u8(o, 0xc6); put_be32(o, (uint32_t)n); }
+      o->append(v.s);
+      break;
+    }
+    case MsgVal::ARRAY: {
+      size_t n = v.arr.size();
+      if (n < 16) put_u8(o, 0x90 | (uint8_t)n);
+      else { put_u8(o, 0xdc); put_be16(o, (uint16_t)n); }
+      for (auto& e : v.arr) encode(e, o);
+      break;
+    }
+    case MsgVal::MAP: {
+      size_t n = v.map.size();
+      if (n < 16) put_u8(o, 0x80 | (uint8_t)n);
+      else { put_u8(o, 0xde); put_be16(o, (uint16_t)n); }
+      for (auto& kv : v.map) { encode(kv.first, o); encode(kv.second, o); }
+      break;
+    }
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  bool take(size_t k, const uint8_t** out) {
+    if (n < k) return false;
+    *out = p; p += k; n -= k; return true;
+  }
+  bool u8(uint8_t* v) {
+    const uint8_t* q;
+    if (!take(1, &q)) return false;
+    *v = q[0]; return true;
+  }
+  bool be(size_t k, uint64_t* v) {
+    const uint8_t* q;
+    if (!take(k, &q)) return false;
+    uint64_t x = 0;
+    for (size_t i = 0; i < k; i++) x = (x << 8) | q[i];
+    *v = x; return true;
+  }
+};
+
+bool decode(Reader* r, MsgVal* out, int depth = 0) {
+  if (depth > 64) return false;
+  uint8_t t;
+  if (!r->u8(&t)) return false;
+  auto str_of = [&](size_t len, MsgVal::Type ty) {
+    const uint8_t* q;
+    if (!r->take(len, &q)) return false;
+    out->type = ty;
+    out->s.assign((const char*)q, len);
+    return true;
+  };
+  auto arr_of = [&](size_t len) {
+    out->type = MsgVal::ARRAY;
+    out->arr.resize(len);
+    for (size_t i = 0; i < len; i++)
+      if (!decode(r, &out->arr[i], depth + 1)) return false;
+    return true;
+  };
+  auto map_of = [&](size_t len) {
+    out->type = MsgVal::MAP;
+    out->map.resize(len);
+    for (size_t i = 0; i < len; i++) {
+      if (!decode(r, &out->map[i].first, depth + 1)) return false;
+      if (!decode(r, &out->map[i].second, depth + 1)) return false;
+    }
+    return true;
+  };
+  uint64_t v;
+  if (t < 0x80) { out->type = MsgVal::INT; out->i = t; return true; }
+  if (t >= 0xe0) { out->type = MsgVal::INT; out->i = (int8_t)t; return true; }
+  if ((t & 0xe0) == 0xa0) return str_of(t & 0x1f, MsgVal::STR);
+  if ((t & 0xf0) == 0x90) return arr_of(t & 0x0f);
+  if ((t & 0xf0) == 0x80) return map_of(t & 0x0f);
+  switch (t) {
+    case 0xc0: out->type = MsgVal::NIL; return true;
+    case 0xc2: out->type = MsgVal::BOOL; out->b = false; return true;
+    case 0xc3: out->type = MsgVal::BOOL; out->b = true; return true;
+    case 0xcc: if (!r->be(1, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int64_t)v; return true;
+    case 0xcd: if (!r->be(2, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int64_t)v; return true;
+    case 0xce: if (!r->be(4, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int64_t)v; return true;
+    case 0xcf: if (!r->be(8, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int64_t)v; return true;
+    case 0xd0: if (!r->be(1, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int8_t)v; return true;
+    case 0xd1: if (!r->be(2, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int16_t)v; return true;
+    case 0xd2: if (!r->be(4, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int32_t)v; return true;
+    case 0xd3: if (!r->be(8, &v)) return false;
+      out->type = MsgVal::INT; out->i = (int64_t)v; return true;
+    case 0xca: { if (!r->be(4, &v)) return false;
+      uint32_t b32 = (uint32_t)v; float f;
+      memcpy(&f, &b32, 4);
+      out->type = MsgVal::FLOAT; out->f = f; return true; }
+    case 0xcb: { if (!r->be(8, &v)) return false;
+      double d; memcpy(&d, &v, 8);
+      out->type = MsgVal::FLOAT; out->f = d; return true; }
+    case 0xd9: if (!r->be(1, &v)) return false;
+      return str_of(v, MsgVal::STR);
+    case 0xda: if (!r->be(2, &v)) return false;
+      return str_of(v, MsgVal::STR);
+    case 0xdb: if (!r->be(4, &v)) return false;
+      return str_of(v, MsgVal::STR);
+    case 0xc4: if (!r->be(1, &v)) return false;
+      return str_of(v, MsgVal::BIN);
+    case 0xc5: if (!r->be(2, &v)) return false;
+      return str_of(v, MsgVal::BIN);
+    case 0xc6: if (!r->be(4, &v)) return false;
+      return str_of(v, MsgVal::BIN);
+    case 0xdc: if (!r->be(2, &v)) return false; return arr_of(v);
+    case 0xdd: if (!r->be(4, &v)) return false; return arr_of(v);
+    case 0xde: if (!r->be(2, &v)) return false; return map_of(v);
+    case 0xdf: if (!r->be(4, &v)) return false; return map_of(v);
+    default: return false;   // ext types unused by the protocol
+  }
+}
+
+bool read_exact(int fd, uint8_t* buf, size_t n) {
+  while (n) {
+    ssize_t k = ::read(fd, buf, n);
+    if (k <= 0) return false;
+    buf += k; n -= (size_t)k;
+  }
+  return true;
+}
+
+bool write_all(int fd, const uint8_t* buf, size_t n) {
+  while (n) {
+    ssize_t k = ::write(fd, buf, n);
+    if (k <= 0) return false;
+    buf += k; n -= (size_t)k;
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string MsgPackEncode(const MsgVal& v) {
+  std::string out;
+  encode(v, &out);
+  return out;
+}
+
+bool MsgPackDecode(const uint8_t* data, size_t len, MsgVal* out) {
+  Reader r{data, len};
+  return decode(&r, out) && r.n == 0;
+}
+
+// -------------------------------------------------------------- GcsClient --
+
+GcsClient::GcsClient() = default;
+GcsClient::~GcsClient() { Close(); }
+
+bool GcsClient::Connect(const std::string& host, int port) {
+  Close();
+  struct addrinfo hints {}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0) return false;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      fd_ = fd;
+      break;
+    }
+    close(fd);
+  }
+  freeaddrinfo(res);
+  return fd_ >= 0;
+}
+
+bool GcsClient::Connected() const { return fd_ >= 0; }
+
+void GcsClient::Close() {
+  if (fd_ >= 0) close(fd_);
+  fd_ = -1;
+}
+
+bool GcsClient::Call(const std::string& method, const MsgVal& payload,
+                     MsgVal* out, std::string* err) {
+  if (fd_ < 0) return false;
+  MsgVal frame = MsgVal::Arr({MsgVal::Int(next_id_++), MsgVal::Str(method),
+                              payload});
+  std::string body = MsgPackEncode(frame);
+  uint8_t hdr[4];
+  uint32_t n = (uint32_t)body.size();
+  memcpy(hdr, &n, 4);                       // little-endian length prefix
+  if (!write_all(fd_, hdr, 4) ||
+      !write_all(fd_, (const uint8_t*)body.data(), body.size())) {
+    Close();
+    return false;
+  }
+  // Responses arrive in order on this single-call-at-a-time client; skip
+  // any server-initiated request frames (method at index 1 is a string).
+  for (;;) {
+    if (!read_exact(fd_, hdr, 4)) { Close(); return false; }
+    memcpy(&n, hdr, 4);
+    std::vector<uint8_t> buf(n);
+    if (!read_exact(fd_, buf.data(), n)) { Close(); return false; }
+    MsgVal resp;
+    if (!MsgPackDecode(buf.data(), n, &resp) ||
+        resp.type != MsgVal::ARRAY || resp.arr.size() != 3)
+      continue;
+    if (resp.arr[1].type == MsgVal::STR) continue;  // server push: ignore
+    if (resp.arr[1].i != 0) {
+      if (err) *err = resp.arr[2].s;
+      return false;
+    }
+    *out = std::move(resp.arr[2]);
+    return true;
+  }
+}
+
+bool GcsClient::Ping() {
+  MsgVal out;
+  return Call("ping", MsgVal::Map(), &out) && out.s == "pong";
+}
+
+bool GcsClient::KvPut(const std::string& ns, const std::string& key,
+                      const std::string& value, bool overwrite) {
+  MsgVal p = MsgVal::Map();
+  p.Set("ns", MsgVal::Str(ns));
+  p.Set("key", MsgVal::Str(key));
+  p.Set("value", MsgVal::Bin(value));
+  p.Set("overwrite", MsgVal::Bool(overwrite));
+  MsgVal out;
+  return Call("kv_put", p, &out);
+}
+
+bool GcsClient::KvGet(const std::string& ns, const std::string& key,
+                      std::string* value) {
+  MsgVal p = MsgVal::Map();
+  p.Set("ns", MsgVal::Str(ns));
+  p.Set("key", MsgVal::Str(key));
+  MsgVal out;
+  if (!Call("kv_get", p, &out) || out.type == MsgVal::NIL) return false;
+  *value = out.s;
+  return true;
+}
+
+bool GcsClient::KvDel(const std::string& ns, const std::string& key) {
+  MsgVal p = MsgVal::Map();
+  p.Set("ns", MsgVal::Str(ns));
+  p.Set("key", MsgVal::Str(key));
+  MsgVal out;
+  return Call("kv_del", p, &out);
+}
+
+bool GcsClient::KvKeys(const std::string& ns, const std::string& prefix,
+                       std::vector<std::string>* keys) {
+  MsgVal p = MsgVal::Map();
+  p.Set("ns", MsgVal::Str(ns));
+  p.Set("prefix", MsgVal::Str(prefix));
+  MsgVal out;
+  if (!Call("kv_keys", p, &out) || out.type != MsgVal::ARRAY) return false;
+  keys->clear();
+  for (auto& k : out.arr) keys->push_back(k.s);
+  return true;
+}
+
+bool GcsClient::ClusterResources(int* alive_nodes,
+                                 std::map<std::string, double>* total) {
+  MsgVal out;
+  if (!Call("get_nodes", MsgVal::Map(), &out) || out.type != MsgVal::ARRAY)
+    return false;
+  *alive_nodes = 0;
+  total->clear();
+  for (auto& node : out.arr) {
+    const MsgVal* alive = node.Get("alive");
+    if (!alive || !alive->b) continue;
+    (*alive_nodes)++;
+    const MsgVal* res = node.Get("resources_total");
+    if (!res) continue;
+    for (auto& kv : res->map) {
+      double v = kv.second.type == MsgVal::FLOAT ? kv.second.f
+                                                 : (double)kv.second.i;
+      (*total)[kv.first.s] += v;
+    }
+  }
+  return true;
+}
+
+// ------------------------------------------------------- ObjectStoreClient --
+
+ObjectStoreClient::ObjectStoreClient() = default;
+ObjectStoreClient::~ObjectStoreClient() {
+  if (hidx_ >= 0) rts_detach(hidx_);
+}
+
+bool ObjectStoreClient::Attach(const std::string& store_path) {
+  hidx_ = rts_attach(store_path.c_str());
+  if (hidx_ < 0) return false;
+  base_ = rts_base(hidx_);
+  return true;
+}
+
+uint8_t* ObjectStoreClient::Create(const uint8_t id[20], uint64_t size) {
+  int64_t off = rts_create_object(hidx_, id, size);
+  if (off < 0) return nullptr;
+  return base_ + off;
+}
+
+bool ObjectStoreClient::Seal(const uint8_t id[20]) {
+  return rts_seal(hidx_, id) == 0;
+}
+
+const uint8_t* ObjectStoreClient::Get(const uint8_t id[20], uint64_t* size,
+                                      int timeout_ms) {
+  int64_t off = rts_get(hidx_, id, size, timeout_ms);
+  if (off < 0) return nullptr;
+  return base_ + off;
+}
+
+bool ObjectStoreClient::Release(const uint8_t id[20]) {
+  return rts_release(hidx_, id) == 0;
+}
+
+bool ObjectStoreClient::Contains(const uint8_t id[20]) {
+  return rts_contains(hidx_, id) == 1;
+}
+
+bool ObjectStoreClient::Delete(const uint8_t id[20]) {
+  return rts_delete(hidx_, id) == 0;
+}
+
+void ObjectStoreClient::Stats(uint64_t* bytes_in_use,
+                              uint64_t* num_objects) {
+  uint64_t cap;
+  rts_stats(hidx_, bytes_in_use, num_objects, &cap);
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,72 @@
+// End-to-end smoke test for the C++ public API (built + run by
+// tests/test_cpp_api.py against a live cluster).
+// Usage: smoke_test <store_path> <gcs_host> <gcs_port>
+
+#include <cstdio>
+#include <cstring>
+
+#include "ray_tpu_api.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <store_path> <gcs_host> <gcs_port>\n",
+            argv[0]);
+    return 2;
+  }
+
+  // Object plane: zero-copy create/seal/get in the node arena.
+  ray_tpu::ObjectStoreClient store;
+  if (!store.Attach(argv[1])) { fprintf(stderr, "attach failed\n"); return 1; }
+  uint8_t id[20];
+  for (int i = 0; i < 20; i++) id[i] = (uint8_t)(0xA0 + i);
+  const char msg[] = "hello from c++";
+  uint8_t* buf = store.Create(id, sizeof msg);
+  if (!buf) { fprintf(stderr, "create failed\n"); return 1; }
+  memcpy(buf, msg, sizeof msg);
+  if (!store.Seal(id)) { fprintf(stderr, "seal failed\n"); return 1; }
+  uint64_t size = 0;
+  const uint8_t* rd = store.Get(id, &size, 1000);
+  if (!rd || size != sizeof msg || memcmp(rd, msg, size) != 0) {
+    fprintf(stderr, "get mismatch\n");
+    return 1;
+  }
+  store.Release(id);
+  if (!store.Contains(id)) { fprintf(stderr, "contains failed\n"); return 1; }
+  store.Delete(id);
+
+  // Control plane: KV + node table over msgpack RPC.
+  ray_tpu::GcsClient gcs;
+  if (!gcs.Connect(argv[2], atoi(argv[3]))) {
+    fprintf(stderr, "gcs connect failed\n");
+    return 1;
+  }
+  if (!gcs.Ping()) { fprintf(stderr, "ping failed\n"); return 1; }
+  if (!gcs.KvPut("cpp_test", "greeting", "bonjour")) {
+    fprintf(stderr, "kv_put failed\n");
+    return 1;
+  }
+  std::string val;
+  if (!gcs.KvGet("cpp_test", "greeting", &val) || val != "bonjour") {
+    fprintf(stderr, "kv_get mismatch: %s\n", val.c_str());
+    return 1;
+  }
+  std::vector<std::string> keys;
+  if (!gcs.KvKeys("cpp_test", "", &keys) || keys.size() != 1) {
+    fprintf(stderr, "kv_keys failed\n");
+    return 1;
+  }
+  gcs.KvDel("cpp_test", "greeting");
+  if (gcs.KvGet("cpp_test", "greeting", &val)) {
+    fprintf(stderr, "kv_del failed\n");
+    return 1;
+  }
+  int alive = 0;
+  std::map<std::string, double> res;
+  if (!gcs.ClusterResources(&alive, &res) || alive < 1 ||
+      res.count("CPU") == 0) {
+    fprintf(stderr, "cluster resources failed\n");
+    return 1;
+  }
+  printf("CPP-SMOKE-OK alive=%d cpu=%.1f\n", alive, res["CPU"]);
+  return 0;
+}

@@ -1,0 +1,86 @@
+// cgroup v2 resource isolation (reference: src/ray/common/cgroup2/
+// CgroupManager — system/application split on Linux; workers are placed
+// in a framework cgroup so runaway user code can be memory/cpu-bounded
+// by the kernel rather than only by the userspace OOM monitor).
+//
+// All functions return 0 on success, -errno on failure; every caller is
+// expected to degrade gracefully (containers frequently mount
+// /sys/fs/cgroup read-only).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+const char* kRoot = "/sys/fs/cgroup";
+
+int write_file(const char* path, const char* data) {
+  int fd = open(path, O_WRONLY);
+  if (fd < 0) return -errno;
+  ssize_t n = write(fd, data, strlen(data));
+  int e = n < 0 ? -errno : 0;
+  close(fd);
+  return e;
+}
+
+void subpath(char* out, size_t cap, const char* name, const char* leaf) {
+  if (leaf)
+    snprintf(out, cap, "%s/%s/%s", kRoot, name, leaf);
+  else
+    snprintf(out, cap, "%s/%s", kRoot, name);
+}
+
+}  // namespace
+
+extern "C" {
+
+// cgroup2 present and writable? (cgroup.controllers exists; root dir rw)
+int cg_available() {
+  char p[512];
+  snprintf(p, sizeof p, "%s/cgroup.controllers", kRoot);
+  if (access(p, R_OK) != 0) return 0;
+  return access(kRoot, W_OK) == 0 ? 1 : 0;
+}
+
+int cg_create(const char* name) {
+  char p[512];
+  subpath(p, sizeof p, name, nullptr);
+  if (mkdir(p, 0755) != 0 && errno != EEXIST) return -errno;
+  return 0;
+}
+
+int cg_set_memory_max(const char* name, long long bytes) {
+  char p[512], v[64];
+  subpath(p, sizeof p, name, "memory.max");
+  if (bytes < 0)
+    snprintf(v, sizeof v, "max");
+  else
+    snprintf(v, sizeof v, "%lld", bytes);
+  return write_file(p, v);
+}
+
+int cg_set_cpu_weight(const char* name, int weight) {
+  char p[512], v[32];
+  subpath(p, sizeof p, name, "cpu.weight");
+  snprintf(v, sizeof v, "%d", weight);
+  return write_file(p, v);
+}
+
+int cg_add_pid(const char* name, int pid) {
+  char p[512], v[32];
+  subpath(p, sizeof p, name, "cgroup.procs");
+  snprintf(v, sizeof v, "%d", pid);
+  return write_file(p, v);
+}
+
+int cg_remove(const char* name) {
+  char p[512];
+  subpath(p, sizeof p, name, nullptr);
+  return rmdir(p) == 0 ? 0 : -errno;
+}
+
+}  // extern "C"

@@ -353,6 +353,8 @@ void rts_detach(int hidx) {
 }
 
 uint64_t rts_data_offset(int hidx) { return g_handles[hidx].hdr->data_offset; }
+// Mapping base for in-process zero-copy (C++ API; Python uses its own mmap).
+uint8_t* rts_base(int hidx) { return g_handles[hidx].base; }
 uint64_t rts_capacity(int hidx) { return g_handles[hidx].hdr->data_capacity; }
 uint64_t rts_total_size(int hidx) { return g_handles[hidx].hdr->total_size; }
 
