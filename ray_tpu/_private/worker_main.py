@@ -197,17 +197,19 @@ class Executor:
             return await self._execute(spec)
 
     def _sem_for_method(self, method_name: str):
-        if self._group_sems:
-            m = getattr(type(self.actor), method_name, None)
-            group = getattr(m, "__ray_concurrency_group__", None)
-            if group is not None:
-                sem = self._group_sems.get(group)
-                if sem is None:
-                    raise exc.RayError(
-                        f"method {method_name!r} declares concurrency "
-                        f"group {group!r} which is not in this actor's "
-                        f"concurrency_groups")
-                return sem
+        m = getattr(type(self.actor), method_name, None)
+        group = getattr(m, "__ray_concurrency_group__", None)
+        if group is not None:
+            # A tag naming an undeclared group is a misconfiguration even
+            # when the actor declares no groups at all — silently running
+            # it in the default group would drop the intended isolation.
+            sem = self._group_sems.get(group)
+            if sem is None:
+                raise exc.RayError(
+                    f"method {method_name!r} declares concurrency "
+                    f"group {group!r} which is not in this actor's "
+                    f"concurrency_groups")
+            return sem
         return self._actor_sem
 
     def _run_sync(self, task_id: bytes, fn, args, kwargs):

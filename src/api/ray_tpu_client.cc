@@ -97,7 +97,8 @@ void encode(const MsgVal& v, std::string* o) {
       size_t n = v.s.size();
       if (n < 32) put_u8(o, 0xa0 | (uint8_t)n);
       else if (n < 256) { put_u8(o, 0xd9); put_u8(o, (uint8_t)n); }
-      else { put_u8(o, 0xda); put_be16(o, (uint16_t)n); }
+      else if (n < (1u << 16)) { put_u8(o, 0xda); put_be16(o, (uint16_t)n); }
+      else { put_u8(o, 0xdb); put_be32(o, (uint32_t)n); }
       o->append(v.s);
       break;
     }
@@ -112,14 +113,16 @@ void encode(const MsgVal& v, std::string* o) {
     case MsgVal::ARRAY: {
       size_t n = v.arr.size();
       if (n < 16) put_u8(o, 0x90 | (uint8_t)n);
-      else { put_u8(o, 0xdc); put_be16(o, (uint16_t)n); }
+      else if (n < (1u << 16)) { put_u8(o, 0xdc); put_be16(o, (uint16_t)n); }
+      else { put_u8(o, 0xdd); put_be32(o, (uint32_t)n); }
       for (auto& e : v.arr) encode(e, o);
       break;
     }
     case MsgVal::MAP: {
       size_t n = v.map.size();
       if (n < 16) put_u8(o, 0x80 | (uint8_t)n);
-      else { put_u8(o, 0xde); put_be16(o, (uint16_t)n); }
+      else if (n < (1u << 16)) { put_u8(o, 0xde); put_be16(o, (uint16_t)n); }
+      else { put_u8(o, 0xdf); put_be32(o, (uint32_t)n); }
       for (auto& kv : v.map) { encode(kv.first, o); encode(kv.second, o); }
       break;
     }
