@@ -341,6 +341,10 @@ class NodeAgent:
             for wh in self.workers.values():
                 if wh.proc.poll() is None:
                     wh.proc.kill()
+                    try:
+                        wh.proc.wait(timeout=2)
+                    except Exception:
+                        pass
             self._worker_cgroup.close()
         try:
             os.unlink(self.store_path)

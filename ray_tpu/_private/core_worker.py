@@ -47,11 +47,14 @@ from .shm_store import ShmStore, StoreFullError
 
 logger = logging.getLogger("ray_tpu.core_worker")
 
-# One task per leased worker at a time (reference semantics: a granted
-# lease runs one task; concurrency comes from holding many leases).  >1
-# pipelines pushes into a busy worker — better tiny-task throughput but
-# long tasks pile onto one worker while other nodes idle.
-PIPELINE_DEPTH = 1
+# In-flight pushes per leased worker.  A granted lease still RUNS one
+# task at a time (the worker's task lock serializes execution, matching
+# reference semantics); a small pipeline hides the push/reply round trip
+# so tiny-task throughput isn't bounded by per-task RTT.  Kept small so
+# long tasks don't pile onto one worker while other nodes idle — the
+# queue drains back through _pump when a lease dies, and queued-at-worker
+# tasks remain cancellable (_cancel_requested check before execution).
+PIPELINE_DEPTH = 3
 
 
 class _PendingTask:
@@ -82,7 +85,8 @@ class _Lease:
 
 class _KeyState:
     __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
-                 "strategy", "runtime_env", "last_demand_report")
+                 "strategy", "runtime_env", "last_demand_report",
+                 "lease_backoff_until")
 
     def __init__(self, resources, strategy, runtime_env=None):
         self.queue: deque[_PendingTask] = deque()
@@ -92,6 +96,7 @@ class _KeyState:
         self.strategy = strategy
         self.runtime_env = runtime_env
         self.last_demand_report = 0.0
+        self.lease_backoff_until = 0.0
 
 
 class _ActorState:
@@ -229,19 +234,27 @@ class CoreWorker:
         }
 
     # Device-resident objects (RDT equivalent — see experimental/
-    # device_objects.py; reference: gpu_object_manager).
+    # device_objects.py; reference: gpu_object_manager).  Transfers are
+    # CHUNKED: one msgpack frame per chunk keeps multi-GB arrays under
+    # the RPC frame cap (like the agent's object plane, h_pull_object).
+    _DEVICE_CHUNK = 64 * 1024 * 1024
+
     async def h_device_fetch(self, conn, p):
         entry = self.device_objects.get(p["object_id"])
         if entry is None:
             return None
+        offset = p.get("offset", 0)
         import numpy as np
 
         def _stage():
             # Device->host readback + copy off the event loop: a multi-GB
             # transfer must not stall the owner's RPC handling.
             arr = np.asarray(entry)
-            return {"data": arr.tobytes(), "dtype": str(arr.dtype),
-                    "shape": list(arr.shape)}
+            flat = arr.reshape(-1).view(np.uint8)
+            total = flat.nbytes
+            chunk = bytes(flat[offset:offset + self._DEVICE_CHUNK])
+            return {"data": chunk, "total": total, "offset": offset,
+                    "dtype": str(arr.dtype), "shape": list(arr.shape)}
 
         return await asyncio.get_running_loop().run_in_executor(
             self.executor, _stage)
@@ -1098,13 +1111,26 @@ class CoreWorker:
     def _pump(self, key: bytes, state: _KeyState):
         """Dispatch queued tasks onto leased workers; grow leases on demand
         (reference: normal_task_submitter.cc lease pool + pipelining)."""
-        for lease in state.leases:
-            while state.queue and lease.inflight < PIPELINE_DEPTH:
-                if lease.conn.closed:
+        # Breadth-first: one task per lease per wave, so a burst of long
+        # tasks spreads across all workers before any lease pipelines a
+        # second push.  While more leases are still in flight, hold at
+        # depth 1 — pipelining is only for hiding RTT once the cluster
+        # has granted all the concurrency it's going to.
+        depth_cap = 1 if state.pending_lease_requests > 0 \
+            else PIPELINE_DEPTH
+        for depth in range(depth_cap):
+            if not state.queue:
+                break
+            for lease in state.leases:
+                if not state.queue:
                     break
+                if lease.conn.closed or lease.inflight > depth:
+                    continue
                 task = state.queue.popleft()
                 lease.inflight += 1
                 self._spawn(self._push_and_track(key, state, lease, task))
+        if time.monotonic() < state.lease_backoff_until:
+            return          # saturated: the denied-retry loop re-pumps
         max_leases = get_config().max_leases_per_scheduling_key
         want = min(len(state.queue), max_leases - len(state.leases)
                    - state.pending_lease_requests)
@@ -1182,9 +1208,14 @@ class CoreWorker:
                     pass
             state.pending_lease_requests -= 1
             if state.queue:
+                retry_s = res.get("retry_after_ms", 100) / 1000
                 if "infeasible" in (res.get("reason") or ""):
                     self._report_demand(key, state)
-                await asyncio.sleep(res.get("retry_after_ms", 100) / 1000)
+                # Stop hot-looping new lease requests while the cluster is
+                # saturated; held leases pipeline in the meantime.
+                state.lease_backoff_until = time.monotonic() + retry_s
+                await asyncio.sleep(retry_s)
+                state.lease_backoff_until = 0.0
                 self._pump(key, state)
             return
         state.pending_lease_requests -= 1
@@ -1823,7 +1854,7 @@ class CoreWorker:
         """Fetch the GCS-recorded death cause (e.g. the OOM monitor's
         reason) for a crashed actor.  The agent's reaper reports the death
         within its 0.5 s poll, so give the record a short grace window."""
-        for _ in range(6):
+        for i in range(8):
             try:
                 info = await self.gcs.call(
                     "get_actor", {"actor_id": actor_id,
@@ -1832,9 +1863,12 @@ class CoreWorker:
                 return ""
             if info and info.get("death_cause"):
                 return info["death_cause"]
-            if info and info["state"] == protocol.ACTOR_ALIVE:
-                return ""        # restarted; not a terminal death
-            await asyncio.sleep(0.5)
+            if info and info["state"] == protocol.ACTOR_ALIVE and i >= 3:
+                # Still ALIVE well past the reaper's report window: the
+                # actor restarted rather than died terminally.  (Early
+                # ALIVE reads just mean the death report hasn't landed.)
+                return ""
+            await asyncio.sleep(0.4)
         return ""
 
     def kill_actor(self, actor_id: bytes, no_restart=True):

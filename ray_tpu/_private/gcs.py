@@ -563,8 +563,15 @@ class GcsServer:
         # (reference: gcs_actor_manager.cc RegisterActor vs CreateActor —
         # clients poll/get with wait_alive).  Keeping PENDING visible also
         # lets the autoscaler see the actor as demand and bring capacity
-        # before the scheduling deadline.
+        # before the scheduling deadline.  Fast placements (warm worker
+        # pool) get a short grace so the common path replies ALIVE with
+        # the address inline — first-call latency matters.
         rpc.spawn(self._schedule_or_bury(actor))
+        start = time.monotonic()
+        while actor.state == protocol.ACTOR_PENDING \
+                and time.monotonic() - start < 0.4:
+            await asyncio.sleep(
+                0.01 if time.monotonic() - start < 0.2 else 0.05)
         return {"existing": False, "actor": actor.view()}
 
     async def _schedule_or_bury(self, actor: ActorInfo):
@@ -672,10 +679,11 @@ class GcsServer:
         if actor is None:
             return None
         if p.get("wait_alive") and actor.state == protocol.ACTOR_PENDING:
-            for _ in range(600):
-                if actor.state != protocol.ACTOR_PENDING:
-                    break
-                await asyncio.sleep(0.05)
+            start = time.monotonic()
+            while actor.state == protocol.ACTOR_PENDING \
+                    and time.monotonic() - start < 30.0:
+                await asyncio.sleep(
+                    0.01 if time.monotonic() - start < 0.3 else 0.05)
         return actor.view()
 
     async def h_list_actors(self, conn, p):
@@ -898,6 +906,21 @@ class GcsServer:
         return True
 
     async def h_get_placement_group(self, conn, p):
+        entry = self.placement_groups.get(p["pg_id"])
+        if entry is None or not p.get("wait_created"):
+            return entry
+        # Server-side wait: spares clients a 20ms+ first poll backoff —
+        # placement usually completes in ~1ms (reference: clients block on
+        # the CreatePlacementGroup reply / ready future).
+        deadline = time.monotonic() + min(p.get("timeout_s", 10.0), 60.0)
+        start = time.monotonic()
+        while entry["state"] == "PENDING" and time.monotonic() < deadline:
+            # Tight poll only briefly (fast placements), then back off so
+            # many waiters don't flood the control loop with wakeups.
+            await asyncio.sleep(
+                0.002 if time.monotonic() - start < 0.2 else 0.05)
+        # Removal during the wait pops the table; honor the None-means-
+        # removed contract rather than returning the orphaned entry.
         return self.placement_groups.get(p["pg_id"])
 
     async def h_list_placement_groups(self, conn, p):

@@ -75,15 +75,30 @@ def device_get(ref: DeviceRef, *, timeout: Optional[float] = 60.0):
         return arr
 
     async def _fetch():
+        # Chunked: each reply is one bounded frame (multi-GB arrays must
+        # not exceed the RPC frame cap).
         conn = await core._peer_owner(tuple(ref.owner_addr))
-        return await conn.call("device_fetch",
-                               {"object_id": ref.object_id},
-                               timeout=timeout or 60.0)
+        chunks = []
+        offset = 0
+        while True:
+            res = await conn.call(
+                "device_fetch",
+                {"object_id": ref.object_id, "offset": offset},
+                timeout=timeout or 60.0)
+            if res is None:
+                return None
+            chunks.append(res["data"])
+            offset += len(res["data"])
+            if offset >= res["total"]:
+                return {"chunks": chunks, "dtype": res["dtype"],
+                        "shape": res["shape"]}
 
     res = core._run(_fetch(), timeout=timeout)
     if res is None:
         raise KeyError("device object was freed at the owner")
-    host = np.frombuffer(res["data"], dtype=np.dtype(res["dtype"]))
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+    host = np.frombuffer(b"".join(res["chunks"]),
+                         dtype=np.dtype(res["dtype"]))
     return jnp.asarray(host.reshape(res["shape"]))
 
 

@@ -39,16 +39,24 @@ class PlacementGroup:
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         """Block until the PG is placed; False on timeout (reference:
-        PlacementGroup.wait)."""
+        PlacementGroup.wait).  The GCS long-polls server-side so the
+        common fast-placement case returns in one round trip."""
+        from .._private.worker import global_runtime
+        core = global_runtime().core
         deadline = time.monotonic() + timeout_seconds
-        delay = 0.02
+        delay = 0.05
         while time.monotonic() < deadline:
-            t = self._table()
+            left = max(0.1, deadline - time.monotonic())
+            t = core.gcs_call(
+                "get_placement_group",
+                {"pg_id": self.id, "wait_created": True,
+                 "timeout_s": min(left, 10.0)},
+                timeout=min(left, 10.0) + 30)
             if t is None:
                 return False            # removed
             if t["state"] == "CREATED":
                 return True
-            time.sleep(delay)
+            time.sleep(delay)           # infeasible-yet: gentle re-poll
             delay = min(delay * 1.5, 0.5)
         return False
 
