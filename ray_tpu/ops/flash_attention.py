@@ -346,7 +346,7 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256):
+                    block_q: int = 1024, block_k: int = 1024):
     """Public entry: q (B,S,Hq,D), k/v (B,S,Hkv,D) → (B,S,Hq,D).
 
     Dispatches to the Pallas kernel on TPU when shapes tile cleanly,
