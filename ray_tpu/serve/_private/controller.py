@@ -64,7 +64,8 @@ class ServeControllerImpl:
     # ------------------------------------------------------------ deploy ---
     async def deploy(self, name: str, blob: bytes, init_args: tuple,
                      init_kwargs: dict, num_replicas: int,
-                     ray_actor_options: Optional[dict] = None) -> bool:
+                     ray_actor_options: Optional[dict] = None,
+                     autoscaling_config: Optional[dict] = None) -> bool:
         import hashlib
         fingerprint = hashlib.sha1(
             blob + repr((init_args, init_kwargs)).encode()).hexdigest()
@@ -82,12 +83,35 @@ class ServeControllerImpl:
                         ray_tpu.kill(r)
                     except Exception:
                         pass
+        autoscale = dict(autoscaling_config) if autoscaling_config else None
+        if autoscale:
+            autoscale.setdefault("min_replicas", 1)
+            if autoscale["min_replicas"] < 1:
+                # Scale-from-zero needs handle-side queue metrics (no
+                # replica exists to report load); not supported yet.
+                raise ValueError(
+                    "autoscaling_config.min_replicas must be >= 1")
+            autoscale.setdefault("max_replicas", max(
+                autoscale["min_replicas"], int(num_replicas)))
+            autoscale.setdefault("target_ongoing_requests", 2.0)
+            autoscale.setdefault("upscale_delay_s", 0.0)
+            autoscale.setdefault("downscale_delay_s", 10.0)
+            if prev is not None and prev["fingerprint"] == fingerprint \
+                    and prev.get("autoscale") == autoscale:
+                # Unchanged redeploy keeps the autoscaled size — snapping
+                # back to min would kill busy replicas with no hysteresis.
+                num_replicas = prev["num_replicas"]
+            else:
+                num_replicas = autoscale["min_replicas"]
         self.deployments[name] = {
             "blob": blob, "init_args": init_args, "init_kwargs": init_kwargs,
             "num_replicas": int(num_replicas),
             "ray_opts": dict(ray_actor_options or {}),
             "replicas": keep,
             "fingerprint": fingerprint,
+            "autoscale": autoscale,
+            "_below_since": None,       # downscale hysteresis
+            "_above_since": None,       # upscale hysteresis
         }
         await self._reconcile_once()
         return True
@@ -130,7 +154,30 @@ class ServeControllerImpl:
                 "last_ping": getattr(self, "_last_ping", None),
                 "pings": pings,
                 "deployments": {n: len(d["replicas"])
-                                for n, d in self.deployments.items()}}
+                                for n, d in self.deployments.items()},
+                "autoscale": {n: {"cfg": d.get("autoscale"),
+                                  "target": d["num_replicas"],
+                                  "last_total": d.get("_last_total")}
+                              for n, d in self.deployments.items()}}
+
+    async def _drain_and_kill(self, replica, drain_timeout_s: float = 30.0):
+        start = time.monotonic()
+        # Routers refresh within refresh_interval_s (2s); only trust an
+        # idle reading after that window has passed, so requests routed
+        # from stale tables still land and drain.
+        while time.monotonic() - start < drain_timeout_s:
+            try:
+                ongoing = await asyncio.wait_for(
+                    replica.ongoing_requests.remote(), 5)
+            except Exception:
+                break           # already dead / unreachable
+            if ongoing == 0 and time.monotonic() - start >= 2.5:
+                break
+            await asyncio.sleep(0.5)
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
 
     async def _reconcile_once(self):
         # Serialized: deploy()/delete and the background tick would
@@ -139,10 +186,66 @@ class ServeControllerImpl:
         async with self._reconcile_lock:
             await self._reconcile_locked()
 
+    async def _autoscale(self, name: str, dep: Dict[str, Any]):
+        """Load-driven replica count (reference: autoscaling_policy.py —
+        desired = total ongoing / target, clamped, with upscale/downscale
+        delays for hysteresis)."""
+        cfg = dep["autoscale"]
+        replicas = dep["replicas"]
+        if cfg is None or not replicas:
+            return
+        async def _one(r):
+            try:
+                return float(await asyncio.wait_for(
+                    r.ongoing_requests.remote(), 5))
+            except Exception:
+                return None     # dying/stalled: health check handles it
+        metrics = await asyncio.gather(*[_one(r) for r in replicas])
+        known = [m for m in metrics if m is not None]
+        total = sum(known)
+        all_reported = len(known) == len(replicas)
+        import math
+        dep["_last_total"] = total
+        desired = math.ceil(total / max(cfg["target_ongoing_requests"],
+                                        1e-6))
+        desired = max(cfg["min_replicas"],
+                      min(cfg["max_replicas"], desired))
+        now = time.monotonic()
+        current = dep["num_replicas"]
+        if desired > current:
+            dep["_below_since"] = None
+            if dep["_above_since"] is None:
+                dep["_above_since"] = now
+            if now - dep["_above_since"] >= cfg["upscale_delay_s"]:
+                logger.info("autoscale %s: %d -> %d (ongoing=%.0f)",
+                            name, current, desired, total)
+                dep["num_replicas"] = desired
+                dep["_above_since"] = None
+        elif desired < current:
+            dep["_above_since"] = None
+            if not all_reported:
+                # Missing metrics deflate the total; never downscale on a
+                # partial view (reference: policy skips absent metrics).
+                dep["_below_since"] = None
+                return
+            if dep["_below_since"] is None:
+                dep["_below_since"] = now
+            if now - dep["_below_since"] >= cfg["downscale_delay_s"]:
+                logger.info("autoscale %s: %d -> %d (ongoing=%.0f)",
+                            name, current, desired, total)
+                dep["num_replicas"] = desired
+                dep["_below_since"] = None
+        else:
+            dep["_above_since"] = dep["_below_since"] = None
+
     async def _reconcile_locked(self):
         from .replica import ReplicaActor
         changed = False
         for name, dep in list(self.deployments.items()):
+            if dep.get("autoscale"):
+                await self._autoscale(name, dep)
+                if self.deployments.get(name) is not dep:
+                    continue
             # Health-check current replicas (reference: replica health
             # checks drive DeploymentState). Fresh replicas get a startup
             # grace window — model __init__ (e.g. TPU weight loading) can
@@ -192,15 +295,14 @@ class ServeControllerImpl:
                          dep["init_kwargs"])
                 dep["replicas"].append(actor)
                 changed = True
-            # Scale down.
+            # Scale down: remove from the table first (routers drop it on
+            # their next refresh), then drain in-flight requests before
+            # killing (reference: graceful replica shutdown).
             while len(dep["replicas"]) > dep["num_replicas"]:
                 victim = dep["replicas"].pop()
                 changed = True
                 self._forget(victim)
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
+                asyncio.ensure_future(self._drain_and_kill(victim))
         if changed:
             self._bump()
 

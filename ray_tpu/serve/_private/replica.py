@@ -9,6 +9,7 @@ and answers controller health checks.
 from __future__ import annotations
 
 import asyncio
+import functools
 import inspect
 from typing import Any, Dict, Optional
 
@@ -27,15 +28,41 @@ class ReplicaActor:
             self.callable = target(*init_args, **init_kwargs)
         else:
             self.callable = target
+        self._ongoing = 0      # in-flight requests (autoscaling metric)
+        # Sync callables execute on threads with bounded concurrency
+        # (reference: replicas run sync methods in a thread pool capped
+        # by max_ongoing_requests; user code that mutates shared state
+        # from sync methods must synchronize, same as the reference).
+        self._sync_sem = asyncio.Semaphore(16)
 
     async def handle_request(self, method: str, args: tuple,
                              kwargs: dict) -> Any:
         fn = (self.callable if method in ("__call__", "")
               else getattr(self.callable, method))
-        out = fn(*args, **kwargs)
-        if inspect.iscoroutine(out):
-            out = await out
-        return out
+        self._ongoing += 1
+        try:
+            if inspect.iscoroutinefunction(fn) or (
+                    not inspect.isfunction(fn) and not inspect.ismethod(fn)
+                    and inspect.iscoroutinefunction(
+                        getattr(fn, "__call__", None))):
+                out = await fn(*args, **kwargs)
+            else:
+                # Sync callables run off the loop so one slow request
+                # doesn't freeze the replica (metrics pings, concurrent
+                # requests keep flowing).
+                async with self._sync_sem:
+                    out = await asyncio.get_running_loop().run_in_executor(
+                        None, functools.partial(fn, *args, **kwargs))
+                if inspect.iscoroutine(out):
+                    out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def ongoing_requests(self) -> int:
+        """Autoscaling metric (reference: replica queue length stats
+        feeding autoscaling_state.py)."""
+        return self._ongoing
 
     async def ping(self) -> str:
         return "pong"

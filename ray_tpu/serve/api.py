@@ -34,17 +34,23 @@ class Application:
 class Deployment:
     def __init__(self, target: Callable, name: str, num_replicas: int = 1,
                  ray_actor_options: Optional[dict] = None,
-                 route_prefix: str = "/"):
+                 route_prefix: str = "/",
+                 autoscaling_config: Optional[dict] = None):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
         self.ray_actor_options = ray_actor_options or {}
         self.route_prefix = route_prefix
+        # {"min_replicas", "max_replicas", "target_ongoing_requests",
+        #  "upscale_delay_s", "downscale_delay_s"} (reference:
+        #  serve AutoscalingConfig, autoscaling_policy.py)
+        self.autoscaling_config = autoscaling_config
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
-                route_prefix: Optional[str] = None) -> "Deployment":
+                route_prefix: Optional[str] = None,
+                autoscaling_config: Optional[dict] = None) -> "Deployment":
         return Deployment(
             self._target,
             name=self.name if name is None else name,
@@ -54,7 +60,10 @@ class Deployment:
                                if ray_actor_options is None
                                else ray_actor_options),
             route_prefix=(self.route_prefix if route_prefix is None
-                          else route_prefix))
+                          else route_prefix),
+            autoscaling_config=(self.autoscaling_config
+                                if autoscaling_config is None
+                                else autoscaling_config))
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -68,13 +77,15 @@ class Deployment:
 def deployment(_target: Callable = None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                ray_actor_options: Optional[dict] = None,
-               route_prefix: str = "/"):
+               route_prefix: str = "/",
+               autoscaling_config: Optional[dict] = None):
     """@serve.deployment decorator (reference: serve/api.py)."""
     def deco(target):
         return Deployment(target, name or target.__name__,
                           num_replicas=num_replicas,
                           ray_actor_options=ray_actor_options,
-                          route_prefix=route_prefix)
+                          route_prefix=route_prefix,
+                          autoscaling_config=autoscaling_config)
     if _target is not None:
         return deco(_target)
     return deco
@@ -193,7 +204,8 @@ def run(app: Application, *, name: Optional[str] = None,
     blob = cloudpickle.dumps(dep._target)
     ray_tpu.get(controller.deploy.remote(
         dep_name, blob, app.init_args, app.init_kwargs,
-        dep.num_replicas, dep.ray_actor_options), timeout=120)
+        dep.num_replicas, dep.ray_actor_options,
+        dep.autoscaling_config), timeout=120)
     _routes[route_prefix or dep.route_prefix] = dep_name
     if _proxy is not None:
         ray_tpu.get(_proxy.set_routes.remote(_routes), timeout=30)
