@@ -1055,6 +1055,7 @@ class NodeAgent:
 
 
 async def _amain(args):
+    rpc.enable_eager_tasks()
     set_config(Config(json.loads(args.system_config) if args.system_config else None))
     agent = NodeAgent(
         gcs_address=json.loads(args.gcs_address),

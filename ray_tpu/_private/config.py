@@ -58,6 +58,11 @@ _define("worker_niceness", 0)
 _define("maximum_gcs_destroyed_actor_cached_count", 100_000)
 _define("task_max_retries_default", 3)
 _define("actor_max_restarts_default", 0)
+_define("actor_scheduling_timeout_s", 90.0,
+        "how long a pending actor waits for a feasible node before failing; "
+        "the deadline restarts whenever a new node registers, so autoscaler "
+        "provisioning slower than this does not kill pending actors "
+        "(reference: GcsActorScheduler queues indefinitely)")
 _define("health_check_period_ms", 1000,
         "reference: gcs_health_check_manager.h health_check_period_ms")
 _define("health_check_failure_threshold", 5)

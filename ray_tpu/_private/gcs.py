@@ -153,6 +153,9 @@ class GcsServer:
         # came back infeasible (reference: autoscaler.proto resource
         # demand in GcsAutoscalerStateManager).  reporter -> shapes+ts.
         self.demand: Dict[bytes, dict] = {}
+        # Bumped on every node registration; pending-actor scheduling resets
+        # its deadline when this moves (new capacity may fit the actor).
+        self._node_epoch = 0
         self._server = rpc.RpcServer(self._handlers(), name="gcs")
         self._health_task: Optional[asyncio.Task] = None
 
@@ -390,6 +393,7 @@ class GcsServer:
             if prev.conn is not None and not prev.conn.closed:
                 await prev.conn.close()
         self.nodes[node.node_id] = node
+        self._node_epoch += 1
         self._log("node", {
             "node_id": node.node_id, "address": list(node.address),
             "resources": node.resources_total, "labels": node.labels,
@@ -631,14 +635,26 @@ class GcsServer:
             return None
         return max(candidates, key=lambda n: sum(n.resources_available.values()))
 
-    async def _schedule_actor(self, actor: ActorInfo, timeout_s: float = 90.0
-                              ) -> bool:
+    async def _schedule_actor(self, actor: ActorInfo,
+                              timeout_s: float | None = None) -> bool:
         """Queue-until-feasible scheduling (reference: GcsActorScheduler keeps
-        pending actors and reschedules as resources free up)."""
+        pending actors and reschedules as resources free up).
+
+        The deadline restarts whenever a new node registers: cloud TPU
+        provisioning routinely exceeds the base timeout, and a node arriving
+        means the autoscaler is actively delivering the capacity this actor
+        is waiting for."""
         spec = actor.spec
+        if timeout_s is None:
+            from .config import get_config
+            timeout_s = float(get_config().actor_scheduling_timeout_s)
         deadline = time.monotonic() + timeout_s
+        epoch = self._node_epoch
         node = None
         while time.monotonic() < deadline:
+            if self._node_epoch != epoch:
+                epoch = self._node_epoch
+                deadline = time.monotonic() + timeout_s
             if actor.state not in (protocol.ACTOR_PENDING,
                                    protocol.ACTOR_RESTARTING):
                 return False        # killed while pending/restarting
@@ -935,6 +951,7 @@ class GcsServer:
 
 
 async def _amain(args):
+    rpc.enable_eager_tasks()
     server = GcsServer(port=args.port,
                        journal_path=args.journal or None)
     addr = await server.start()

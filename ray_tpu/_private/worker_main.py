@@ -24,6 +24,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Dict, Optional
 
 from . import protocol, rpc
@@ -35,6 +36,9 @@ from .shm_store import StoreFullError
 from .. import exceptions as exc
 
 logger = logging.getLogger("ray_tpu.worker")
+
+# Debug: log every push/execute with a timestamp (RAY_TPU_TRACE_EXEC=1).
+_TRACE_EXEC = bool(os.environ.get("RAY_TPU_TRACE_EXEC"))
 
 
 class Executor:
@@ -53,6 +57,12 @@ class Executor:
         self._thread_guard = threading.Lock()
         self._cancel_requested: set = set()   # cancels that arrived early
         self._cancel_intent: set = set()      # async-exc deliveries sent
+        # Default-group serial queue for sync actors (chunked execution).
+        self._serial_q: deque = deque()
+        self._serial_draining = False
+        # Normal-task queue (chunked execution under the task lock).
+        self._task_q: deque = deque()
+        self._task_draining = False
 
     # ------------------------------------------------------------ helpers ---
     async def _load_function(self, fn_id: bytes):
@@ -162,10 +172,49 @@ class Executor:
 
     # ------------------------------------------------------------ handlers --
     async def h_push_task(self, conn, spec):
-        async with self._task_lock:
-            return await self._execute(spec)
+        # Normal tasks execute one-at-a-time per worker; a burst pushed by
+        # the submitter's per-lease multi-call frame drains through the
+        # same chunked path as serial actor calls (one executor hop per
+        # chunk, replies coalesced).
+        fut = asyncio.get_running_loop().create_future()
+        self._task_q.append((spec, fut))
+        if not self._task_draining:
+            self._task_draining = True
+            rpc.spawn(self._drain_chunked(self._task_q, "_task_draining",
+                                          self._task_gate))
+        return await fut
+
+    def _task_gate(self, spec):
+        """Chunk-eligibility for a normal task: the cached sync function,
+        or None to route through the classic singleton path (cache miss —
+        _execute loads from the GCS; coroutine fn; ref args; PG-targeted
+        tasks, which need the per-task placement-group context _execute
+        installs for get_current_placement_group)."""
+        fn = self._fn_cache.get(spec.get("fn_id"))
+        strat = spec.get("scheduling_strategy") or {}
+        if (fn is None or asyncio.iscoroutinefunction(fn)
+                or strat.get("type") == "placement_group"
+                or not all("v" in e for e in spec["args"])):
+            return None
+        return fn
+
+    def _actor_gate(self, spec):
+        """Chunk-eligibility for a default-group actor call: the bound
+        sync method, or None (async method racing actor init, unknown
+        method, ref args)."""
+        if self.actor is None:
+            return None
+        m = getattr(self.actor, spec["method"], None)
+        if (m is None or asyncio.iscoroutinefunction(m)
+                or not all("v" in e for e in spec["args"])):
+            return None
+        return m
 
     async def h_push_actor_task(self, conn, spec):
+        if _TRACE_EXEC:
+            logger.warning("PUSH %s t=%.3f actor=%s groups=%s",
+                           spec.get("method"), time.monotonic(),
+                           self.actor is not None, list(self._group_sems))
         # Concurrency groups (reference: ConcurrencyGroupManager — each
         # named group has its own concurrency budget; untagged methods
         # share the default group).  A sync actor's default group is a
@@ -193,8 +242,18 @@ class Executor:
             # actor): bounded parallel execution on the thread pool.
             async with self._actor_sem:
                 return await self._execute(spec)
-        async with self._task_lock:
-            return await self._execute(spec)
+        # Default group of a serial sync actor: run through the chunked
+        # drain — a burst of queued calls executes back-to-back in ONE
+        # thread-pool hop, and their replies resolve in one loop tick (so
+        # the response frames coalesce into one socket write). Order is
+        # the FIFO arrival order, exactly as the task-lock queue gave.
+        fut = asyncio.get_running_loop().create_future()
+        self._serial_q.append((spec, fut))
+        if not self._serial_draining:
+            self._serial_draining = True
+            rpc.spawn(self._drain_chunked(self._serial_q, "_serial_draining",
+                                          self._actor_gate))
+        return await fut
 
     def _sem_for_method(self, method_name: str):
         m = getattr(type(self.actor), method_name, None)
@@ -211,6 +270,143 @@ class Executor:
                     f"concurrency_groups")
             return sem
         return self._actor_sem
+
+    def _error_reply(self, e: BaseException, tb: str | None = None) -> dict:
+        try:
+            blob = get_context().dumps_code(e)
+        except Exception:
+            blob = get_context().dumps_code(
+                exc.RayError(f"{type(e).__name__}: {e} (unpicklable)"))
+        return {"status": "error", "error": blob,
+                "traceback": tb or traceback.format_exc()}
+
+    async def _drain_chunked(self, q: deque, flag: str, gate):
+        """Chunked executor shared by serial sync-actor calls and normal
+        tasks. Calls queued in the same burst run back-to-back in ONE
+        thread-pool hop (instead of one run_in_executor round trip — two
+        GIL handoffs — per call), and their replies resolve in the same
+        loop tick so the response frames leave in one socket write.
+        Arrival order == execution order, identical to the task-lock queue
+        it replaces. gate(spec) returns the callable to run for
+        chunk-eligible specs, or None to route the spec through the
+        classic singleton _execute path."""
+        try:
+            while q:
+                chunk = []
+                while q and len(chunk) < 128:
+                    spec, fut = q[0]
+                    if gate(spec) is None:
+                        if chunk:
+                            break          # run the fast chunk first
+                        q.popleft()
+                        async with self._task_lock:
+                            reply = await self._execute(spec)
+                        if not fut.done():
+                            fut.set_result(reply)
+                        continue
+                    q.popleft()
+                    chunk.append((spec, fut))
+                if not chunk:
+                    continue
+                async with self._task_lock:
+                    replies = await self._execute_chunk(chunk, gate)
+                for (spec, fut), reply in zip(chunk, replies):
+                    if not fut.done():
+                        fut.set_result(reply)
+        finally:
+            setattr(self, flag, False)
+            if q:
+                # Items appended between the empty-check and this reset
+                # (or left behind by an exception) restart the drain.
+                setattr(self, flag, True)
+                rpc.spawn(self._drain_chunked(q, flag, gate))
+
+    async def _execute_chunk(self, chunk, resolve_fn):
+        """Execute a burst of inline-arg sync functions: per-task
+        bookkeeping matches _execute (events, cancel semantics, borrow
+        metadata), but all user functions run in a single executor
+        submission. resolve_fn maps spec -> callable (the drain gate)."""
+        loop = asyncio.get_running_loop()
+        replies: list = [None] * len(chunk)
+        runnable = []                      # (i, tid, method, args, kwargs)
+        for i, (spec, _fut) in enumerate(chunk):
+            tid = spec["task_id"]
+            if tid in self._cancel_requested:
+                self._cancel_requested.discard(tid)
+                replies[i] = {"status": "cancelled"}
+                continue
+            self.core.record_task_event(
+                tid, spec.get("name") or spec.get("method", ""), "RUNNING")
+            try:
+                args, kwargs = await self._resolve_arg_entries(spec["args"])
+                method = resolve_fn(spec)
+                if method is None:
+                    raise exc.RayError(
+                        f"chunk spec no longer resolvable: "
+                        f"{spec.get('name') or spec.get('method', '')}")
+                runnable.append((i, tid, method, args, kwargs))
+            except Exception as e:  # noqa: BLE001
+                replies[i] = self._error_reply(e)
+        if runnable:
+            def _run_all():
+                out = []
+                prev = self.core.current_task_id
+                try:
+                    for _i, tid, method, args, kwargs in runnable:
+                        if tid in self._cancel_requested:
+                            self._cancel_requested.discard(tid)
+                            out.append(("cancelled", None))
+                            continue
+                        self.core.current_task_id = tid
+                        self._running[tid] = (None, False)
+                        try:
+                            out.append(
+                                ("ok", self._run_sync(tid, method,
+                                                      args, kwargs)))
+                        except exc.TaskCancelledError:
+                            out.append(("cancelled", None))
+                        except BaseException as e:  # noqa: BLE001
+                            out.append(("error",
+                                        (e, traceback.format_exc())))
+                        finally:
+                            self._running.pop(tid, None)
+                finally:
+                    self.core.current_task_id = prev
+                return out
+
+            outcomes = await loop.run_in_executor(self.core.executor,
+                                                  _run_all)
+            for (i, tid, _m, _a, _k), (status, payload) in zip(runnable,
+                                                               outcomes):
+                spec = chunk[i][0]
+                if status == "cancelled":
+                    replies[i] = {"status": "cancelled"}
+                elif status == "error":
+                    e, tb = payload
+                    replies[i] = self._error_reply(e, tb)
+                else:
+                    prev = self.core.current_task_id
+                    self.core.current_task_id = tid
+                    try:
+                        returns = await self._serialize_returns(
+                            tid, spec["nreturns"], payload,
+                            caller_addr=spec.get("owner_addr"))
+                        await self._post_serialize(returns)
+                        reply = {"status": "ok", "returns": returns}
+                        caller = spec.get("owner_addr")
+                        if caller is not None:
+                            borrows = \
+                                self.core.reference_counter.borrowed_from(
+                                    tuple(caller))
+                            if borrows:
+                                reply["borrows"] = borrows
+                                reply["borrower_id"] = self.core.worker_id
+                        replies[i] = reply
+                    except Exception as e:  # noqa: BLE001
+                        replies[i] = self._error_reply(e)
+                    finally:
+                        self.core.current_task_id = prev
+        return replies
 
     def _run_sync(self, task_id: bytes, fn, args, kwargs):
         """Sync user code on an executor thread; the thread id is recorded so
@@ -236,6 +432,9 @@ class Executor:
                 self._running_threads.pop(task_id, None)
 
     async def _execute(self, spec):
+        if _TRACE_EXEC:
+            logger.warning("EXEC %s t=%.3f", spec.get("method")
+                           or spec.get("name"), time.monotonic())
         loop = asyncio.get_running_loop()
         prev_task_id = self.core.current_task_id
         self.core.current_task_id = spec["task_id"]
@@ -303,13 +502,7 @@ class Executor:
             # cancel_task raised inside the sync function's thread.
             return {"status": "cancelled"}
         except Exception as e:  # noqa: BLE001 — every user error is reported
-            tb = traceback.format_exc()
-            try:
-                blob = get_context().dumps_code(e)
-            except Exception:
-                blob = get_context().dumps_code(
-                    exc.RayError(f"{type(e).__name__}: {e} (unpicklable)"))
-            return {"status": "error", "error": blob, "traceback": tb}
+            return self._error_reply(e)
         finally:
             self._running.pop(spec["task_id"], None)
             self.core.current_task_id = prev_task_id
@@ -386,6 +579,11 @@ class Executor:
                     ctypes.c_ulong(tid),
                     ctypes.py_object(exc.TaskCancelledError))
                 return True
+        if task is None:
+            # Chunk-executed item between registration and thread start:
+            # mark for the pre-run check inside the chunk runner.
+            self._cancel_requested.add(task_id)
+            return True
         # Sync task dispatched to the executor but its thread hasn't begun:
         # cancelling the awaiting coroutine cancels the not-yet-started
         # pool callable too.
@@ -399,6 +597,7 @@ class Executor:
 
 
 async def amain():
+    rpc.enable_eager_tasks()
     worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
     agent_addr = json.loads(os.environ["RAY_TPU_AGENT_ADDR"])
     gcs_addr = json.loads(os.environ["RAY_TPU_GCS_ADDR"])
@@ -453,6 +652,20 @@ async def amain():
 def main():
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
     signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
+    prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER_DIR")
+    if prof_dir:
+        # Debug hook: cProfile the whole worker, dumped on exit (reference:
+        # dashboard reporter's py-spy profiling fills this role for live
+        # processes).
+        import cProfile
+        prof = cProfile.Profile()
+        prof.enable()
+        path = os.path.join(prof_dir, f"worker_{os.getpid()}.pstats")
+        import atexit
+        atexit.register(lambda: (prof.disable(), prof.dump_stats(path)))
+        signal.signal(signal.SIGTERM,
+                      lambda *a: (prof.disable(), prof.dump_stats(path),
+                                  os._exit(0)))
     try:
         asyncio.run(amain())
     except KeyboardInterrupt:

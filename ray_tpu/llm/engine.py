@@ -340,6 +340,8 @@ class LLMEngine:
         object store."""
         params = params or SamplingParams()
         S = len(prompt_tokens)
+        if S >= self.max_len:
+            raise ValueError(f"prompt ({S}) >= max_len ({self.max_len})")
         logits, ks, vs = self._run_prefill(prompt_tokens)
         first = self._sample_host(logits, params)
         return {"k": np.asarray(ks[:, :S]), "v": np.asarray(vs[:, :S]),
@@ -349,6 +351,9 @@ class LLMEngine:
                     params: Optional[SamplingParams] = None) -> List[int]:
         """Decode-node half: install a shipped prefill and run decode."""
         params = params or SamplingParams()
+        if kv_blob["len"] >= self.max_len:
+            raise ValueError(
+                f"prompt ({kv_blob['len']}) >= max_len ({self.max_len})")
         if not self._free:
             raise RuntimeError("no free slots on decode engine")
         slot = self._free.pop(0)

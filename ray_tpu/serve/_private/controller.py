@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import rpc
 
 logger = logging.getLogger("ray_tpu.serve")
 
@@ -302,7 +303,7 @@ class ServeControllerImpl:
                 victim = dep["replicas"].pop()
                 changed = True
                 self._forget(victim)
-                asyncio.ensure_future(self._drain_and_kill(victim))
+                rpc.spawn(self._drain_and_kill(victim))
         if changed:
             self._bump()
 
