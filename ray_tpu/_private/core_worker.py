@@ -86,7 +86,7 @@ class _Lease:
 class _KeyState:
     __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
                  "strategy", "runtime_env", "last_demand_report",
-                 "lease_backoff_until", "pump_scheduled")
+                 "lease_backoff_until", "pump_scheduled", "avg_task_s")
 
     def __init__(self, resources, strategy, runtime_env=None):
         self.queue: deque[_PendingTask] = deque()
@@ -98,6 +98,8 @@ class _KeyState:
         self.last_demand_report = 0.0
         self.lease_backoff_until = 0.0
         self.pump_scheduled = False
+        # EMA of push->reply latency; gates deep pipelining (see _pump).
+        self.avg_task_s: Optional[float] = None
 
 
 class _ActorState:
@@ -1014,6 +1016,10 @@ class CoreWorker:
         state.pump_scheduled = False
         self._pump(key, state)
 
+    def _note_task_latency(self, state: _KeyState, dt: float) -> None:
+        state.avg_task_s = dt if state.avg_task_s is None \
+            else 0.8 * state.avg_task_s + 0.2 * dt
+
     def _schedule_pump(self, key: bytes, state):
         """Pump at the END of the current loop tick: a burst of replies
         landing together then dispatches the next wave as per-lease
@@ -1134,17 +1140,23 @@ class CoreWorker:
         # tasks spreads across all workers before any lease pipelines a
         # second push.  While more leases are still in flight, hold at
         # depth 1 — pipelining is only for hiding RTT once the cluster
-        # has granted all the concurrency it's going to.  Once the lease
-        # pool is fully grown and the backlog still dwarfs it, deepen the
-        # pipelines so each worker receives a chunk worth amortizing (one
-        # frame, one executor hop per chunk) instead of trickling 1-3
-        # tasks per reply round trip.
+        # has granted all the concurrency it's going to.  When observed
+        # task latency is SHORT (EMA < 50ms), deepen the pipelines so each
+        # worker receives a chunk worth amortizing (one frame, one
+        # executor hop per chunk) instead of trickling 1-3 tasks per reply
+        # round trip — binding a burst of sub-50ms tasks to the granted
+        # leases costs at most a few hundred ms even if the pool later
+        # grows.  Long/unknown tasks never deep-pipeline: they must stay
+        # queued here so lease growth (and spillback to other nodes) can
+        # still spread them.
         if state.pending_lease_requests > 0:
             depth_cap = 1
-        else:
+        elif state.avg_task_s is not None and state.avg_task_s < 0.05:
             depth_cap = max(PIPELINE_DEPTH,
                             min(64, len(state.queue)
                                 // max(1, len(state.leases))))
+        else:
+            depth_cap = PIPELINE_DEPTH
         assign: Dict[int, tuple] = {}
         for depth in range(depth_cap):
             if not state.queue:
@@ -1369,6 +1381,7 @@ class CoreWorker:
         # Concurrent reply handling: a long task in the frame must not
         # delay a short one's result (see _push_actor_tasks).
         lost: list = []
+        t_push = time.monotonic()
 
         async def _one(task, fut):
             spec = task.spec
@@ -1378,10 +1391,19 @@ class CoreWorker:
             except rpc.ConnectionLost:
                 lost.append(task)
                 return
+            except Exception as e:  # dispatch-level RemoteError: fail the
+                #                     task, keep the lease slot accounted
+                self._store_task_exception(spec, exc.RayError(
+                    f"task push failed: {e}"))
+                self._release_task_pins(task)
+                lease.inflight -= 1
+                self._schedule_pump(key, state)
+                return
             finally:
                 self._inflight_tasks.pop(tid, None)
             lease.inflight -= 1
             lease.idle_since = time.monotonic()
+            self._note_task_latency(state, lease.idle_since - t_push)
             self._handle_reply(spec, task, reply)
             self._schedule_pump(key, state)
 
@@ -1443,6 +1465,7 @@ class CoreWorker:
             self._pump(key, state)
             return
         self._inflight_tasks[task_id] = lease
+        t_push = time.monotonic()
         try:
             reply = await lease.conn.call("push_task", spec)
         except rpc.ConnectionLost:
@@ -1486,6 +1509,7 @@ class CoreWorker:
             self._inflight_tasks.pop(task_id, None)
         lease.inflight -= 1
         lease.idle_since = time.monotonic()
+        self._note_task_latency(state, lease.idle_since - t_push)
         self._handle_reply(spec, task, reply)
         self._schedule_pump(key, state)
 

@@ -153,6 +153,8 @@ class GcsServer:
         # came back infeasible (reference: autoscaler.proto resource
         # demand in GcsAutoscalerStateManager).  reporter -> shapes+ts.
         self.demand: Dict[bytes, dict] = {}
+        # Created/removed wakeups for PG waiters (not journaled).
+        self._pg_events: Dict[bytes, asyncio.Event] = {}
         # Bumped on every node registration; pending-actor scheduling resets
         # its deadline when this moves (new capacity may fit the actor).
         self._node_epoch = 0
@@ -796,43 +798,50 @@ class GcsServer:
             if chosen is None:
                 await asyncio.sleep(0.2)
                 continue
-            # Phase 1: prepare on every node; roll back on any failure.
-            prepared = []
-            failed = False
-            for idx, (bundle, node) in enumerate(zip(bundles, chosen)):
+            # Phase 1: prepare on every node IN PARALLEL; roll back on any
+            # failure (a 64-bundle Train worker group pays one agent round
+            # trip, not 64).
+            async def _prepare(idx, bundle, node):
                 try:
-                    ok = await node.conn.call("prepare_bundle", {
+                    return await node.conn.call("prepare_bundle", {
                         "pg_id": pg_id, "bundle_index": idx,
                         "resources": bundle}, timeout=30)
                 except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
-                    ok = False
-                if not ok:
-                    failed = True
-                    break
-                prepared.append((idx, node))
-            if failed:
-                for idx, node in prepared:
-                    try:
-                        await node.conn.call("return_bundle", {
-                            "pg_id": pg_id, "bundle_index": idx})
-                    except rpc.RpcError:
-                        pass
+                    return False
+
+            async def _return(idx, node):
+                try:
+                    await node.conn.call("return_bundle", {
+                        "pg_id": pg_id, "bundle_index": idx})
+                except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
+                    pass
+
+            oks = await asyncio.gather(
+                *[_prepare(i, b, n)
+                  for i, (b, n) in enumerate(zip(bundles, chosen))])
+            prepared = [(i, n) for i, (ok, n) in
+                        enumerate(zip(oks, chosen)) if ok]
+            if not all(oks):
+                await asyncio.gather(*[_return(i, n) for i, n in prepared])
                 await asyncio.sleep(0.2)
                 continue
-            # Phase 2: commit; on any failure return every bundle and retry
-            # placement from scratch (a node died between prepare and commit).
-            try:
-                for idx, node in prepared:
-                    await node.conn.call("commit_bundle",
-                                         {"pg_id": pg_id, "bundle_index": idx})
-            except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
-                for idx, node in prepared:
-                    try:
-                        await node.conn.call("return_bundle", {
-                            "pg_id": pg_id, "bundle_index": idx})
-                    except (rpc.RpcError, AttributeError,
-                            asyncio.TimeoutError):
-                        pass
+            # Phase 2: commit (parallel); on any failure return every
+            # bundle and retry placement from scratch (a node died between
+            # prepare and commit).
+            async def _commit(idx, node):
+                return await node.conn.call(
+                    "commit_bundle", {"pg_id": pg_id, "bundle_index": idx})
+
+            # return_exceptions: every commit must SETTLE before any
+            # return_bundle goes out — a plain gather raises on the first
+            # failure while sibling commits are still in flight, and a
+            # commit processed after its bundle's return would leak the
+            # node's resources on the retry.
+            outcomes = await asyncio.gather(
+                *[_commit(i, n) for i, n in prepared],
+                return_exceptions=True)
+            if any(isinstance(o, BaseException) for o in outcomes):
+                await asyncio.gather(*[_return(i, n) for i, n in prepared])
                 await asyncio.sleep(0.2)
                 continue
             if entry["state"] != "PENDING":     # removed mid-placement
@@ -849,6 +858,7 @@ class GcsServer:
                 for b, n in zip(bundles, chosen)]
             entry["state"] = "CREATED"
             self._log("pg", entry)
+            self._pg_event(pg_id).set()
             self._publish(protocol.CH_PG,
                           {"event": "created", "pg_id": pg_id})
             return
@@ -910,31 +920,48 @@ class GcsServer:
         if pg is None:
             return False
         pg["state"] = "REMOVED"         # stops a pending _place_pg loop
+        ev = self._pg_events.pop(p["pg_id"], None)
+        if ev is not None:
+            ev.set()                    # wake pending waiters (-> None)
         self._log("pg_del", p["pg_id"])
-        for idx, bundle in enumerate(pg["bundles"]):
+
+        async def _return(idx, bundle):
             node = self.nodes.get(bundle["node_id"])
             if node and node.conn and not node.conn.closed:
                 try:
-                    await node.conn.call("return_bundle",
-                                         {"pg_id": p["pg_id"], "bundle_index": idx})
+                    await node.conn.call(
+                        "return_bundle",
+                        {"pg_id": p["pg_id"], "bundle_index": idx})
                 except rpc.RpcError:
                     pass
+
+        # Bundles return in parallel — removal latency is one agent round
+        # trip, not one per bundle.
+        await asyncio.gather(*[_return(i, b)
+                               for i, b in enumerate(pg["bundles"])])
         return True
+
+    def _pg_event(self, pg_id) -> asyncio.Event:
+        ev = self._pg_events.get(pg_id)
+        if ev is None:
+            ev = self._pg_events[pg_id] = asyncio.Event()
+        return ev
 
     async def h_get_placement_group(self, conn, p):
         entry = self.placement_groups.get(p["pg_id"])
         if entry is None or not p.get("wait_created"):
             return entry
-        # Server-side wait: spares clients a 20ms+ first poll backoff —
-        # placement usually completes in ~1ms (reference: clients block on
-        # the CreatePlacementGroup reply / ready future).
-        deadline = time.monotonic() + min(p.get("timeout_s", 10.0), 60.0)
-        start = time.monotonic()
-        while entry["state"] == "PENDING" and time.monotonic() < deadline:
-            # Tight poll only briefly (fast placements), then back off so
-            # many waiters don't flood the control loop with wakeups.
-            await asyncio.sleep(
-                0.002 if time.monotonic() - start < 0.2 else 0.05)
+        # Server-side event wait: the waiter wakes the moment _place_pg
+        # publishes CREATED (or removal fires the event) — no poll loop
+        # (reference: clients block on the CreatePlacementGroup reply /
+        # ready future).
+        if entry["state"] == "PENDING":
+            try:
+                await asyncio.wait_for(
+                    self._pg_event(p["pg_id"]).wait(),
+                    min(p.get("timeout_s", 10.0), 60.0))
+            except asyncio.TimeoutError:
+                pass
         # Removal during the wait pops the table; honor the None-means-
         # removed contract rather than returning the orphaned entry.
         return self.placement_groups.get(p["pg_id"])

@@ -299,8 +299,11 @@ class Executor:
                         if chunk:
                             break          # run the fast chunk first
                         q.popleft()
-                        async with self._task_lock:
-                            reply = await self._execute(spec)
+                        try:
+                            async with self._task_lock:
+                                reply = await self._execute(spec)
+                        except BaseException as e:  # noqa: BLE001
+                            reply = self._error_reply(e)
                         if not fut.done():
                             fut.set_result(reply)
                         continue
@@ -308,8 +311,14 @@ class Executor:
                     chunk.append((spec, fut))
                 if not chunk:
                     continue
-                async with self._task_lock:
-                    replies = await self._execute_chunk(chunk, gate)
+                try:
+                    async with self._task_lock:
+                        replies = await self._execute_chunk(chunk, gate)
+                except BaseException as e:  # noqa: BLE001 — an infra
+                    # failure (executor shutdown, drain cancellation) must
+                    # still resolve every popped future, or the submitter's
+                    # push RPCs hang forever with their lease slots held.
+                    replies = [self._error_reply(e)] * len(chunk)
                 for (spec, fut), reply in zip(chunk, replies):
                     if not fut.done():
                         fut.set_result(reply)

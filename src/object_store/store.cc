@@ -29,7 +29,9 @@
 #include <pthread.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <thread>
 #include <unistd.h>
+#include <vector>
 
 namespace {
 
@@ -251,6 +253,10 @@ int alloc_handle() {
 
 extern "C" {
 
+int rts_seal(int hidx, const uint8_t* id);
+int rts_release(int hidx, const uint8_t* id);
+int64_t rts_create_object(int hidx, const uint8_t* id, uint64_t size);
+
 // Create a new store file at `path` with `capacity` data bytes and
 // `table_slots` metadata slots (power of two). Returns handle >= 0 or -errno.
 int rts_create(const char* path, uint64_t capacity, uint64_t table_slots) {
@@ -386,6 +392,69 @@ int64_t rts_create_object(int hidx, const uint8_t* id, uint64_t size) {
   h.hdr->bytes_in_use += need;
   h.hdr->num_objects++;
   return (int64_t)off;
+}
+
+// One-shot put: create + populate + copy + seal + release. Called from
+// Python through ctypes (which drops the GIL), so a large memcpy no longer
+// blocks the caller's event loop; the copy itself parallelizes across
+// nthreads for big objects (a single core saturates well below memory
+// bandwidth on server parts). srcs/lens describe an iovec of source
+// buffers concatenated into the object. Returns 0 or -errno.
+// (reference: plasma CreateAndSeal fast path, object_manager/plasma/)
+int rts_put_iov(int hidx, const uint8_t* id, const uint8_t* const* srcs,
+                const uint64_t* lens, int nparts, int nthreads) {
+  Handle& h = g_handles[hidx];
+  uint64_t total = 0;
+  for (int i = 0; i < nparts; i++) total += lens[i];
+  int64_t off = rts_create_object(hidx, id, total);
+  if (off < 0) return (int)off;
+  uint8_t* dst = h.base + off;
+  if (total >= (4u << 20)) {
+    // Batch-fault the destination range in one syscall instead of taking
+    // a per-4k write fault during the copy (~3-5x faster on cold pages;
+    // no-op on already-resident ones). Ignore failures: the copy below
+    // faults pages in regardless.
+    uintptr_t a = reinterpret_cast<uintptr_t>(dst) & ~uintptr_t(4095);
+    uintptr_t e = (reinterpret_cast<uintptr_t>(dst) + total + 4095)
+                  & ~uintptr_t(4095);
+#ifdef MADV_POPULATE_WRITE
+    madvise(reinterpret_cast<void*>(a), e - a, MADV_POPULATE_WRITE);
+#endif
+  }
+  // Flatten the iovec copy into [start, end) ranges per thread.
+  const uint64_t kParallelMin = 32u << 20;
+  int nt = (total >= kParallelMin && nthreads > 1) ? nthreads : 1;
+  if (nt == 1) {
+    uint64_t pos = 0;
+    for (int i = 0; i < nparts; i++) {
+      memcpy(dst + pos, srcs[i], lens[i]);
+      pos += lens[i];
+    }
+  } else {
+    uint64_t chunk = (total + nt - 1) / nt;
+    std::vector<std::thread> ts;
+    ts.reserve(nt);
+    for (int t = 0; t < nt; t++) {
+      uint64_t begin = (uint64_t)t * chunk;
+      uint64_t end = begin + chunk < total ? begin + chunk : total;
+      if (begin >= end) break;
+      ts.emplace_back([&, begin, end]() {
+        // Copy the intersection of each source part with this thread's
+        // [begin, end) byte range of the concatenated object.
+        uint64_t pos = 0;
+        for (int i = 0; i < nparts && pos < end; i++) {
+          uint64_t s = pos > begin ? pos : begin;
+          uint64_t e2 = pos + lens[i] < end ? pos + lens[i] : end;
+          if (s < e2) memcpy(dst + s, srcs[i] + (s - pos), e2 - s);
+          pos += lens[i];
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+  }
+  int rc = rts_seal(hidx, id);
+  rts_release(hidx, id);
+  return rc == -EALREADY ? 0 : rc;
 }
 
 // Seal a created object, making it visible to Get. Returns 0 or -errno.
