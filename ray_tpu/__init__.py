@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 from . import exceptions
 from ._private import worker as _worker_mod
 from ._private.worker import init, is_initialized, shutdown
+from ._private.streaming import ObjectRefGenerator
 from .actor import ActorClass, ActorHandle
 from .object_ref import ObjectRef
 from .remote_function import RemoteFunction
@@ -30,8 +31,8 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
-    "available_resources", "ObjectRef", "ActorHandle", "exceptions",
-    "method", "timeline", "get_runtime_context",
+    "available_resources", "ObjectRef", "ObjectRefGenerator", "ActorHandle",
+    "exceptions", "method", "timeline", "get_runtime_context",
 ]
 
 
@@ -64,7 +65,8 @@ def remote(*args, **kwargs):
             return ActorClass(target, **cls_kwargs)
         fn_kwargs = {k: v for k, v in kwargs.items() if k in (
             "num_returns", "num_cpus", "num_tpus", "resources",
-            "max_retries", "scheduling_strategy", "runtime_env", "name")}
+            "max_retries", "scheduling_strategy", "runtime_env", "name",
+            "_generator_backpressure_num_objects")}
         return RemoteFunction(target, **fn_kwargs)
 
     return deco
@@ -83,6 +85,11 @@ def method(num_returns: int = 1, concurrency_group: Optional[str] = None):
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    if isinstance(refs, ObjectRefGenerator):
+        raise TypeError(
+            "ray_tpu.get() on a streaming generator: iterate it instead "
+            "(`for ref in gen: value = ray_tpu.get(ref)`), or get "
+            "gen.completed() to wait for the whole stream")
     return _core().get(refs, timeout=timeout)
 
 
@@ -113,6 +120,8 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
         logging.getLogger("ray_tpu").debug(
             "cancel(recursive=True): child-task tracking not implemented; "
             "cancelling only the target task")
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ref.completed()
     return _core().cancel(ref, force=force)
 
 

@@ -44,6 +44,7 @@ from .memory_store import MemoryStore
 from .reference_counter import ReferenceCounter
 from .serialization import get_context
 from .shm_store import ShmStore, StoreFullError
+from .streaming import ObjectRefGenerator, StreamState, item_object_id
 
 logger = logging.getLogger("ray_tpu.core_worker")
 
@@ -157,6 +158,8 @@ class CoreWorker:
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
         self._recovering: Dict[bytes, asyncio.Future] = {}
         self._cancelled: set = set()               # task ids cancelled
+        # task_id -> StreamState for in-flight streaming generators we own.
+        self._streams: Dict[bytes, StreamState] = {}
         self._inflight_tasks: Dict[bytes, _Lease] = {}        # normal tasks
         self._inflight_actor_tasks: Dict[bytes, _ActorState] = {}
         # actor_id -> future of an in-flight background registration this
@@ -236,8 +239,137 @@ class CoreWorker:
             "recover_object": self.h_recover_object,
             "device_fetch": self.h_device_fetch,
             "device_free": self.h_device_free,
+            "stream_item": self.h_stream_item,
+            "stream_end": self.h_stream_end,
             "ping": lambda conn, p: "pong",
         }
+
+    # Streaming generators (reference: _raylet.pyx:939 streaming-generator
+    # execution; see _private/streaming.py for the wire design).
+    async def h_stream_item(self, conn, p):
+        """An executing generator yielded item `index`; store it under its
+        deterministic id and ack — the ack is delayed while the consumer
+        lags more than the configured backpressure, which stalls the
+        producer's in-flight window (reference: generator_waiter.cc
+        consumed-offset watermark)."""
+        tid, idx, entry = p["task_id"], p["index"], p["entry"]
+        st = self._streams.get(tid)
+        if st is None or st.released:
+            return {"dropped": True}   # consumer released the generator
+        if p.get("attempt", 0) != st.expected_attempt:
+            return True                # straggler from a dead attempt
+        if idx < st.consumed:
+            # Retry re-delivery of an item the consumer already took: its
+            # ObjectRef (if still held) keeps the original value; if it was
+            # dropped, the object is freed — re-storing would resurrect an
+            # untracked entry that never gets released.
+            return True
+        oid = item_object_id(tid, idx)
+        first = st.item_arrived(idx)
+        if first:
+            self.reference_counter.add_owned(oid)
+            # Held by the stream until the consumer takes the item (or the
+            # generator is released) — there is no ObjectRef yet.
+            self.reference_counter.add_escape_pin(oid)
+            nested = [(bytes(noid),
+                       None if tuple(nowner) == self.address
+                       else tuple(nowner))
+                      for noid, nowner in entry.get("nested", [])]
+            for noid, nowner in nested:
+                if nowner is None:
+                    # Our own refs nested in the item: we take the pin
+                    # here, synchronously before the ack (same
+                    # reply-carried-pin protocol as _handle_reply).
+                    self.reference_counter.add_escape_pin(noid)
+            if nested:
+                self._record_contained(oid, nested, take_pins=False)
+        # Duplicates (unconsumed re-delivery after a retry) refresh the
+        # stored entry — the plasma copy moved to the new attempt's node —
+        # but never re-take ownership or nested pins.
+        if "inline" in entry:
+            self.memory_store.put_inline(oid, entry["inline"])
+        else:
+            self.memory_store.put_plasma_location(oid, entry["plasma"])
+        while (st.bp and st.unconsumed() >= st.bp and st.total is None
+               and not st.released):
+            ev = st.consume_event
+            await ev.wait()
+        return True
+
+    async def h_stream_end(self, conn, p):
+        st = self._streams.get(p["task_id"])
+        if st is not None and p.get("attempt", 0) == st.expected_attempt:
+            st.finish(p["count"], bool(p.get("errored")))
+        return True
+
+    async def stream_next_async(self, task_id: bytes):
+        st = self._streams.get(task_id)
+        if st is None:
+            return None
+        idx = await st.next_index()
+        if idx is None:
+            return None
+        oid = item_object_id(task_id, idx)
+        ref = ObjectRef(oid, self.address, worker=self)
+        # The ObjectRef's local ref now keeps the item alive; drop the
+        # stream's pin (ordered: pin released only after add_local_ref).
+        self.reference_counter.release_escape_pin(oid)
+        return ref
+
+    def stream_next(self, task_id: bytes):
+        return self._run(self.stream_next_async(task_id))
+
+    def stream_errored(self, task_id: bytes) -> bool:
+        st = self._streams.get(task_id)
+        return st is not None and st.errored
+
+    def register_stream(self, task_id: bytes, backpressure: int = 0,
+                        expected_attempt: int = 0):
+        self._streams[task_id] = StreamState(task_id, backpressure,
+                                             expected_attempt)
+
+    def release_stream(self, task_id: bytes):
+        """Drop a generator the consumer abandoned: free unconsumed items
+        and let parked producer acks return (the producer sees `dropped`
+        on its next item and stops).  Safe from any thread (__del__)."""
+        st = self._streams.get(task_id)
+        if st is None or st.released:
+            return
+        loop = self.loop
+        if loop is None or loop.is_closed():
+            return
+
+        def _release():
+            stt = self._streams.pop(task_id, None)
+            if stt is None or stt.released:
+                return
+            stt.released = True
+            stt.consume_event.set()
+            stt.event.set()
+            for idx in stt.seen:
+                if idx >= stt.consumed:
+                    oid = item_object_id(task_id, idx)
+                    self.reference_counter.release_escape_pin(oid)
+                    self.memory_store.delete(oid)
+
+        loop.call_soon_threadsafe(_release)
+
+    def _stream_reset_for_retry(self, spec):
+        if spec.get("streaming"):
+            st = self._streams.get(spec["task_id"])
+            if st is not None:
+                st.reset()   # the new attempt regenerates every item
+                # Only messages stamped with the retried attempt (the
+                # decremented retries_left) finalize the stream now.
+                st.expected_attempt = spec["retries_left"]
+
+    def _stream_on_task_failed(self, spec):
+        """Task-level failure (error reply, crash out of retries, cancel):
+        finalize so iteration drains arrived items then raises the
+        completion ref's stored exception."""
+        st = self._streams.get(spec["task_id"])
+        if st is not None and st.total is None:
+            st.finish(st.produced, errored=True)
 
     # Device-resident objects (RDT equivalent — see experimental/
     # device_objects.py; reference: gpu_object_manager).  Transfers are
@@ -922,28 +1054,45 @@ class CoreWorker:
                 self, runtime_env)
         return cached
 
+    @staticmethod
+    def _parse_streaming(num_returns, generator_backpressure):
+        """num_returns="streaming" (alias "dynamic") -> (1, streaming-spec)
+        (reference: remote_function.py:404 num_returns handling)."""
+        if not isinstance(num_returns, str):
+            return num_returns, None
+        if num_returns not in ("streaming", "dynamic"):
+            raise ValueError(
+                f"num_returns must be an int, 'streaming' or 'dynamic', "
+                f"got {num_returns!r}")
+        return 1, {"bp": int(generator_backpressure or 0)}
+
     def submit_task(self, *, fn, fn_id: Optional[bytes], args, kwargs,
-                    num_returns: int, resources: Dict[str, float],
+                    num_returns, resources: Dict[str, float],
                     max_retries: int, scheduling_strategy=None,
                     runtime_env=None, name="",
-                    fn_blob: Optional[bytes] = None) -> List[ObjectRef]:
+                    fn_blob: Optional[bytes] = None,
+                    generator_backpressure: int = 0) -> List[ObjectRef]:
+        num_returns, streaming = self._parse_streaming(
+            num_returns, generator_backpressure)
         runtime_env = self.package_runtime_env_cached(runtime_env)
         refs = self._try_submit_fast(
             fn_id=fn_id, args=args, kwargs=kwargs, num_returns=num_returns,
             resources=resources, max_retries=max_retries,
             scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env, name=name)
+            runtime_env=runtime_env, name=name, streaming=streaming)
         if refs is not None:
             return refs
         return self._run(self.submit_task_async(
             fn=fn, fn_id=fn_id, args=args, kwargs=kwargs,
             num_returns=num_returns, resources=resources,
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env, name=name, fn_blob=fn_blob))
+            runtime_env=runtime_env, name=name, fn_blob=fn_blob,
+            streaming=streaming))
 
     def _try_submit_fast(self, *, fn_id, args, kwargs, num_returns,
                          resources, max_retries, scheduling_strategy,
-                         runtime_env, name) -> Optional[List[ObjectRef]]:
+                         runtime_env, name,
+                         streaming=None) -> Optional[List[ObjectRef]]:
         """Submission hot path (reference: the Cython submit_task releases
         the GIL and never blocks on the raylet, _raylet.pyx:3432).  When
         the function is already exported and every arg inlines, the spec
@@ -986,12 +1135,16 @@ class CoreWorker:
             owner_addr=list(self.address), resources=resources,
             retries_left=max_retries,
             scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env, name=name)
+            runtime_env=runtime_env, name=name, streaming=streaming)
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             self.reference_counter.add_owned(oid, lineage=spec)
             refs.append(ObjectRef(oid, self.address, worker=self))
+        if streaming is not None:
+            self.register_stream(task_id, streaming["bp"],
+                                 expected_attempt=max_retries)
+            refs = [ObjectRefGenerator(self, task_id, refs[0])]
         key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
                                       runtime_env)
 
@@ -1031,7 +1184,13 @@ class CoreWorker:
     async def submit_task_async(self, *, fn, fn_id, args, kwargs, num_returns,
                                 resources, max_retries,
                                 scheduling_strategy=None, runtime_env=None,
-                                name="", fn_blob=None) -> List[ObjectRef]:
+                                name="", fn_blob=None,
+                                streaming=None,
+                                generator_backpressure: int = 0
+                                ) -> List[ObjectRef]:
+        if streaming is None:
+            num_returns, streaming = self._parse_streaming(
+                num_returns, generator_backpressure)
         if fn_id is None or fn_id not in self._fn_cache:
             fn_id = await self._export_function(fn, fn_id=fn_id,
                                                 blob=fn_blob)
@@ -1043,12 +1202,16 @@ class CoreWorker:
             args=arg_entries, nreturns=num_returns, owner_addr=list(self.address),
             resources=resources, retries_left=max_retries,
             scheduling_strategy=scheduling_strategy, runtime_env=runtime_env,
-            name=name or getattr(fn, "__name__", ""))
+            name=name or getattr(fn, "__name__", ""), streaming=streaming)
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             self.reference_counter.add_owned(oid, lineage=spec)
             refs.append(ObjectRef(oid, self.address, worker=self))
+        if streaming is not None:
+            self.register_stream(task_id, streaming["bp"],
+                                 expected_attempt=max_retries)
+            refs = [ObjectRefGenerator(self, task_id, refs[0])]
         for oid in ref_args:
             self.reference_counter.add_submitted(oid)
         key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
@@ -1439,6 +1602,7 @@ class CoreWorker:
                 self._cancelled.discard(tid)
             elif spec["retries_left"] > 0:
                 spec["retries_left"] -= 1
+                self._stream_reset_for_retry(spec)
                 state.queue.append(task)
             else:
                 if fate and fate.get("oom_killed"):
@@ -1481,6 +1645,7 @@ class CoreWorker:
                 self._cancelled.discard(task_id)
             elif spec["retries_left"] > 0:
                 spec["retries_left"] -= 1
+                self._stream_reset_for_retry(spec)
                 state.queue.append(task)
             else:
                 # Triage the crash with the worker's agent: an OOM kill
@@ -1594,6 +1759,8 @@ class CoreWorker:
             oid = ObjectID.for_task_return(
                 TaskID(spec["task_id"]), i + 1).binary()
             self.memory_store.put_inline(oid, data, is_exception=True)
+        if spec.get("streaming"):
+            self._stream_on_task_failed(spec)
 
     # -------------------------------------------------------------- cancel ---
     def cancel(self, ref: ObjectRef, force: bool = False):
@@ -1815,7 +1982,8 @@ class CoreWorker:
                     e["ref"][2] = list(self.agent_address)
 
     def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
-                          num_returns: int, max_task_retries: int = 0
+                          num_returns, max_task_retries: int = 0,
+                          generator_backpressure: int = 0
                           ) -> List[ObjectRef]:
         """Sync-safe from ANY thread, including the event loop (async actor
         methods submitting to other actors — e.g. a Serve controller
@@ -1825,6 +1993,8 @@ class CoreWorker:
         itself run as a scheduled coroutine."""
         if self.loop is None:
             raise RuntimeError("core worker not started")
+        num_returns, streaming = self._parse_streaming(
+            num_returns, generator_backpressure)
         state = self._actors.get(actor_id)
         if state is None:
             state = self._actors.setdefault(actor_id, _ActorState(actor_id))
@@ -1838,12 +2008,17 @@ class CoreWorker:
             task_id=task_id, job_id=self.job_id, fn_id=b"", args=entries,
             nreturns=num_returns, owner_addr=list(self.address), resources={},
             retries_left=max_task_retries,
-            actor_id=actor_id, method=method, seq=seq, name=method)
+            actor_id=actor_id, method=method, seq=seq, name=method,
+            streaming=streaming)
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             self.reference_counter.add_owned(oid)
             refs.append(ObjectRef(oid, self.address, worker=self))
+        if streaming is not None:
+            self.register_stream(task_id, streaming["bp"],
+                                 expected_attempt=max_task_retries)
+            refs = [ObjectRefGenerator(self, task_id, refs[0])]
         task = _PendingTask(spec, ref_args, borrowed_args)
         self.record_task_event(task_id, method, "SUBMITTED")
 
@@ -1872,11 +2047,13 @@ class CoreWorker:
         self.loop.call_soon(_kick)
 
     async def submit_actor_task_async(self, *, actor_id, method, args, kwargs,
-                                      num_returns, max_task_retries: int = 0
+                                      num_returns, max_task_retries: int = 0,
+                                      generator_backpressure: int = 0
                                       ) -> List[ObjectRef]:
         return self.submit_actor_task(
             actor_id=actor_id, method=method, args=args, kwargs=kwargs,
-            num_returns=num_returns, max_task_retries=max_task_retries)
+            num_returns=num_returns, max_task_retries=max_task_retries,
+            generator_backpressure=generator_backpressure)
 
     _ACTOR_PUSH_BATCH = 256
 
@@ -2091,6 +2268,7 @@ class CoreWorker:
                     self._cancelled.discard(tid)
                 elif spec["retries_left"] > 0:
                     spec["retries_left"] -= 1
+                    self._stream_reset_for_retry(spec)
                     retry.append((spec, task))
                 else:
                     if death_cause is None:
@@ -2141,6 +2319,7 @@ class CoreWorker:
                     return
                 if spec["retries_left"] > 0:
                     spec["retries_left"] -= 1
+                    self._stream_reset_for_retry(spec)
                     continue
                 cause = await self._actor_death_cause(state.actor_id)
                 self._store_task_exception(spec, exc.ActorDiedError(

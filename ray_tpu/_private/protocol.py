@@ -41,6 +41,7 @@ def make_task_spec(
     scheduling_strategy: Optional[dict] = None,
     runtime_env: Optional[dict] = None,
     name: str = "",
+    streaming: Optional[dict] = None,
 ) -> dict:
     """Equivalent of the reference's TaskSpecification (common/task/).
 
@@ -63,6 +64,9 @@ def make_task_spec(
         "scheduling_strategy": scheduling_strategy,
         "runtime_env": runtime_env,
         "name": name,
+        # {"bp": N} for streaming-generator tasks (num_returns="streaming");
+        # absent/None for regular tasks.
+        "streaming": streaming,
     }
 
 

@@ -1,0 +1,192 @@
+"""Streaming generators: tasks that yield a stream of objects.
+
+Equivalent of the reference's streaming generator machinery
+(reference: python/ray/remote_function.py:404-410 num_returns="streaming",
+python/ray/_raylet.pyx:939 streaming-generator execution context,
+python/ray/_private/streaming_generator.py ObjectRefGenerator): a task or
+actor method declared with ``num_returns="streaming"`` returns an
+:class:`ObjectRefGenerator` immediately; each value the remote generator
+yields becomes its own owner-owned object the caller can consume while the
+task is still running.
+
+Wire design (TPU-native, no Cython): the executing worker streams each
+yielded item to the owner as a ``stream_item`` RPC over the worker→owner
+peer connection (the same socket the borrow/escape protocol rides), with a
+small in-flight window for pipelining, and finishes with an ordered
+``stream_end``.  Caller-side backpressure is the *ack*: when the consumer
+lags more than ``_generator_backpressure_num_objects`` items, the owner
+simply delays the stream_item reply, which stalls the producer's window —
+no separate credit channel (reference: generator_waiter.cc waits on a
+consumed-offset watermark; the delayed ack is the same watermark folded
+into the RPC we already send).
+
+Item ``i`` (0-based) is stored under return index ``i + 2`` of the task —
+index 1 is the generator's *completion* object, which resolves (via the
+normal push-task reply path) to None on success or the task's exception,
+so ``gen.completed()`` composes with get/wait like any ref.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Set
+
+from .ids import ObjectID, TaskID
+
+# Item i of task T lives at return index i + _ITEM_BASE (index 1 is the
+# completion ref).
+_ITEM_BASE = 2
+
+
+def item_object_id(task_id: bytes, index: int) -> bytes:
+    return ObjectID.for_task_return(TaskID(task_id),
+                                    index + _ITEM_BASE).binary()
+
+
+class StreamState:
+    """Owner-side bookkeeping for one in-flight streaming generator."""
+
+    __slots__ = ("task_id", "ready", "seen", "consumed", "produced",
+                 "total", "errored", "event", "consume_event", "bp",
+                 "released", "expected_attempt")
+
+    def __init__(self, task_id: bytes, backpressure: int = 0,
+                 expected_attempt: int = 0):
+        self.task_id = task_id
+        self.ready: Set[int] = set()    # arrived, unconsumed item indices
+        self.seen: Set[int] = set()     # every index ever pinned (survives
+        #                                 retry resets: pins are not retaken)
+        self.consumed = 0               # next index the consumer takes
+        self.produced = 0               # high-water arrival mark this attempt
+        self.total: Optional[int] = None
+        self.errored = False            # stream ended with an error: the
+        #                                 completion ref holds the exception
+        self.event = asyncio.Event()          # producer -> consumer wakeup
+        self.consume_event = asyncio.Event()  # consumer -> delayed-ack wakeup
+        self.bp = backpressure
+        self.released = False
+        # Messages are stamped with the producing attempt (the spec's
+        # retries_left at dispatch); a stale stream_end from a dead attempt
+        # must not finalize the retried attempt's stream.
+        self.expected_attempt = expected_attempt
+
+    # ------------------------------------------------------------ producer --
+    def unconsumed(self) -> int:
+        return self.produced - self.consumed
+
+    def item_arrived(self, index: int) -> bool:
+        """Record arrival; True if this index needs pinning (first sight)."""
+        first = index not in self.seen
+        self.seen.add(index)
+        if index >= self.consumed:
+            self.ready.add(index)
+        self.produced = max(self.produced, index + 1)
+        self.event.set()
+        return first
+
+    def finish(self, total: int, errored: bool) -> None:
+        self.total = total
+        self.errored = errored
+        self.event.set()
+        # Unblock any ack parked on backpressure: the stream is over.
+        self.consume_event.set()
+
+    def reset(self) -> None:
+        """The executing worker died and the task is being retried: the new
+        attempt regenerates every item from 0 (reference: streaming tasks
+        re-execute whole on retry).  Consumed refs stay consumed — the
+        re-arriving items simply refresh their stored values under the same
+        deterministic ids."""
+        self.ready.clear()
+        self.produced = self.consumed
+        self.total = None
+        self.errored = False
+        self.consume_event.set()
+
+    # ------------------------------------------------------------ consumer --
+    async def next_index(self) -> Optional[int]:
+        """Index of the next ready item, or None when the stream is
+        exhausted (caller then raises StopIteration or fetches the
+        completion ref's exception if `errored`)."""
+        while True:
+            if self.consumed in self.ready:
+                idx = self.consumed
+                self.ready.discard(idx)
+                self.consumed += 1
+                # Wake delayed acks; re-arm so the next over-budget item
+                # parks again.
+                self.consume_event.set()
+                self.consume_event = asyncio.Event()
+                return idx
+            if self.total is not None and self.consumed >= self.total:
+                return None
+            if self.total is not None and self.errored:
+                # Errored stream with a delivery gap: in-flight emissions
+                # were cancelled when the generator raised, so items past
+                # the gap will never arrive — end iteration here (the
+                # consumer then raises the completion ref's exception).
+                return None
+            self.event.clear()
+            await self.event.wait()
+
+
+class ObjectRefGenerator:
+    """Caller-side handle to a streaming generator task (reference:
+    python/ray/_private/streaming_generator.py ObjectRefGenerator —
+    usable as both a sync and an async iterator; each __next__ returns an
+    ObjectRef that is already resolvable locally)."""
+
+    def __init__(self, core, task_id: bytes, completed_ref):
+        self._core = core
+        self._task_id = task_id
+        self._completed_ref = completed_ref
+
+    # -------------------------------------------------------------- iter ----
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self._core.stream_next(self._task_id)
+        if ref is None:
+            self._raise_if_errored_sync()
+            raise StopIteration
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        ref = await self._core.stream_next_async(self._task_id)
+        if ref is None:
+            await self._raise_if_errored_async()
+            raise StopAsyncIteration
+        return ref
+
+    # ------------------------------------------------------------ control ---
+    def completed(self):
+        """Ref that resolves when the generator finishes (None on success,
+        raises the task's exception on failure)."""
+        return self._completed_ref
+
+    def task_id(self) -> bytes:
+        return self._task_id
+
+    def _raise_if_errored_sync(self):
+        if self._core.stream_errored(self._task_id):
+            # The completion ref holds the exception; get() raises it.
+            self._core.get([self._completed_ref], timeout=30)
+
+    async def _raise_if_errored_async(self):
+        if self._core.stream_errored(self._task_id):
+            await self._core.get_async(self._completed_ref, timeout=30)
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()})"
+
+    def __del__(self):
+        core = self._core
+        if core is not None:
+            try:
+                core.release_stream(self._task_id)
+            except Exception:
+                pass

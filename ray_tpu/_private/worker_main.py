@@ -111,13 +111,48 @@ class Executor:
                 args.append(val)
         return args, kwargs
 
+    async def _serialize_value(self, oid: bytes, value, caller_addr=None):
+        """Serialize ONE return/stream-item value into a reply entry:
+        small -> inline bytes, large -> local shared-memory store via the
+        create-backpressure path (reference: core_worker.h:1045
+        AllocateReturnObject — same split).  The caller must pass the
+        entry through _post_serialize to pin any plasma copy."""
+        ctx = get_context()
+        ctx.capture = captured = []
+        try:
+            parts = ctx.serialize(value)
+        finally:
+            ctx.capture = None
+        size = ctx.total_size(parts)
+        # The serializer takes the nested-ref pins NOW — synchronously
+        # for objects this worker owns (no unpinned window between the
+        # reply and the submitter's bookkeeping). Refs owned by the
+        # CALLER are deliberately NOT pinned here: an escape_pin notify
+        # travels a different socket than the push reply and can lose
+        # the race against the caller releasing its submitted arg pins,
+        # freeing the object mid-handoff — instead the caller takes
+        # those pins itself, synchronously, from the reply's `nested`
+        # metadata (see _handle_reply). Third-party owners get the
+        # notify (sent before the reply, tiny residual window).
+        for noid, nowner in captured:
+            if nowner is None:
+                self.core.reference_counter.add_escape_pin(noid)
+            elif caller_addr is None or tuple(nowner) != tuple(caller_addr):
+                self.core._notify_owner(nowner, "escape_pin", noid)
+        nested = [[noid, list(nowner) if nowner else
+                   list(self.core.address)]
+                  for noid, nowner in captured]
+        if size <= self.core._inline_limit:
+            entry = {"inline": protocol.concat_parts(parts)}
+        else:
+            await self.core.store_with_backpressure(oid, parts)
+            entry = {"plasma": list(self.core.agent_address), "pin": oid}
+        if nested:
+            entry["nested"] = nested
+        return entry
+
     async def _serialize_returns(self, task_id: bytes, nreturns: int, result,
                                  caller_addr=None):
-        """Small returns inline in the reply; large ones go to the local
-        shared-memory store — through the create-backpressure path, so a
-        return that doesn't fit spills like a put would — with the agent
-        pinning the primary copy (reference: core_worker.h:1045
-        AllocateReturnObject — same split)."""
         if nreturns == 1:
             results = [result]
         else:
@@ -126,42 +161,10 @@ class Executor:
                 raise ValueError(
                     f"task declared {nreturns} returns but produced "
                     f"{len(results)}")
-        ctx = get_context()
         out = []
         for i, value in enumerate(results):
-            ctx.capture = captured = []
-            try:
-                parts = ctx.serialize(value)
-            finally:
-                ctx.capture = None
-            size = ctx.total_size(parts)
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
-            # The serializer takes the nested-ref pins NOW — synchronously
-            # for objects this worker owns (no unpinned window between the
-            # reply and the submitter's bookkeeping). Refs owned by the
-            # CALLER are deliberately NOT pinned here: an escape_pin notify
-            # travels a different socket than the push reply and can lose
-            # the race against the caller releasing its submitted arg pins,
-            # freeing the object mid-handoff — instead the caller takes
-            # those pins itself, synchronously, from the reply's `nested`
-            # metadata (see _handle_reply). Third-party owners get the
-            # notify (sent before the reply, tiny residual window).
-            for noid, nowner in captured:
-                if nowner is None:
-                    self.core.reference_counter.add_escape_pin(noid)
-                elif caller_addr is None or tuple(nowner) != tuple(caller_addr):
-                    self.core._notify_owner(nowner, "escape_pin", noid)
-            nested = [[noid, list(nowner) if nowner else
-                       list(self.core.address)]
-                      for noid, nowner in captured]
-            if size <= self.core._inline_limit:
-                entry = {"inline": protocol.concat_parts(parts)}
-            else:
-                await self.core.store_with_backpressure(oid, parts)
-                entry = {"plasma": list(self.core.agent_address), "pin": oid}
-            if nested:
-                entry["nested"] = nested
-            out.append(entry)
+            out.append(await self._serialize_value(oid, value, caller_addr))
         return out
 
     async def _post_serialize(self, entries):
@@ -193,6 +196,7 @@ class Executor:
         fn = self._fn_cache.get(spec.get("fn_id"))
         strat = spec.get("scheduling_strategy") or {}
         if (fn is None or asyncio.iscoroutinefunction(fn)
+                or spec.get("streaming")
                 or strat.get("type") == "placement_group"
                 or not all("v" in e for e in spec["args"])):
             return None
@@ -202,7 +206,7 @@ class Executor:
         """Chunk-eligibility for a default-group actor call: the bound
         sync method, or None (async method racing actor init, unknown
         method, ref args)."""
-        if self.actor is None:
+        if self.actor is None or spec.get("streaming"):
             return None
         m = getattr(self.actor, spec["method"], None)
         if (m is None or asyncio.iscoroutinefunction(m)
@@ -440,6 +444,120 @@ class Executor:
             with self._thread_guard:
                 self._running_threads.pop(task_id, None)
 
+    # ------------------------------------------------- streaming generators --
+    # In-flight stream_item calls per generator: pipelines item delivery
+    # (hides per-item RTT) while bounding worker-side buffering.  Consumer
+    # backpressure composes with it: the owner parks over-budget acks,
+    # which stalls this window (reference: _raylet.pyx:939 streaming
+    # execution + generator_waiter.cc).
+    _STREAM_WINDOW = 8
+
+    class _StreamDropped(Exception):
+        """Owner released the generator: stop producing."""
+
+    async def _run_streaming(self, spec, fn, args, kwargs):
+        """Execute a generator task, shipping each yielded value to the
+        owner as its own object (see core_worker.h_stream_item).  Returns
+        None — the completion object's value (reference:
+        remote_function.py:404 num_returns='streaming')."""
+        tid = spec["task_id"]
+        loop = asyncio.get_running_loop()
+        conn = await self.core._peer_owner(tuple(spec["owner_addr"]))
+        progress = [0]     # item count, shared with the sync-thread driver
+        try:
+            import inspect
+            if inspect.isasyncgenfunction(fn):
+                self._running[tid] = (asyncio.current_task(), True)
+                agen = fn(*args, **kwargs)
+                pending: deque = deque()
+                try:
+                    async for val in agen:
+                        fut = rpc.spawn(self._emit_stream_item(
+                            conn, spec, progress[0], val))
+                        progress[0] += 1
+                        pending.append(fut)
+                        while len(pending) >= self._STREAM_WINDOW:
+                            await pending.popleft()
+                    while pending:
+                        await pending.popleft()
+                finally:
+                    # Settle stragglers so an exception above doesn't leave
+                    # unretrieved task errors behind.
+                    for fut in pending:
+                        fut.cancel()
+                    await asyncio.gather(*pending, return_exceptions=True)
+            else:
+                gen = fn(*args, **kwargs)
+                if not hasattr(gen, "__iter__"):
+                    raise exc.RayError(
+                        f"num_returns='streaming' requires a generator "
+                        f"function, got {type(gen).__name__}")
+                self._running[tid] = (asyncio.current_task(), False)
+                await loop.run_in_executor(
+                    self.core.executor,
+                    lambda: self._run_sync(
+                        tid, self._drive_sync_gen,
+                        (gen, spec, conn, loop, progress), {}))
+        except self._StreamDropped:
+            # Consumer abandoned the stream mid-flight: finish quietly
+            # (the completion ref still resolves to None).
+            return None
+        except BaseException:
+            # Ordered after every delivered item on the same socket, so the
+            # owner finalizes with an accurate count before the error reply
+            # (which travels the push connection) resolves the completion
+            # ref to the exception.
+            try:
+                await conn.call("stream_end", {
+                    "task_id": tid, "count": progress[0], "errored": True,
+                    "attempt": spec.get("retries_left", 0)})
+            except (rpc.RpcError, asyncio.TimeoutError):
+                pass
+            raise
+        await conn.call("stream_end", {
+            "task_id": tid, "count": progress[0],
+            "attempt": spec.get("retries_left", 0)})
+        return None
+
+    def _drive_sync_gen(self, gen, spec, conn, loop, progress):
+        """Iterate a sync generator on an executor thread; each yield hands
+        the value to the loop for serialization + delivery (serialization
+        context is loop-confined), blocking only when the in-flight window
+        fills.  Runs under _run_sync, so cancel_task's async-exc lands
+        between yields exactly like it lands in a plain sync task."""
+        window: deque = deque()
+
+        def _drain_one():
+            cf = window.popleft()
+            err = cf.exception()   # blocks; surfaces _StreamDropped/conn loss
+            if err is not None:
+                raise err
+        try:
+            for val in gen:
+                cf = asyncio.run_coroutine_threadsafe(
+                    self._emit_stream_item(conn, spec, progress[0], val),
+                    loop)
+                progress[0] += 1
+                window.append(cf)
+                while len(window) >= self._STREAM_WINDOW:
+                    _drain_one()
+            while window:
+                _drain_one()
+        finally:
+            gen.close()
+
+    async def _emit_stream_item(self, conn, spec, index: int, value):
+        from .streaming import item_object_id
+        oid = item_object_id(spec["task_id"], index)
+        entry = await self._serialize_value(oid, value,
+                                            caller_addr=spec.get("owner_addr"))
+        await self._post_serialize([entry])
+        reply = await conn.call("stream_item", {
+            "task_id": spec["task_id"], "index": index, "entry": entry,
+            "attempt": spec.get("retries_left", 0)})
+        if isinstance(reply, dict) and reply.get("dropped"):
+            raise self._StreamDropped()
+
     async def _execute(self, spec):
         if _TRACE_EXEC:
             logger.warning("EXEC %s t=%.3f", spec.get("method")
@@ -473,7 +591,10 @@ class Executor:
                 if self.actor is None:
                     raise exc.RayError("actor task on non-actor worker")
                 method = getattr(self.actor, spec["method"])
-                if asyncio.iscoroutinefunction(method):
+                if spec.get("streaming"):
+                    result = await self._run_streaming(spec, method,
+                                                       args, kwargs)
+                elif asyncio.iscoroutinefunction(method):
                     result = await method(*args, **kwargs)
                 else:
                     self._running[tid] = (asyncio.current_task(), False)
@@ -482,10 +603,13 @@ class Executor:
                         lambda: self._run_sync(tid, method, args, kwargs))
             else:
                 fn = await self._load_function(spec["fn_id"])
-                self._running[tid] = (asyncio.current_task(), False)
-                result = await loop.run_in_executor(
-                    self.core.executor,
-                    lambda: self._run_sync(tid, fn, args, kwargs))
+                if spec.get("streaming"):
+                    result = await self._run_streaming(spec, fn, args, kwargs)
+                else:
+                    self._running[tid] = (asyncio.current_task(), False)
+                    result = await loop.run_in_executor(
+                        self.core.executor,
+                        lambda: self._run_sync(tid, fn, args, kwargs))
             returns = await self._serialize_returns(
                 spec["task_id"], spec["nreturns"], result,
                 caller_addr=spec.get("owner_addr"))

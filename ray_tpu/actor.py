@@ -13,13 +13,16 @@ from ._private.ids import ActorID, JobID
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, generator_backpressure: int = 0):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
-    def options(self, num_returns: int = 1, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1,
+                _generator_backpressure_num_objects: int = 0, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           _generator_backpressure_num_objects)
 
     def remote(self, *args, **kwargs):
         from ._private.worker import global_runtime
@@ -27,8 +30,12 @@ class ActorMethod:
         refs = core.submit_actor_task(
             actor_id=self._handle._actor_id, method=self._method_name,
             args=args, kwargs=kwargs, num_returns=self._num_returns,
-            max_task_retries=self._handle._max_task_retries)
-        return refs[0] if self._num_returns == 1 else refs
+            max_task_retries=self._handle._max_task_retries,
+            generator_backpressure=self._generator_backpressure)
+        # num_returns="streaming" yields a single ObjectRefGenerator.
+        if self._num_returns == 1 or isinstance(self._num_returns, str):
+            return refs[0]
+        return refs
 
     def bind(self, *args, **kwargs):
         """Author a compiled-graph node (reference: dag/class_node.py

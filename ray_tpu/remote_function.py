@@ -13,9 +13,18 @@ from ._private.serialization import get_context
 class RemoteFunction:
     def __init__(self, fn, *, num_returns=1, num_cpus=1, num_tpus=0,
                  resources=None, max_retries=None, scheduling_strategy=None,
-                 runtime_env=None, name=None):
+                 runtime_env=None, name=None,
+                 _generator_backpressure_num_objects=0):
         self._fn = fn
+        import inspect
+        if num_returns == 1 and (inspect.isgeneratorfunction(fn)
+                                 or inspect.isasyncgenfunction(fn)):
+            # Generator functions stream by default (reference:
+            # remote_function.py:404 — generators return an
+            # ObjectRefGenerator unless num_returns overrides).
+            num_returns = "streaming"
         self._num_returns = num_returns
+        self._generator_backpressure = _generator_backpressure_num_objects
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus
         self._resources = dict(resources or {})
@@ -38,7 +47,8 @@ class RemoteFunction:
             num_tpus=self._num_tpus, resources=self._resources,
             max_retries=self._max_retries,
             scheduling_strategy=self._scheduling_strategy,
-            runtime_env=self._runtime_env, name=self._name)
+            runtime_env=self._runtime_env, name=self._name,
+            _generator_backpressure_num_objects=self._generator_backpressure)
         merged.update(overrides)
         return RemoteFunction(self._fn, **merged)
 
@@ -71,5 +81,9 @@ class RemoteFunction:
             max_retries=max_retries,
             scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
             runtime_env=self._runtime_env, name=self._name,
-            fn_blob=self._export_blob)
-        return refs[0] if self._num_returns == 1 else refs
+            fn_blob=self._export_blob,
+            generator_backpressure=self._generator_backpressure)
+        # num_returns="streaming" yields a single ObjectRefGenerator.
+        if self._num_returns == 1 or isinstance(self._num_returns, str):
+            return refs[0]
+        return refs
