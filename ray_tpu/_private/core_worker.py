@@ -170,6 +170,12 @@ class CoreWorker:
         # FlushEvents). Bounded: drops oldest under pressure.
         self._task_events: deque = deque(maxlen=10000)
         self._seq_lock = threading.Lock()   # seq/put-id minting, any thread
+        # Cross-thread submission mailbox: caller threads append closures
+        # and schedule ONE loop wakeup per burst instead of one
+        # call_soon_threadsafe (self-pipe write + epoll wake) per call —
+        # the dominant submit-side syscall cost under task fan-out.
+        self._mailbox: deque = deque()
+        self._mailbox_scheduled = False
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -452,6 +458,31 @@ class CoreWorker:
         """ensure_future with a strong reference held until completion."""
         return rpc.spawn(coro)
 
+    def _post_to_loop(self, fn) -> None:
+        """Run `fn` on the event loop, coalescing a burst of cross-thread
+        posts into one loop wakeup.  deque.append is GIL-atomic; the
+        flag race (append landing as the drain exits) is closed by the
+        drain's re-check."""
+        self._mailbox.append(fn)
+        if not self._mailbox_scheduled:
+            self._mailbox_scheduled = True
+            self.loop.call_soon_threadsafe(self._drain_mailbox)
+
+    def _drain_mailbox(self) -> None:
+        mb = self._mailbox
+        while mb:
+            try:
+                mb.popleft()()
+            except IndexError:
+                break
+            except Exception:
+                logger.exception("mailbox callback failed")
+        self._mailbox_scheduled = False
+        if mb:
+            # An append raced the flag reset: make sure it runs.
+            self._mailbox_scheduled = True
+            self.loop.call_soon(self._drain_mailbox)
+
     async def _agent_list_objects(self, agent_addr: tuple,
                                   limit: int = 10_000):
         conn = await rpc.connect(agent_addr, name="cw->agent-state",
@@ -602,7 +633,38 @@ class CoreWorker:
 
     # ------------------------------------------------------------- put/get --
     def put(self, value: Any) -> ObjectRef:
+        ref = self._try_put_fast(value)
+        if ref is not None:
+            return ref
         return self._run(self.put_async(value))
+
+    def _try_put_fast(self, value: Any) -> Optional[ObjectRef]:
+        """Small-value put entirely on the calling thread (reference: the
+        Cython put path releases the GIL and never waits on the raylet for
+        inline objects).  A freshly minted id can have no waiters, plasma
+        isn't touched, and the serialization capture is thread-local —
+        so no loop round trip (run_coroutine_threadsafe + queue wait) is
+        needed.  Values with nested refs or above the inline limit take
+        the async path (plasma / containment bookkeeping live there)."""
+        approx = (len(value) if isinstance(value, (bytes, bytearray, str))
+                  else getattr(value, "nbytes", 0))
+        if approx > self._inline_limit:
+            return None
+        cfg = get_config()
+        if not cfg.put_small_object_in_memory_store:
+            return None
+        ctx = get_context()
+        ctx.capture = captured = []
+        try:
+            parts = ctx.serialize(value)
+        finally:
+            ctx.capture = None
+        if captured or ctx.total_size(parts) > self._inline_limit:
+            return None
+        oid = self._next_put_id()
+        self.reference_counter.add_owned(oid)
+        self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+        return ObjectRef(oid, self.address, worker=self)
 
     def _next_put_id(self) -> bytes:
         # Minted from the driver thread (submit_actor_task) and the loop
@@ -1071,15 +1133,19 @@ class CoreWorker:
                     max_retries: int, scheduling_strategy=None,
                     runtime_env=None, name="",
                     fn_blob: Optional[bytes] = None,
-                    generator_backpressure: int = 0) -> List[ObjectRef]:
+                    generator_backpressure: int = 0,
+                    sched_key: Optional[bytes] = None) -> List[ObjectRef]:
         num_returns, streaming = self._parse_streaming(
             num_returns, generator_backpressure)
-        runtime_env = self.package_runtime_env_cached(runtime_env)
+        if sched_key is None:
+            # Caller didn't pre-package: do it here (memoized).
+            runtime_env = self.package_runtime_env_cached(runtime_env)
         refs = self._try_submit_fast(
             fn_id=fn_id, args=args, kwargs=kwargs, num_returns=num_returns,
             resources=resources, max_retries=max_retries,
             scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env, name=name, streaming=streaming)
+            runtime_env=runtime_env, name=name, streaming=streaming,
+            sched_key=sched_key)
         if refs is not None:
             return refs
         return self._run(self.submit_task_async(
@@ -1091,8 +1157,8 @@ class CoreWorker:
 
     def _try_submit_fast(self, *, fn_id, args, kwargs, num_returns,
                          resources, max_retries, scheduling_strategy,
-                         runtime_env, name,
-                         streaming=None) -> Optional[List[ObjectRef]]:
+                         runtime_env, name, streaming=None,
+                         sched_key=None) -> Optional[List[ObjectRef]]:
         """Submission hot path (reference: the Cython submit_task releases
         the GIL and never blocks on the raylet, _raylet.pyx:3432).  When
         the function is already exported and every arg inlines, the spec
@@ -1145,8 +1211,9 @@ class CoreWorker:
             self.register_stream(task_id, streaming["bp"],
                                  expected_attempt=max_retries)
             refs = [ObjectRefGenerator(self, task_id, refs[0])]
-        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
-                                      runtime_env)
+        key = sched_key if sched_key is not None else \
+            protocol.scheduling_key(fn_id, resources, scheduling_strategy,
+                                    runtime_env)
 
         self.record_task_event(task_id, spec["name"], "SUBMITTED")
 
@@ -1162,7 +1229,7 @@ class CoreWorker:
             # frames instead of one frame each.
             self._schedule_pump(key, state)
 
-        self.loop.call_soon_threadsafe(_enqueue)
+        self._post_to_loop(_enqueue)
         return refs
 
     def _deferred_pump(self, key: bytes, state):
@@ -2026,7 +2093,10 @@ class CoreWorker:
             state.submit_queue.append((spec, task, big_puts))
             self._schedule_actor_drain(state)
 
-        self.loop.call_soon_threadsafe(_go)
+        if self._on_loop_thread():
+            _go()
+        else:
+            self._post_to_loop(_go)
         return refs
 
     def _schedule_actor_drain(self, state: _ActorState):

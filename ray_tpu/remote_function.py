@@ -34,6 +34,12 @@ class RemoteFunction:
         self._name = name or getattr(fn, "__name__", "fn")
         self._export_blob: Optional[bytes] = None
         self._fn_id: Optional[bytes] = None  # cached after first export
+        # Submit-path invariants (resource dict, strategy dict, packaged
+        # runtime env, scheduling key) computed once on first .remote():
+        # they are pure functions of this object's immutable fields, and
+        # recomputing them (sha1 + dict building) dominated the per-call
+        # submit cost under fan-out.
+        self._submit_cache: Optional[tuple] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -62,11 +68,7 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         from ._private.worker import global_runtime
-        from .util.scheduling_strategies import strategy_to_dict
         core = global_runtime().core
-        from ._private.config import get_config
-        max_retries = (self._max_retries if self._max_retries is not None
-                       else get_config().task_max_retries_default)
         if self._fn_id is None:
             # Pickle the code object ONCE per RemoteFunction; later calls
             # ride the core's fast path keyed on this id.  Blob is
@@ -75,14 +77,29 @@ class RemoteFunction:
             blob = get_context().dumps_code(self._fn)
             self._export_blob = blob
             self._fn_id = protocol.function_id(blob)
+        if self._submit_cache is None:
+            from ._private.config import get_config
+            from .util.scheduling_strategies import strategy_to_dict
+            max_retries = (self._max_retries
+                           if self._max_retries is not None
+                           else get_config().task_max_retries_default)
+            resources = self._resource_dict()
+            strat = strategy_to_dict(self._scheduling_strategy)
+            renv = core.package_runtime_env_cached(self._runtime_env)
+            key = protocol.scheduling_key(self._fn_id, resources, strat,
+                                          renv)
+            # Single assignment: a racing thread sees all or nothing.
+            self._submit_cache = (max_retries, resources, strat, renv, key)
+        max_retries, resources, strat, renv, key = self._submit_cache
         refs = core.submit_task(
             fn=self._fn, fn_id=self._fn_id, args=args, kwargs=kwargs,
-            num_returns=self._num_returns, resources=self._resource_dict(),
+            num_returns=self._num_returns, resources=resources,
             max_retries=max_retries,
-            scheduling_strategy=strategy_to_dict(self._scheduling_strategy),
-            runtime_env=self._runtime_env, name=self._name,
+            scheduling_strategy=strat,
+            runtime_env=renv, name=self._name,
             fn_blob=self._export_blob,
-            generator_backpressure=self._generator_backpressure)
+            generator_backpressure=self._generator_backpressure,
+            sched_key=key)
         # num_returns="streaming" yields a single ObjectRefGenerator.
         if self._num_returns == 1 or isinstance(self._num_returns, str):
             return refs[0]
