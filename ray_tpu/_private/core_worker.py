@@ -472,9 +472,11 @@ class CoreWorker:
         mb = self._mailbox
         while mb:
             try:
-                mb.popleft()()
+                fn = mb.popleft()
             except IndexError:
-                break
+                break   # raced another drain
+            try:
+                fn()
             except Exception:
                 logger.exception("mailbox callback failed")
         self._mailbox_scheduled = False
@@ -633,38 +635,51 @@ class CoreWorker:
 
     # ------------------------------------------------------------- put/get --
     def put(self, value: Any) -> ObjectRef:
-        ref = self._try_put_fast(value)
-        if ref is not None:
-            return ref
-        return self._run(self.put_async(value))
-
-    def _try_put_fast(self, value: Any) -> Optional[ObjectRef]:
-        """Small-value put entirely on the calling thread (reference: the
-        Cython put path releases the GIL and never waits on the raylet for
-        inline objects).  A freshly minted id can have no waiters, plasma
+        """Serialize ONCE on the calling thread (also keeps multi-GB
+        pickling off the event loop); small ref-free values then complete
+        entirely here — a freshly minted id can have no waiters, plasma
         isn't touched, and the serialization capture is thread-local —
-        so no loop round trip (run_coroutine_threadsafe + queue wait) is
-        needed.  Values with nested refs or above the inline limit take
-        the async path (plasma / containment bookkeeping live there)."""
-        approx = (len(value) if isinstance(value, (bytes, bytearray, str))
-                  else getattr(value, "nbytes", 0))
-        if approx > self._inline_limit:
-            return None
-        cfg = get_config()
-        if not cfg.put_small_object_in_memory_store:
-            return None
+        while large/ref-bearing values hand the pre-serialized parts to
+        the loop for plasma + containment bookkeeping (reference: the
+        Cython put path releases the GIL and never waits on the raylet
+        for inline objects)."""
         ctx = get_context()
         ctx.capture = captured = []
         try:
             parts = ctx.serialize(value)
         finally:
             ctx.capture = None
-        if captured or ctx.total_size(parts) > self._inline_limit:
-            return None
+        size = ctx.total_size(parts)
+        cfg = get_config()
+        if not captured and size <= self._inline_limit \
+                and cfg.put_small_object_in_memory_store:
+            oid = self._next_put_id()
+            self.reference_counter.add_owned(oid)
+            self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+            return ObjectRef(oid, self.address, worker=self)
+        return self._run(self._put_serialized_async(parts, captured, size))
+
+    async def _put_serialized_async(self, parts, captured, size
+                                    ) -> ObjectRef:
         oid = self._next_put_id()
         self.reference_counter.add_owned(oid)
-        self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+        self._record_contained(oid, captured)
+        cfg = get_config()
+        if size <= self._inline_limit and cfg.put_small_object_in_memory_store:
+            self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+        else:
+            await self._put_plasma(oid, parts)
         return ObjectRef(oid, self.address, worker=self)
+
+    async def put_async(self, value: Any) -> ObjectRef:
+        ctx = get_context()
+        ctx.capture = captured = []
+        try:
+            parts = ctx.serialize(value)
+        finally:
+            ctx.capture = None
+        return await self._put_serialized_async(
+            parts, captured, ctx.total_size(parts))
 
     def _next_put_id(self) -> bytes:
         # Minted from the driver thread (submit_actor_task) and the loop
@@ -683,23 +698,6 @@ class CoreWorker:
             task = self._process_task_id_cache
         return ObjectID.for_put(TaskID(task), idx).binary()
 
-    async def put_async(self, value: Any) -> ObjectRef:
-        oid = self._next_put_id()
-        ctx = get_context()
-        ctx.capture = captured = []
-        try:
-            parts = ctx.serialize(value)
-        finally:
-            ctx.capture = None
-        size = ctx.total_size(parts)
-        self.reference_counter.add_owned(oid)
-        self._record_contained(oid, captured)
-        cfg = get_config()
-        if size <= self._inline_limit and cfg.put_small_object_in_memory_store:
-            self.memory_store.put_inline(oid, protocol.concat_parts(parts))
-        else:
-            await self._put_plasma(oid, parts)
-        return ObjectRef(oid, self.address, worker=self)
 
     def _record_contained(self, container_id: bytes, captured,
                           take_pins: bool = True):

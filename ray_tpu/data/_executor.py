@@ -33,6 +33,14 @@ class DataContext:
     """Execution knobs (reference: data/context.py DataContext)."""
     max_in_flight_pipelines: int = 8
     target_block_rows: int = 65536
+    # Memory-budget backpressure (reference: execution/resource_manager.py:47
+    # + backpressure_policy/): pause launching new pipelines while the local
+    # object-store arena is fuller than this fraction.  Consumption frees
+    # blocks (refs drop as the iterator advances), which unblocks launches.
+    store_usage_pause_fraction: float = 0.85
+    # Producer lead per streaming pipeline, in blocks (the streaming
+    # generator's backpressure budget).
+    stream_block_backpressure: int = 16
 
     _current = None
 
@@ -41,6 +49,33 @@ class DataContext:
         if cls._current is None:
             cls._current = cls()
         return cls._current
+
+
+def _store_usage_fraction() -> float:
+    """Fraction of the local shared-memory arena in use (0.0 on any
+    failure — backpressure must never wedge execution)."""
+    try:
+        from ray_tpu._private.worker import global_runtime
+        stats = global_runtime().core.store.stats()
+        cap = stats.get("capacity") or 0
+        return (stats.get("bytes_in_use", 0) / cap) if cap else 0.0
+    except Exception:
+        return 0.0
+
+
+def _pause_for_memory(pending_count: int) -> None:
+    """Block the (driver-side) launch loop while the store is over budget.
+    Never pauses when nothing is in flight — that would deadlock an
+    empty-store-but-full-arena situation (somebody else's objects)."""
+    import time as _time
+    ctx = DataContext.get_current()
+    frac = ctx.store_usage_pause_fraction
+    if frac >= 1.0 or pending_count == 0:
+        return
+    deadline = _time.monotonic() + 30.0
+    while (_store_usage_fraction() > frac
+           and _time.monotonic() < deadline):
+        _time.sleep(0.05)
 
 
 @ray_tpu.remote
@@ -69,23 +104,28 @@ class _MapActor:
         return True
 
 
-def execute_streaming(plan: Plan,
-                      max_in_flight: Optional[int] = None
-                      ) -> Iterator[Block]:
-    """Yield final blocks on the driver in read-task order."""
-    ctx = DataContext.get_current()
-    window = max_in_flight or ctx.max_in_flight_pipelines
-    n = len(plan.read_tasks)
-    if n == 0:
-        return
-    window = min(window, n)
+@ray_tpu.remote
+def _run_pipeline_streaming(read_task, ops: List[Operator]):
+    """One pipeline as a streaming generator: each finished block is its
+    own yielded object, consumable on the driver before the pipeline
+    finishes (consumed by iter_batches; reference: streaming_executor
+    output backpressure + streaming generator returns)."""
+    transforms = [op.resolve_transform() for op in ops]
 
-    pools = {}
-    for i, op in enumerate(plan.ops):
-        if op.compute == "actors":
-            pools[i] = [_MapActor.remote(op)
-                        for _ in range(op.actor_pool_size)]
+    def _chain(up, t):
+        # Bound per stage (a bare genexp in the loop would late-bind `t`
+        # and apply the LAST transform at every stage).
+        return (o for x in up for o in t(x))
 
+    gen = iter(read_task())
+    for t in transforms:
+        # Lazy chaining: a block is yielded downstream the moment the
+        # last transform produces it — nothing materializes a stage.
+        gen = _chain(gen, t)
+    yield from gen
+
+
+def _build_pipeline_launcher(plan: Plan, pools: dict):
     def launch(idx: int):
         ref = _run_read.remote(plan.read_tasks[idx])
         for i, op in enumerate(plan.ops):
@@ -95,13 +135,67 @@ def execute_streaming(plan: Plan,
             else:
                 ref = _run_op.remote(op, ref)
         return ref
+    return launch
 
+
+def _make_actor_pools(plan: Plan) -> dict:
+    pools = {}
+    for i, op in enumerate(plan.ops):
+        if op.compute == "actors":
+            pools[i] = [_MapActor.remote(op)
+                        for _ in range(op.actor_pool_size)]
+    return pools
+
+
+def execute_streaming(plan: Plan,
+                      max_in_flight: Optional[int] = None
+                      ) -> Iterator[Block]:
+    """Yield final blocks on the driver in read-task order.
+
+    Task-only plans run each pipeline as a STREAMING GENERATOR task:
+    blocks arrive (and are freed) one at a time with producer-side
+    backpressure, so a pipeline's whole output never materializes at
+    once.  Plans with actor-pool ops keep the chained-task path (the
+    pool actors live across pipelines).  New pipeline launches pause
+    while the object-store arena is over the memory budget."""
+    ctx = DataContext.get_current()
+    window = max_in_flight or ctx.max_in_flight_pipelines
+    n = len(plan.read_tasks)
+    if n == 0:
+        return
+    window = min(window, n)
+    pools = _make_actor_pools(plan)
+
+    if not pools:
+        bp = ctx.stream_block_backpressure
+        gen_task = _run_pipeline_streaming.options(
+            num_returns="streaming",
+            _generator_backpressure_num_objects=bp)
+
+        def launch_gen(idx: int):
+            return gen_task.remote(plan.read_tasks[idx], plan.ops)
+
+        pending = deque(launch_gen(i) for i in range(window))
+        next_launch = window
+        while pending:
+            gen = pending.popleft()
+            for ref in gen:
+                yield ray_tpu.get(ref, timeout=600)
+            ray_tpu.get(gen.completed(), timeout=600)  # surface errors
+            if next_launch < n:
+                _pause_for_memory(len(pending))
+                pending.append(launch_gen(next_launch))
+                next_launch += 1
+        return
+
+    launch = _build_pipeline_launcher(plan, pools)
     try:
         pending = deque(launch(i) for i in range(window))
         next_launch = window
         while pending:
             blocks = ray_tpu.get(pending.popleft(), timeout=600)
             if next_launch < n:
+                _pause_for_memory(len(pending))
                 pending.append(launch(next_launch))
                 next_launch += 1
             yield from blocks
@@ -112,6 +206,35 @@ def execute_streaming(plan: Plan,
                     ray_tpu.kill(a)
                 except Exception:
                     pass
+
+
+def execute_to_refs(plan: Plan) -> List:
+    """Launch every pipeline and return one ObjectRef per pipeline (each
+    resolving to List[Block]) WITHOUT fetching — the ref plumbing for
+    distributed shuffles: block data stays in the cluster (reference:
+    hash_shuffle.py consumes upstream refs, never driver copies)."""
+    pools = _make_actor_pools(plan)
+    launch = _build_pipeline_launcher(plan, pools)
+    refs = [launch(i) for i in range(len(plan.read_tasks))]
+    if pools:
+        # Pool actors must outlive their in-flight apply tasks; wait for
+        # completion WITHOUT fetching (fetch_local=False keeps the block
+        # bytes in the cluster), then release the actors.  wait() returns
+        # (ready, pending) on timeout without raising — loop until every
+        # pipeline actually finished, else the kills below would fail
+        # still-running apply tasks.
+        pending = list(refs)
+        while pending:
+            _, pending = ray_tpu.wait(
+                pending, num_returns=len(pending), timeout=600,
+                fetch_local=False)
+        for pool in pools.values():
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+    return refs
 
 
 def execute_local(plan: Plan) -> Iterator[Block]:

@@ -73,18 +73,19 @@ class Plan:
 def make_map_batches(fn: Callable, batch_size: Optional[int],
                      fn_kwargs: Dict[str, Any],
                      fn_args: tuple = ()) -> Callable:
-    def transform(block: Block) -> List[Block]:
+    def transform(block: Block):
+        """Generator: each produced batch flows downstream immediately —
+        load-bearing for streaming consumption (iter_batches gets batch
+        k while batch k+1 is still being computed)."""
         pieces = (split_block(block, batch_size) if batch_size
                   else ([block] if block_num_rows(block) else []))
-        out = []
         for piece in pieces:
             res = fn(piece, *fn_args, **fn_kwargs)
             if isinstance(res, dict):
-                out.append({k: np.asarray(v) for k, v in res.items()})
+                yield {k: np.asarray(v) for k, v in res.items()}
             else:  # generator of batches
-                out.extend({k: np.asarray(v) for k, v in b.items()}
-                           for b in res)
-        return out
+                for b in res:
+                    yield {k: np.asarray(v) for k, v in b.items()}
     return transform
 
 

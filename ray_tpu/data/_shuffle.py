@@ -1,12 +1,14 @@
-"""All-to-all ops: sort, groupby/aggregate, join.
+"""All-to-all ops: sort, groupby/aggregate, join — fully distributed.
 
 Reference: python/ray/data/_internal/execution/operators/hash_shuffle.py
 (+ sort.py, join.py planners) — partition every input block by key hash
-or range, then reduce each partition independently.  Here the partition
-pass runs on the driver (blocks stream through it anyway — this is the
-same barrier the reference's shuffle takes) and the reduce pass fans out
-as remote tasks, one per partition, so the heavy work (sorting,
-grouping, joining) runs cluster-parallel.
+or range on the MAP side (one remote task per input pipeline, emitting
+its P partitions as P separate return objects), then reduce each
+partition independently (one remote task per partition, pulling its
+pieces peer-to-peer through the object store).  The driver only ever
+holds ObjectRefs: block data never stages through driver memory, so
+shuffles scale to datasets larger than any single process (reference:
+hash_shuffle.py:61 HashShuffleOperator's map/reduce split).
 """
 
 from __future__ import annotations
@@ -81,6 +83,97 @@ def range_partition(block: Block, key: str, bounds: np.ndarray,
     idx = np.searchsorted(bounds, np.asarray(block[key]), side="right")
     parts = [{c: v[idx == i] for c, v in block.items()} for i in range(p)]
     return parts[::-1] if descending else parts
+
+
+# ---------------------------------------------------------------------------
+# Remote map-side partitioners (one task per input pipeline; P returns)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+def _map_hash_partition(keys: List[str], p: int, blocks: List[Block]):
+    """Partition one pipeline's blocks by key hash into p outputs.
+    Submitted with num_returns=p, so each partition is its own object —
+    the reduce task for partition i fetches only piece i (reference:
+    hash_shuffle.py map task emitting per-partition blocks)."""
+    outs: List[List[Block]] = [[] for _ in range(p)]
+    for b in blocks:
+        if not b:
+            continue
+        for i, piece in enumerate(hash_partition(b, keys, p)):
+            outs[i].append(piece)
+    merged = [concat_blocks([x for x in o if x]) for o in outs]
+    return merged[0] if p == 1 else tuple(merged)
+
+
+@ray_tpu.remote
+def _map_range_partition(key: str, bounds, descending: bool,
+                         blocks: List[Block]):
+    p = len(bounds) + 1
+    outs: List[List[Block]] = [[] for _ in range(p)]
+    for b in blocks:
+        if not b:
+            continue
+        for i, piece in enumerate(range_partition(b, key, bounds,
+                                                  descending)):
+            outs[i].append(piece)
+    merged = [concat_blocks([x for x in o if x]) for o in outs]
+    return merged[0] if p == 1 else tuple(merged)
+
+
+@ray_tpu.remote
+def _sample_blocks(key: str, sample_per_block: int, blocks: List[Block]
+                   ) -> np.ndarray:
+    """Map-side sampling for sort bounds: only the (tiny) sample array
+    returns to the driver (reference: sort.py SampleBlock stage)."""
+    samples = []
+    rng = np.random.default_rng(0)
+    for b in blocks:
+        col = np.asarray(b.get(key, []))
+        if len(col) == 0:
+            continue
+        take = min(sample_per_block, len(col))
+        samples.append(rng.choice(col, take, replace=False))
+    if not samples:
+        return np.asarray([])
+    return np.concatenate(samples)
+
+
+def merge_sample_bounds(samples: List[np.ndarray], p: int) -> np.ndarray:
+    """Quantile boundaries from the map tasks' samples (driver-side: the
+    samples are O(64 per block), never the data)."""
+    samples = [s for s in samples if len(s)]
+    if not samples:
+        return np.asarray([])
+    allv = np.sort(np.concatenate(samples))
+    qs = [int(len(allv) * (i + 1) / p) for i in range(p - 1)]
+    return allv[np.clip(qs, 0, len(allv) - 1)]
+
+
+def shuffle_partitions(pipeline_refs: List, *, keys=None, p: int,
+                       range_key: Optional[str] = None, bounds=None,
+                       descending: bool = False) -> List[List]:
+    """Launch map-side partition tasks over per-pipeline block-list refs;
+    returns parts[i] = list of partition-i refs, one per map task.  Pure
+    ref plumbing — no block bytes on the driver."""
+    parts: List[List] = [[] for _ in range(p)]
+    # Hoisted: .options() builds a fresh RemoteFunction (new submit
+    # cache); p is loop-invariant.
+    if range_key is not None:
+        task = _map_range_partition.options(num_returns=p)
+    else:
+        task = _map_hash_partition.options(num_returns=p)
+        keys = list(keys)
+    for ref in pipeline_refs:
+        if range_key is not None:
+            out = task.remote(range_key, bounds, descending, ref)
+        else:
+            out = task.remote(keys, p, ref)
+        if p == 1:
+            out = [out]
+        for i in range(p):
+            parts[i].append(out[i])
+    return parts
 
 
 # ---------------------------------------------------------------------------
@@ -165,11 +258,65 @@ def _reduce_map_groups(keys: List[str], fn: Callable, *parts: Block
 
 
 @ray_tpu.remote
-def _reduce_join(on: List[str], how: str, rcols: List[str],
-                 left_parts: List[Block], right_parts: List[Block]
-                 ) -> Block:
-    """rcols: right-side value columns, passed explicitly so partitions
+def _pipeline_column_stats(column: str, blocks: List[Block]) -> dict:
+    """Per-pipeline partial aggregates for Dataset.sum/min/max/mean/std
+    and unique — only O(distinct)-sized stats return to the driver.
+    Variance ships as (mean, M2) so the driver combines with Chan's
+    parallel formula instead of the cancellation-prone sum-of-squares."""
+    tot = 0.0
+    n = 0
+    mean = 0.0
+    m2 = 0.0
+    mn = mx = None
+    uniq: set = set()
+    for b in blocks:
+        if not b:
+            continue
+        col = np.asarray(b[column])
+        if len(col) == 0:
+            continue
+        if col.dtype.kind in "iufb":
+            c = col.astype(np.float64)
+            tot += float(np.sum(c))
+            bn = len(c)
+            bmean = float(np.mean(c))
+            bm2 = float(np.sum((c - bmean) ** 2))
+            # Chan et al. pairwise combine of (n, mean, M2).
+            delta = bmean - mean
+            tot_n = n + bn
+            m2 = m2 + bm2 + delta * delta * n * bn / tot_n if tot_n else 0.0
+            mean = (mean * n + bmean * bn) / tot_n if tot_n else 0.0
+            n = tot_n
+        else:
+            n += len(col)
+        try:
+            vals = col.tolist()
+            bmn, bmx = min(vals), max(vals)
+            mn = bmn if mn is None else min(mn, bmn)
+            mx = bmx if mx is None else max(mx, bmx)
+        except (TypeError, ValueError):
+            pass   # unorderable column: min/max stay None
+        uniq.update(col.tolist())
+    return {"sum": tot, "n": n, "mean": mean, "m2": m2,
+            "min": mn, "max": mx, "unique": list(uniq)}
+
+
+@ray_tpu.remote
+def _block_columns(blocks: List[Block]) -> List[str]:
+    """Column names of the first non-empty block (schema probe)."""
+    for b in blocks:
+        if b:
+            return list(b.keys())
+    return []
+
+
+@ray_tpu.remote
+def _reduce_join(on: List[str], how: str, rcols: List[str], nleft: int,
+                 *parts: Block) -> Block:
+    """parts[:nleft] are the left partition pieces, the rest right-side.
+    rcols: right-side value columns, passed explicitly so partitions
     with an empty right side still emit a consistent schema."""
+    left_parts, right_parts = parts[:nleft], parts[nleft:]
     left = concat_blocks([p for p in left_parts if p])
     right = concat_blocks([p for p in right_parts if p])
     if not left:

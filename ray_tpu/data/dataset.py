@@ -149,22 +149,24 @@ class Dataset:
              num_partitions: Optional[int] = None) -> "Dataset":
         """Distributed sample-partition sort (reference: Dataset.sort →
         _internal sort planner: sample bounds → range partition →
-        per-partition sort tasks)."""
+        per-partition sort tasks).  Map-side sampling and partitioning
+        both run as remote tasks; only the O(samples) bound array and
+        ObjectRefs ever reach the driver."""
         from . import _shuffle
-        blocks = [b for b in self.iter_internal_blocks() if b]
-        if not blocks:
+        from ._executor import execute_to_refs
+        refs = execute_to_refs(self._materialize_if_limited()._plan)
+        if not refs:
             return from_blocks([])
-        p = num_partitions or max(1, len(blocks))
-        bounds = _shuffle.range_bounds(blocks, key, p)
-        parts: List[List[Block]] = [[] for _ in builtins.range(len(bounds) + 1)]
-        for b in blocks:
-            for i, piece in enumerate(
-                    _shuffle.range_partition(b, key, bounds, descending)):
-                parts[i].append(piece)
-        refs = [_shuffle._reduce_sort.remote(key, descending, *ps)
-                for ps in parts if ps]
-        out = [b for b in ray_tpu.get(refs) if b]
-        return from_blocks(out)
+        p = num_partitions or max(1, len(refs))
+        samples = ray_tpu.get(
+            [_shuffle._sample_blocks.remote(key, 64, r) for r in refs])
+        bounds = _shuffle.merge_sample_bounds(samples, p)
+        parts = _shuffle.shuffle_partitions(
+            refs, p=len(bounds) + 1, range_key=key, bounds=bounds,
+            descending=descending)
+        out = [_shuffle._reduce_sort.remote(key, descending, *ps)
+               for ps in parts]
+        return from_block_refs(out)
 
     def groupby(self, key) -> "GroupedData":
         """reference: Dataset.groupby -> GroupedData (grouped_data.py)."""
@@ -178,62 +180,89 @@ class Dataset:
         if how not in ("inner", "left"):
             raise ValueError("how must be 'inner' or 'left'")
         from . import _shuffle
+        from ._executor import execute_to_refs
         on = [on] if isinstance(on, str) else list(on)
-        lblocks = [b for b in self.iter_internal_blocks() if b]
-        rblocks = [b for b in other.iter_internal_blocks() if b]
-        p = num_partitions or max(1, len(lblocks))
-        lparts: List[List[Block]] = [[] for _ in builtins.range(p)]
-        rparts: List[List[Block]] = [[] for _ in builtins.range(p)]
-        for b in lblocks:
-            for i, piece in enumerate(_shuffle.hash_partition(b, on, p)):
-                lparts[i].append(piece)
-        for b in rblocks:
-            for i, piece in enumerate(_shuffle.hash_partition(b, on, p)):
-                rparts[i].append(piece)
-        rcols = [c for c in (rblocks[0] if rblocks else {}) if c not in on]
-        refs = [_shuffle._reduce_join.remote(on, how, rcols, lp, rp)
-                for lp, rp in zip(lparts, rparts)]
-        return from_blocks([b for b in ray_tpu.get(refs) if b])
+        lrefs = execute_to_refs(self._materialize_if_limited()._plan)
+        rrefs = execute_to_refs(other._materialize_if_limited()._plan)
+        if not lrefs:
+            return from_blocks([])
+        p = num_partitions or max(1, len(lrefs))
+        # Right-side schema from a (tiny) remote column probe so empty
+        # partitions still emit consistent columns.
+        col_lists = ray_tpu.get(
+            [_shuffle._block_columns.remote(r) for r in rrefs]) \
+            if rrefs else []
+        rcols = []
+        for cols in col_lists:
+            if cols:
+                rcols = [c for c in cols if c not in on]
+                break
+        lparts = _shuffle.shuffle_partitions(lrefs, keys=on, p=p)
+        rparts = _shuffle.shuffle_partitions(rrefs, keys=on, p=p) \
+            if rrefs else [[] for _ in builtins.range(p)]
+        refs = [_shuffle._reduce_join.remote(
+                    on, how, rcols, len(lparts[i]),
+                    *(list(lparts[i]) + list(rparts[i])))
+                for i in builtins.range(p)]
+        return from_block_refs(refs)
+
+    def _column_stats(self, column: str) -> List[dict]:
+        """Remote per-pipeline partial aggregates: only O(1) stats reach
+        the driver (reference: Dataset.sum -> AggregateNumRows plan)."""
+        from . import _shuffle
+        from ._executor import execute_to_refs
+        refs = execute_to_refs(self._materialize_if_limited()._plan)
+        stats = ray_tpu.get(
+            [_shuffle._pipeline_column_stats.remote(column, r)
+             for r in refs])
+        return [s for s in stats if s["n"]]
 
     def unique(self, column: str) -> List[Any]:
-        vals = set()
-        for b in self.iter_internal_blocks():
-            if b:
-                vals.update(np.asarray(b[column]).tolist())
+        vals: set = set()
+        for s in self._column_stats(column):
+            vals.update(s["unique"])
         return sorted(vals)
 
     # global aggregates (reference: Dataset.sum/min/max/mean/std)
     def sum(self, column: str):
-        return self._agg(column, np.sum, 0)
+        stats = self._column_stats(column)
+        if not stats:
+            return 0
+        total = builtins.sum(s["sum"] for s in stats)
+        return int(total) if float(total).is_integer() else total
 
     def min(self, column: str):
-        return self._agg(column, np.min, None)
+        stats = self._column_stats(column)
+        return builtins.min((s["min"] for s in stats), default=None)
 
     def max(self, column: str):
-        return self._agg(column, np.max, None)
+        stats = self._column_stats(column)
+        return builtins.max((s["max"] for s in stats), default=None)
 
     def mean(self, column: str):
-        tot, n = 0.0, 0
-        for b in self.iter_internal_blocks():
-            if b:
-                col = np.asarray(b[column])
-                tot += float(np.sum(col))
-                n += len(col)
-        return tot / n if n else None
+        stats = self._column_stats(column)
+        n = builtins.sum(s["n"] for s in stats)
+        return builtins.sum(s["sum"] for s in stats) / n if n else None
 
     def std(self, column: str, ddof: int = 1):
-        vals = [np.asarray(b[column]) for b in self.iter_internal_blocks()
-                if b]
-        if not vals:
+        stats = self._column_stats(column)
+        if not stats:
             return None
-        return float(np.std(np.concatenate(vals), ddof=ddof))
-
-    def _agg(self, column: str, fn, empty):
-        parts = [fn(np.asarray(b[column]))
-                 for b in self.iter_internal_blocks() if b]
-        if not parts:
-            return empty
-        return fn(np.asarray(parts)).item()
+        # Chan et al. parallel combine of per-pipeline (n, mean, M2) —
+        # numerically stable for large-mean data, unlike sum-of-squares.
+        n, mean, m2 = 0, 0.0, 0.0
+        for s in stats:
+            bn, bmean, bm2 = s["n"], s["mean"], s["m2"]
+            if bn == 0:
+                continue
+            delta = bmean - mean
+            tot_n = n + bn
+            m2 = m2 + bm2 + delta * delta * n * bn / tot_n
+            mean = (mean * n + bmean * bn) / tot_n
+            n = tot_n
+        if n == 0:
+            return None
+        return float((m2 / builtins.max(n - ddof, 1)) ** 0.5)
 
     def limit(self, n: int) -> "Dataset":
         import dataclasses
@@ -418,6 +447,21 @@ def from_blocks(blocks: List[Block]) -> Dataset:
     return Dataset(Plan([make(b) for b in blocks], []))
 
 
+def from_block_refs(refs: List) -> Dataset:
+    """Dataset over cluster-resident blocks: each read task resolves its
+    ref INSIDE the executing worker, so downstream consumption pulls
+    blocks peer-to-peer through the object store — the driver only holds
+    the refs (reference: Dataset from upstream operator refs)."""
+    def make(ref):
+        def read():
+            v = ray_tpu.get(ref)
+            if isinstance(v, list):
+                return [b for b in v if b]
+            return [v] if v else []
+        return read
+    return Dataset(Plan([make(r) for r in refs], []))
+
+
 def range(n: int, *, parallelism: int = 16) -> Dataset:  # noqa: A001
     return Dataset(Plan(_plan.range_read_tasks(n, parallelism), []))
 
@@ -475,22 +519,23 @@ class GroupedData:
         self._keys = keys
 
     def _partitions(self, num_partitions: Optional[int]):
+        """Distributed map-side hash partition: parts[i] = one ref per
+        map task; block bytes never reach the driver."""
         from . import _shuffle
-        blocks = [b for b in self._ds.iter_internal_blocks() if b]
-        p = num_partitions or max(1, len(blocks))
-        parts: List[List[Block]] = [[] for _ in builtins.range(p)]
-        for b in blocks:
-            for i, piece in enumerate(
-                    _shuffle.hash_partition(b, self._keys, p)):
-                parts[i].append(piece)
-        return [ps for ps in parts if ps]
+        from ._executor import execute_to_refs
+        refs = execute_to_refs(
+            self._ds._materialize_if_limited()._plan)
+        if not refs:
+            return []
+        p = num_partitions or max(1, len(refs))
+        return _shuffle.shuffle_partitions(refs, keys=self._keys, p=p)
 
     def _aggregate(self, aggs: List[tuple],
                    num_partitions: Optional[int] = None) -> Dataset:
         from . import _shuffle
         refs = [_shuffle._reduce_groupby.remote(self._keys, aggs, *ps)
                 for ps in self._partitions(num_partitions)]
-        return from_blocks([b for b in ray_tpu.get(refs) if b])
+        return from_block_refs(refs)
 
     def count(self) -> Dataset:
         return self._aggregate([("count", None, "count()")])
@@ -517,7 +562,4 @@ class GroupedData:
         from . import _shuffle
         refs = [_shuffle._reduce_map_groups.remote(self._keys, fn, *ps)
                 for ps in self._partitions(num_partitions)]
-        out: List[Block] = []
-        for blocks in ray_tpu.get(refs):
-            out.extend(b for b in blocks if b)
-        return from_blocks(out)
+        return from_block_refs(refs)

@@ -77,7 +77,12 @@ class RemoteFunction:
             blob = get_context().dumps_code(self._fn)
             self._export_blob = blob
             self._fn_id = protocol.function_id(blob)
-        if self._submit_cache is None:
+        cache = self._submit_cache
+        if cache is None or cache[0] is not core:
+            # Keyed on the core instance: a shutdown()/init() cycle mints
+            # a new CoreWorker, and the packaged runtime-env URIs (and
+            # config defaults) from the old cluster must not leak into
+            # the new one.
             from ._private.config import get_config
             from .util.scheduling_strategies import strategy_to_dict
             max_retries = (self._max_retries
@@ -89,8 +94,9 @@ class RemoteFunction:
             key = protocol.scheduling_key(self._fn_id, resources, strat,
                                           renv)
             # Single assignment: a racing thread sees all or nothing.
-            self._submit_cache = (max_retries, resources, strat, renv, key)
-        max_retries, resources, strat, renv, key = self._submit_cache
+            cache = self._submit_cache = (core, max_retries, resources,
+                                          strat, renv, key)
+        _, max_retries, resources, strat, renv, key = cache
         refs = core.submit_task(
             fn=self._fn, fn_id=self._fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns, resources=resources,

@@ -94,3 +94,110 @@ def test_global_aggregates_and_unique(rows):
     assert ds.mean("v") == pytest.approx(sum(vs) / len(vs))
     assert ds.std("v") == pytest.approx(float(np.std(vs, ddof=1)))
     assert ds.unique("tag") == ["t0", "t1", "t2"]
+
+
+def test_shuffle_never_materializes_on_driver(ray_start_regular):
+    """The map/reduce shuffle is pure ref plumbing on the driver: block
+    bytes flow worker-to-worker through the object store (reference:
+    hash_shuffle.py map/reduce split)."""
+    ds = rdata.range(1000, parallelism=8)
+
+    def boom(*a, **k):
+        raise AssertionError("driver materialized blocks during shuffle")
+
+    orig = rdata.dataset.Dataset.iter_internal_blocks
+    rdata.dataset.Dataset.iter_internal_blocks = boom
+    try:
+        sorted_ds = ds.sort("id")
+        grouped = ds.groupby("id").count()
+        joined = ds.join(rdata.range(500, parallelism=4), on="id")
+    finally:
+        rdata.dataset.Dataset.iter_internal_blocks = orig
+    assert [r["id"] for r in sorted_ds.take(5)] == [0, 1, 2, 3, 4]
+    assert len(grouped.take_all()) == 1000
+    assert len(joined.take_all()) == 500
+
+
+def test_shuffle_multinode():
+    """Sort + groupby across a 3-node cluster: partitions move between
+    node stores, reduce tasks run on remote nodes."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        c.add_node(num_cpus=2)
+        c.add_node(num_cpus=2)
+        c.wait_for_nodes()
+        ray_tpu.init(address=c.address)
+        rng = np.random.default_rng(3)
+        rows = [{"k": int(rng.integers(0, 7)), "v": float(v)}
+                for v in rng.permutation(300)]
+        ds = rdata.from_items(rows, parallelism=6)
+        got = [r["v"] for r in ds.sort("v").take_all()]
+        assert got == sorted(r["v"] for r in rows)
+        counts = {r["k"]: r["count()"]
+                  for r in ds.groupby("k").count().take_all()}
+        want: dict = {}
+        for r in rows:
+            want[r["k"]] = want.get(r["k"], 0) + 1
+        assert counts == want
+    finally:
+        c.shutdown()
+
+
+def test_memory_budget_pauses_launches(ray_start_regular, monkeypatch):
+    """The streaming executor pauses new pipeline launches while the
+    store is over budget and resumes when usage drops (reference:
+    backpressure_policy/ + resource_manager.py)."""
+    from ray_tpu.data import _executor
+
+    usage = {"v": 0.99}
+    monkeypatch.setattr(_executor, "_store_usage_fraction",
+                        lambda: usage["v"])
+
+    import threading
+    import time as _time
+
+    def drop_usage():
+        _time.sleep(0.6)
+        usage["v"] = 0.1
+
+    t = threading.Thread(target=drop_usage)
+    t.start()
+    t0 = _time.monotonic()
+    _executor._pause_for_memory(pending_count=3)
+    dt = _time.monotonic() - t0
+    t.join()
+    assert dt >= 0.5, f"did not pause ({dt:.2f}s)"
+    assert dt < 10, "pause did not release after usage dropped"
+    # Never pauses when nothing is in flight (deadlock guard).
+    usage["v"] = 0.99
+    t0 = _time.monotonic()
+    _executor._pause_for_memory(pending_count=0)
+    assert _time.monotonic() - t0 < 0.2
+
+
+def test_iter_batches_streams_blocks(ray_start_regular):
+    """iter_batches consumes pipelines through streaming-generator tasks:
+    early batches arrive before the pipeline's tail is produced."""
+    import time as _time
+
+    def slow_double(b):
+        _time.sleep(0.05)
+        return {"id": b["id"] * 2}
+
+    ds = rdata.range(4000, parallelism=4).map_batches(slow_double,
+                                                      batch_size=100)
+    t0 = _time.monotonic()
+    it = ds.iter_batches(batch_size=100)
+    first = next(it)
+    dt_first = _time.monotonic() - t0
+    rest = list(it)
+    dt_all = _time.monotonic() - t0
+    assert len(first["id"]) == 100
+    assert dt_first < dt_all * 0.6, (
+        f"first batch at {dt_first:.2f}s of {dt_all:.2f}s — not streaming")
+    total = sum(len(b["id"]) for b in rest) + len(first["id"])
+    assert total == 4000
