@@ -90,6 +90,13 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
             "ray_tpu.get() on a streaming generator: iterate it instead "
             "(`for ref in gen: value = ray_tpu.get(ref)`), or get "
             "gen.completed() to wait for the whole stream")
+    from .dag import CompiledDAGRef
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout)
+    if isinstance(refs, (list, tuple)) and any(
+            isinstance(r, CompiledDAGRef) for r in refs):
+        return [r.get(timeout) if isinstance(r, CompiledDAGRef)
+                else _core().get(r, timeout=timeout) for r in refs]
     return _core().get(refs, timeout=timeout)
 
 

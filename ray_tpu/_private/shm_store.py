@@ -85,6 +85,27 @@ def _load():
                                 ctypes.POINTER(ctypes.c_uint64),
                                 ctypes.c_int, ctypes.c_int]
     lib.rts_put_iov.restype = ctypes.c_int
+    lib.rts_chan_init.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.c_uint32, ctypes.c_uint64,
+                                  ctypes.c_uint32]
+    lib.rts_chan_init.restype = ctypes.c_int64
+    lib.rts_chan_write.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                   ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_int]
+    lib.rts_chan_write.restype = ctypes.c_int
+    lib.rts_chan_peek.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                  ctypes.c_uint32,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.c_int]
+    lib.rts_chan_peek.restype = ctypes.c_int
+    lib.rts_chan_advance.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                     ctypes.c_uint32]
+    lib.rts_chan_advance.restype = ctypes.c_int
+    lib.rts_chan_close.argtypes = [ctypes.c_int, ctypes.c_uint64]
+    lib.rts_chan_close.restype = ctypes.c_int
+    lib.rts_chan_destroy.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rts_chan_destroy.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -260,3 +281,88 @@ class ShmStore:
         n = self._lib.rts_list_evictable(self._h, buf, max_ids)
         raw = buf.raw
         return [raw[i * 20:(i + 1) * 20] for i in range(n)]
+
+
+class ChannelClosed(ShmObjectStoreError):
+    pass
+
+
+class Channel:
+    """Mutable single-writer multi-reader ring channel inside the arena
+    (reference: python/ray/experimental/channel/shared_memory_channel.py
+    backed by experimental_mutable_object_manager.cc).  A write is a
+    memcpy + futex wake; a read is a futex wait + copy-out — the compiled
+    graph's per-step transport.  Use `create` once (the creator's pin
+    keeps it alive), `attach` from each endpoint process."""
+
+    def __init__(self, store: "ShmStore", channel_id: bytes, offset: int,
+                 attached: bool):
+        self._store = store
+        self._lib = store._lib
+        self.channel_id = channel_id
+        self._off = offset
+        self._attached = attached   # holds a get() pin to drop on close
+
+    @classmethod
+    def create(cls, store: "ShmStore", channel_id: bytes, *,
+               nslots: int = 8, slot_bytes: int = 1 << 20,
+               nreaders: int = 1) -> "Channel":
+        off = store._lib.rts_chan_init(store._h, channel_id, nslots,
+                                       slot_bytes, nreaders)
+        if off < 0:
+            raise StoreFullError(f"channel create failed: errno {-off}")
+        return cls(store, channel_id, off, attached=False)
+
+    @classmethod
+    def attach(cls, store: "ShmStore", channel_id: bytes,
+               timeout_ms: int = 10_000) -> "Channel":
+        size = ctypes.c_uint64()
+        off = store._lib.rts_get(store._h, channel_id,
+                                 ctypes.byref(size), timeout_ms)
+        if off < 0:
+            raise ShmObjectStoreError(
+                f"channel {channel_id.hex()} not found")
+        return cls(store, channel_id, off, attached=True)
+
+    def write(self, data: bytes, timeout_ms: int = -1) -> None:
+        rc = self._lib.rts_chan_write(self._store._h, self._off, data,
+                                      len(data), timeout_ms)
+        if rc == -32:        # EPIPE
+            raise ChannelClosed(self.channel_id.hex())
+        if rc == -90:        # EMSGSIZE
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds the channel slot "
+                f"size; recompile the DAG with a larger slot_bytes")
+        if rc == -110:       # ETIMEDOUT
+            raise TimeoutError("channel write timed out (ring full)")
+        if rc < 0:
+            raise ShmObjectStoreError(f"channel write: errno {-rc}")
+
+    def read(self, reader: int = 0, timeout_ms: int = -1) -> bytes:
+        """Next message for `reader` (copied out — the ring slot is reused
+        as soon as we advance). Raises ChannelClosed when closed+drained."""
+        moff = ctypes.c_uint64()
+        mlen = ctypes.c_uint64()
+        rc = self._lib.rts_chan_peek(self._store._h, self._off, reader,
+                                     ctypes.byref(moff), ctypes.byref(mlen),
+                                     timeout_ms)
+        if rc == -32:
+            raise ChannelClosed(self.channel_id.hex())
+        if rc == -110:
+            raise TimeoutError("channel read timed out")
+        if rc < 0:
+            raise ShmObjectStoreError(f"channel read: errno {-rc}")
+        data = bytes(self._store._view[moff.value:moff.value + mlen.value])
+        self._lib.rts_chan_advance(self._store._h, self._off, reader)
+        return data
+
+    def close(self) -> None:
+        """Signal EOF to all endpoints (idempotent; does not free)."""
+        self._lib.rts_chan_close(self._store._h, self._off)
+        if self._attached:
+            self._store.release(self.channel_id)
+            self._attached = False
+
+    def destroy(self) -> None:
+        """Creator-side: close + free the backing object."""
+        self._lib.rts_chan_destroy(self._store._h, self.channel_id)

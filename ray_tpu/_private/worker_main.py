@@ -215,6 +215,13 @@ class Executor:
         return m
 
     async def h_push_actor_task(self, conn, spec):
+        if spec.get("method") == "__ray_dag_serve__":
+            # Compiled-graph serve loop (reference: compiled_dag_node.py's
+            # resident exec loop in the _ray_system concurrency group):
+            # runs on its own executor thread OUTSIDE the actor's normal
+            # concurrency gates, so ordinary method calls keep working
+            # while the DAG is live; returns when the input channel closes.
+            return await self._execute_dag_serve(spec)
         if _TRACE_EXEC:
             logger.warning("PUSH %s t=%.3f actor=%s groups=%s",
                            spec.get("method"), time.monotonic(),
@@ -443,6 +450,134 @@ class Executor:
         finally:
             with self._thread_guard:
                 self._running_threads.pop(task_id, None)
+
+    # ------------------------------------------------- compiled-graph serve --
+    async def _execute_dag_serve(self, spec):
+        loop = asyncio.get_running_loop()
+        try:
+            args, _ = await self._resolve_arg_entries(spec["args"])
+            stage = args[0]
+            # Wait for actor init (the serve push can race actor_init).
+            deadline = time.monotonic() + 30
+            while self.actor is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            if self.actor is None:
+                raise exc.RayError("dag serve on uninitialized actor")
+            await loop.run_in_executor(
+                self.core.executor, lambda: self._dag_serve(stage))
+            returns = await self._serialize_returns(
+                spec["task_id"], 1, None,
+                caller_addr=spec.get("owner_addr"))
+            return {"status": "ok", "returns": returns}
+        except Exception as e:  # noqa: BLE001
+            return self._error_reply(e)
+
+    @staticmethod
+    def _dag_err_body(ctx, e):
+        try:
+            eb = ctx.serialize(e)
+        except Exception:
+            eb = ctx.serialize(exc.RayError(
+                f"{type(e).__name__}: {e} (unpicklable)"))
+        from ..dag import _transport
+        return _transport.ERR + b"".join(bytes(p) for p in eb)
+
+    def _dag_serve(self, stage):
+        """Resident compiled-graph stage loop: block on input channels,
+        run the bound method, write the result downstream.  Errors are
+        serialized and PROPAGATED as messages (the pipeline keeps
+        running); channel closure cascades a clean shutdown."""
+        import pickle
+        from ..dag import _transport
+        from .shm_store import Channel, ChannelClosed
+        store = self.core.store
+        ctx = get_context()
+        ins = [(Channel.attach(store, s["chan"]), s["reader"])
+               for s in stage["in"]]
+        if not ins:
+            raise exc.RayError(
+                "compiled DAG stage has no channel inputs (every stage "
+                "must consume the InputNode or an upstream stage)")
+        out = Channel.attach(store, stage["out_chan"])
+        method = getattr(self.actor, stage["method"])
+        slot_bytes = stage["slot_bytes"]
+        nreaders = stage["out_readers"]
+        coll = stage.get("collective")
+        consts = {}      # unpickled once
+        try:
+            while True:
+                try:
+                    bodies = [_transport.recv(store, ch, r)
+                              for ch, r in ins]
+                except ChannelClosed:
+                    break
+                err_body = next(
+                    (b for b in bodies if b[:1] == _transport.ERR), None)
+                result = None
+                if err_body is None:
+                    try:
+                        vals = [ctx.deserialize(memoryview(b)[1:])
+                                for b in bodies]
+
+                        def _arg(p):
+                            kind, v = p
+                            if kind == "ch":
+                                return vals[v]
+                            if id(p) not in consts:
+                                consts[id(p)] = pickle.loads(v)
+                            return consts[id(p)]
+
+                        a = [_arg(p) for p in stage["argplan"]]
+                        kw = {k: _arg(p)
+                              for k, p in stage["kwargplan"].items()}
+                        result = method(*a, **kw)
+                    except BaseException as e:  # noqa: BLE001
+                        err_body = self._dag_err_body(ctx, e)
+                if coll:
+                    # Collective stages stay in LOCKSTEP even on error
+                    # steps: every rank allgathers its ok/err flag first,
+                    # and the value-allreduce runs only when all ranks
+                    # are ok — otherwise every rank emits an error for
+                    # this step.  Skipping the collective on one rank
+                    # would permanently desync the group's sequence
+                    # numbers and silently pair tensors from different
+                    # steps (reference: collective_node.py executes the
+                    # collective unconditionally per step).
+                    import numpy as np
+                    from .. import collective as _c
+                    try:
+                        flags = _c.allgather(
+                            np.asarray([0.0 if err_body is not None
+                                        else 1.0]),
+                            group_name=coll["group"])
+                        all_ok = bool(np.all(np.asarray(flags) > 0.5))
+                        if all_ok:
+                            result = _c.allreduce(
+                                np.asarray(result),
+                                group_name=coll["group"], op=coll["op"])
+                        elif err_body is None:
+                            err_body = self._dag_err_body(
+                                ctx, exc.RayError(
+                                    "collective peer failed this step"))
+                    except BaseException as e:  # noqa: BLE001
+                        if err_body is None:
+                            err_body = self._dag_err_body(ctx, e)
+                if err_body is not None:
+                    body = err_body
+                else:
+                    body = _transport.OK + b"".join(
+                        bytes(p) for p in ctx.serialize(result))
+                _transport.send(store, out, body, nreaders, slot_bytes,
+                                self.core._next_put_id)
+        finally:
+            out.close()   # cascade EOF downstream
+            for ch, _ in ins:
+                try:
+                    if ch._attached:
+                        store.release(ch.channel_id)
+                        ch._attached = False
+                except Exception:
+                    pass
 
     # ------------------------------------------------- streaming generators --
     # In-flight stream_item calls per generator: pipelines item delivery

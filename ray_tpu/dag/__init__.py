@@ -1,38 +1,48 @@
-"""Compiled graphs (aDAG): pre-compiled actor pipelines.
+"""Compiled graphs (aDAG): pre-compiled actor pipelines over shm channels.
 
 Reference surface: python/ray/dag — DAG authoring via `.bind()`
 (dag/dag_node.py, class_node.py, input_node.py), `experimental_compile` →
 CompiledDAG (dag/compiled_dag_node.py:805) executing over channels
-(experimental/channel/shared_memory_channel.py).
+(experimental/channel/shared_memory_channel.py,
+src/ray/core_worker/experimental_mutable_object_manager.cc), collective
+nodes (dag/collective_node.py).
 
-TPU-native design: compilation walks the bound graph ONCE into a static
-execution plan (topological stage order + argument wiring). `execute()`
-replays the plan by chaining actor tasks through object references — each
-stage's return ref feeds the next stage's submission without waiting, so
-consecutive `execute()` calls pipeline naturally across the actor set
-(stage k of item i runs concurrently with stage k-1 of item i+1, the same
-overlap the reference gets from its resident exec loops). Intermediate
-values move driver-free through the shared-memory store on one host and
-the chunked object plane across hosts; device tensors ride the normal
-serialization path. A bounded in-flight window provides the reference's
-channel backpressure (compiled_dag_node.py _max_inflight_executions).
+TPU-native design: compilation wires the bound graph into MUTABLE SHM
+CHANNELS — fixed futex-synchronized rings inside the node's object-store
+arena (src/object_store/store.cc rts_chan_*). Each actor runs a resident
+serve loop (worker_main._dag_serve) that blocks on its input channels,
+invokes the bound method, and writes the result to its output channel: a
+step costs two futex wakes and a memcpy per hop — no sockets, RPC frames,
+or per-call task bookkeeping. execute() writes the input into the first
+ring and returns a CompiledDAGRef whose get() reads the output ring, so
+consecutive executions pipeline across stages naturally; the ring depth
+IS the reference's _max_inflight_executions backpressure.
+
+When the graph spans nodes (actors not co-located with the driver's
+arena) compilation falls back to chained actor tasks through the object
+store — same semantics, RPC-path performance.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.dag")
 
 __all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
-           "CompiledDAG"]
+           "CompiledDAG", "CompiledDAGRef", "allreduce_bind"]
 
 
 class DAGNode:
     """Base authoring node (reference: dag/dag_node.py)."""
 
-    def experimental_compile(self, _max_inflight_executions: int = 10
+    def experimental_compile(self, _max_inflight_executions: int = 10,
+                             _channel_slot_bytes: int = 256 * 1024
                              ) -> "CompiledDAG":
-        return CompiledDAG(self, max_inflight=_max_inflight_executions)
+        return CompiledDAG(self, max_inflight=_max_inflight_executions,
+                           slot_bytes=_channel_slot_bytes)
 
 
 class InputNode(DAGNode):
@@ -54,6 +64,16 @@ class ClassMethodNode(DAGNode):
         self.actor_method = actor_method
         self.args = args
         self.kwargs = kwargs
+        self.collective: Optional[dict] = None   # set by allreduce_bind
+
+
+class CollectiveOutNode(DAGNode):
+    """Post-collective view of an upstream stage (reference:
+    dag/collective_node.py CollectiveOutputNode): consumers read the
+    allreduced value the upstream actor computed for this step."""
+
+    def __init__(self, upstream: ClassMethodNode):
+        self.upstream = upstream
 
 
 class MultiOutputNode(DAGNode):
@@ -64,23 +84,74 @@ class MultiOutputNode(DAGNode):
         self.outputs = list(outputs)
 
 
+def allreduce_bind(nodes: List[ClassMethodNode], op: str = "sum"
+                   ) -> List[CollectiveOutNode]:
+    """Bind an in-graph allreduce across stages on distinct actors
+    (reference: ray.experimental.collective.allreduce.bind →
+    dag/collective_node.py). Each step, after the bound methods produce
+    their values, the participating actors allreduce them through the
+    collective library and every returned node yields the reduced value."""
+    if not nodes:
+        raise ValueError("allreduce_bind needs at least one node")
+    group = {"op": op, "nodes": nodes}
+    for i, n in enumerate(nodes):
+        if not isinstance(n, ClassMethodNode):
+            raise TypeError("allreduce_bind takes actor-method bind() nodes")
+        n.collective = {"op": op, "rank": i, "world": len(nodes),
+                        "_group": group}
+    return [CollectiveOutNode(n) for n in nodes]
+
+
+class _Err:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (reference: CompiledDAGRef in
+    compiled_dag_node.py). get() blocks on the output channel; results
+    arrive in execution order."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int, out_j: int):
+        self._dag = dag
+        self._idx = idx
+        self._j = out_j
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._fetch(self._idx, self._j, timeout)
+
+    def __repr__(self):
+        return f"CompiledDAGRef(exec={self._idx}, out={self._j})"
+
+
 class CompiledDAG:
     """The static execution plan (reference: compiled_dag_node.py:805)."""
 
-    def __init__(self, root: DAGNode, max_inflight: int = 10):
+    def __init__(self, root: DAGNode, max_inflight: int = 10,
+                 slot_bytes: int = 256 * 1024):
         self._lock = threading.Lock()
         self._sem = threading.Semaphore(max_inflight)
+        self._max_inflight = max_inflight
+        self._slot_bytes = slot_bytes
         self._torn_down = False
-        # Topological plan: list of (node, arg_spec) where arg_spec mirrors
-        # the bound args with placeholders for input/upstream refs.
-        self._plan: List[ClassMethodNode] = []
         self._root = root
         self._outputs: List[DAGNode] = (
             root.outputs if isinstance(root, MultiOutputNode) else [root])
+        # Topological plan of ClassMethodNodes (CollectiveOutNode resolves
+        # to its upstream stage).
+        self._plan: List[ClassMethodNode] = []
         seen: Dict[int, bool] = {}
 
         def _walk(node: DAGNode):
             if isinstance(node, InputNode):
+                return
+            if isinstance(node, CollectiveOutNode):
+                # The whole collective group must be in the plan even if
+                # only one member's output is consumed.
+                for peer in node.upstream.collective["_group"]["nodes"]:
+                    _walk(peer)
                 return
             if not isinstance(node, ClassMethodNode):
                 raise TypeError(
@@ -100,14 +171,279 @@ class CompiledDAG:
         if not self._plan:
             raise ValueError("empty DAG: nothing was bound")
 
+        self._channel_mode = False
+        self._broken: Optional[BaseException] = None
+        try:
+            self._compile_channels()
+            self._channel_mode = True
+        except Exception as e:  # noqa: BLE001 — any setup failure falls back
+            # Partially created channels hold creator pins (never evicted):
+            # reclaim them before falling back.
+            for ch in getattr(self, "_channels", {}).values():
+                try:
+                    ch.destroy()
+                except Exception:
+                    pass
+            self._channels = {}
+            if any(getattr(n, "collective", None) or
+                   isinstance(n, CollectiveOutNode)
+                   for n in self._plan + self._outputs):
+                raise RuntimeError(
+                    "DAG collective nodes require the shm-channel path "
+                    f"(all actors on the driver's node); setup failed: {e}"
+                ) from e
+            logger.info("compiled DAG falling back to task chaining: %s", e)
+
+    # ---------------------------------------------------------- channels ----
+    @staticmethod
+    def _producer(node) -> Any:
+        return node.upstream if isinstance(node, CollectiveOutNode) else node
+
+    def _compile_channels(self):
+        from .._private.serialization import get_context
+        from .._private.shm_store import Channel
+        from ..actor import ActorMethod
+        from .._private.worker import global_runtime
+        import pickle
+
+        core = global_runtime().core
+        self._core = core
+        store = core.store
+
+        # Locality: every actor must share the driver's arena.
+        actor_ids = []
+        for node in self._plan:
+            aid = node.actor_method._handle._actor_id
+            if aid not in actor_ids:
+                actor_ids.append(aid)
+        for aid in actor_ids:
+            info = core.gcs_call("get_actor", {"actor_id": aid,
+                                               "wait_alive": True})
+            if info is None or info.get("node_id") != core.node_id:
+                raise RuntimeError(
+                    "actor not co-located with the driver's object store")
+
+        # Consumers per producer (plan nodes and InputNode instances);
+        # the driver consumes the output nodes.
+        consumers: Dict[int, list] = {}
+        producers: Dict[int, Any] = {}
+
+        def _note(producer, consumer):
+            key = id(producer)
+            producers[key] = producer
+            consumers.setdefault(key, [])
+            if consumer not in consumers[key]:
+                consumers[key].append(consumer)
+
+        for node in self._plan:
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, InputNode) or isinstance(a, DAGNode):
+                    if isinstance(a, (InputNode, ClassMethodNode,
+                                      CollectiveOutNode)):
+                        _note(self._producer(a) if not isinstance(
+                            a, InputNode) else a, id(node))
+        for out in self._outputs:
+            _note(self._producer(out) if not isinstance(out, InputNode)
+                  else out, "driver")
+
+        # One channel per producer; ring depth = max_inflight so the ring
+        # is the backpressure window.
+        nslots = max(2, self._max_inflight)
+        self._channels: Dict[int, Channel] = {}
+        self._chan_ids: Dict[int, bytes] = {}
+        self._chan_readers: Dict[int, int] = {}       # nreaders
+        reader_of: Dict[Tuple[int, Any], int] = {}    # (producer, consumer)
+        for key, cons in consumers.items():
+            cid = core._next_put_id()
+            ch = Channel.create(store, cid, nslots=nslots,
+                                slot_bytes=self._slot_bytes,
+                                nreaders=len(cons))
+            self._channels[key] = ch
+            self._chan_ids[key] = cid
+            self._chan_readers[key] = len(cons)
+            for ridx, c in enumerate(cons):
+                reader_of[(key, c)] = ridx
+
+        # Input channels (written by the driver each execute()).
+        self._input_keys = [id(p) for p in producers.values()
+                            if isinstance(p, InputNode)]
+        # Driver-read output channels, in output order.
+        self._out_readers: List[Tuple[Channel, int, int]] = []
+        for out in self._outputs:
+            p = self._producer(out)
+            key = id(p)
+            self._out_readers.append(
+                (self._channels[key], reader_of[(key, "driver")],
+                 self._chan_readers[key]))
+
+        # Collective groups: one declared group per allreduce_bind call.
+        groups: Dict[int, str] = {}
+        for node in self._plan:
+            coll = node.collective
+            if not coll:
+                continue
+            gid = id(coll["_group"])
+            if gid not in groups:
+                from .. import collective as _c
+                name = f"dag_{core.worker_id.hex()[:8]}_{len(groups)}_{gid & 0xffff}"
+                actors = [n.actor_method._handle
+                          for n in coll["_group"]["nodes"]]
+                _c.create_collective_group(
+                    actors, world_size=len(actors), backend="host",
+                    group_name=name)
+                groups[gid] = name
+
+        # Build stage specs + start the serve loops.
+        ctx = get_context()
+        self._serve_refs = []
+        for node in self._plan:
+            in_specs: List[dict] = []
+            chan_index: Dict[int, int] = {}
+
+            def _chan_slot(producer) -> int:
+                key = id(producer)
+                if key not in chan_index:
+                    chan_index[key] = len(in_specs)
+                    in_specs.append({
+                        "chan": self._chan_ids[key],
+                        "reader": reader_of[(key, id(node))],
+                    })
+                return chan_index[key]
+
+            def _plan_arg(a):
+                if isinstance(a, InputNode):
+                    return ("ch", _chan_slot(a))
+                if isinstance(a, (ClassMethodNode, CollectiveOutNode)):
+                    return ("ch", _chan_slot(self._producer(a)))
+                return ("const", pickle.dumps(a))
+
+            argplan = [_plan_arg(a) for a in node.args]
+            kwargplan = {k: _plan_arg(v) for k, v in node.kwargs.items()}
+            stage = {
+                "method": node.actor_method._method_name,
+                "in": in_specs,
+                "argplan": argplan,
+                "kwargplan": kwargplan,
+                "out_chan": self._chan_ids[id(node)],
+                "out_readers": self._chan_readers[id(node)],
+                "slot_bytes": self._slot_bytes,
+                "collective": (
+                    {"group": groups[id(node.collective["_group"])],
+                     "op": node.collective["op"]}
+                    if node.collective else None),
+            }
+            serve = ActorMethod(node.actor_method._handle,
+                                "__ray_dag_serve__")
+            self._serve_refs.append(serve.remote(stage))
+
+        # Producer and consumer sides use separate locks so a blocked
+        # input-ring write (backpressure) never prevents the consumer
+        # from draining the output ring.
+        self._send_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self._exec_idx = 0
+        self._next_read = 0
+        self._results: Dict[int, list] = {}
+        self._pending_outs: Dict[int, int] = {}
+        # In-progress step read: recv() advances each ring as it reads, so
+        # a timeout partway through a multi-output step must resume where
+        # it stopped, not re-read advanced channels.
+        self._partial: List[Any] = []
+
+    # ---------------------------------------------------------- execution ---
     def execute(self, *input_args):
-        """Run one item through the pipeline; returns the final ObjectRef
-        (list of refs for MultiOutputNode). Does NOT wait — call
-        ray_tpu.get on the result; successive execute() calls overlap
-        across stages (per-actor FIFO queues provide stage ordering)."""
+        """Run one item through the pipeline. Channel mode returns
+        CompiledDAGRef(s) — get with .get() or ray_tpu.get; fallback mode
+        returns plain ObjectRef(s)."""
         if self._torn_down:
             raise RuntimeError("this compiled DAG was torn down")
+        if self._broken is not None:
+            raise RuntimeError(
+                "this compiled DAG is broken (a multi-input send partially "
+                f"failed, desyncing the pipeline): {self._broken}")
         inp = input_args[0] if len(input_args) == 1 else input_args
+        if not self._channel_mode:
+            return self._execute_fallback(inp)
+        from . import _transport
+        from .._private.serialization import get_context
+        ctx = get_context()
+        body = _transport.OK + b"".join(
+            bytes(p) for p in ctx.serialize(inp))
+        with self._send_lock:
+            idx = self._exec_idx
+            sent = 0
+            try:
+                for key in self._input_keys:
+                    _transport.send(
+                        self._core.store, self._channels[key], body,
+                        self._chan_readers[key], self._slot_bytes,
+                        self._core._next_put_id, timeout_ms=600_000)
+                    sent += 1
+            except BaseException as e:
+                if sent:
+                    # Some stages saw this step's input and some didn't:
+                    # everything downstream would pair mismatched steps.
+                    self._broken = e
+                raise
+            # Only a fully delivered step consumes an index — a failed
+            # send must not shift later results by one.
+            self._exec_idx += 1
+        refs = [CompiledDAGRef(self, idx, j)
+                for j in range(len(self._outputs))]
+        if isinstance(self._root, MultiOutputNode):
+            return refs
+        return refs[0]
+
+    def _fetch(self, idx: int, j: int, timeout: Optional[float]):
+        from . import _transport
+        from .._private.serialization import get_context
+        from .. import exceptions as exc
+        import time as _time
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        ctx = get_context()
+        with self._read_lock:
+            if idx < self._next_read and idx not in self._results:
+                raise ValueError(
+                    f"CompiledDAGRef(exec={idx}) was already consumed")
+            while idx not in self._results:
+                if self._torn_down:
+                    raise RuntimeError("this compiled DAG was torn down")
+                # Resume the in-progress step: channels already read for
+                # this step sit in _partial (recv advances the ring, so
+                # re-reading would misalign steps after a timeout).
+                while len(self._partial) < len(self._out_readers):
+                    ch, ridx, _nr = self._out_readers[len(self._partial)]
+                    if deadline is None:
+                        tmo = 600_000
+                    else:
+                        tmo = max(0, int((deadline - _time.monotonic())
+                                         * 1000))
+                    body = _transport.recv(self._core.store, ch, ridx,
+                                           timeout_ms=tmo)
+                    status, payload = body[:1], body[1:]
+                    v = ctx.deserialize(memoryview(payload))
+                    self._partial.append(
+                        _Err(v) if status == _transport.ERR else v)
+                self._results[self._next_read] = self._partial
+                self._pending_outs[self._next_read] = len(self._outputs)
+                self._partial = []
+                self._next_read += 1
+            vals = self._results[idx]
+            v = vals[j]
+            self._pending_outs[idx] -= 1
+            if self._pending_outs[idx] <= 0:
+                del self._results[idx]
+                del self._pending_outs[idx]
+        if isinstance(v, _Err):
+            if isinstance(v.exc, BaseException):
+                raise exc.RayTaskError("compiled DAG stage failed",
+                                       cause=v.exc) from v.exc
+            raise exc.RayError(f"compiled DAG stage failed: {v.exc}")
+        return v
+
+    # ----------------------------------------------------------- fallback ---
+    def _execute_fallback(self, inp):
         self._sem.acquire()
         try:
             with self._lock:
@@ -117,19 +453,18 @@ class CompiledDAG:
                         if isinstance(a, InputNode):
                             return inp
                         if isinstance(a, DAGNode):
-                            return produced[id(a)]
+                            return produced[id(self._producer(a))]
                         return a
                     args = tuple(_resolve(a) for a in node.args)
                     kwargs = {k: _resolve(v)
                               for k, v in node.kwargs.items()}
                     produced[id(node)] = node.actor_method.remote(
                         *args, **kwargs)
-                refs = [produced[id(o)] for o in self._outputs]
+                refs = [produced[id(self._producer(o))]
+                        for o in self._outputs]
         except BaseException:
             self._sem.release()
             raise
-        # Backpressure window counts in-flight items, released when the
-        # final ref resolves (reference: _max_inflight_executions).
         try:
             refs[-1].future().add_done_callback(
                 lambda _: self._sem.release())
@@ -139,5 +474,44 @@ class CompiledDAG:
             return refs
         return refs[0]
 
+    # ------------------------------------------------------------ teardown --
     def teardown(self):
+        if self._torn_down:
+            return
         self._torn_down = True
+        if not self._channel_mode:
+            return
+        import ray_tpu
+        # Closing the input rings cascades: each serve loop drains, closes
+        # its own output, and returns.
+        for key in self._input_keys:
+            try:
+                self._channels[key].close()
+            except Exception:
+                pass
+        done = []
+        try:
+            done, pending = ray_tpu.wait(
+                self._serve_refs, num_returns=len(self._serve_refs),
+                timeout=10)
+        except Exception:
+            pending = self._serve_refs
+        if pending:
+            # A serve loop is still running (long user compute): freeing
+            # the rings now would let it dereference recycled arena
+            # memory.  Close everything (sticky EOF) and leak the ring
+            # buffers instead — they die with the session.
+            logger.warning(
+                "DAG teardown: %d serve loop(s) still running; leaving "
+                "channel buffers allocated", len(pending))
+            for ch in self._channels.values():
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+            return
+        for ch in self._channels.values():
+            try:
+                ch.destroy()
+            except Exception:
+                pass

@@ -1,0 +1,59 @@
+"""Compiled-graph message transport over mutable shm channels.
+
+Messages are [envelope][status][payload]:
+- envelope: inline (fits the ring slot) or spilled (payload stored as a
+  pinned arena object, the ring carries its 20-byte id) — the same split
+  the reference makes between its shm channel buffer and plasma fallback
+  (reference: experimental/channel/shared_memory_channel.py buffer_size).
+- status: OK value or ERR (serialized exception, propagated stage-to-stage
+  so the driver raises at get(), reference: compiled_dag_node.py error
+  propagation).
+
+Spilled objects are pre-pinned once per reader by the writer; each reader
+drops one pin after copying out and the last drop deletes the object
+atomically (release_n_and_delete_if), so no extra coordination round.
+"""
+
+from __future__ import annotations
+
+from .._private.shm_store import Channel, ShmStore
+
+_INLINE = b"\x00"
+_SPILL = b"\x01"
+
+OK = b"\x00"
+ERR = b"\x01"
+
+
+def send(store: ShmStore, chan: Channel, body: bytes, nreaders: int,
+         slot_bytes: int, mint_id, timeout_ms: int = -1) -> None:
+    """body = status byte + serialized value."""
+    if 1 + len(body) <= slot_bytes:
+        chan.write(_INLINE + body, timeout_ms=timeout_ms)
+        return
+    oid = mint_id()
+    buf = store.create_buffer(oid, len(body))   # created pinned (refcount 1)
+    buf[:len(body)] = body
+    buf.release()
+    store.seal(oid)
+    for _ in range(nreaders - 1):               # one pin per reader total
+        store.get(oid)
+    chan.write(_SPILL + oid, timeout_ms=timeout_ms)
+
+
+def recv(store: ShmStore, chan: Channel, reader: int,
+         timeout_ms: int = -1) -> bytes:
+    """Returns body (status byte + payload). Raises ChannelClosed at EOF."""
+    msg = chan.read(reader, timeout_ms=timeout_ms)
+    if msg[:1] == _INLINE:
+        return msg[1:]
+    oid = bytes(msg[1:21])
+    view = store.get(oid, timeout_ms=10_000)
+    if view is None:
+        raise RuntimeError(f"spilled DAG message {oid.hex()} vanished")
+    body = bytes(view)
+    view.release()
+    # Drop the read pin just taken plus this reader's writer-granted pin;
+    # the last reader's drop deletes the object.
+    store.release_n_and_delete_if(oid, 2)
+    return body

@@ -22,13 +22,16 @@
 // Build: g++ -O2 -fPIC -shared -o _shmstore.so store.cc -lpthread
 
 #include <cerrno>
+#include <climits>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <pthread.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <thread>
 #include <unistd.h>
 #include <vector>
@@ -636,6 +639,225 @@ int rts_list_objects(int hidx, uint8_t* out, int max) {
     }
   }
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Mutable channels: single-writer multi-reader rings inside the arena.
+//
+// TPU-native equivalent of the reference's experimental mutable objects
+// (reference: src/ray/core_worker/experimental_mutable_object_manager.cc,
+// python/ray/experimental/channel/shared_memory_channel.py): a compiled
+// graph's per-step values move through a fixed ring of slots with
+// futex-based wakeups — a write is a memcpy + one FUTEX_WAKE, a read is a
+// futex wait + zero-copy peek — no sockets, no allocation, no msgpack on
+// the hot path.  Channels live as pinned sealed objects so the normal
+// get()/offset machinery locates them and eviction never touches them.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint32_t kChanMagic = 0x43484e4cu;  // "CHNL"
+constexpr int kMaxChanReaders = 8;
+
+struct ChanHdr {
+  uint32_t magic;
+  uint32_t nslots;
+  uint64_t slot_bytes;
+  uint32_t nreaders;
+  uint32_t closed;      // sticky; guarded by futex bumps
+  uint32_t wfutex;      // bumped on every write and on close
+  uint32_t rfutex;      // bumped on every reader advance and on close
+  uint64_t wseq;        // completed writes (release-published)
+  uint64_t rseq[kMaxChanReaders];  // per-reader consumed counts
+  // Ring data follows: nslots * (8-byte length + slot_bytes), 64B aligned.
+};
+
+inline uint64_t chan_slot_stride(const ChanHdr* c) {
+  return align_up(8 + c->slot_bytes, kAlign);
+}
+
+inline int futex_wait_ms(uint32_t* addr, uint32_t val, int timeout_ms) {
+  struct timespec ts;
+  struct timespec* tsp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
+    tsp = &ts;
+  }
+  // No FUTEX_PRIVATE_FLAG: the word is shared across processes.
+  return syscall(SYS_futex, addr, FUTEX_WAIT, val, tsp, nullptr, 0);
+}
+
+inline void futex_wake_all(uint32_t* addr) {
+  syscall(SYS_futex, addr, FUTEX_WAKE, INT_MAX, nullptr, nullptr, 0);
+}
+
+inline int remaining_ms(const struct timespec& deadline, int timeout_ms) {
+  if (timeout_ms < 0) return -1;
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  long ms = (deadline.tv_sec - now.tv_sec) * 1000L +
+            (deadline.tv_nsec - now.tv_nsec) / 1000000L;
+  return ms > 0 ? (int)ms : 0;
+}
+
+inline void chan_deadline(struct timespec* deadline, int timeout_ms) {
+  clock_gettime(CLOCK_MONOTONIC, deadline);
+  deadline->tv_sec += timeout_ms / 1000;
+  deadline->tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+  if (deadline->tv_nsec >= 1000000000L) {
+    deadline->tv_sec++;
+    deadline->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a channel object under `id`: ring of `nslots` messages of up to
+// `slot_bytes` each, consumed by exactly `nreaders` readers (indices
+// 0..nreaders-1, assigned statically by the creator).  The object is
+// created pinned and sealed (never evicted; attachers locate it with
+// rts_get).  Returns the channel's data offset (>0) or -errno.
+int64_t rts_chan_init(int hidx, const uint8_t* id, uint32_t nslots,
+                      uint64_t slot_bytes, uint32_t nreaders) {
+  if (nreaders == 0 || nreaders > kMaxChanReaders || nslots == 0)
+    return -EINVAL;
+  uint64_t stride = align_up(8 + slot_bytes, kAlign);
+  uint64_t total = align_up(sizeof(ChanHdr), kAlign) + (uint64_t)nslots * stride;
+  int64_t off = rts_create_object(hidx, id, total);
+  if (off < 0) return off;
+  Handle& h = g_handles[hidx];
+  ChanHdr* c = reinterpret_cast<ChanHdr*>(h.base + off);
+  memset(c, 0, sizeof(ChanHdr));
+  c->nslots = nslots;
+  c->slot_bytes = slot_bytes;
+  c->nreaders = nreaders;
+  __atomic_store_n(&c->magic, kChanMagic, __ATOMIC_RELEASE);
+  rts_seal(hidx, id);
+  // Deliberately NOT released: the creator's pin keeps the channel alive
+  // until rts_chan_destroy.
+  return off;
+}
+
+// Write one message. Blocks (futex) while the ring is full — i.e. the
+// slowest reader is nslots behind. Returns 0, -EMSGSIZE (message larger
+// than a slot), -EPIPE (channel closed), -ETIMEDOUT.
+int rts_chan_write(int hidx, uint64_t off, const uint8_t* buf, uint64_t len,
+                   int timeout_ms) {
+  Handle& h = g_handles[hidx];
+  ChanHdr* c = reinterpret_cast<ChanHdr*>(h.base + off);
+  if (__atomic_load_n(&c->magic, __ATOMIC_ACQUIRE) != kChanMagic)
+    return -EINVAL;
+  if (len > c->slot_bytes) return -EMSGSIZE;
+  struct timespec deadline;
+  if (timeout_ms >= 0) chan_deadline(&deadline, timeout_ms);
+  for (;;) {
+    if (__atomic_load_n(&c->closed, __ATOMIC_ACQUIRE)) return -EPIPE;
+    uint64_t w = __atomic_load_n(&c->wseq, __ATOMIC_ACQUIRE);
+    uint64_t minr = UINT64_MAX;
+    for (uint32_t i = 0; i < c->nreaders; i++) {
+      uint64_t r = __atomic_load_n(&c->rseq[i], __ATOMIC_ACQUIRE);
+      if (r < minr) minr = r;
+    }
+    if (w - minr < c->nslots) {
+      uint8_t* slot = h.base + off + align_up(sizeof(ChanHdr), kAlign) +
+                      (w % c->nslots) * chan_slot_stride(c);
+      memcpy(slot, &len, 8);
+      memcpy(slot + 8, buf, len);
+      __atomic_store_n(&c->wseq, w + 1, __ATOMIC_RELEASE);
+      __atomic_add_fetch(&c->wfutex, 1, __ATOMIC_ACQ_REL);
+      futex_wake_all(&c->wfutex);
+      return 0;
+    }
+    uint32_t rv = __atomic_load_n(&c->rfutex, __ATOMIC_ACQUIRE);
+    // Re-check after loading the futex word (a reader advancing between
+    // the min scan and here bumps rfutex, making the wait return at once).
+    uint64_t minr2 = UINT64_MAX;
+    for (uint32_t i = 0; i < c->nreaders; i++) {
+      uint64_t r = __atomic_load_n(&c->rseq[i], __ATOMIC_ACQUIRE);
+      if (r < minr2) minr2 = r;
+    }
+    if (w - minr2 < c->nslots) continue;
+    int rem = remaining_ms(deadline, timeout_ms);
+    if (rem == 0) return -ETIMEDOUT;
+    futex_wait_ms(&c->rfutex, rv, rem);
+  }
+}
+
+// Peek the next unread message for `reader`. On success sets *msg_off (arena
+// offset of the payload — valid until rts_chan_advance) and *len, returns 0.
+// Returns -EPIPE when the channel is closed AND drained, -ETIMEDOUT.
+int rts_chan_peek(int hidx, uint64_t off, uint32_t reader, uint64_t* msg_off,
+                  uint64_t* len, int timeout_ms) {
+  Handle& h = g_handles[hidx];
+  ChanHdr* c = reinterpret_cast<ChanHdr*>(h.base + off);
+  if (__atomic_load_n(&c->magic, __ATOMIC_ACQUIRE) != kChanMagic ||
+      reader >= c->nreaders)
+    return -EINVAL;
+  struct timespec deadline;
+  if (timeout_ms >= 0) chan_deadline(&deadline, timeout_ms);
+  for (;;) {
+    uint64_t r = __atomic_load_n(&c->rseq[reader], __ATOMIC_ACQUIRE);
+    uint64_t w = __atomic_load_n(&c->wseq, __ATOMIC_ACQUIRE);
+    if (w > r) {
+      uint8_t* slot = h.base + off + align_up(sizeof(ChanHdr), kAlign) +
+                      (r % c->nslots) * chan_slot_stride(c);
+      memcpy(len, slot, 8);
+      *msg_off = (uint64_t)(slot + 8 - h.base);
+      return 0;
+    }
+    if (__atomic_load_n(&c->closed, __ATOMIC_ACQUIRE)) return -EPIPE;
+    uint32_t wv = __atomic_load_n(&c->wfutex, __ATOMIC_ACQUIRE);
+    if (__atomic_load_n(&c->wseq, __ATOMIC_ACQUIRE) > r) continue;
+    int rem = remaining_ms(deadline, timeout_ms);
+    if (rem == 0) return -ETIMEDOUT;
+    futex_wait_ms(&c->wfutex, wv, rem);
+  }
+}
+
+// Consume the message last peeked by `reader`, freeing its ring slot for
+// the writer once every reader has advanced past it.
+int rts_chan_advance(int hidx, uint64_t off, uint32_t reader) {
+  Handle& h = g_handles[hidx];
+  ChanHdr* c = reinterpret_cast<ChanHdr*>(h.base + off);
+  if (__atomic_load_n(&c->magic, __ATOMIC_ACQUIRE) != kChanMagic ||
+      reader >= c->nreaders)
+    return -EINVAL;
+  uint64_t r = __atomic_load_n(&c->rseq[reader], __ATOMIC_ACQUIRE);
+  __atomic_store_n(&c->rseq[reader], r + 1, __ATOMIC_RELEASE);
+  __atomic_add_fetch(&c->rfutex, 1, __ATOMIC_ACQ_REL);
+  futex_wake_all(&c->rfutex);
+  return 0;
+}
+
+// Close: writers get -EPIPE immediately, readers after draining.
+int rts_chan_close(int hidx, uint64_t off) {
+  Handle& h = g_handles[hidx];
+  ChanHdr* c = reinterpret_cast<ChanHdr*>(h.base + off);
+  if (__atomic_load_n(&c->magic, __ATOMIC_ACQUIRE) != kChanMagic)
+    return -EINVAL;
+  __atomic_store_n(&c->closed, 1, __ATOMIC_RELEASE);
+  __atomic_add_fetch(&c->wfutex, 1, __ATOMIC_ACQ_REL);
+  __atomic_add_fetch(&c->rfutex, 1, __ATOMIC_ACQ_REL);
+  futex_wake_all(&c->wfutex);
+  futex_wake_all(&c->rfutex);
+  return 0;
+}
+
+// Close + drop the creator's pin + delete the backing object.
+int rts_chan_destroy(int hidx, const uint8_t* id) {
+  Handle& h = g_handles[hidx];
+  uint64_t size = 0;
+  int64_t off = rts_get(hidx, id, &size, 0);
+  if (off < 0) return (int)off;
+  rts_chan_close(hidx, (uint64_t)off);
+  rts_release(hidx, id);  // the rts_get pin just taken
+  rts_release(hidx, id);  // the creator's init pin
+  return rts_delete(hidx, id);
 }
 
 }  // extern "C"
