@@ -176,6 +176,9 @@ class CoreWorker:
         # the dominant submit-side syscall cost under task fan-out.
         self._mailbox: deque = deque()
         self._mailbox_scheduled = False
+        # channel -> [callback] for GCS pubsub fan-in (see subscribe()).
+        self._pubsub_handlers: Dict[str, list] = {}
+        self._gcs_subscribed: set = set()   # channels subscribed at GCS
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -228,8 +231,10 @@ class CoreWorker:
         # Reconnecting: calls issued across a GCS restart re-dial and
         # retry once (mutations are id-keyed upserts, so replays are
         # idempotent).
-        self.gcs = rpc.ReconnectingConnection(self.gcs_address,
-                                              name="cw->gcs")
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, name="cw->gcs",
+            handlers={"pubsub": self.h_pubsub},
+            on_reconnect=self._resubscribe)
         await self.gcs.ensure()
         self.agent = await rpc.connect(self.agent_address, name="cw->agent")
         self._spawn(self._telemetry_flush_loop())
@@ -457,6 +462,63 @@ class CoreWorker:
     def _spawn(self, coro) -> asyncio.Task:
         """ensure_future with a strong reference held until completion."""
         return rpc.spawn(coro)
+
+    # -------------------------------------------------------------- pubsub --
+    async def h_pubsub(self, conn, p):
+        for cb in list(self._pubsub_handlers.get(p["channel"], [])):
+            try:
+                cb(p["message"])
+            except Exception:
+                logger.exception("pubsub callback failed (%s)", p["channel"])
+        return True
+
+    async def _resubscribe(self, conn):
+        for channel in self._gcs_subscribed:
+            await conn.call("subscribe", {"channel": channel})
+
+    def subscribe(self, channel: str, callback) -> None:
+        """Register callback(message) for a GCS pubsub channel (reference:
+        GcsSubscriber). Thread-safe; callbacks run on the event loop.
+        Subscriptions survive GCS reconnects (re-registered in
+        _resubscribe)."""
+        def _do():
+            self._pubsub_handlers.setdefault(channel, []).append(callback)
+            if channel not in self._gcs_subscribed:
+                # Once per (connection, channel): the GCS appends the conn
+                # to the channel's subscriber list unconditionally, so a
+                # re-subscribe would duplicate every notify.
+                self._gcs_subscribed.add(channel)
+                self._spawn(self.gcs.call("subscribe",
+                                          {"channel": channel}))
+        if self._on_loop_thread():
+            _do()
+        else:
+            self.loop.call_soon_threadsafe(_do)
+
+    def unsubscribe(self, channel: str, callback) -> None:
+        """Remove a callback registered with subscribe() (thread-safe).
+        The GCS-side channel subscription persists (harmless: messages
+        with no local handlers are dropped)."""
+        def _do():
+            lst = self._pubsub_handlers.get(channel)
+            if lst and callback in lst:
+                lst.remove(callback)
+            if lst is not None and not lst:
+                del self._pubsub_handlers[channel]
+        if self._on_loop_thread():
+            _do()
+        else:
+            self.loop.call_soon_threadsafe(_do)
+
+    def publish(self, channel: str, message) -> None:
+        """Fire-and-forget publish (thread-safe)."""
+        def _do():
+            self._spawn(self.gcs.call("publish", {
+                "channel": channel, "message": message}))
+        if self._on_loop_thread():
+            _do()
+        else:
+            self.loop.call_soon_threadsafe(_do)
 
     def _post_to_loop(self, fn) -> None:
         """Run `fn` on the event loop, coalescing a burst of cross-thread

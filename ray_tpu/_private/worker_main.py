@@ -531,6 +531,12 @@ class Executor:
                         kw = {k: _arg(p)
                               for k, p in stage["kwargplan"].items()}
                         result = method(*a, **kw)
+                        if asyncio.iscoroutine(result):
+                            # Async actor method bound into the DAG: run
+                            # it on the actor's event loop (this serve
+                            # loop lives on an executor thread).
+                            result = asyncio.run_coroutine_threadsafe(
+                                result, self.core.loop).result()
                     except BaseException as e:  # noqa: BLE001
                         err_body = self._dag_err_body(ctx, e)
                 if coll:

@@ -415,7 +415,7 @@ class CompiledDAG:
                 while len(self._partial) < len(self._out_readers):
                     ch, ridx, _nr = self._out_readers[len(self._partial)]
                     if deadline is None:
-                        tmo = 600_000
+                        tmo = -1   # block indefinitely, like get()
                     else:
                         tmo = max(0, int((deadline - _time.monotonic())
                                          * 1000))

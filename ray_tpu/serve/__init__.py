@@ -7,6 +7,7 @@ long_poll.py:228, batching.py).
 """
 
 from ._private.batching import batch
+from ._private.multiplex import get_multiplexed_model_id, multiplexed
 from ._private.proxy import Request
 from .api import (Application, Deployment, DeploymentHandle,
                   DeploymentResponse, deployment, get_deployment_handle,
@@ -16,4 +17,5 @@ __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "run", "start", "shutdown",
     "get_deployment_handle", "batch", "Request",
+    "multiplexed", "get_multiplexed_model_id",
 ]

@@ -40,6 +40,7 @@ class ServeControllerImpl:
         self._born: Dict[bytes, float] = {}       # replica -> first seen
         self._confirmed: set = set()              # replicas that ponged once
         self._version_event: Optional[asyncio.Event] = None
+        self._model_ids: Dict[bytes, List[str]] = {}  # replica -> models
         self._reconcile_lock = asyncio.Lock()
         self._reconcile_task = None
         self._shutdown = False
@@ -55,12 +56,47 @@ class ServeControllerImpl:
     def _forget(self, replica):
         self._born.pop(replica._actor_id, None)
         self._confirmed.discard(replica._actor_id)
+        self._model_ids.pop(replica._actor_id, None)
 
     def _bump(self):
         self.version += 1
         if self._version_event is not None:
             self._version_event.set()
             self._version_event = asyncio.Event()
+        self._push_tables()
+
+    def _push_tables(self, only: Optional[str] = None):
+        """PUSH routing tables to subscribed routers via GCS pubsub
+        (reference: long_poll.py:228 LongPollHost notify_changed) —
+        replica churn propagates in one publish hop instead of waiting
+        out a poll interval."""
+        core = ray_tpu._core()
+        for name in ([only] if only else list(self.deployments)):
+            dep = self.deployments.get(name)
+            if dep is None:
+                msg = {"name": name, "version": self.version,
+                       "replicas": []}
+            else:
+                msg = {"name": name, "version": self.version,
+                       "replicas": [
+                           {"id": r._actor_id,
+                            "models": sorted(
+                                self._model_ids.get(r._actor_id, ()))}
+                           for r in dep["replicas"]]}
+            core.publish(f"serve_rt:{name}", msg)
+
+    async def update_model_ids(self, replica_id: bytes,
+                               model_ids: List[str]) -> bool:
+        """A replica's multiplexed-model set changed (reference:
+        multiplex.py reporting into the long-poll snapshot)."""
+        self._model_ids[replica_id] = list(model_ids)
+        # Model placement affects routing choice: push only the owning
+        # deployment, without bumping the structural version.
+        for name, dep in self.deployments.items():
+            if any(r._actor_id == replica_id for r in dep["replicas"]):
+                self._push_tables(only=name)
+                break
+        return True
 
     # ------------------------------------------------------------ deploy ---
     async def deploy(self, name: str, blob: bytes, init_args: tuple,
@@ -311,7 +347,10 @@ class ServeControllerImpl:
     def _table(self, name: str) -> Dict[str, Any]:
         dep = self.deployments.get(name)
         return {"version": self.version,
-                "replicas": list(dep["replicas"]) if dep else []}
+                "replicas": list(dep["replicas"]) if dep else [],
+                "models": {r._actor_id: sorted(
+                               self._model_ids.get(r._actor_id, ()))
+                           for r in (dep["replicas"] if dep else [])}}
 
     async def get_routing_table(self, name: str,
                                 known_version: int = -1,

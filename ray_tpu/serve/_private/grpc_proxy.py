@@ -1,0 +1,136 @@
+"""gRPC ingress proxy.
+
+Reference: python/ray/serve/_private/proxy.py gRPCProxy + serve's gRPC
+ingress (grpc_util.py): requests arrive over gRPC, route to a deployment,
+and the reply streams back.  The reference compiles user-provided proto
+servicers; here the ingress speaks a GENERIC byte-oriented service
+instead (no protoc step): method path
+
+    /ray_tpu.serve.Generic/<deployment>[/<method>]
+
+with a request message that is either raw bytes (passed through to the
+deployment as one argument) or a pickled (args, kwargs) tuple when the
+client sets the `ray-tpu-pickled` metadata flag.  The response message is
+the pickled return value (or raw bytes when the deployment returns
+bytes).  `ray_tpu.serve.grpc_client` wraps this for Python callers; any
+gRPC stack can speak it by sending bytes on that method path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+from typing import Any, Dict
+
+import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
+
+SERVICE_PREFIX = "/ray_tpu.serve.Generic/"
+
+
+@ray_tpu.remote
+class GrpcProxyActor:
+    """gRPC ingress (reference: proxy.py gRPCProxy — one per node)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._routers: Dict[str, Any] = {}
+        core = ray_tpu._core()
+        fut = asyncio.run_coroutine_threadsafe(self._start(), core.loop)
+        self.port = fut.result(30)
+
+    async def _start(self) -> int:
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                path = handler_call_details.method
+                if not path.startswith(SERVICE_PREFIX):
+                    return None
+                target = path[len(SERVICE_PREFIX):]
+                dep, _, method = target.partition("/")
+                meta = dict(handler_call_details.invocation_metadata or ())
+
+                async def _unary(request: bytes, context):
+                    return await proxy._handle(dep, method or "__call__",
+                                               request, meta, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    _unary,
+                    request_deserializer=None,   # raw bytes in/out
+                    response_serializer=None)
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        return port
+
+    def ready(self) -> int:
+        return self.port
+
+    def _router_for(self, deployment: str):
+        r = self._routers.get(deployment)
+        if r is None:
+            from .controller import CONTROLLER_NAME
+            from .router import Router
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            r = self._routers[deployment] = Router(controller, deployment)
+        return r
+
+    async def _handle(self, deployment: str, method: str, request: bytes,
+                      meta: dict, context) -> bytes:
+        import grpc
+        try:
+            if meta.get("ray-tpu-pickled") == "1":
+                args, kwargs = pickle.loads(request)
+            else:
+                args, kwargs = (request,), {}
+            model_id = meta.get("ray-tpu-multiplexed-model-id") or None
+            loop = asyncio.get_running_loop()
+            # Router construction + assignment use the sync API: off-loop.
+            ref = await loop.run_in_executor(
+                None, lambda: self._router_for(deployment).assign(
+                    method, args, kwargs, model_id=model_id))
+            result = await ref
+            # One-byte discriminator: raw bytes vs pickled value (parse-
+            # guessing on the client would misread bytes payloads that
+            # happen to be valid pickle streams).
+            if isinstance(result, bytes):
+                return b"\x01" + result
+            return b"\x00" + pickle.dumps(result)
+        except Exception as e:  # noqa: BLE001 — gRPC surface reports all
+            logger.exception("grpc request failed")
+            await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+
+def grpc_client(address: str):
+    """Minimal Python client for the generic ingress: returns
+    call(deployment, *args, method="__call__", multiplexed_model_id=None)
+    -> result."""
+    import grpc
+
+    channel = grpc.insecure_channel(address)
+
+    def call(deployment: str, *args, method: str = "__call__",
+             multiplexed_model_id: str = None, timeout: float = 60.0,
+             **kwargs):
+        fn = channel.unary_unary(
+            f"{SERVICE_PREFIX}{deployment}/{method}",
+            request_serializer=None, response_deserializer=None)
+        meta = [("ray-tpu-pickled", "1")]
+        if multiplexed_model_id:
+            meta.append(("ray-tpu-multiplexed-model-id",
+                         multiplexed_model_id))
+        payload = pickle.dumps((args, kwargs))
+        out = fn(payload, metadata=meta, timeout=timeout)
+        if out[:1] == b"\x01":
+            return out[1:]            # raw bytes result
+        return pickle.loads(out[1:])
+
+    call.close = channel.close
+    return call

@@ -1,0 +1,120 @@
+"""Model multiplexing: many models per replica with LRU eviction.
+
+Reference: python/ray/serve/multiplex.py:22 (_ModelMultiplexWrapper) +
+serve/api.py:740 (@serve.multiplexed) — a deployment declares one
+model-loader method; requests tagged with a model id route preferentially
+to replicas already holding that model (router affinity), and each
+replica keeps at most N models, evicting least-recently-used (awaiting
+the model's __del__/release is the user's loader contract, as in the
+reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+import logging
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger("ray_tpu.serve")
+
+# Set by the replica around each request carrying a multiplexed model id
+# (reference: serve/context.py _serve_request_context.multiplexed_model_id).
+_request_model_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("serve_multiplexed_model_id", default=None)
+
+# The hosting replica registers itself so the wrapper can report its
+# current model set to the controller (routing affinity).
+_model_report_hook: Optional[Callable] = None
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id this request was tagged with
+    (reference: serve.get_multiplexed_model_id)."""
+    return _request_model_id.get() or ""
+
+
+class _ModelMultiplexWrapper:
+    """Per-replica LRU cache of loaded models keyed by model id."""
+
+    def __init__(self, loader: Callable, owner: Any, max_models: int):
+        self._loader = loader
+        self._owner = owner
+        self._max = max(1, int(max_models))
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}      # model_id -> asyncio.Future
+
+    def model_ids(self):
+        return list(self._models)
+
+    async def load_model(self, model_id: str) -> Any:
+        if not model_id:
+            raise ValueError(
+                "no multiplexed model id on this request; call the handle "
+                "with .options(multiplexed_model_id=...)")
+        if model_id in self._models:
+            self._models.move_to_end(model_id)
+            return self._models[model_id]
+        # Single-flight per model id (concurrent requests for the same
+        # model await one load).
+        fut = self._loading.get(model_id)
+        if fut is None:
+            fut = self._loading[model_id] = asyncio.get_running_loop(
+                ).create_future()
+            try:
+                res = self._loader(self._owner, model_id)
+                if inspect.isawaitable(res):
+                    res = await res
+                while len(self._models) >= self._max:
+                    evicted_id, evicted = self._models.popitem(last=False)
+                    logger.info("multiplex: evicting model %r", evicted_id)
+                    del evicted
+                self._models[model_id] = res
+                fut.set_result(res)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+                raise
+            finally:
+                self._loading.pop(model_id, None)
+                self._report()
+            return res
+        return await asyncio.shield(fut)
+
+    __call__ = load_model
+
+    def _report(self):
+        if _model_report_hook is not None:
+            try:
+                _model_report_hook(self.model_ids())
+            except Exception:
+                logger.exception("model-id report failed")
+
+
+class multiplexed:  # noqa: N801 — decorator, reference-parity name
+    """@serve.multiplexed(max_num_models_per_replica=N) on the loader
+    method of a deployment class (reference: serve/api.py:740)."""
+
+    def __init__(self, _fn: Callable = None, *,
+                 max_num_models_per_replica: int = 3):
+        self._fn = _fn
+        self._max = max_num_models_per_replica
+        self._attr = None
+
+    def __call__(self, fn: Callable) -> "multiplexed":
+        self._fn = fn
+        return self
+
+    def __set_name__(self, owner, name):
+        self._attr = f"__serve_multiplex_{name}"
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        wrapper = getattr(obj, self._attr, None) if self._attr else None
+        if wrapper is None:
+            wrapper = _ModelMultiplexWrapper(self._fn, obj, self._max)
+            if self._attr:
+                object.__setattr__(obj, self._attr, wrapper)
+        return wrapper

@@ -59,6 +59,44 @@ class ReplicaActor:
         finally:
             self._ongoing -= 1
 
+    async def handle_request_multiplexed(self, method: str, args: tuple,
+                                         kwargs: dict, model_id: str
+                                         ) -> Any:
+        """handle_request with the request's multiplexed model id bound
+        into the context (reference: replica.py multiplexed request
+        metadata -> serve.get_multiplexed_model_id)."""
+        from . import multiplex as _mx
+        if _mx._model_report_hook is None:
+            _mx._model_report_hook = self._report_models
+        token = _mx._request_model_id.set(model_id)
+        try:
+            return await self.handle_request(method, args, kwargs)
+        finally:
+            _mx._request_model_id.reset(token)
+
+    def _report_models(self, model_ids):
+        """Push this replica's model set to the controller so routers
+        prefer it for those models (fire-and-forget).  Called from the
+        replica's event loop (inside load_model), so the controller
+        lookup must use the async path."""
+        from ray_tpu._private import rpc
+        core = ray_tpu._core()
+        ids = list(model_ids)
+
+        async def _go():
+            try:
+                from ray_tpu.actor import ActorHandle
+                info = await core.get_actor_info_async(
+                    name="SERVE_CONTROLLER")
+                if info is None:
+                    return
+                ActorHandle(bytes(info["actor_id"])).update_model_ids \
+                    .remote(core.current_actor_id, ids)
+            except Exception:
+                pass
+
+        rpc.spawn(_go())
+
     async def ongoing_requests(self) -> int:
         """Autoscaling metric (reference: replica queue length stats
         feeding autoscaling_state.py)."""

@@ -1,100 +1,183 @@
-"""Router: replica choice with power-of-two-choices load balancing.
+"""Router: push-updated replica sets + power-of-two-choices balancing.
 
 Reference: python/ray/serve/_private/router.py:472 +
 request_router/pow_2_router.py:27 — sample two replicas, send to the one
-with fewer in-flight requests from this router; replica sets refresh from
-the controller (long-poll in async contexts, stale-triggered fetch in sync
-driver contexts).
+with fewer in-flight requests from this router — and long_poll.py:228:
+replica sets are PUSHED from the controller (here over GCS pubsub), so
+replica churn reaches every router in one publish hop and the request
+path never blocks on the controller.  Multiplexed requests prefer
+replicas that already hold the model (reference: multiplex-aware ranking
+in replica_scheduler).
 """
 
 from __future__ import annotations
 
+import logging
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
+logger = logging.getLogger("ray_tpu.serve")
+
+# Fallback poll interval when the pubsub subscription could not be
+# established (the push path makes this a safety net, not the mechanism).
+_FALLBACK_REFRESH_S = 30.0
+
 
 class Router:
-    def __init__(self, controller, deployment: str,
-                 refresh_interval_s: float = 2.0):
+    def __init__(self, controller, deployment: str):
         self._controller = controller
         self._deployment = deployment
         self._replicas: List[Any] = []
+        self._models: Dict[bytes, set] = {}
         self._version = -1
         self._inflight: Dict[bytes, int] = {}
         self._last_refresh = 0.0
-        self._refresh_interval_s = refresh_interval_s
+        self._table_event = threading.Event()   # set on any table update
+        self._subscribed = False
+        self._channel = f"serve_rt:{deployment}"
+        try:
+            core = ray_tpu._core()
+            core.subscribe(self._channel, self._on_push)
+            self._subscribed = True
+        except Exception:
+            logger.exception("router pubsub subscribe failed; "
+                             "falling back to polling")
 
-    def _refresh(self, force: bool = False, wait_nonempty_s: float = 30.0):
-        now = time.monotonic()
-        if (not force and self._replicas
-                and now - self._last_refresh < self._refresh_interval_s):
+    def close(self) -> None:
+        """Drop the pubsub callback (serve.shutdown; prevents dead
+        routers from accumulating in the core's handler table)."""
+        if self._subscribed:
+            try:
+                ray_tpu._core().unsubscribe(self._channel, self._on_push)
+            except Exception:
+                pass
+            self._subscribed = False
+
+    # ------------------------------------------------------------- updates --
+    def _on_push(self, msg: dict) -> None:
+        """Controller-pushed table (runs on the core's event loop)."""
+        from ray_tpu.actor import ActorHandle
+        if msg.get("version", -1) < self._version:
+            return            # stale out-of-order publish
+        self._replicas = [ActorHandle(bytes(r["id"]))
+                          for r in msg.get("replicas", [])]
+        self._models = {bytes(r["id"]): set(r.get("models", ()))
+                        for r in msg.get("replicas", [])}
+        self._version = msg.get("version", self._version)
+        self._last_refresh = time.monotonic()
+        self._table_event.set()
+
+    def _apply_table(self, table: dict) -> None:
+        if table["version"] < self._version:
+            return   # a push already delivered something newer
+        self._version = table["version"]
+        self._replicas = table["replicas"]
+        self._models = {rid: set(ms)
+                        for rid, ms in table.get("models", {}).items()}
+        self._last_refresh = time.monotonic()
+        self._table_event.set()
+
+    # Even with a live subscription, re-poll occasionally: the subscribe
+    # RPC itself is fire-and-forget, so this bounds the damage if it was
+    # lost (a frozen table would otherwise never recover).
+    _SUBSCRIBED_SAFETY_REFRESH_S = 60.0
+
+    def _stale(self) -> bool:
+        if not self._replicas:
+            return True
+        age = time.monotonic() - self._last_refresh
+        if self._subscribed:
+            return age > self._SUBSCRIBED_SAFETY_REFRESH_S
+        return age > _FALLBACK_REFRESH_S
+
+    def _refresh(self, wait_nonempty_s: float = 30.0):
+        if not self._stale():
             return
-        deadline = now + wait_nonempty_s
-        known = -1 if force else self._version
+        deadline = time.monotonic() + wait_nonempty_s
+        known = -1
         while True:
             table = ray_tpu.get(
                 self._controller.get_routing_table.remote(
                     self._deployment, known, 5.0), timeout=35)
-            self._version = table["version"]
-            self._replicas = table["replicas"]
-            self._last_refresh = time.monotonic()
+            self._apply_table(table)
             if self._replicas or time.monotonic() >= deadline:
                 return
+            # Empty table: with a live subscription, wait for the push
+            # instead of hammering the long-poll.
+            if self._subscribed:
+                self._table_event.clear()
+                if self._table_event.wait(
+                        max(0.0, deadline - time.monotonic())):
+                    if self._replicas:
+                        return
             known = self._version
 
-    async def _refresh_async(self, force: bool = False,
-                             wait_nonempty_s: float = 30.0):
-        """Loop-thread-safe refresh (awaits the controller ref directly)
-        for handles used inside deployments/async actors."""
-        now = time.monotonic()
-        if (not force and self._replicas
-                and now - self._last_refresh < self._refresh_interval_s):
+    async def _refresh_async(self, wait_nonempty_s: float = 30.0):
+        """Loop-thread-safe refresh for handles used inside deployments."""
+        if not self._stale():
             return
-        deadline = now + wait_nonempty_s
-        known = -1 if force else self._version
+        deadline = time.monotonic() + wait_nonempty_s
+        known = -1
         while True:
             table = await self._controller.get_routing_table.remote(
                 self._deployment, known, 5.0)
-            self._version = table["version"]
-            self._replicas = table["replicas"]
-            self._last_refresh = time.monotonic()
+            self._apply_table(table)
             if self._replicas or time.monotonic() >= deadline:
                 return
             known = self._version
 
-    async def assign_async(self, method: str, args: tuple, kwargs: dict):
-        """assign() for async contexts (model composition: a deployment
-        calling another deployment's handle — reference: handle.py async
-        dispatch path)."""
+    # ------------------------------------------------------------ dispatch --
+    async def assign_async(self, method: str, args: tuple, kwargs: dict,
+                           model_id: Optional[str] = None):
         await self._refresh_async()
-        return self._dispatch(method, args, kwargs)
+        return self._dispatch(method, args, kwargs, model_id)
 
-    def assign(self, method: str, args: tuple, kwargs: dict):
-        """Pick a replica (pow-2) and dispatch; returns the ObjectRef."""
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: Optional[str] = None):
+        """Pick a replica (pow-2, model-affine) and dispatch."""
         self._refresh()
-        return self._dispatch(method, args, kwargs)
+        return self._dispatch(method, args, kwargs, model_id)
 
-    def _dispatch(self, method: str, args: tuple, kwargs: dict):
-        if not self._replicas:
+    def _pick(self, replicas: List[Any], model_id: Optional[str]):
+        if model_id is not None:
+            # Prefer replicas that already hold the model; fall back to
+            # everyone (the chosen replica then loads it, possibly
+            # evicting LRU — reference: multiplex.py).
+            holding = [r for r in replicas
+                       if model_id in self._models.get(r._actor_id, ())]
+            if holding:
+                replicas = holding
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        return min((a, b),
+                   key=lambda r: self._inflight.get(r._actor_id, 0))
+
+    def _dispatch(self, method: str, args: tuple, kwargs: dict,
+                  model_id: Optional[str] = None):
+        # Snapshot: _on_push mutates self._replicas from the core loop
+        # thread; the emptiness check and the pick must see one list.
+        replicas = self._replicas
+        if not replicas:
             raise RuntimeError(
                 f"no replicas available for deployment "
                 f"{self._deployment!r}")
-        if len(self._replicas) == 1:
-            replica = self._replicas[0]
-        else:
-            a, b = random.sample(self._replicas, 2)
-            replica = min(
-                (a, b), key=lambda r: self._inflight.get(r._actor_id, 0))
+        replica = self._pick(replicas, model_id)
         rid = replica._actor_id
         self._inflight[rid] = self._inflight.get(rid, 0) + 1
         try:
-            ref = replica.handle_request.remote(method, args, kwargs)
+            if model_id is not None:
+                ref = replica.handle_request_multiplexed.remote(
+                    method, args, kwargs, model_id)
+            else:
+                ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             self._inflight[rid] -= 1
-            # Invalidate so the next assign (sync or async) refetches.
+            # Invalidate so the next assign refetches.
             self._replicas, self._version = [], -1
             raise
         fut = ref.future()

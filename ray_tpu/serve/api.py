@@ -18,6 +18,7 @@ from ._private.controller import CONTROLLER_NAME, ServeController
 from ._private.router import Router
 
 _proxy = None          # ProxyActor handle (one per serve.start with http)
+_grpc_proxy = None     # GrpcProxyActor handle
 _http_port: Optional[int] = None
 _routes: Dict[str, str] = {}
 
@@ -116,30 +117,48 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     """reference: serve/handle.py:692; method access via attribute chaining
-    (handle.method.remote(...)), plain calls via handle.remote(...)."""
+    (handle.method.remote(...)), plain calls via handle.remote(...).
+    .options(multiplexed_model_id=...) tags requests for model-affine
+    routing (reference: handle.py options + multiplex)."""
 
-    def __init__(self, deployment_name: str, method: str = "__call__"):
+    # Routers are shared per (deployment, process): handle copies and
+    # .options() clones reuse one pushed routing table + inflight map.
+    _routers: Dict[str, Router] = {}
+
+    def __init__(self, deployment_name: str, method: str = "__call__",
+                 multiplexed_model_id: Optional[str] = None):
         self._deployment = deployment_name
         self._method = method
-        self._router: Optional[Router] = None
+        self._model_id = multiplexed_model_id
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return DeploymentHandle(self._deployment, item)
+        return DeploymentHandle(self._deployment, item, self._model_id)
 
-    def _get_router(self) -> Router:
-        if self._router is None:
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            self._router = Router(controller, self._deployment)
-        return self._router
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._deployment, method_name or self._method,
+            multiplexed_model_id
+            if multiplexed_model_id is not None else self._model_id)
+
+    def _get_router(self, controller=None) -> Router:
+        router = self._routers.get(self._deployment)
+        if router is None:
+            if controller is None:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            router = Router(controller, self._deployment)
+            self._routers[self._deployment] = router
+        return router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import asyncio
         try:
             asyncio.get_running_loop()
         except RuntimeError:
-            ref = self._get_router().assign(self._method, args, kwargs)
+            ref = self._get_router().assign(
+                self._method, args, kwargs, model_id=self._model_id)
             return DeploymentResponse(ref=ref)
         # Called from inside the event loop (an async actor / another
         # deployment): dispatch eagerly on the loop, fully async.
@@ -147,7 +166,8 @@ class DeploymentHandle:
             task=asyncio.ensure_future(self._remote_async(args, kwargs)))
 
     async def _remote_async(self, args, kwargs):
-        if self._router is None:
+        router = self._routers.get(self._deployment)
+        if router is None:
             from ray_tpu._private.worker import global_runtime
             from ray_tpu.actor import ActorHandle
             core = global_runtime().core
@@ -156,12 +176,14 @@ class DeploymentHandle:
                 raise ValueError(f"no actor named {CONTROLLER_NAME!r}")
             controller = ActorHandle(bytes(info["actor_id"]),
                                      info.get("class_name", ""))
-            self._router = Router(controller, self._deployment)
-        ref = await self._router.assign_async(self._method, args, kwargs)
+            router = self._get_router(controller)
+        ref = await router.assign_async(self._method, args, kwargs,
+                                        model_id=self._model_id)
         return await ref
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._deployment, self._method))
+        return (DeploymentHandle, (self._deployment, self._method,
+                                   self._model_id))
 
 
 def _get_or_create_controller():
@@ -174,10 +196,12 @@ def _get_or_create_controller():
 
 
 def start(http_host: str = "127.0.0.1",
-          http_port: Optional[int] = None) -> None:
-    """Start the Serve control plane (reference: serve.start). HTTP ingress
-    only spins up when a port is given."""
-    global _proxy, _http_port
+          http_port: Optional[int] = None,
+          grpc_port: Optional[int] = None) -> Optional[int]:
+    """Start the Serve control plane (reference: serve.start). HTTP/gRPC
+    ingress only spin up when a port is given (0 = OS-assigned).  Returns
+    the bound gRPC port when gRPC was requested."""
+    global _proxy, _http_port, _grpc_proxy
     _get_or_create_controller()
     if http_port is not None and _proxy is None:
         from ._private.proxy import ProxyActor
@@ -186,6 +210,13 @@ def start(http_host: str = "127.0.0.1",
             http_host, http_port)
         ray_tpu.get(_proxy.ready.remote(), timeout=60)
         _http_port = http_port
+    if grpc_port is not None and _grpc_proxy is None:
+        from ._private.grpc_proxy import GrpcProxyActor
+        _grpc_proxy = GrpcProxyActor.options(
+            name="SERVE_GRPC_PROXY", get_if_exists=True).remote(
+            http_host, grpc_port)
+        return ray_tpu.get(_grpc_proxy.ready.remote(), timeout=60)
+    return None
 
 
 def run(app: Application, *, name: Optional[str] = None,
@@ -227,18 +258,25 @@ def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
 
 
 def shutdown() -> None:
-    """Tear down all deployments, the controller, and the proxy."""
-    global _proxy, _routes
+    """Tear down all deployments, the controller, and the proxies."""
+    global _proxy, _grpc_proxy, _routes
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
         ray_tpu.kill(controller)
     except ValueError:
         pass
-    if _proxy is not None:
+    for h in (_proxy, _grpc_proxy):
+        if h is not None:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+    _proxy = _grpc_proxy = None
+    for router in DeploymentHandle._routers.values():
         try:
-            ray_tpu.kill(_proxy)
+            router.close()
         except Exception:
             pass
-        _proxy = None
+    DeploymentHandle._routers.clear()
     _routes = {}

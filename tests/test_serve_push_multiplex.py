@@ -1,0 +1,160 @@
+"""Serve push-based routing, model multiplexing, gRPC ingress.
+
+Reference model: serve/_private/long_poll.py:228 (LongPollHost push),
+serve/multiplex.py:22 (_ModelMultiplexWrapper LRU), serve/api.py:740
+(@serve.multiplexed), _private/proxy.py gRPCProxy.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    serve.start()
+    yield
+    serve.shutdown()
+
+
+def test_push_propagates_replica_churn_fast(serve_cluster):
+    """Replica-set changes reach routers by controller push, not polling:
+    after a scale-up the router's table updates well under the old 2s
+    poll interval without any request traffic."""
+    @serve.deployment(num_replicas=1)
+    class D:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(D.bind(), name="push_test")
+    assert h.remote(1).result(timeout_s=30) == 1
+    router = h._get_router()
+    assert router._subscribed, "router did not subscribe to pushes"
+    v0 = router._version
+    n0 = len(router._replicas)
+    assert n0 == 1
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    # Redeploy with 3 replicas; measure push latency from the bump.
+    serve.run(D.options(num_replicas=3).bind(), name="push_test",
+              _blocking=False)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if len(router._replicas) >= 3 and router._version > v0:
+            break
+        time.sleep(0.01)
+    assert len(router._replicas) >= 3
+    # Now verify PUSH latency with replicas already warm: kill one
+    # replica via scale-down and watch the table shrink without issuing
+    # any requests (a poller would need its interval to elapse AND a
+    # request to trigger the fetch).
+    t0 = time.monotonic()
+    ray_tpu.get(controller.deploy.remote(
+        "push_test", *_dep_args(D, ()), 2, None, None), timeout=30)
+    while time.monotonic() - t0 < 10:
+        if len(router._replicas) == 2:
+            break
+        time.sleep(0.005)
+    dt = time.monotonic() - t0
+    assert len(router._replicas) == 2
+    assert dt < 1.5, f"churn took {dt*1000:.0f}ms to reach the router"
+
+
+def _dep_args(dep, init_args):
+    import cloudpickle
+    return cloudpickle.dumps(dep._target), init_args, {}
+
+
+def test_multiplexed_lru_and_affinity(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[1:])}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return x * model["scale"]
+
+        async def load_log(self):
+            return list(self.loads)
+
+    h = serve.run(MultiModel.bind(), name="mux")
+    assert h.options(multiplexed_model_id="m2").remote(
+        10).result(timeout_s=60) == 20
+    assert h.options(multiplexed_model_id="m3").remote(
+        10).result(timeout_s=60) == 30
+    # Cached: no reload for a resident model.
+    assert h.options(multiplexed_model_id="m2").remote(
+        5).result(timeout_s=60) == 10
+    # Third model evicts the LRU one (m3 was used more recently than m2?
+    # m2 was touched last -> m3 is LRU).
+    assert h.options(multiplexed_model_id="m4").remote(
+        1).result(timeout_s=60) == 4
+    # m3 was evicted: using it again must reload.
+    assert h.options(multiplexed_model_id="m3").remote(
+        1).result(timeout_s=60) == 3
+    loads = h.load_log.remote().result(timeout_s=30)
+    counts = {m: sum(1 for x in loads if x == m) for m in set(loads)}
+    assert counts["m2"] == 1          # never evicted
+    assert counts["m3"] == 2          # evicted once, reloaded
+    assert counts["m4"] == 1
+
+    # Router affinity: the replica's model set reached the routing table.
+    router = h._get_router()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        models = set().union(*router._models.values()) \
+            if router._models else set()
+        if "m3" in models:
+            break
+        time.sleep(0.05)
+    assert any("m3" in ms for ms in router._models.values())
+
+
+def test_multiplexed_requires_model_id(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class M:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, x):
+            model = await self.get_model(serve.get_multiplexed_model_id())
+            return f"{model}:{x}"
+
+    h = serve.run(M.bind(), name="mux_req")
+    with pytest.raises(Exception):
+        h.remote(1).result(timeout_s=30)   # no model id tagged
+    assert h.options(multiplexed_model_id="a").remote(
+        1).result(timeout_s=30) == "a:1"
+
+
+def test_grpc_ingress(serve_cluster):
+    from ray_tpu.serve._private.grpc_proxy import grpc_client
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return {"got": x}
+
+        def shout(self, s):
+            return s.upper()
+
+    serve.run(Echo.bind(), name="grpc_echo")
+    port = serve.start(grpc_port=0)
+    assert port and port > 0
+    call = grpc_client(f"127.0.0.1:{port}")
+    assert call("grpc_echo", 42) == {"got": 42}
+    assert call("grpc_echo", "hey", method="shout") == "HEY"
+    call.close()
