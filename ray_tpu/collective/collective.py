@@ -113,8 +113,45 @@ class HostCollectiveGroup:
 
     def reduce(self, tensor: np.ndarray, dst_rank: int = 0,
                op: str = "sum") -> np.ndarray:
-        out = self.allreduce(tensor, op)
-        return out if self.rank == dst_rank else np.asarray(tensor)
+        """Binomial-tree reduce toward dst_rank: each rank reads at most
+        log2(W) partials and writes one, vs the W-reads-per-rank of a
+        full allreduce (reference: collective.py:392 reduce is a true
+        rooted reduction, not allreduce-at-everyone)."""
+        if self.world_size == 1:
+            return np.asarray(tensor)
+        self._seq += 1
+        base = f"{self.name}/{self._seq}/rd"
+        acc = np.asarray(tensor)
+        # Virtual ranks place dst at 0 so the standard binomial recursion
+        # roots there.
+        vr = (self.rank - dst_rank) % self.world_size
+        mask = 1
+        while mask < self.world_size:
+            if vr & mask:
+                # Leaf for this level: ship the partial up and stop
+                # combining.
+                _KV.put(f"{base}/{self.rank}", pickle.dumps(acc))
+                break
+            child_vr = vr + mask
+            if child_vr < self.world_size:
+                child = (child_vr + dst_rank) % self.world_size
+                part = pickle.loads(
+                    _KV.wait(f"{base}/{child}", self.timeout_s))
+                acc = REDUCE_OPS[op]([acc, part])
+            mask <<= 1
+        if vr == 0:
+            out = acc
+            # Completion marker: non-dst ranks block on it, which (a)
+            # keeps all ranks in lockstep rounds and (b) proves every
+            # rank wrote this round before anyone advances — the
+            # precondition the lag-2 cleanup relies on.
+            _KV.put(f"{base}/done", b"1")
+        else:
+            _KV.wait(f"{base}/done", self.timeout_s)
+            out = np.asarray(tensor)
+        if self.rank == 0 and self._seq >= 3:
+            _KV.delete_prefix(f"{self.name}/{self._seq - 2}/")
+        return out
 
     def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
         parts = self._round(pickle.dumps(np.asarray(tensor)), "ag")
@@ -135,8 +172,30 @@ class HostCollectiveGroup:
 
     def reducescatter(self, tensor: np.ndarray,
                       op: str = "sum") -> np.ndarray:
-        full = self.allreduce(tensor, op)
-        return np.array_split(full, self.world_size, axis=0)[self.rank]
+        """Chunked reduce-scatter: rank r publishes chunk j of its local
+        tensor to rank j and reads only chunk r from each peer — O(N)
+        bytes moved per rank instead of the O(W·N) an
+        allreduce-then-slice pays (reference: collective.py:553)."""
+        x = np.asarray(tensor)
+        w = self.world_size
+        if w == 1:
+            return x
+        self._seq += 1
+        base = f"{self.name}/{self._seq}/rs"
+        chunks = np.array_split(x, w, axis=0)
+        for j in range(w):
+            if j != self.rank:
+                _KV.put(f"{base}/{self.rank}-{j}", pickle.dumps(chunks[j]))
+        mine = [chunks[self.rank]]
+        for r in range(w):
+            if r != self.rank:
+                mine.append(pickle.loads(
+                    _KV.wait(f"{base}/{r}-{self.rank}", self.timeout_s)))
+        # Symmetric round (every rank reads a write from every peer), so
+        # the same lag-2 cleanup argument as _round applies.
+        if self.rank == 0 and self._seq >= 3:
+            _KV.delete_prefix(f"{self.name}/{self._seq - 2}/")
+        return REDUCE_OPS[op](mine)
 
     def barrier(self) -> None:
         self._round(b"", "bar")
@@ -173,6 +232,7 @@ class XlaCollectiveGroup:
         self.name = group_name
         self.world_size = world_size
         self.rank = rank
+        self._bridge: Optional[HostCollectiveGroup] = None
         if world_size > 1 and jax.process_count() != world_size:
             raise RuntimeError(
                 f"XlaCollectiveGroup({group_name}) needs a formed "
@@ -224,6 +284,30 @@ class XlaCollectiveGroup:
         multihost_utils.sync_global_devices(f"ray_tpu:{self.name}")
 
     def reducescatter(self, tensor, op: str = "sum"):
+        """In-graph psum_scatter over the process axis when the layout
+        allows (sum, 1 device/process, divisible length): the reduction
+        and the scatter ride ICI in one fused XLA collective, O(N)
+        per-link instead of allgather's O(W·N).  Other shapes fall back
+        to allreduce + slice."""
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray(tensor)
+        if self.world_size == 1:
+            return x
+        if (op == "sum" and jax.local_device_count() == 1
+                and x.shape[0] % self.world_size == 0):
+            from jax.experimental import multihost_utils
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            mesh = self._global_mesh()
+            g = multihost_utils.host_local_array_to_global_array(
+                x, mesh, P("p"))
+            out = jax.jit(shard_map(
+                lambda s: jax.lax.psum_scatter(
+                    s, "p", scatter_dimension=0, tiled=True),
+                mesh=mesh, in_specs=P("p"), out_specs=P("p")))(g)
+            return multihost_utils.global_array_to_host_local_array(
+                out, mesh, P("p"))
         full = self.allreduce(tensor, op)
         return np.array_split(np.asarray(full), self.world_size,
                               axis=0)[self.rank]
@@ -232,16 +316,33 @@ class XlaCollectiveGroup:
         out = self.allreduce(tensor, op)
         return out if self.rank == dst_rank else tensor
 
-    def send(self, tensor, dst_rank: int):
-        raise NotImplementedError(
-            "xla backend p2p: use the host backend for control-plane "
-            "send/recv, or jax.lax.ppermute inside a shard_map for "
-            "in-graph device transfers")
+    # ------------------------------------------------------------------ p2p
 
-    recv = send
+    def _host_bridge(self) -> HostCollectiveGroup:
+        # Lazily-built host-plane twin of this group: device arrays are
+        # staged through host memory and the GCS KV (the DCN tier).
+        # In-graph device-to-device transfers belong in lax.ppermute
+        # inside a shard_map — this bridge covers the control-plane and
+        # cross-mesh cases (reference: collective.py:612/:675 send/recv).
+        if self._bridge is None:
+            self._bridge = HostCollectiveGroup(
+                f"{self.name}@xla-p2p", self.world_size, self.rank)
+        return self._bridge
+
+    def send(self, tensor, dst_rank: int):
+        self._host_bridge().send(np.asarray(tensor), dst_rank)
+
+    def recv(self, src_rank: int):
+        import jax.numpy as jnp
+        return jnp.asarray(self._host_bridge().recv(src_rank))
 
     def destroy(self) -> None:
-        pass
+        # Unconditional on rank 0: peers create the p2p bridge lazily, so
+        # rank 0 may have no bridge while unconsumed sends from other
+        # ranks still sit under the bridge namespace in the KV.
+        if self.rank == 0:
+            _KV.delete_prefix(f"{self.name}@xla-p2p/")
+        self._bridge = None
 
 
 BACKENDS = {"host": HostCollectiveGroup, "xla": XlaCollectiveGroup,

@@ -8,13 +8,20 @@ learner_group.py:101), PPO (algorithms/ppo/ppo.py).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import DQN, DQNConfig, DQNLearner
 from .env_runner import EnvRunner, EnvRunnerGroup
+from .impala import (IMPALA, AggregatorActor, IMPALAConfig, ImpalaLearner,
+                     vtrace)
 from .learner import Learner, LearnerGroup, compute_gae
 from .ppo import PPO, PPOConfig
+from .replay_buffers import (EpisodeReplayBuffer, PrioritizedReplayBuffer,
+                             ReplayBuffer)
 from .rl_module import RLModule, RLModuleSpec
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "EnvRunner", "EnvRunnerGroup",
-    "Learner", "LearnerGroup", "compute_gae", "PPO", "PPOConfig",
-    "RLModule", "RLModuleSpec",
+    "Algorithm", "AlgorithmConfig", "AggregatorActor", "DQN", "DQNConfig",
+    "DQNLearner", "EnvRunner", "EnvRunnerGroup", "EpisodeReplayBuffer",
+    "IMPALA", "IMPALAConfig", "ImpalaLearner", "Learner", "LearnerGroup",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "compute_gae", "PPO",
+    "PPOConfig", "RLModule", "RLModuleSpec", "vtrace",
 ]

@@ -108,6 +108,10 @@ class Algorithm:
     """Driver-side training loop (reference: algorithm.py; Trainable
     surface: train()/save()/restore()/stop() so Tune can drive it)."""
 
+    # Subclasses select their loss family here (reference: Algorithm
+    # subclasses override get_default_learner_class).
+    learner_class: Optional[type] = None
+
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
@@ -116,7 +120,8 @@ class Algorithm:
         self.learner_group = LearnerGroup(
             spec_kwargs, config.learner_config_dict(),
             num_learners=config.num_learners,
-            learner_resources=config.learner_resources, seed=config.seed)
+            learner_resources=config.learner_resources, seed=config.seed,
+            learner_cls=self.learner_class)
         self.env_runner_group = EnvRunnerGroup(
             env_name=config.env, spec_kwargs=spec_kwargs,
             num_env_runners=config.num_env_runners,

@@ -87,8 +87,10 @@ class EnvRunner:
         self.gamma = gamma
         self.key = jax.random.key(seed)
         self._explore = jax.jit(self.module.forward_exploration)
+        self._greedy = jax.jit(self.module.forward_inference)
         self._value_only = jax.jit(
             lambda p, o: self.module.logits_and_value(p, o)[1])
+        self._np_rng = np.random.default_rng(seed)
 
     def sample(self, weights, rollout_len: int) -> Dict[str, Any]:
         import jax
@@ -128,6 +130,58 @@ class EnvRunner:
             "rewards": np.stack(rew_l),
             "dones": np.stack(done_l),
             "bootstrap_value": bootstrap,
+            # Raw final observations: off-policy learners (V-trace)
+            # recompute the bootstrap value with CURRENT params instead
+            # of trusting the stale runner-side vf.
+            "final_obs": obs.astype(np.float32),
+            "episode_returns": self.vec.drain_returns(),
+        }
+
+    def sample_transitions(self, weights, n_steps: int,
+                           epsilon: float) -> Dict[str, Any]:
+        """Epsilon-greedy flat transition collection for off-policy
+        algorithms (reference: env runners feeding
+        utils/replay_buffers — obs/action/reward/next_obs/done rows).
+
+        Terminals are REAL terminals only: a time-limit truncation stores
+        done=False with the true final observation as next_obs, so the
+        Q target still bootstraps through the cut (reference: episode
+        truncation handling in single_agent_env_runner).  Arrays come
+        back time-major [T, N, ...] with a `resets` mask (done OR trunc)
+        so the caller can fold n-step returns without blending
+        episodes."""
+        import jax.numpy as jnp
+
+        rows_obs, rows_next, rows_act, rows_rew = [], [], [], []
+        rows_done, rows_reset = [], []
+        obs = self.vec.obs
+        n_envs = obs.shape[0]
+        rng = self._np_rng
+        for _ in range(n_steps):
+            greedy = np.asarray(self._greedy(
+                weights, jnp.asarray(obs, jnp.float32)))
+            explore = rng.random(n_envs) < epsilon
+            actions = np.where(
+                explore, rng.integers(0, self.module.spec.num_actions,
+                                      n_envs), greedy)
+            prev_obs = obs.astype(np.float32)
+            obs, rewards, dones, truncs, final_obs = self.vec.step(actions)
+            next_obs = obs.astype(np.float32)  # astype = private copy
+            for i in np.where(truncs)[0]:
+                next_obs[i] = final_obs[i]
+            rows_obs.append(prev_obs)
+            rows_next.append(next_obs)
+            rows_act.append(actions)
+            rows_rew.append(rewards)
+            rows_done.append(dones & ~truncs)
+            rows_reset.append(dones)
+        return {
+            "obs": np.stack(rows_obs),
+            "next_obs": np.stack(rows_next),
+            "actions": np.stack(rows_act).astype(np.int32),
+            "rewards": np.stack(rows_rew).astype(np.float32),
+            "dones": np.stack(rows_done),
+            "resets": np.stack(rows_reset),
             "episode_returns": self.vec.drain_returns(),
         }
 

@@ -147,28 +147,33 @@ class Learner:
 
 import ray_tpu
 
-RemoteLearner = ray_tpu.remote(Learner)
-
 
 class LearnerGroup:
     """Local or remote learner placement (reference:
     core/learner/learner_group.py:101). num_learners=0 runs in-process
-    (driver); 1 runs a remote learner actor (e.g. pinned to a TPU host)."""
+    (driver); 1 runs a remote learner actor (e.g. pinned to a TPU host).
+    learner_cls selects the loss family (PPO default, DQN/IMPALA
+    subclasses)."""
 
     def __init__(self, spec_kwargs, config, *, num_learners: int = 0,
-                 learner_resources=None, seed: int = 0):
+                 learner_resources=None, seed: int = 0,
+                 learner_cls: type = None):
+        learner_cls = learner_cls or Learner
         self.is_remote = num_learners > 0
         if self.is_remote:
             res = dict(learner_resources or {})
-            self.learner = RemoteLearner.options(
+            self.learner = ray_tpu.remote(learner_cls).options(
                 num_cpus=res.get("num_cpus", 1),
                 num_tpus=res.get("num_tpus", 0),
                 resources=res.get("resources")).remote(
                 spec_kwargs, config, seed)
         else:
-            self.learner = Learner(spec_kwargs, config, seed)
+            self.learner = learner_cls(spec_kwargs, config, seed)
 
     def update(self, samples):
+        """samples may contain ObjectRefs; the remote path passes them
+        through unresolved (the learner actor pulls the data, the driver
+        never materializes it — reference: LearnerGroup async updates)."""
         if self.is_remote:
             import ray_tpu
             return ray_tpu.get(self.learner.update.remote(samples),

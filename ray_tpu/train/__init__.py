@@ -7,8 +7,8 @@ CheckpointConfig, Result, DataParallelTrainer) and train/v2/jax
 """
 
 from ._checkpoint import Checkpoint, CheckpointManager
-from ._session import (TrainContext, get_context, get_dataset_shard,
-                       report)
+from ._session import (TrainContext, get_checkpoint, get_context,
+                       get_dataset_shard, report)
 from .backend import Backend, BackendConfig, JaxConfig
 from .callbacks import UserCallback
 from .trainer import (CheckpointConfig, DataParallelTrainer, FailureConfig,

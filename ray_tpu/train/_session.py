@@ -33,6 +33,9 @@ class TrainSession:
         # name -> DataIterator for this worker's shard (reference:
         # train session dataset_shard plumbing).
         self.dataset_shards: Dict[str, Any] = {}
+        # Packed checkpoint to resume from (set by the controller on
+        # restart/exploit; read via get_checkpoint()).
+        self.resume_packed: Optional[bytes] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional["Checkpoint"] = None) -> None:
@@ -116,3 +119,14 @@ def get_dataset_shard(name: str = "train"):
             f"no dataset {name!r} was passed to the trainer "
             f"(have: {sorted(s.dataset_shards)})")
     return s.dataset_shards[name]
+
+
+def get_checkpoint():
+    """The checkpoint this worker should resume from, or None (reference:
+    ray.train.get_checkpoint / ray.tune.get_checkpoint — set by the
+    controller on failure restart or a PBT exploit)."""
+    s = get_session()
+    if s is None or s.resume_packed is None:
+        return None
+    from ._checkpoint import Checkpoint
+    return Checkpoint.unpack(s.resume_packed)

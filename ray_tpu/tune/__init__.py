@@ -5,8 +5,10 @@ TuneConfig, grid_search + sampling distributions (search/sample.py),
 schedulers (ASHAScheduler), tune.report, ResultGrid.
 """
 
+from ..train._session import get_checkpoint
 from ..train._session import report as _session_report
-from .schedulers import ASHAScheduler, FIFOScheduler
+from .schedulers import (ASHAScheduler, FIFOScheduler,
+                         PopulationBasedTraining)
 from .search import (choice, grid_search, loguniform, randint, uniform,
                      generate_variants)
 from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneController,
@@ -22,5 +24,6 @@ def report(metrics, checkpoint=None):
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "TuneController",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "generate_variants", "ASHAScheduler", "FIFOScheduler", "report",
+    "generate_variants", "ASHAScheduler", "FIFOScheduler",
+    "PopulationBasedTraining", "report", "get_checkpoint",
 ]

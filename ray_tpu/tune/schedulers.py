@@ -1,17 +1,24 @@
-"""Trial schedulers: FIFO + Async Successive Halving (ASHA).
+"""Trial schedulers: FIFO, Async Successive Halving (ASHA), PBT.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py
 (AsyncHyperBandScheduler/ASHAScheduler) — rungs at
 grace_period * reduction_factor^k; at each rung a trial continues only if
-its metric is in the top 1/reduction_factor of results recorded there.
+its metric is in the top 1/reduction_factor of results recorded there —
+and tune/schedulers/pbt.py (PopulationBasedTraining: exploit = clone a
+top-quantile trial's checkpoint + config, explore = perturb/resample
+hyperparams).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# PBT: stop the current actor, clone config+checkpoint from a top trial,
+# restart in place (the controller drives the mechanics).
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
@@ -89,3 +96,123 @@ class ASHAScheduler:
 
     def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
         pass
+
+
+class PopulationBasedTraining:
+    """PBT (reference: tune/schedulers/pbt.py — Jaderberg et al. 2017).
+
+    Every `perturbation_interval` units of `time_attr`, a trial in the
+    bottom quantile EXPLOITs: the controller clones a top-quantile
+    trial's latest checkpoint and config, then this scheduler EXPLOREs
+    the cloned config — each key in `hyperparam_mutations` is either
+    resampled (probability `resample_probability`) or perturbed
+    (numeric: x0.8 / x1.2; categorical: shift to a neighbor), matching
+    the reference's explore() defaults (pbt.py _explore).
+
+    hyperparam_mutations values may be: a list (categorical), a search
+    Domain (uniform/loguniform/...), or a 0-arg callable.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: float = 4.0,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        if not hyperparam_mutations:
+            raise ValueError("PBT needs hyperparam_mutations")
+        if not 0.0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = dict(hyperparam_mutations)
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        self._rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}        # trial -> signed score
+        self._last_perturb: Dict[str, float] = {}  # trial -> time mark
+        self.num_exploits = 0                      # observability/tests
+
+    # ------------------------------------------------------------ internals
+    def _value(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        v = float(v)
+        return v if self.mode == "max" else -v
+
+    def _quantiles(self) -> Tuple[List[str], List[str]]:
+        ranked = sorted(self._scores, key=self._scores.__getitem__)
+        k = max(1, int(len(ranked) * self.quantile_fraction))
+        if len(ranked) < 2:
+            return [], []
+        return ranked[:k], ranked[-k:]
+
+    def _perturb(self, key: str, spec: Any, current: Any) -> Any:
+        resample = self._rng.random() < self.resample_probability
+        if isinstance(spec, list):
+            if resample or current not in spec:
+                return self._rng.choice(spec)
+            i = spec.index(current)
+            j = min(len(spec) - 1, max(0, i + self._rng.choice((-1, 1))))
+            return spec[j]
+        if callable(getattr(spec, "sample", None)):
+            if resample:
+                return spec.sample(self._rng)
+            if isinstance(current, (int, float)):
+                factor = self._rng.choice((0.8, 1.2))
+                out = current * factor
+                # Truncate like the reference's _explore: round() would
+                # make small ints (1, 2) fixed points that never move.
+                return int(out) if isinstance(current, int) else out
+            return spec.sample(self._rng)
+        if callable(spec):
+            return spec()
+        raise ValueError(f"unsupported mutation spec for {key!r}: {spec!r}")
+
+    # ------------------------------------------------------------------ api
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        v = self._value(result)
+        if t is None or v is None:
+            return CONTINUE
+        self._scores[trial_id] = v
+        last = self._last_perturb.get(trial_id, 0.0)
+        if float(t) - last < self.perturbation_interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = float(t)
+        bottom, top = self._quantiles()
+        if trial_id in bottom and trial_id not in top:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit(self, trial_id: str,
+                configs: Dict[str, Dict[str, Any]]
+                ) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Pick a top-quantile source and return (source_trial_id,
+        explored_config).  The controller copies the source's checkpoint;
+        we mutate a copy of its config (reference: pbt.py
+        _exploit/_explore)."""
+        _, top = self._quantiles()
+        top = [t for t in top if t != trial_id and t in configs]
+        if not top:
+            return None
+        src = self._rng.choice(top)
+        new_config = dict(configs[src])
+        for key, spec in self.hyperparam_mutations.items():
+            new_config[key] = self._perturb(key, spec,
+                                            new_config.get(key))
+        self.num_exploits += 1
+        return src, new_config
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        # Dead trials must leave the population: a terminated/errored
+        # ghost in the bottom quantile would otherwise shield every live
+        # laggard from ever exploiting (and top-quantile ghosts would
+        # make exploit() come up empty).
+        self._scores.pop(trial_id, None)
+        self._last_perturb.pop(trial_id, None)

@@ -28,7 +28,7 @@ import cloudpickle
 
 import ray_tpu
 from ..train._checkpoint import Checkpoint, CheckpointManager
-from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from .search import generate_variants
 
 # Trial statuses (reference: trial.py Trial.PENDING/RUNNING/...)
@@ -120,6 +120,11 @@ class Trial:
         self.error: Optional[str] = None
         self.actor = None
         self.ckpt_mgr: Optional[CheckpointManager] = None
+        # PBT bookkeeping: exploit provenance, and a flag telling the run
+        # loop the actor was swapped mid-poll (its stale poll state must
+        # not be applied to the fresh actor).
+        self.pbt_history: List[Dict[str, Any]] = []
+        self.restarted_this_poll = False
 
     @property
     def last_metrics(self) -> Dict[str, Any]:
@@ -138,10 +143,12 @@ class _TrialActor:
         self.trial_id = trial_id
         self._thread = None
 
-    def run(self, trainable_blob: bytes, config: Dict[str, Any]) -> bool:
+    def run(self, trainable_blob: bytes, config: Dict[str, Any],
+            resume_packed: bytes = None) -> bool:
         import threading
         trainable = cloudpickle.loads(trainable_blob)
         session = self.session
+        session.resume_packed = resume_packed
 
         def _go():
             session.state = "running"
@@ -301,16 +308,18 @@ class TuneController:
         return trials
 
     # ---------------------------------------------------------- run loop ---
-    def _start_trial(self, trial: Trial):
+    def _start_trial(self, trial: Trial, resume_packed: bytes = None):
         res = dict(self.tc.resources_per_trial or {"CPU": 1})
         trial_dir = os.path.join(self.exp_dir, trial.trial_id)
-        trial.ckpt_mgr = CheckpointManager(
-            trial_dir, score_attribute=self.tc.metric,
-            score_order=self.tc.mode)
+        if trial.ckpt_mgr is None:
+            trial.ckpt_mgr = CheckpointManager(
+                trial_dir, score_attribute=self.tc.metric,
+                score_order=self.tc.mode)
         trial.actor = _TrialActor.options(
             num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
             resources=res or None).remote(trial.trial_id, trial_dir)
-        ray_tpu.get(trial.actor.run.remote(self._blob, trial.config),
+        ray_tpu.get(trial.actor.run.remote(self._blob, trial.config,
+                                           resume_packed),
                     timeout=120)
         trial.status = RUNNING
 
@@ -323,6 +332,13 @@ class TuneController:
             except Exception:
                 pass
             trial.actor = None
+        # Every exit path notifies the scheduler so population-based
+        # schedulers drop dead trials from their quantile bookkeeping.
+        try:
+            self.scheduler.on_trial_complete(trial.trial_id,
+                                             trial.last_metrics)
+        except Exception:
+            pass
 
     def _ingest(self, trial: Trial, poll: Dict[str, Any]):
         for rep in poll["reports"]:
@@ -335,6 +351,47 @@ class TuneController:
             if decision == STOP and trial.status == RUNNING:
                 self._stop_trial(trial, STOPPED)
                 return
+            if decision == EXPLOIT and trial.status == RUNNING:
+                self._exploit_trial(trial)
+                return
+
+    def _exploit_trial(self, trial: Trial):
+        """PBT exploit/explore: kill the lagging trial's actor, clone a
+        top trial's config (explored by the scheduler) + latest
+        checkpoint, restart in place (reference: pbt.py
+        _exploit; the reference pauses/restores through the Trainable's
+        save/restore — here the trainable resumes via
+        tune.get_checkpoint())."""
+        configs = {t.trial_id: t.config for t in self.trials
+                   if t.status in (RUNNING, PENDING)}
+        picked = self.scheduler.exploit(trial.trial_id, configs)
+        if picked is None:
+            return
+        src_id, new_config = picked
+        src = next(t for t in self.trials if t.trial_id == src_id)
+        src_ckpt = src.ckpt_mgr.latest if src.ckpt_mgr else None
+        if src_ckpt is None:
+            return    # nothing to clone yet; try again next interval
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.config = new_config
+        trial.pbt_history.append(
+            {"exploited_from": src_id, "config": dict(new_config)})
+        try:
+            self._start_trial(trial, resume_packed=src_ckpt.pack())
+        except Exception as e:
+            # A failed exploit restart (e.g. transiently saturated
+            # cluster) errors this trial only — same policy as the
+            # pending-start path: one broken trial must not abort the
+            # sweep.
+            self._stop_trial(trial, ERROR, f"PBT exploit restart "
+                                           f"failed: {e}")
+            return
+        trial.restarted_this_poll = True
 
     def run(self) -> ResultGrid:
         max_conc = self.tc.max_concurrent_trials or 4
@@ -363,9 +420,13 @@ class TuneController:
                     self._ingest(t, poll)
                     if t.status != RUNNING:
                         continue
+                    if t.restarted_this_poll:
+                        # The actor was swapped (PBT exploit) while this
+                        # poll was in flight; its finished/error state
+                        # belongs to the killed actor, not the clone.
+                        t.restarted_this_poll = False
+                        continue
                     if poll["state"] == "finished":
-                        self.scheduler.on_trial_complete(
-                            t.trial_id, t.last_metrics)
                         self._stop_trial(t, TERMINATED)
                     elif poll["state"] == "error":
                         self._stop_trial(t, ERROR, poll["error"])
