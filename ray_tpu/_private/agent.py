@@ -108,6 +108,14 @@ class NodeAgent:
         self._spill_dir = cfg.object_spill_dir or os.path.join(
             session_dir, "spill", node_id.hex()[:12])
         self._spill_threshold = cfg.object_spill_threshold
+        # Durable external tier (reference: _private/external_storage.py):
+        # spills also upload here + register in the GCS KV, so any node
+        # can restore a dead node's spilled objects.
+        self._ext = None
+        self._ext_uris: Dict[bytes, str] = {}
+        if cfg.object_spill_external_uri:
+            from .external_storage import storage_from_uri
+            self._ext = storage_from_uri(cfg.object_spill_external_uri)
         self._pull_inflight: Dict[bytes, asyncio.Future] = {}
         self._pull_waiters: List[Tuple[int, int, asyncio.Future]] = []  # heap
         self._pull_active = 0
@@ -401,7 +409,11 @@ class NodeAgent:
             raise rpc.RpcError("unknown worker")
         wh.address = tuple(p["address"])
         wh.conn = conn
-        conn.on_close = lambda c, wh=wh: None
+        # Do NOT override conn.on_close here: the server installed its
+        # close chain (connection-set cleanup + _on_client_close lease
+        # reclaim).  A worker's registration conn is the same conn it
+        # later requests leases over — clobbering the chain made every
+        # lease held by a killed worker leak its CPUs permanently.
         wh.registered.set()
         return {"node_id": self.node_id}
 
@@ -506,6 +518,15 @@ class NodeAgent:
             # spawn failure) must release the acquired resources.
             self._release_resources(resources, bundle_key)
             return {"granted": False, "reason": str(e), "retry_after_ms": 200}
+        if conn.closed:
+            # The requester died while this grant was in flight (worker
+            # spawn can take seconds) — its disconnect cleanup already
+            # ran, so a grant recorded now would leak these resources
+            # forever.  Hand everything back instead; the reply goes
+            # nowhere anyway.
+            self._release_resources(resources, bundle_key)
+            self._recycle_worker(wh)
+            return {"granted": False, "reason": "client disconnected"}
         lease_id = os.urandom(16)
         wh.lease_id = lease_id
         wh.lease_resources = resources
@@ -550,6 +571,18 @@ class NodeAgent:
                     best, best_avail = n, s
         return list(best["address"]) if best else None
 
+    def _recycle_worker(self, wh: WorkerHandle):
+        """Return a no-longer-leased worker to its idle pool, or
+        terminate it.  Runtime-env workers are never pooled: their
+        env_vars / PYTHONPATH / cwd would leak into default-env tasks."""
+        wh.last_idle = time.monotonic()
+        pool = self.idle_tpu_workers if wh.needs_tpu else self.idle_workers
+        if (wh.proc.poll() is None and not wh.is_actor and not wh.has_env
+                and len(pool) < IDLE_WORKER_KEEP):
+            pool.append(wh)
+        elif not wh.is_actor:
+            wh.proc.terminate()
+
     def _on_client_close(self, conn):
         """A lease client (driver/worker) disconnected: reclaim every
         lease it still holds — a driver exiting mid-lease must not leak
@@ -562,15 +595,7 @@ class NodeAgent:
                 wh.lease_resources = {}
                 wh.lease_bundle = None
                 wh.lease_owner_conn = None
-                if wh.proc.poll() is None and not wh.is_actor \
-                        and not wh.has_env:
-                    pool = (self.idle_tpu_workers if wh.needs_tpu
-                            else self.idle_workers)
-                    if len(pool) < IDLE_WORKER_KEEP:
-                        pool.append(wh)
-                        continue
-                if not wh.is_actor:
-                    wh.proc.terminate()
+                self._recycle_worker(wh)
 
     async def h_return_lease(self, conn, p):
         wh = self.leases.pop(p["lease_id"], None)
@@ -580,15 +605,7 @@ class NodeAgent:
         wh.lease_id = None
         wh.lease_resources = {}
         wh.lease_bundle = None
-        wh.last_idle = time.monotonic()
-        pool = self.idle_tpu_workers if wh.needs_tpu else self.idle_workers
-        if (wh.proc.poll() is None and not wh.is_actor and not wh.has_env
-                and len(pool) < IDLE_WORKER_KEEP):
-            pool.append(wh)
-        elif not wh.is_actor:
-            # Runtime-env workers are never pooled: their env_vars /
-            # PYTHONPATH / cwd would leak into default-env tasks.
-            wh.proc.terminate()
+        self._recycle_worker(wh)
         return True
 
     # --------------------------------------------------------------- actors --
@@ -711,8 +728,28 @@ class NodeAgent:
                     os.unlink(spill[0])
                 except FileNotFoundError:
                     pass
+            self._ext_delete(oid)
             self.store.delete(oid)
         return True
+
+    def _ext_delete(self, oid: bytes) -> None:
+        """Best-effort removal of an object's durable external copy + its
+        GCS registration (freed objects must not accumulate in the cloud
+        tier)."""
+        if self._ext is None:
+            return
+        uri = self._ext_uris.pop(oid, None)
+        if uri is None:
+            return
+        try:
+            self._ext.delete(uri)
+        except Exception:
+            logger.exception("external spill delete failed for %s",
+                             oid.hex())
+        if self.gcs is not None:
+            rpc.spawn(self.gcs.call(
+                "kv_del", {"ns": "spill_ext", "key": oid.hex(),
+                           "prefix": False}))
 
     # --- spilling (reference: local_object_manager.h:43 + plasma
     # create_request_queue backpressure) ------------------------------------
@@ -757,6 +794,11 @@ class NodeAgent:
                 pass
             return 0
         self.spilled[oid] = (path, size)
+        if self._ext is not None:
+            # Synchronous: the object is not durably spilled until the
+            # external copy exists (the reference's cloud spill IS the
+            # spill write, not a background mirror).
+            await self._ext_upload(oid, path)
         return size
 
     async def _free_space(self, need: int) -> int:
@@ -793,34 +835,130 @@ class NodeAgent:
         if not os.path.exists(path):
             return False
         self.spilled[oid] = (path, os.path.getsize(path))
+        if self._ext is not None:
+            await self._ext_upload(oid, path)
         return True
+
+    async def _ext_upload(self, oid: bytes, path: str) -> None:
+        """Push a freshly-spilled object to the durable tier and register
+        its URI in the GCS KV (any node can then restore it)."""
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(None, _read_file, path)
+            uri = await loop.run_in_executor(
+                None, self._ext.spill, oid.hex(), data)
+        except Exception:
+            logger.exception("external spill upload failed for %s",
+                             oid.hex())
+            return
+        if oid not in self.spilled:
+            # Freed (or restored-and-freed) while uploading.
+            try:
+                self._ext.delete(uri)
+            except Exception:
+                pass
+            return
+        self._ext_uris[oid] = uri
+        if self.gcs is not None:
+            try:
+                await self.gcs.call("kv_put", {
+                    "ns": "spill_ext", "key": oid.hex(),
+                    "value": uri.encode(), "overwrite": True})
+            except rpc.RpcError:
+                logger.warning("could not register external spill of %s",
+                               oid.hex())
+
+    def _put_restored(self, oid: bytes, data: bytes) -> bool:
+        """Insert restored bytes into shm + re-acquire this agent's pins."""
+        try:
+            self.store.put(oid, [data])
+        except ObjectExistsError:
+            pass
+        except Exception:
+            return False
+        for _ in range(self.pinned.get(oid, 0)):
+            self.store.get(oid, timeout_ms=0)
+        return True
+
+    async def _restore_from_external(self, oid: bytes) -> bool:
+        """Pull a durable copy registered by ANY node (possibly dead) out
+        of the external tier (reference: spilled-object URLs resolvable
+        cluster-wide via external_storage.py)."""
+        if self._ext is None:
+            return False
+        uri = self._ext_uris.get(oid)
+        if uri is None and self.gcs is not None:
+            try:
+                v = await self.gcs.call(
+                    "kv_get", {"ns": "spill_ext", "key": oid.hex()})
+            except rpc.RpcError:
+                return False
+            if v is None:
+                return False
+            uri = v.decode() if isinstance(v, (bytes, bytearray)) else v
+        if uri is None:
+            return False
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(None, self._ext.restore, uri)
+        except Exception:
+            # A transiently unreachable tier (NFS blip, backend IOError)
+            # must read as "not restorable" so callers fall back to
+            # lineage — not as an RPC error surfacing in a user get().
+            logger.exception("external restore failed for %s", oid.hex())
+            return False
+        if data is None:
+            return False
+        # This agent now co-owns the durable copy: record its URI so a
+        # later free from HERE also reclaims the cloud object + KV key
+        # (the spiller node may be dead — cross-node restores must not
+        # leak the external tier).
+        self._ext_uris[oid] = uri
+        for _ in range(3):
+            if self._put_restored(oid, data):
+                return True
+            if await self._free_space(len(data)) == 0:
+                break
+        # Arena too contended to admit the object (live reader views make
+        # primaries unspillable): re-materialize the local spill file so
+        # readers can stream from it via the normal spilled-object path
+        # (reference: spilled_object_reader.h).  Only this fallback pays
+        # the disk write — the common uncontended restore stays in shm.
+        path = self._spill_path(oid)
+        try:
+            await loop.run_in_executor(None, _write_file, path, data)
+        except OSError:
+            logger.exception("spill re-materialization failed for %s",
+                             oid.hex())
+            return False
+        self.spilled[oid] = (path, len(data))
+        return False  # callers fall back to streaming the spill file
 
     async def _restore_object(self, oid: bytes) -> bool:
         """Bring a spilled object back into shm (reference: raylet
         RestoreSpilledObject). Re-acquires the agent's pins; deletes the
-        disk copy on success."""
+        disk copy on success.  Falls back to the external tier when the
+        local spill file is missing (e.g. restored on a different node
+        than the spiller after a node death)."""
         spill = self.spilled.get(oid)
         if spill is None:
-            return self.store.contains(oid)
+            if self.store.contains(oid):
+                return True
+            return await self._restore_from_external(oid)
         path, size = spill
         loop = asyncio.get_running_loop()
         try:
             data = await loop.run_in_executor(None, _read_file, path)
         except FileNotFoundError:
-            return False
+            self.spilled.pop(oid, None)
+            return await self._restore_from_external(oid)
         for _ in range(3):
-            try:
-                self.store.put(oid, [data])
+            if self._put_restored(oid, data):
                 break
-            except ObjectExistsError:
-                break
-            except Exception:
-                if await self._free_space(size) == 0:
-                    return False
+            if await self._free_space(size) == 0:
+                return False
         else:
             return False
-        for _ in range(self.pinned.get(oid, 0)):
-            self.store.get(oid, timeout_ms=0)
         self.spilled.pop(oid, None)
         self._disk_cached.pop(oid, None)
         try:

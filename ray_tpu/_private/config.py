@@ -71,6 +71,13 @@ _define("resource_report_period_ms", 250,
 _define("lineage_max_entries", 100_000,
         "owner-side lineage cap (reference: task_manager.h max_lineage_bytes)")
 _define("object_spill_dir", "", "empty = <session_dir>/spill")
+_define("object_spill_external_uri", "",
+        "external/cloud spill tier (reference: _private/external_storage"
+        ".py:398 smart_open impl): when set (file:///shared/mount, "
+        "mock://bucket/prefix, or a registered custom scheme), every "
+        "local spill also uploads a durable copy and registers its URI "
+        "in the GCS KV, so any node can restore a dead node's spilled "
+        "objects without lineage re-execution")
 _define("object_spill_threshold", 0.8,
         "fraction of store capacity above which pinned primaries spill "
         "proactively (reference: local_object_manager.h spill threshold)")

@@ -473,7 +473,9 @@ class CoreWorker:
         return True
 
     async def _resubscribe(self, conn):
-        for channel in self._gcs_subscribed:
+        # Snapshot: a concurrent first-time subscribe() may add channels
+        # while we await (set-changed-during-iteration otherwise).
+        for channel in list(self._gcs_subscribed):
             await conn.call("subscribe", {"channel": channel})
 
     def subscribe(self, channel: str, callback) -> None:
@@ -962,6 +964,25 @@ class CoreWorker:
                     await self._primary_alive(oid, tuple(entry.plasma_node)):
                 fut.set_result(True)
                 return True
+            # Cloud-spill fast path: if a durable external copy was
+            # registered (object_spill_external_uri), the LOCAL agent can
+            # restore it — no destructive lineage re-execution, and it
+            # works even when the spiller node is dead (reference:
+            # spilled-object URLs usable cluster-wide,
+            # external_storage.py).
+            try:
+                if await self.agent.call("restore_object",
+                                         {"object_id": oid}, timeout=60):
+                    # The local agent is the new primary: re-pin there
+                    # and repoint the owner's location record.
+                    await self.agent.call("pin_object",
+                                          {"object_id": oid})
+                    if entry is not None:
+                        entry.plasma_node = self.agent_address
+                    fut.set_result(True)
+                    return True
+            except (rpc.RpcError, asyncio.TimeoutError):
+                pass
             # Resubmission can only succeed if its by-reference args are
             # still resolvable (live somewhere, or themselves recoverable).
             for e in spec["args"]:

@@ -495,7 +495,12 @@ class GcsServer:
 
     # --------------------------------------------------------------- pubsub --
     async def h_subscribe(self, conn, p):
-        self._subscribers.setdefault(p["channel"], []).append(conn)
+        # Idempotent per (channel, conn): a client whose subscribe RPC
+        # raced a GCS restart retries it AFTER its reconnect hook already
+        # re-subscribed — appending blindly would double every notify.
+        subs = self._subscribers.setdefault(p["channel"], [])
+        if conn not in subs:
+            subs.append(conn)
         return True
 
     async def h_publish(self, conn, p):

@@ -10,9 +10,11 @@ hosts at once, not 1-by-1.
 """
 
 from .autoscaler import Autoscaler, AutoscalerConfig
+from .gke import DryRunTransport, GkeNodeType, GkeTpuNodeProvider
 from .node_provider import FakeMultiNodeProvider, NodeProvider
 from .scheduler import NodeTypeConfig, ResourceDemandScheduler
 
-__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
+__all__ = ["Autoscaler", "AutoscalerConfig", "DryRunTransport",
+           "GkeNodeType", "GkeTpuNodeProvider", "NodeProvider",
            "FakeMultiNodeProvider", "NodeTypeConfig",
            "ResourceDemandScheduler"]

@@ -61,7 +61,8 @@ class Cluster:
                  num_tpus: Optional[int] = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 object_store_memory: int = 256 << 20) -> ClusterNode:
+                 object_store_memory: int = 256 << 20,
+                 _system_config: Optional[dict] = None) -> ClusterNode:
         """Start a real node agent process with its own /dev/shm store
         (reference: cluster_utils.py:202 add_node)."""
         res = dict(resources or {})
@@ -71,7 +72,8 @@ class Cluster:
         res.setdefault("memory", float(1 << 30))
         proc, addr, store_path, node_id = node_mod.start_agent(
             self.session_dir, self.gcs_address, res, labels=labels,
-            store_capacity=object_store_memory)
+            store_capacity=object_store_memory,
+            system_config=_system_config)
         node = ClusterNode(proc, addr, store_path, node_id)
         self.nodes.append(node)
         return node

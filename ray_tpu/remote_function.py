@@ -42,6 +42,16 @@ class RemoteFunction:
         self._submit_cache: Optional[tuple] = None
         functools.update_wrapper(self, fn)
 
+    def __getstate__(self):
+        # Remote functions are picklable (they travel inside closures of
+        # other tasks/actor classes, reference: cross-task fn handles).
+        # The submit cache holds the live CoreWorker (ctypes handles) and
+        # is process-local — drop it; the receiver recomputes on first
+        # .remote().
+        d = self.__dict__.copy()
+        d["_submit_cache"] = None
+        return d
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._name} cannot be called directly; use "

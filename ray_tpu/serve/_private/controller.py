@@ -58,12 +58,20 @@ class ServeControllerImpl:
         self._confirmed.discard(replica._actor_id)
         self._model_ids.pop(replica._actor_id, None)
 
-    def _bump(self):
+    def _bump(self, only=None):
+        """Bump the structural version and push. Callers that know which
+        deployments changed pass `only` (a name or list of names) so D
+        deployments don't cost O(D) publishes per change (O(D^2) during
+        a mass rollout)."""
         self.version += 1
         if self._version_event is not None:
             self._version_event.set()
             self._version_event = asyncio.Event()
-        self._push_tables()
+        if only is None:
+            self._push_tables()
+        else:
+            for name in ([only] if isinstance(only, str) else only):
+                self._push_tables(only=name)
 
     def _push_tables(self, only: Optional[str] = None):
         """PUSH routing tables to subscribed routers via GCS pubsub
@@ -162,7 +170,7 @@ class ServeControllerImpl:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
-            self._bump()
+            self._bump(name)
         return True
 
     # --------------------------------------------------------- reconcile ---
@@ -277,7 +285,7 @@ class ServeControllerImpl:
 
     async def _reconcile_locked(self):
         from .replica import ReplicaActor
-        changed = False
+        changed_names = set()
         for name, dep in list(self.deployments.items()):
             if dep.get("autoscale"):
                 await self._autoscale(name, dep)
@@ -308,7 +316,7 @@ class ServeControllerImpl:
                         time.monotonic() - born < self.startup_timeout_s:
                     healthy.append(r)   # still starting: keep waiting
                     continue
-                changed = True
+                changed_names.add(name)
                 self._forget(r)
                 try:
                     ray_tpu.kill(r)
@@ -331,17 +339,17 @@ class ServeControllerImpl:
                 ).remote(name, dep["blob"], dep["init_args"],
                          dep["init_kwargs"])
                 dep["replicas"].append(actor)
-                changed = True
+                changed_names.add(name)
             # Scale down: remove from the table first (routers drop it on
             # their next refresh), then drain in-flight requests before
             # killing (reference: graceful replica shutdown).
             while len(dep["replicas"]) > dep["num_replicas"]:
                 victim = dep["replicas"].pop()
-                changed = True
+                changed_names.add(name)
                 self._forget(victim)
                 rpc.spawn(self._drain_and_kill(victim))
-        if changed:
-            self._bump()
+        if changed_names:
+            self._bump(sorted(changed_names))
 
     # ------------------------------------------------------------ routing --
     def _table(self, name: str) -> Dict[str, Any]:

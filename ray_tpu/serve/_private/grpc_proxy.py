@@ -35,8 +35,10 @@ class GrpcProxyActor:
     """gRPC ingress (reference: proxy.py gRPCProxy — one per node)."""
 
     def __init__(self, host: str, port: int):
+        import threading
         self.host, self.port = host, port
         self._routers: Dict[str, Any] = {}
+        self._router_lock = threading.Lock()
         core = ray_tpu._core()
         fut = asyncio.run_coroutine_threadsafe(self._start(), core.loop)
         self.port = fut.result(30)
@@ -74,13 +76,19 @@ class GrpcProxyActor:
         return self.port
 
     def _router_for(self, deployment: str):
-        r = self._routers.get(deployment)
-        if r is None:
-            from .controller import CONTROLLER_NAME
-            from .router import Router
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            r = self._routers[deployment] = Router(controller, deployment)
-        return r
+        # Lock: _handle runs this on executor threads; two concurrent
+        # first requests would otherwise both build a Router, and the
+        # discarded one's pubsub subscription would stay registered (and
+        # processed) forever.
+        with self._router_lock:
+            r = self._routers.get(deployment)
+            if r is None:
+                from .controller import CONTROLLER_NAME
+                from .router import Router
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                r = self._routers[deployment] = Router(controller,
+                                                       deployment)
+            return r
 
     async def _handle(self, deployment: str, method: str, request: bytes,
                       meta: dict, context) -> bytes:

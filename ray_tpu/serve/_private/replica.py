@@ -49,10 +49,15 @@ class ReplicaActor:
             else:
                 # Sync callables run off the loop so one slow request
                 # doesn't freeze the replica (metrics pings, concurrent
-                # requests keep flowing).
+                # requests keep flowing).  copy_context() carries the
+                # request's ContextVars (multiplexed model id) into the
+                # executor thread — run_in_executor alone does not.
+                import contextvars
+                ctx = contextvars.copy_context()
                 async with self._sync_sem:
                     out = await asyncio.get_running_loop().run_in_executor(
-                        None, functools.partial(fn, *args, **kwargs))
+                        None, lambda: ctx.run(
+                            functools.partial(fn, *args, **kwargs)))
                 if inspect.iscoroutine(out):
                     out = await out
             return out

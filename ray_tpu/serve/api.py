@@ -8,6 +8,7 @@ deployment.py (Deployment/Application), handle.py:692 (DeploymentHandle,
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -124,6 +125,7 @@ class DeploymentHandle:
     # Routers are shared per (deployment, process): handle copies and
     # .options() clones reuse one pushed routing table + inflight map.
     _routers: Dict[str, Router] = {}
+    _routers_lock = threading.Lock()
 
     def __init__(self, deployment_name: str, method: str = "__call__",
                  multiplexed_model_id: Optional[str] = None):
@@ -144,13 +146,17 @@ class DeploymentHandle:
             if multiplexed_model_id is not None else self._model_id)
 
     def _get_router(self, controller=None) -> Router:
-        router = self._routers.get(self._deployment)
-        if router is None:
-            if controller is None:
-                controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            router = Router(controller, self._deployment)
-            self._routers[self._deployment] = router
-        return router
+        # Locked check-then-act: concurrent first calls from several
+        # driver threads must not build duplicate Routers (the loser's
+        # pubsub subscription would leak and keep firing).
+        with self._routers_lock:
+            router = self._routers.get(self._deployment)
+            if router is None:
+                if controller is None:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                router = Router(controller, self._deployment)
+                self._routers[self._deployment] = router
+            return router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import asyncio
@@ -210,11 +216,14 @@ def start(http_host: str = "127.0.0.1",
             http_host, http_port)
         ray_tpu.get(_proxy.ready.remote(), timeout=60)
         _http_port = http_port
-    if grpc_port is not None and _grpc_proxy is None:
-        from ._private.grpc_proxy import GrpcProxyActor
-        _grpc_proxy = GrpcProxyActor.options(
-            name="SERVE_GRPC_PROXY", get_if_exists=True).remote(
-            http_host, grpc_port)
+    if grpc_port is not None:
+        if _grpc_proxy is None:
+            from ._private.grpc_proxy import GrpcProxyActor
+            _grpc_proxy = GrpcProxyActor.options(
+                name="SERVE_GRPC_PROXY", get_if_exists=True).remote(
+                http_host, grpc_port)
+        # Idempotent: a repeated start(grpc_port=...) returns the port
+        # the existing proxy is already bound to.
         return ray_tpu.get(_grpc_proxy.ready.remote(), timeout=60)
     return None
 

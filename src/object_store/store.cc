@@ -48,6 +48,10 @@ enum SlotState : uint8_t {
   kAllocated = 1,  // created, not sealed
   kSealed = 2,
   kTombstone = 3,
+  kDoomed = 4,     // delete requested while pinned: extent freed at last
+                   // release (reference: plasma defers deletion until the
+                   // object's refcount drains — freeing under a live
+                   // reader recycles memory beneath its zero-copy view)
 };
 
 struct Slot {
@@ -375,7 +379,12 @@ int64_t rts_create_object(int hidx, const uint8_t* id, uint64_t size) {
   uint64_t need = align_up(size ? size : 1, kAlign);
   Guard g(h.hdr);
   Slot* existing = find_slot(h, id, /*for_insert=*/false);
-  if (existing) return -EEXIST;
+  // Doomed = deleted-while-pinned: the id is logically absent
+  // (contains/get say no) but its extent drains only when the last
+  // reader leaves.  A re-create now is a transient -EAGAIN, NOT -EEXIST
+  // — callers treating EEXIST as "data already present" would trust
+  // bytes that vanish at the last release.
+  if (existing) return existing->state == kDoomed ? -EAGAIN : -EEXIST;
   uint64_t off = free_alloc(h, need);
   if (off == 0) {
     if (evict_some(h, need)) off = free_alloc(h, need);
@@ -508,6 +517,15 @@ int64_t rts_get(int hidx, const uint8_t* id, uint64_t* size, int timeout_ms) {
   }
 }
 
+// Free a slot's extent and tombstone it. Caller holds the lock.
+void free_slot(Handle& h, Slot* s) {
+  uint64_t bsz = align_up(s->size ? s->size : 1, kAlign);
+  free_insert(h, s->offset, bsz);
+  h.hdr->bytes_in_use -= bsz;
+  h.hdr->num_objects--;
+  s->state = kTombstone;
+}
+
 // Drop one pin. Returns 0 or -errno.
 int rts_release(int hidx, const uint8_t* id) {
   Handle& h = g_handles[hidx];
@@ -515,21 +533,28 @@ int rts_release(int hidx, const uint8_t* id) {
   Slot* s = find_slot(h, id, false);
   if (!s) return -ENOENT;
   if (s->refcount > 0) s->refcount--;
+  if (s->state == kDoomed && s->refcount == 0) {
+    free_slot(h, s);  // deferred delete: last reader just left
+    return 0;
+  }
   s->lru_tick = ++h.hdr->lru_clock;
   return 0;
 }
 
-// Delete an object regardless of pins (owner-driven free). Returns 0/-ENOENT.
+// Delete an object (owner-driven free). If readers hold pins the extent
+// is NOT recycled yet: the slot is doomed (invisible to get/contains)
+// and freed when the last pin drops — freeing under a live reader would
+// hand its memory to the next create. Returns 0/-ENOENT.
 int rts_delete(int hidx, const uint8_t* id) {
   Handle& h = g_handles[hidx];
   Guard g(h.hdr);
   Slot* s = find_slot(h, id, false);
-  if (!s) return -ENOENT;
-  uint64_t bsz = align_up(s->size ? s->size : 1, kAlign);
-  free_insert(h, s->offset, bsz);
-  h.hdr->bytes_in_use -= bsz;
-  h.hdr->num_objects--;
-  s->state = kTombstone;
+  if (!s || s->state == kDoomed) return s ? 0 : -ENOENT;
+  if (s->refcount > 0) {
+    s->state = kDoomed;
+    return 0;
+  }
+  free_slot(h, s);
   return 0;
 }
 
@@ -557,11 +582,8 @@ int rts_release_n_and_delete_if(int hidx, const uint8_t* id, int n) {
     if (s->refcount > 0) s->refcount--;  // drop the read pin only
     return -EBUSY;
   }
-  uint64_t bsz = align_up(s->size ? s->size : 1, kAlign);
-  free_insert(h, s->offset, bsz);
-  h.hdr->bytes_in_use -= bsz;
-  h.hdr->num_objects--;
-  s->state = kTombstone;
+  s->refcount = 0;
+  free_slot(h, s);
   return 0;
 }
 
@@ -582,11 +604,8 @@ int rts_abort(int hidx, const uint8_t* id) {
   Guard g(h.hdr);
   Slot* s = find_slot(h, id, false);
   if (!s || s->state != kAllocated) return -ENOENT;
-  uint64_t bsz = align_up(s->size ? s->size : 1, kAlign);
-  free_insert(h, s->offset, bsz);
-  h.hdr->bytes_in_use -= bsz;
-  h.hdr->num_objects--;
-  s->state = kTombstone;
+  s->refcount = 0;
+  free_slot(h, s);
   return 0;
 }
 

@@ -1,0 +1,201 @@
+// Multi-process / multi-thread stress + crash harness for store.cc.
+//
+// Reference model: the plasma store's gtest + ASAN/TSAN CI story
+// (reference: src/ray/object_manager/tests/, ci/ray_ci/tester.py TSAN
+// configs).  A process-shared robust-mutex allocator is exactly the code
+// where races and UB hide; this binary drives it three ways:
+//
+//   --threads  N writers/readers hammer create/put/seal/get/release/
+//              delete concurrently in one process.  Built with
+//              -fsanitize=thread this is the TSAN gate.
+//   --procs    the same workload across forked processes (true
+//              multi-client arena sharing, plain build).
+//   --crash    children are SIGKILLed at random points mid-operation;
+//              the parent then verifies the robust mutex recovers
+//              (EOWNERDEAD consistency path) and the arena still serves
+//              create/get/delete with consistent accounting.
+//
+// Exit code 0 = all invariants held.  Any TSAN report fails the build's
+// test driver (tests/test_store_stress.py) via non-zero exit / stderr.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <signal.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+// The store is a single TU with C linkage exports; include it directly so
+// the harness links without a shared library (and TSAN instruments it).
+#include "store.cc"
+
+namespace {
+
+constexpr int kIds = 64;          // small id space => heavy contention
+constexpr uint64_t kMaxObj = 64 * 1024;
+
+uint64_t xorshift(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return *s = x;
+}
+
+void make_id(int i, uint8_t* out) {
+  memset(out, 0, 20);
+  snprintf(reinterpret_cast<char*>(out), 20, "obj-%04d", i);
+}
+
+// One worker iteration: pick a random id and do a random op. Returns
+// ops completed.
+int work_iter(int h, uint64_t* rng) {
+  uint8_t id[20];
+  make_id(int(xorshift(rng) % kIds), id);
+  uint64_t op = xorshift(rng) % 100;
+  if (op < 35) {                               // create+put+seal
+    uint64_t size = 64 + xorshift(rng) % kMaxObj;
+    int64_t off = rts_create_object(h, id, size);
+    if (off < 0) return 0;                     // exists/ENOMEM: fine
+    uint8_t* base = g_handles[h].base;
+    memset(base + off, int(size & 0xff), size);
+    rts_seal(h, id);
+    rts_release(h, id);                        // create leaves a pin
+  } else if (op < 75) {                        // get+verify+release
+    uint64_t size = 0;
+    int64_t off = rts_get(h, id, &size, 0);
+    if (off < 0) return 0;
+    uint8_t* base = g_handles[h].base;
+    uint8_t want = uint8_t(size & 0xff);
+    // Spot-check payload integrity under concurrency.
+    if (size > 0 && (base[off] != want || base[off + size - 1] != want)) {
+      fprintf(stderr, "CORRUPT payload id=%s size=%llu\n", id,
+              (unsigned long long)size);
+      abort();
+    }
+    rts_release(h, id);
+  } else if (op < 90) {                        // delete
+    rts_delete(h, id);
+  } else {                                     // stats invariants
+    uint64_t in_use = 0, n = 0, ev = 0, evb = 0, cap = 0;
+    rts_stats(h, &in_use, &n, &ev, &evb, &cap);
+    if (in_use > cap) {
+      fprintf(stderr, "ACCOUNTING in_use=%llu > cap=%llu\n",
+              (unsigned long long)in_use, (unsigned long long)cap);
+      abort();
+    }
+  }
+  return 1;
+}
+
+int run_threads(const char* path, int nthreads, int iters) {
+  int h = rts_attach(path);
+  if (h < 0) { fprintf(stderr, "attach failed: %d\n", h); return 1; }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; t++) {
+    ts.emplace_back([h, t, iters] {
+      uint64_t rng = 0x9e3779b97f4a7c15ULL ^ (uint64_t)t * 2654435761u;
+      for (int i = 0; i < iters; i++) work_iter(h, &rng);
+    });
+  }
+  for (auto& t : ts) t.join();
+  return 0;
+}
+
+int child_worker(const char* path, int seed, int iters, bool crashy) {
+  int h = rts_attach(path);
+  if (h < 0) _exit(2);
+  uint64_t rng = 0xdeadbeefcafeULL ^ (uint64_t)seed * 1099511628211ULL;
+  for (int i = 0; i < iters; i++) {
+    work_iter(h, &rng);
+    if (crashy && (xorshift(&rng) % 997) == 0) {
+      // Die without cleanup — possibly inside the arena mutex (the op
+      // above may have been preempted anywhere). raise(SIGKILL) never
+      // returns; the robust mutex must hand EOWNERDEAD to the next
+      // locker, which completes the consistency pass.
+      raise(SIGKILL);
+    }
+  }
+  _exit(0);
+}
+
+int run_procs(const char* path, int nprocs, int iters, bool crashy) {
+  std::vector<pid_t> pids;
+  for (int p = 0; p < nprocs; p++) {
+    pid_t pid = fork();
+    if (pid == 0) child_worker(path, p, iters, crashy);
+    pids.push_back(pid);
+  }
+  int killed = 0, clean = 0;
+  for (pid_t pid : pids) {
+    int st = 0;
+    waitpid(pid, &st, 0);
+    if (WIFSIGNALED(st)) killed++;
+    else if (WIFEXITED(st) && WEXITSTATUS(st) == 0) clean++;
+    else { fprintf(stderr, "child failed st=%d\n", st); return 1; }
+  }
+  fprintf(stderr, "procs done: %d clean, %d killed\n", clean, killed);
+  if (crashy && killed == 0) {
+    fprintf(stderr, "crash mode but nothing crashed (tune rate)\n");
+  }
+  // Post-mortem: the arena must still be fully serviceable.
+  int h = rts_attach(path);
+  if (h < 0) { fprintf(stderr, "post-crash attach failed\n"); return 1; }
+  uint8_t id[20];
+  for (int i = 0; i < kIds; i++) {   // clear any crashed-mid-create slots
+    make_id(i, id);
+    rts_abort(h, id);
+    rts_delete(h, id);
+  }
+  for (int i = 0; i < kIds; i++) {
+    make_id(i, id);
+    int64_t off = rts_create_object(h, id, 4096);
+    if (off < 0) {
+      fprintf(stderr, "post-crash create %d failed: %lld\n", i,
+              (long long)off);
+      return 1;
+    }
+    memset(g_handles[h].base + off, 7, 4096);
+    rts_seal(h, id);
+    rts_release(h, id);
+    uint64_t size = 0;
+    if (rts_get(h, id, &size, 0) < 0 || size != 4096) {
+      fprintf(stderr, "post-crash get %d failed\n", i);
+      return 1;
+    }
+    rts_release(h, id);
+  }
+  uint64_t in_use = 0, n = 0, ev = 0, evb = 0, cap = 0;
+  rts_stats(h, &in_use, &n, &ev, &evb, &cap);
+  fprintf(stderr, "post-crash: %llu objects, %llu/%llu bytes\n",
+          (unsigned long long)n, (unsigned long long)in_use,
+          (unsigned long long)cap);
+  return in_use <= cap ? 0 : 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string mode = argc > 1 ? argv[1] : "--threads";
+  int workers = argc > 2 ? atoi(argv[2]) : 8;
+  int iters = argc > 3 ? atoi(argv[3]) : 20000;
+  char path[64];
+  snprintf(path, sizeof(path), "/dev/shm/rts_stress_%d", getpid());
+  unlink(path);
+  int h = rts_create(path, 16ull << 20, 1 << 10);
+  if (h < 0) { fprintf(stderr, "create failed: %d\n", h); return 1; }
+  int rc = 1;
+  if (mode == "--threads") rc = run_threads(path, workers, iters);
+  else if (mode == "--procs") rc = run_procs(path, workers, iters, false);
+  else if (mode == "--crash") rc = run_procs(path, workers, iters, true);
+  else fprintf(stderr, "usage: %s --threads|--procs|--crash [n] [iters]\n",
+               argv[0]);
+  unlink(path);
+  return rc;
+}

@@ -140,3 +140,36 @@ def test_wait_all_ready_immediately(ray_start_regular):
     refs = [ray_tpu.put(i) for i in range(8)]
     ready, pending = ray_tpu.wait(refs, num_returns=8, timeout=5)
     assert len(ready) == 8 and not pending
+
+
+def test_killed_actor_releases_cached_leases(ray_start_regular):
+    """A killed actor that holds cached worker leases must return their
+    CPUs (regression: the agent's disconnect cleanup was disabled by an
+    on_close override, and grants completing after the disconnect leaked
+    permanently — reference: raylet lease cleanup on client disconnect)."""
+    import time
+
+    total = ray_tpu.cluster_resources().get("CPU")
+
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    @ray_tpu.remote
+    class Burster:
+        def burst(self, n):
+            return len(ray_tpu.get([_noop.remote() for _ in range(n)]))
+
+    b = Burster.remote()
+    # The burst makes the actor's core worker cache several leases.
+    assert ray_tpu.get(b.burst.remote(20), timeout=120) == 20
+    ray_tpu.kill(b)
+    deadline = time.monotonic() + 30
+    avail = None
+    while time.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get("CPU")
+        if avail == total:
+            break
+        time.sleep(0.25)
+    assert avail == total, \
+        f"leases leaked: {total - avail} CPUs still held after kill"
