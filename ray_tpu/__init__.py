@@ -61,7 +61,8 @@ def remote(*args, **kwargs):
                 "num_cpus", "num_tpus", "resources", "max_restarts",
                 "max_task_retries", "max_concurrency", "name", "namespace",
                 "lifetime", "runtime_env", "scheduling_strategy",
-                "get_if_exists", "concurrency_groups")}
+                "get_if_exists", "concurrency_groups",
+                "allow_out_of_order_execution")}
             return ActorClass(target, **cls_kwargs)
         fn_kwargs = {k: v for k, v in kwargs.items() if k in (
             "num_returns", "num_cpus", "num_tpus", "resources",

@@ -168,6 +168,7 @@ class NodeAgent:
             "list_objects": self.h_list_objects,
             "ping": lambda conn, p: "pong",
             "worker_fate": self.h_worker_fate,
+            "profile_worker": self.h_profile_worker,
             "shutdown": self.h_shutdown,
         }
 
@@ -554,22 +555,51 @@ class NodeAgent:
         return None
 
     async def _find_spillback(self, resources) -> Optional[list]:
-        """Ask GCS's resource view for a feasible node (stands in for the
-        reference's in-raylet cluster view synced by ray_syncer)."""
+        """Pick a better node from the GCS resource view (stands in for
+        the reference's in-raylet cluster view synced by ray_syncer),
+        scored by the hybrid top-k policy
+        (reference: hybrid_scheduling_policy.h:50)."""
+        from . import scheduling_policy as policy
         try:
             nodes = await self.gcs.call("get_nodes", {})
         except rpc.RpcError:
             return None
-        best, best_avail = None, -1.0
-        for n in nodes:
-            if not n["alive"] or bytes(n["node_id"]) == self.node_id:
+        cands = [(tuple(n["address"]), n["resources_total"],
+                  n["resources_available"])
+                 for n in nodes
+                 if n["alive"] and bytes(n["node_id"]) != self.node_id]
+        best = policy.hybrid_pick(cands, resources)
+        return list(best) if best else None
+
+    async def h_profile_worker(self, conn, p):
+        """Forward a live-profiling request to workers on this node
+        (reference: the reporter agent launching py-spy/memray against
+        worker pids, dashboard/modules/reporter/profile_manager.py).
+        kind: 'stacks' | 'cpu_profile'; worker_id None = every live
+        registered worker."""
+        kind = p.get("kind", "stacks")
+        if kind not in ("stacks", "cpu_profile"):
+            raise rpc.RpcError(f"unknown profile kind {kind!r}")
+        payload = {"duration_s": p.get("duration_s", 5.0)}
+        targets = []
+        want = p.get("worker_id")
+        for wid, wh in self.workers.items():
+            if want is not None and wid != want:
                 continue
-            avail = n["resources_available"]
-            if all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
-                s = sum(avail.values())
-                if s > best_avail:
-                    best, best_avail = n, s
-        return list(best["address"]) if best else None
+            if wh.conn is None or wh.conn.closed or wh.proc.poll() is not None:
+                continue
+            targets.append((wid, wh))
+        out = {}
+        results = await asyncio.gather(
+            *[wh.conn.call(kind, payload,
+                           timeout=float(p.get("duration_s", 5.0)) + 30)
+              for _, wh in targets],
+            return_exceptions=True)
+        for (wid, _), res in zip(targets, results):
+            out[wid.hex()] = (
+                {"error": str(res)} if isinstance(res, BaseException)
+                else res)
+        return out
 
     def _recycle_worker(self, wh: WorkerHandle):
         """Return a no-longer-leased worker to its idle pool, or

@@ -105,7 +105,8 @@ class _KeyState:
 
 class _ActorState:
     __slots__ = ("actor_id", "address", "conn", "seq", "dead", "death_cause",
-                 "resolving", "submit_queue", "draining", "drain_scheduled")
+                 "resolving", "submit_queue", "draining", "drain_scheduled",
+                 "out_of_order")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
@@ -121,6 +122,11 @@ class _ActorState:
         self.submit_queue: deque = deque()
         self.draining = False
         self.drain_scheduled = False
+        # allow_out_of_order_execution actors use the out-of-order submit
+        # queue: dep resolution per call, no head-of-line blocking
+        # (reference: out_of_order_actor_submit_queue.cc vs
+        # sequential_actor_submit_queue.cc).
+        self.out_of_order = False
 
 
 class CoreWorker:
@@ -1518,6 +1524,29 @@ class CoreWorker:
                              hops: int = 0):
         strat = state.strategy or {}
         is_pg = strat.get("type") == "placement_group"
+        if agent_conn is None and strat.get("type") in (
+                "spread", "node_affinity", "node_label"):
+            # Submitter-side raylet choice for non-default strategies
+            # (reference: lease_policy.cc picks the target raylet before
+            # the lease request leaves the worker).
+            routed, verdict = await self._route_lease_agent(
+                strat, state.resources)
+            if verdict == "retry":
+                # Transient (stale view / unreachable-but-listed node):
+                # keep the tasks queued and try again — the refreshed
+                # GCS view either finds the node or declares it dead.
+                state.pending_lease_requests -= 1
+                if state.queue:
+                    await asyncio.sleep(0.5)
+                    self._pump(key, state)
+                return
+            if verdict == "infeasible":
+                state.pending_lease_requests -= 1
+                self._fail_queued_tasks(state, exc.RayError(
+                    f"scheduling strategy {strat.get('type')} has no "
+                    "satisfiable node (hard constraint)"))
+                return
+            agent_conn = routed
         if agent_conn is None and is_pg:
             # Route the lease to the agent hosting the target bundle — the
             # local agent may not hold it at all (reference: lease_policy.cc
@@ -1559,6 +1588,11 @@ class CoreWorker:
                 # PG removal).
                 self._pg_cache.pop(strat["pg_id"], None)
             spill = res.get("spillback")
+            if strat.get("type") == "node_affinity" and \
+                    not strat.get("soft"):
+                spill = None   # hard affinity never follows spillback
+            if strat.get("type") == "node_label" and strat.get("hard"):
+                spill = None   # hard label selector likewise
             if spill and hops < 4:
                 try:
                     peer = await self._peer_owner(tuple(spill))
@@ -1595,6 +1629,91 @@ class CoreWorker:
         state.leases.append(lease)
         self._pump(key, state)
         self._spawn(self._lease_reaper(key, state, lease))
+
+    async def _cluster_nodes(self):
+        """GCS node view, cached briefly (strategy routing must not add
+        a GCS round trip per lease request)."""
+        now = time.monotonic()
+        cached = getattr(self, "_nodes_cache", None)
+        if cached is not None and now - cached[0] < 2.0:
+            return cached[1]
+        nodes = await self.gcs.call("get_nodes", {})
+        self._nodes_cache = (now, nodes)
+        return nodes
+
+    async def _route_lease_agent(self, strat: dict, resources):
+        """Pick the agent to lease from for spread / node_affinity /
+        node_label tasks (reference: lease_policy.cc +
+        scheduling/policy/{spread,node_affinity,node_label}*).
+
+        Returns (conn, verdict): verdict 'ok' with a connection,
+        'retry' for transient state (stale node view, GCS hiccup, a
+        listed-alive node refusing connections — death-lag), or
+        'infeasible' when a HARD constraint is unsatisfiable per the
+        authoritative GCS view (target dead/absent, no label match)."""
+        from . import scheduling_policy as policy
+        hard = ((strat.get("type") == "node_affinity"
+                 and not strat.get("soft"))
+                or (strat.get("type") == "node_label"
+                    and strat.get("hard")))
+        try:
+            nodes = [n for n in await self._cluster_nodes() if n["alive"]]
+        except (rpc.RpcError, asyncio.TimeoutError):
+            # Never silently violate a hard constraint on a GCS blip.
+            return (None, "retry") if hard else (self.agent, "ok")
+        typ = strat.get("type")
+
+        async def _connect(addr):
+            try:
+                return await self._peer_owner(tuple(addr))
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                # Listed alive but unreachable: either restarting or the
+                # health check hasn't marked it dead yet — let the caller
+                # retry; the refreshed view converges either way.
+                self._nodes_cache = None
+                return None
+
+        if typ == "node_affinity":
+            target = bytes(strat["node_id"])
+            node = next((n for n in nodes
+                         if bytes(n["node_id"]) == target), None)
+            if node is None:
+                # Authoritative: the target is dead/absent in the view.
+                return (self.agent, "ok") if strat.get("soft") \
+                    else (None, "infeasible")
+            conn = await _connect(node["address"])
+            if conn is not None:
+                return conn, "ok"
+            return (self.agent, "ok") if strat.get("soft") \
+                else (None, "retry")
+        if typ == "node_label":
+            ordered = policy.label_filter(
+                [(tuple(n["address"]), n.get("labels") or {})
+                 for n in nodes],
+                strat.get("hard") or None, strat.get("soft") or None)
+            if not ordered:
+                return (None, "infeasible") if hard else (self.agent, "ok")
+            by_addr = {tuple(n["address"]): n for n in nodes}
+            # Feasible matches first, then any match (its agent
+            # backpressures; spillback is suppressed for hard).
+            for addr in sorted(ordered, key=lambda a: not policy.feasible(
+                    by_addr[a]["resources_available"], resources)):
+                conn = await _connect(addr)
+                if conn is not None:
+                    return conn, "ok"
+            return (None, "retry") if hard else (self.agent, "ok")
+        if typ == "spread":
+            feas = [n for n in nodes
+                    if policy.feasible(n["resources_available"],
+                                       resources)] or nodes
+            self._spread_rr = getattr(self, "_spread_rr", -1) + 1
+            for i in range(len(feas)):
+                node = feas[(self._spread_rr + i) % len(feas)]
+                conn = await _connect(node["address"])
+                if conn is not None:
+                    return conn, "ok"
+            return self.agent, "ok"
+        return self.agent, "ok"
 
     async def _pg_agent_conn(self, strat: dict):
         """Resolve the agent hosting a PG-targeted lease's bundle.
@@ -2131,7 +2250,8 @@ class CoreWorker:
 
     def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
                           num_returns, max_task_retries: int = 0,
-                          generator_backpressure: int = 0
+                          generator_backpressure: int = 0,
+                          out_of_order: bool = False
                           ) -> List[ObjectRef]:
         """Sync-safe from ANY thread, including the event loop (async actor
         methods submitting to other actors — e.g. a Serve controller
@@ -2146,6 +2266,8 @@ class CoreWorker:
         state = self._actors.get(actor_id)
         if state is None:
             state = self._actors.setdefault(actor_id, _ActorState(actor_id))
+        if out_of_order:
+            state.out_of_order = True
         task_id = TaskID.for_actor_task(ActorID(actor_id)).binary()
         entries, ref_args, borrowed_args, big_puts = \
             self._build_arg_entries_sync(args, kwargs)
@@ -2245,36 +2367,20 @@ class CoreWorker:
                 # flush what's accumulated first so ready pushes aren't
                 # gated behind this item's awaits.
                 _flush()
-                try:
-                    await self._store_big_puts(spec["args"], big_puts)
-                    # Submitter-side dependency resolution for owned ref
-                    # args (reference: dependency_resolver.cc — the task is
-                    # not pushed until its deps exist): pending results are
-                    # awaited here, small values inlined, plasma locations
-                    # stamped. Keeps the callee's execution slot free while
-                    # deps materialize and removes the callee-side fetch
-                    # timeout from the path.
-                    for e in spec["args"]:
-                        if "ref" not in e:
-                            continue
-                        roid = bytes(e["ref"][0])
-                        if tuple(e["ref"][1]) != self.address:
-                            continue   # borrowed: callee resolves via owner
-                        if e["ref"][2] is not None:
-                            continue   # already has a plasma location
-                        entry = await self.memory_store.wait_for(roid)
-                        if entry.data is not None:
-                            val = {"v": entry.data}
-                            if "kw" in e:
-                                val["kw"] = e["kw"]
-                            e.clear()
-                            e.update(val)
-                        elif entry.plasma_node is not None:
-                            e["ref"][2] = list(entry.plasma_node)
-                except Exception as e:  # put/resolve failed: fail this task
-                    self._store_task_exception(spec, exc.RayError(
-                        f"failed to resolve actor-task arg: {e}"))
-                    self._release_task_pins(task)
+                if state.out_of_order:
+                    # Out-of-order submit queue (reference:
+                    # out_of_order_actor_submit_queue.cc, opted into via
+                    # allow_out_of_order_execution): this call resolves
+                    # its deps OFF the drain, so later calls whose deps
+                    # are already ready are not head-of-line blocked
+                    # behind it.  Only meaningful for actors that execute
+                    # concurrently anyway (async / max_concurrency>1).
+                    self._spawn(
+                        self._resolve_and_push_actor_task(state, spec,
+                                                          task, big_puts))
+                    continue
+                if not await self._resolve_actor_task_args(spec, task,
+                                                           big_puts):
                     continue
                 self._spawn(
                     self._push_actor_task(state, spec, task))
@@ -2285,6 +2391,47 @@ class CoreWorker:
             # after the while-check) restart the drain.
             if state.submit_queue:
                 self._schedule_actor_drain(state)
+
+    async def _resolve_actor_task_args(self, spec, task, big_puts) -> bool:
+        """Submitter-side dependency resolution for owned ref args
+        (reference: dependency_resolver.cc — the task is not pushed until
+        its deps exist): pending results are awaited, small values
+        inlined, plasma locations stamped.  Keeps the callee's execution
+        slot free while deps materialize and removes the callee-side
+        fetch timeout from the path.  Returns False (task failed) on a
+        put/resolve error."""
+        try:
+            await self._store_big_puts(spec["args"], big_puts)
+            for e in spec["args"]:
+                if "ref" not in e:
+                    continue
+                roid = bytes(e["ref"][0])
+                if tuple(e["ref"][1]) != self.address:
+                    continue   # borrowed: callee resolves via owner
+                if e["ref"][2] is not None:
+                    continue   # already has a plasma location
+                entry = await self.memory_store.wait_for(roid)
+                if entry.data is not None:
+                    val = {"v": entry.data}
+                    if "kw" in e:
+                        val["kw"] = e["kw"]
+                    e.clear()
+                    e.update(val)
+                elif entry.plasma_node is not None:
+                    e["ref"][2] = list(entry.plasma_node)
+        except Exception as e:  # put/resolve failed: fail this task
+            self._store_task_exception(spec, exc.RayError(
+                f"failed to resolve actor-task arg: {e}"))
+            self._release_task_pins(task)
+            return False
+        return True
+
+    async def _resolve_and_push_actor_task(self, state, spec, task,
+                                           big_puts):
+        """Out-of-order path: resolve deps independently, push when
+        ready."""
+        if await self._resolve_actor_task_args(spec, task, big_puts):
+            await self._push_actor_task(state, spec, task)
 
     async def _actor_conn(self, state: _ActorState) -> rpc.Connection:
         if state.conn is not None and not state.conn.closed:

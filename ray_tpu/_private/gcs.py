@@ -631,16 +631,41 @@ class GcsServer:
                 if node and node.alive:
                     return node
             return None
-        candidates = []
-        for node in self.nodes.values():
-            if not node.alive:
-                continue
-            avail = node.resources_available
-            if all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
-                candidates.append(node)
-        if not candidates:
-            return None
-        return max(candidates, key=lambda n: sum(n.resources_available.values()))
+        from . import scheduling_policy as policy
+        live = [n for n in self.nodes.values() if n.alive]
+        if strategy and strategy.get("type") == "node_label":
+            keep = set(policy.label_filter(
+                [(n.node_id, n.labels or {}) for n in live],
+                strategy.get("hard") or None))
+            live = [n for n in live if n.node_id in keep]
+            if not live:
+                return None
+            soft = strategy.get("soft")
+            if soft:
+                # Soft preference: place within the preferred subset when
+                # any of it is feasible, falling back to all hard matches
+                # (reference: node_label policy's soft reordering).
+                preferred = [n for n in live
+                             if all((n.labels or {}).get(a) == b
+                                    for a, b in soft.items())]
+                if preferred:
+                    pick = policy.hybrid_pick(
+                        [(n, n.resources_total, n.resources_available)
+                         for n in preferred], resources)
+                    if pick is not None:
+                        return pick
+        cands = [(n, n.resources_total, n.resources_available)
+                 for n in live]
+        if strategy and strategy.get("type") == "spread":
+            # Least-utilized feasible node (reference:
+            # spread_scheduling_policy.h round-robins; least-utilized is
+            # the stateless equivalent under a live resource view).
+            feas = [(n, policy.critical_utilization(t, a, resources))
+                    for n, t, a in cands if policy.feasible(a, resources)]
+            return min(feas, key=lambda nu: nu[1])[0] if feas else None
+        # Default: hybrid top-k pack-then-spread
+        # (reference: hybrid_scheduling_policy.h:50).
+        return policy.hybrid_pick(cands, resources)
 
     async def _schedule_actor(self, actor: ActorInfo,
                               timeout_s: float | None = None) -> bool:

@@ -67,7 +67,18 @@ def make_task_spec(
         # {"bp": N} for streaming-generator tasks (num_returns="streaming");
         # absent/None for regular tasks.
         "streaming": streaming,
+        # {"trace_id", "span_id"} of the submitting span when tracing is
+        # enabled (reference: remote_function.py:344 — tracing context
+        # injected into every submit; workers chain execution spans to
+        # it).  make_task_spec is the single choke point every task and
+        # actor call flows through, so injection lives here.
+        "trace": _trace_inject(),
     }
+
+
+def _trace_inject():
+    from ..util import tracing
+    return tracing.inject()
 
 
 def scheduling_key(fn_id: bytes, resources: Dict[str, float],

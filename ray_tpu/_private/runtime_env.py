@@ -1,12 +1,19 @@
-"""Runtime environments: working_dir / py_modules / env_vars.
+"""Runtime environments: working_dir / py_modules / env_vars / pip / uv.
 
 Reference surface: python/ray/_private/runtime_env/ — the driver packages
 local directories into content-addressed zips uploaded to the GCS KV
 (reference: runtime_env/packaging.py gcs:// URIs), and each node's agent
 materializes URIs into a per-session cache before spawning workers
 (reference: runtime_env agent creating env on each node, URI caching).
-Unsupported plugins (pip/conda/container) raise up front rather than
-silently no-op.
+
+Package plugins (reference: runtime_env/pip.py, uv.py): `pip` / `uv`
+install a spec's packages into a per-node content-addressed target dir
+(`pip install --target`), prepended to the worker's PYTHONPATH — cached
+by spec hash so N workers pay one install.  Air-gapped clusters pass
+`find_links` (a local wheel dir) and installs run `--no-index`, which is
+also how the tests exercise the plugin without network.  Unsupported
+plugins (conda/container) still raise up front rather than silently
+no-op.
 """
 
 from __future__ import annotations
@@ -22,7 +29,25 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PKG_BYTES = 512 * 1024 * 1024
 
 _SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules",
-                   "working_dir_uri", "py_modules_uris", "config"}
+                   "working_dir_uri", "py_modules_uris", "config",
+                   "pip", "uv"}
+
+
+def _normalize_pkg_spec(spec, kind: str) -> dict:
+    """Accept the reference's shapes — a list of requirements, or a dict
+    {"packages": [...], "find_links": dir} — and normalize for stable
+    hashing (reference: pip.py RuntimeEnvPlugin validation)."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not spec.get("packages"):
+        raise ValueError(
+            f"runtime_env[{kind!r}] must be a non-empty list of "
+            "requirements or {'packages': [...], 'find_links': dir}")
+    out = {"packages": sorted(str(p) for p in spec["packages"])}
+    fl = spec.get("find_links")
+    if fl:
+        out["find_links"] = os.path.abspath(str(fl))
+    return out
 
 
 def _zip_dir(path: str) -> bytes:
@@ -83,6 +108,11 @@ def package_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
             else:
                 uris.append(_upload_dir(core, m))
         out["py_modules_uris"] = uris
+    if "pip" in out and "uv" in out:
+        raise ValueError("runtime_env cannot set both 'pip' and 'uv'")
+    for kind in ("pip", "uv"):
+        if kind in out:
+            out[kind] = _normalize_pkg_spec(out[kind], kind)
     return out
 
 
@@ -151,6 +181,73 @@ class UriCache:
             if not fut.done():
                 fut.cancel()
 
+    async def ensure_packages(self, spec: dict, kind: str) -> str:
+        """Install a pip/uv spec into a content-addressed target dir once
+        per node (reference: pip.py/uv.py create-once + URI cache).
+        Returns the directory to prepend to PYTHONPATH."""
+        import asyncio
+        import shutil
+        import subprocess
+        import sys
+
+        digest = hashlib.sha1(
+            json.dumps({"kind": kind, **spec}, sort_keys=True).encode()
+        ).hexdigest()
+        dest = os.path.join(self.cache_root, "pkg_envs", digest)
+        if os.path.isdir(dest):
+            return dest
+        key = f"pkg:{digest}"
+        fut = self._inflight.get(key)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[key] = fut
+        try:
+            if kind == "uv":
+                uv = shutil.which("uv")
+                if uv is None:
+                    raise RuntimeError(
+                        "runtime_env['uv'] requested but no `uv` binary "
+                        "is on PATH on this node; use the 'pip' plugin "
+                        "or install uv")
+                cmd = [uv, "pip", "install", "--target"]
+            else:
+                cmd = [sys.executable, "-m", "pip", "install",
+                       "--no-warn-script-location", "--target"]
+            tmp = dest + f".tmp{os.getpid()}_{os.urandom(3).hex()}"
+            full = cmd + [tmp]
+            if spec.get("find_links"):
+                # Air-gapped path: local wheels only, never the index.
+                full += ["--no-index", "--find-links", spec["find_links"]]
+            full += spec["packages"]
+
+            def _install():
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                try:
+                    proc = subprocess.run(full, capture_output=True,
+                                          text=True, timeout=600)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"runtime_env {kind} install failed "
+                            f"(packages={spec['packages']}): "
+                            f"{proc.stderr.strip()[-2000:]}")
+                    os.replace(tmp, dest)
+                except BaseException:
+                    # Timeout/anything: never leave a half-populated
+                    # staging dir behind (a retry must start clean).
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise
+            await asyncio.get_running_loop().run_in_executor(None, _install)
+            fut.set_result(dest)
+            return dest
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._inflight.pop(key, None)
+            if not fut.done():
+                fut.cancel()
+
     async def setup(self, gcs_conn, runtime_env: Optional[dict]
                     ) -> Tuple[Dict[str, str], Optional[str]]:
         """Materialize a worker's runtime env. Returns (env_extra, cwd)."""
@@ -166,6 +263,10 @@ class UriCache:
             py_paths.append(cwd)
         for uri in renv.get("py_modules_uris") or []:
             py_paths.append(await self.ensure(gcs_conn, uri))
+        for kind in ("pip", "uv"):
+            if renv.get(kind):
+                py_paths.append(
+                    await self.ensure_packages(renv[kind], kind))
         if py_paths:
             existing = env_extra.get("PYTHONPATH",
                                      os.environ.get("PYTHONPATH", ""))

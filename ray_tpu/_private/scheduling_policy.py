@@ -1,0 +1,87 @@
+"""Cluster scheduling policies: hybrid top-k, spread, label matching.
+
+Reference surface: src/ray/raylet/scheduling/policy/ —
+HybridSchedulingPolicy (hybrid_scheduling_policy.h:50: pack onto nodes
+below a utilization threshold, choosing uniformly among the top-k to
+avoid thundering herds; above the threshold fall back to spreading by
+least utilization), SpreadSchedulingPolicy (round-robin over feasible
+nodes), NodeLabelSchedulingPolicy (hard/soft label selectors), and the
+scorer (scorer.h critical-resource utilization).
+
+Shared by the GCS actor scheduler, the agents' spillback choice, and the
+submitter-side lease routing (the reference's lease_policy.cc picks the
+raylet BEFORE the request goes out the same way).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Reference defaults (ray_config_def.h scheduler_spread_threshold 0.5;
+# top-k = 20% of nodes, RAY_scheduler_top_k_fraction).
+SPREAD_THRESHOLD = 0.5
+TOP_K_FRACTION = 0.2
+
+
+def feasible(avail: Dict[str, float], resources: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in resources.items()
+               if v > 0)
+
+
+def critical_utilization(total: Dict[str, float],
+                         avail: Dict[str, float],
+                         resources: Dict[str, float]) -> float:
+    """Utilization of the most-contended requested resource AFTER a
+    hypothetical placement (reference: scorer.h — the max over dims is
+    what drives both packing and spreading decisions)."""
+    worst = 0.0
+    dims = [k for k, v in resources.items() if v > 0] or list(total)
+    for k in dims:
+        t = total.get(k, 0.0)
+        if t <= 0:
+            continue
+        used = t - avail.get(k, 0.0) + resources.get(k, 0.0)
+        worst = max(worst, min(1.0, used / t))
+    return worst
+
+
+def hybrid_pick(candidates: Sequence[Tuple[object, Dict[str, float],
+                                           Dict[str, float]]],
+                resources: Dict[str, float],
+                *, spread_threshold: float = SPREAD_THRESHOLD,
+                top_k_fraction: float = TOP_K_FRACTION,
+                rng: Optional[random.Random] = None):
+    """candidates: (key, resources_total, resources_available) per node.
+    Returns the chosen key or None.
+
+    Phase 1 (pack): among feasible nodes whose post-placement critical
+    utilization stays <= threshold, prefer the MOST utilized (binpack),
+    picking uniformly from the top-k so concurrent schedulers don't
+    herd onto one node.  Phase 2 (spread): otherwise take the least
+    utilized feasible node."""
+    rng = rng or random
+    scored = [(key, critical_utilization(total, avail, resources))
+              for key, total, avail in candidates
+              if feasible(avail, resources)]
+    if not scored:
+        return None
+    below = [(k, u) for k, u in scored if u <= spread_threshold]
+    if below:
+        below.sort(key=lambda ku: -ku[1])        # most utilized first
+        k = max(1, int(len(below) * top_k_fraction))
+        return rng.choice(below[:k])[0]
+    return min(scored, key=lambda ku: ku[1])[0]
+
+
+def label_filter(candidates, selector: Optional[Dict[str, str]],
+                 soft: Optional[Dict[str, str]] = None):
+    """NodeLabelSchedulingPolicy: hard selector filters, soft selector
+    reorders (preferred nodes first).  candidates: (key, labels)."""
+    out = [(k, labels) for k, labels in candidates
+           if not selector or all(labels.get(a) == b
+                                  for a, b in selector.items())]
+    if soft:
+        out.sort(key=lambda kl: 0 if all(
+            kl[1].get(a) == b for a, b in soft.items()) else 1)
+    return [k for k, _ in out]

@@ -40,4 +40,18 @@ def chrome_trace_events(raw: List[dict]) -> List[dict]:
                 "cat": "submit", "ph": "i", "s": "t",
                 "ts": e["ts"] * 1e6, "pid": pid, "tid": wid,
             })
+        elif e["event"] == "SPAN":
+            # Tracing spans (util/tracing.py): complete events carrying
+            # the trace/span ids so cross-process causality is visible in
+            # Perfetto without an external collector.
+            events.append({
+                "name": f"span:{e.get('name') or tid.hex()[:8]}",
+                "cat": "trace", "ph": "X",
+                "ts": e.get("start_us", e["ts"] * 1e6),
+                "dur": e.get("dur_us", 0),
+                "pid": pid, "tid": wid,
+                "args": {"trace_id": e.get("trace_id"),
+                         "span_id": e.get("span_id"),
+                         "parent_span_id": e.get("parent_span_id")},
+            })
     return events

@@ -364,7 +364,7 @@ class Executor:
                     raise exc.RayError(
                         f"chunk spec no longer resolvable: "
                         f"{spec.get('name') or spec.get('method', '')}")
-                runnable.append((i, tid, method, args, kwargs))
+                runnable.append((i, tid, method, args, kwargs, spec))
             except Exception as e:  # noqa: BLE001
                 replies[i] = self._error_reply(e)
         if runnable:
@@ -372,7 +372,7 @@ class Executor:
                 out = []
                 prev = self.core.current_task_id
                 try:
-                    for _i, tid, method, args, kwargs in runnable:
+                    for _i, tid, method, args, kwargs, spec_ in runnable:
                         if tid in self._cancel_requested:
                             self._cancel_requested.discard(tid)
                             out.append(("cancelled", None))
@@ -381,8 +381,8 @@ class Executor:
                         self._running[tid] = (None, False)
                         try:
                             out.append(
-                                ("ok", self._run_sync(tid, method,
-                                                      args, kwargs)))
+                                ("ok", self._run_sync(tid, method, args,
+                                                      kwargs, spec_)))
                         except exc.TaskCancelledError:
                             out.append(("cancelled", None))
                         except BaseException as e:  # noqa: BLE001
@@ -396,8 +396,8 @@ class Executor:
 
             outcomes = await loop.run_in_executor(self.core.executor,
                                                   _run_all)
-            for (i, tid, _m, _a, _k), (status, payload) in zip(runnable,
-                                                               outcomes):
+            for (i, tid, _m, _a, _k, _s), (status, payload) in zip(
+                    runnable, outcomes):
                 spec = chunk[i][0]
                 if status == "cancelled":
                     replies[i] = {"status": "cancelled"}
@@ -428,7 +428,7 @@ class Executor:
                         self.core.current_task_id = prev
         return replies
 
-    def _run_sync(self, task_id: bytes, fn, args, kwargs):
+    def _run_sync(self, task_id: bytes, fn, args, kwargs, spec=None):
         """Sync user code on an executor thread; the thread id is recorded so
         cancel_task can raise TaskCancelledError inside it (the same effect
         as the reference's SIGINT-to-worker for running tasks — lands at the
@@ -436,6 +436,14 @@ class Executor:
         with self._thread_guard:
             self._running_threads[task_id] = threading.get_ident()
         try:
+            if spec is not None and spec.get("trace"):
+                # Span set HERE (the executing thread), not around the
+                # run_in_executor call: contextvars don't cross executor
+                # submission, and nested .remote() calls from user code
+                # read the context from this thread.
+                from ..util import tracing
+                with tracing.execution_span(self.core, spec):
+                    return fn(*args, **kwargs)
             return fn(*args, **kwargs)
         except exc.TaskCancelledError:
             if task_id in self._cancel_intent:
@@ -736,12 +744,18 @@ class Executor:
                     result = await self._run_streaming(spec, method,
                                                        args, kwargs)
                 elif asyncio.iscoroutinefunction(method):
-                    result = await method(*args, **kwargs)
+                    if spec.get("trace"):
+                        from ..util import tracing
+                        with tracing.execution_span(self.core, spec):
+                            result = await method(*args, **kwargs)
+                    else:
+                        result = await method(*args, **kwargs)
                 else:
                     self._running[tid] = (asyncio.current_task(), False)
                     result = await loop.run_in_executor(
                         self.core.executor,
-                        lambda: self._run_sync(tid, method, args, kwargs))
+                        lambda: self._run_sync(tid, method, args, kwargs,
+                                               spec))
             else:
                 fn = await self._load_function(spec["fn_id"])
                 if spec.get("streaming"):
@@ -750,7 +764,8 @@ class Executor:
                     self._running[tid] = (asyncio.current_task(), False)
                     result = await loop.run_in_executor(
                         self.core.executor,
-                        lambda: self._run_sync(tid, fn, args, kwargs))
+                        lambda: self._run_sync(tid, fn, args, kwargs,
+                                               spec))
             returns = await self._serialize_returns(
                 spec["task_id"], spec["nreturns"], result,
                 caller_addr=spec.get("owner_addr"))
@@ -813,6 +828,49 @@ class Executor:
             name: asyncio.Semaphore(int(n))
             for name, n in (spec.get("concurrency_groups") or {}).items()}
         return True
+
+    # -------------------------------------------------- live profiling ---
+    # (reference: dashboard/modules/reporter/profile_manager.py — py-spy
+    # CPU flamegraphs + memray dumps launched against a live worker.
+    # Neither tool ships in this image, so the equivalents are built in:
+    # a pure-Python stack dump and a sampling CPU profiler.)
+
+    async def h_stacks(self, conn, p):
+        """All threads' current stacks (the py-spy `dump` equivalent)."""
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in frames.items():
+            out[f"{names.get(tid, '?')}-{tid}"] = "".join(
+                traceback.format_stack(frame))
+        return {"pid": os.getpid(), "actor": bool(self.actor_id),
+                "stacks": out}
+
+    async def h_cpu_profile(self, conn, p):
+        """Sampling CPU profile: poll every thread's frames at ~100Hz for
+        `duration_s`, aggregate identical stacks (the py-spy `record`
+        equivalent, pure Python)."""
+        duration = min(float(p.get("duration_s", 5.0)), 60.0)
+        interval = max(float(p.get("interval_s", 0.01)), 0.001)
+        counts: Dict[str, int] = {}
+        samples = 0
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + duration
+        while loop.time() < deadline:
+            for frame in sys._current_frames().values():
+                stack = []
+                f = frame
+                while f is not None:
+                    stack.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{f.f_lineno}:{f.f_code.co_name}")
+                    f = f.f_back
+                key = ";".join(reversed(stack))
+                counts[key] = counts.get(key, 0) + 1
+            samples += 1
+            await asyncio.sleep(interval)
+        top = sorted(counts.items(), key=lambda kv: -kv[1])[:50]
+        return {"pid": os.getpid(), "samples": samples,
+                "stacks": [{"stack": k, "count": v} for k, v in top]}
 
     async def h_cancel_task(self, conn, p):
         """Cancel a task (reference: CoreWorkerService CancelTask,
@@ -891,6 +949,8 @@ async def amain():
         "actor_init": executor.h_actor_init,
         "cancel_task": executor.h_cancel_task,
         "kill": executor.h_kill,
+        "stacks": executor.h_stacks,
+        "cpu_profile": executor.h_cpu_profile,
     }
     core._server.handlers.update(exec_handlers)
     # Register with the agent over a dedicated connection that stays open —

@@ -31,7 +31,8 @@ class ActorMethod:
             actor_id=self._handle._actor_id, method=self._method_name,
             args=args, kwargs=kwargs, num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
-            generator_backpressure=self._generator_backpressure)
+            generator_backpressure=self._generator_backpressure,
+            out_of_order=self._handle._out_of_order)
         # num_returns="streaming" yields a single ObjectRefGenerator.
         if self._num_returns == 1 or isinstance(self._num_returns, str):
             return refs[0]
@@ -50,9 +51,15 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: bytes, class_name: str = "",
-                 owned: bool = False, max_task_retries: int = 0):
+                 owned: bool = False, max_task_retries: int = 0,
+                 out_of_order: bool = False):
         self._actor_id = actor_id
         self._class_name = class_name
+        # allow_out_of_order_execution: submit-queue behavior only —
+        # calls may be pushed as their deps resolve, not in call order
+        # (reference: actor option use_out_of_order via
+        # out_of_order_actor_submit_queue.cc).
+        self._out_of_order = out_of_order
         # Retries of in-flight method calls across actor restarts
         # (reference: actor.py max_task_retries; requires max_restarts>0
         # on the actor for a retry to ever find a new incarnation).
@@ -79,7 +86,7 @@ class ActorHandle:
         # Handles are freely serializable into tasks/objects (reference:
         # actor handles are first-class serializable values).
         return (ActorHandle, (self._actor_id, self._class_name, False,
-                              self._max_task_retries))
+                              self._max_task_retries, self._out_of_order))
 
     def __del__(self):
         if not getattr(self, "_owned", False):
@@ -97,7 +104,8 @@ class ActorClass:
                  max_restarts=0, max_task_retries=0, max_concurrency=1,
                  name=None, namespace=None, lifetime=None, runtime_env=None,
                  scheduling_strategy=None, get_if_exists=False,
-                 concurrency_groups=None):
+                 concurrency_groups=None,
+                 allow_out_of_order_execution=False):
         self._cls = cls
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus
@@ -111,6 +119,7 @@ class ActorClass:
         self._runtime_env = runtime_env
         self._scheduling_strategy = scheduling_strategy
         self._get_if_exists = get_if_exists
+        self._allow_out_of_order = allow_out_of_order_execution
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -126,7 +135,8 @@ class ActorClass:
             lifetime=self._lifetime, runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
             get_if_exists=self._get_if_exists,
-            concurrency_groups=self._concurrency_groups)
+            concurrency_groups=self._concurrency_groups,
+            allow_out_of_order_execution=self._allow_out_of_order)
         merged.update(overrides)
         return ActorClass(self._cls, **merged)
 
@@ -156,4 +166,5 @@ class ActorClass:
         owned = self._lifetime != "detached"
         return ActorHandle(bytes(info["actor_id"]), self._cls.__name__,
                            owned=owned,
-                           max_task_retries=self._max_task_retries)
+                           max_task_retries=self._max_task_retries,
+                           out_of_order=self._allow_out_of_order)

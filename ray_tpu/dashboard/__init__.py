@@ -160,8 +160,9 @@ class DashboardHead:
                 h = await asyncio.wait_for(reader.readline(), 30)
                 if h in (b"\r\n", b"\n", b""):
                     break
-            path = target.split("?", 1)[0]
-            status, ctype, body = await self._route(method, path)
+            # Full target (incl. query string): _route urlsplits it —
+            # /api/profile's node/kind/duration parameters live there.
+            status, ctype, body = await self._route(method, target)
         except (asyncio.TimeoutError, ConnectionError):
             return
         except Exception as e:
@@ -184,8 +185,39 @@ class DashboardHead:
     async def _route(self, method: str, path: str):
         if method != "GET":
             return 404, "text/plain", b"only GET"
+        from urllib.parse import parse_qs, urlsplit
+        parts = urlsplit(path)
+        path, query = parts.path, parse_qs(parts.query)
         if path in ("/", "/index.html"):
             return 200, "text/html", _INDEX.encode()
+        if path == "/api/profile":
+            # Live profiling (reference: dashboard reporter module's
+            # py-spy/memray endpoints): /api/profile?node=<hex>&
+            # kind=stacks|cpu_profile&duration=5[&worker=<hex>]
+            from .._private import rpc as rpc_mod
+            gcs = await self._gcs()
+            nodes = await gcs.call("get_nodes", {})
+            want = query.get("node", [None])[0]
+            node = next(
+                (n for n in nodes if n["alive"] and
+                 (want is None or bytes(n["node_id"]).hex()
+                  .startswith(want))), None)
+            if node is None:
+                return 404, "text/plain", b"no such live node"
+            agent = await rpc_mod.connect(tuple(node["address"]),
+                                          name="dash->agent")
+            try:
+                wid = query.get("worker", [None])[0]
+                res = await agent.call("profile_worker", {
+                    "kind": query.get("kind", ["stacks"])[0],
+                    "duration_s": float(
+                        query.get("duration", ["5"])[0]),
+                    "worker_id": bytes.fromhex(wid) if wid else None,
+                }, timeout=90)
+            finally:
+                await agent.close()
+            return (200, "application/json",
+                    json.dumps(_hexify(res)).encode())
         if path == "/healthz":
             gcs = await self._gcs()
             await gcs.call("ping", {})

@@ -1,0 +1,114 @@
+"""Distributed tracing: span propagation across task/actor submission.
+
+Reference surface: python/ray/util/tracing/tracing_helper.py — tracing
+wrappers injected into every remote function at submit time
+(reference: remote_function.py:344 _inject_tracing_into_function), with
+the span context carried in task metadata so worker-side execution spans
+chain to the caller's trace.
+
+TPU-native design: the runtime carries a W3C-shaped context
+(trace_id/span_id hex) in the task spec and records every submit/execute
+span into the existing task-event pipeline — so `ray_tpu.timeline()`
+shows the full cross-process trace with ZERO external collectors (the
+cluster has no egress).  When an OpenTelemetry SDK provider is
+configured in the process (opentelemetry-api ships in-image; the SDK is
+a soft dep like the reference's), the same spans are additionally
+emitted through `opentelemetry.trace`, giving OTLP export for free where
+the user wires it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_enabled = False
+# The active span context in THIS thread/coroutine:
+# {"trace_id": hex32, "span_id": hex16}
+_ctx: contextvars.ContextVar[Optional[Dict[str, str]]] = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def enable_tracing() -> None:
+    """Turn on span injection for every subsequent submit in this
+    process (workers inherit the decision through the task spec: a spec
+    carrying a trace context is always traced on the executing side)."""
+    global _enabled
+    _enabled = True
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def _otel_tracer():
+    """The OTel tracer if a real SDK provider is installed (the bare API
+    yields no-op spans — harmless)."""
+    try:
+        from opentelemetry import trace
+        return trace.get_tracer("ray_tpu")
+    except Exception:   # pragma: no cover - api always importable here
+        return None
+
+
+def inject() -> Optional[Dict[str, str]]:
+    """Submit-side: the context to stamp into an outgoing task spec.
+    An active context always propagates — worker processes never call
+    enable_tracing(), they inherit the decision through the spec that
+    carried a context into execution_span.  New root traces start only
+    where tracing was explicitly enabled (reference: spans start at the
+    driver's first .remote())."""
+    cur = _ctx.get()
+    if cur is not None:
+        return {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
+    if not _enabled:
+        return None
+    return {"trace_id": _new_id(16), "span_id": _new_id(8)}
+
+
+@contextmanager
+def execution_span(core, spec: Dict[str, Any]):
+    """Worker-side: run a task under a child span of the submitted
+    context; nested .remote() calls made by the user code inherit it via
+    the contextvar.  Span rows ride the task-event pipeline
+    (kind='span')."""
+    parent = spec.get("trace")
+    if not parent:
+        yield
+        return
+    span = {"trace_id": parent["trace_id"], "span_id": _new_id(8)}
+    token = _ctx.set(span)
+    name = spec.get("name") or spec.get("method", "task")
+    t0 = time.time()
+    otel = _otel_tracer()
+    om = otel.start_as_current_span(name) if otel is not None else None
+    if om is not None:
+        om.__enter__()
+    try:
+        yield
+    finally:
+        if om is not None:
+            om.__exit__(None, None, None)
+        _ctx.reset(token)
+        try:
+            core.record_task_event(
+                spec["task_id"], name, "SPAN",
+                trace_id=span["trace_id"],
+                span_id=span["span_id"],
+                parent_span_id=parent["span_id"],
+                start_us=int(t0 * 1e6),
+                dur_us=int((time.time() - t0) * 1e6))
+        except Exception:   # pragma: no cover - tracing must not fail tasks
+            pass
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active trace context (for user code to log/correlate)."""
+    return _ctx.get()

@@ -122,3 +122,39 @@ def test_prometheus_text_histogram_rendering():
     assert 'lat_bucket{le="+Inf"} 4' in out   # overflow == _count
     assert "lat_sum 16.0" in out
     assert "lat_count 4" in out
+
+
+def test_profile_endpoint_with_query_params(dashboard):
+    """/api/profile?kind=...&duration=... reaches the agent's live
+    profiler with its query parameters intact (reference: reporter
+    module's profiling endpoints)."""
+    import json as _json
+
+    @ray_tpu.remote
+    class Busy:
+        def churn(self, s):
+            import time
+            t0 = time.monotonic()
+            x = 0
+            while time.monotonic() - t0 < s:
+                x += 1
+            return x
+
+    b = Busy.remote()
+    ref = b.churn.remote(5.0)
+    import time
+    time.sleep(0.5)
+    st, ct, body = _get(dashboard,
+                        "/api/profile?kind=cpu_profile&duration=1")
+    assert st == 200, body
+    res = _json.loads(body)
+    assert res, "no workers profiled"
+    joined = " ".join(s["stack"] for w in res.values()
+                      if isinstance(w, dict) and "stacks" in w
+                      for s in w["stacks"])
+    assert "churn" in joined, "cpu samples missed the busy method"
+    # samples field proves the cpu_profile kind (stacks has none).
+    assert any("samples" in w for w in res.values()
+               if isinstance(w, dict))
+    assert ray_tpu.get(ref, timeout=60) > 0
+    ray_tpu.kill(b)

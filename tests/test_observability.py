@@ -119,3 +119,95 @@ def test_user_metrics_counter_gauge_histogram(ray_start_regular):
     text = metrics.prometheus_text()
     assert "# TYPE obs_test_requests counter" in text
     assert "obs_test_requests" in text
+
+
+def test_tracing_spans_chain_across_tasks(ray_start_regular):
+    """Span propagation (reference: tracing_helper.py — context injected
+    at submit, worker execution spans chain to the caller): a task that
+    submits a nested task produces two SPAN events sharing one trace_id,
+    with the child's parent_span_id set."""
+    from ray_tpu.util import tracing
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def inner():
+            return 1
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(inner.remote())
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == 1
+
+        def _spans():
+            core = ray_tpu._core()
+            raw = core.gcs_call("get_task_events", {"limit": 100_000})
+            spans = [e for e in raw if e.get("event") == "SPAN"]
+            names = {e.get("name") for e in spans}
+            if not {"inner", "outer"} <= names:
+                return None
+            return spans
+        spans = _wait_for(_spans, msg="SPAN events never reached the GCS")
+        outer_s = next(e for e in spans if e["name"] == "outer")
+        inner_s = next(e for e in spans if e["name"] == "inner")
+        assert outer_s["trace_id"] == inner_s["trace_id"]
+        # inner executed INSIDE outer's execution span.
+        assert inner_s["parent_span_id"] == outer_s["span_id"]
+        assert inner_s["dur_us"] >= 0
+        # Spans render in the chrome timeline.
+        from ray_tpu._private.timeline import chrome_trace_events
+        evs = chrome_trace_events(
+            ray_tpu._core().gcs_call("get_task_events",
+                                     {"limit": 100_000}))
+        assert any(e["cat"] == "trace" and e["name"] == "span:inner"
+                   for e in evs)
+    finally:
+        tracing._enabled = False
+
+
+def test_live_profiling_endpoints(ray_start_regular):
+    """Worker stack dumps + sampling CPU profile through the agent
+    (reference: dashboard/modules/reporter/profile_manager.py py-spy
+    equivalents)."""
+    import asyncio
+    import time as _t
+
+    @ray_tpu.remote
+    class Spinner:
+        def spin_away(self, s):
+            t0 = _t.monotonic()
+            x = 0
+            while _t.monotonic() - t0 < s:
+                x += 1
+            return x
+
+    sp = Spinner.remote()
+    ref = sp.spin_away.remote(6.0)
+
+    from ray_tpu._private import rpc as rpc_mod
+
+    async def _profile():
+        core = ray_tpu._core()
+        agent = await rpc_mod.connect(core.agent_address,
+                                      name="test->agent")
+        try:
+            stacks = await agent.call("profile_worker",
+                                      {"kind": "stacks"}, timeout=30)
+            cpu = await agent.call("profile_worker",
+                                   {"kind": "cpu_profile",
+                                    "duration_s": 1.0}, timeout=40)
+        finally:
+            await agent.close()
+        return stacks, cpu
+
+    _t.sleep(0.5)   # let the spin start
+    stacks, cpu = asyncio.run(_profile())
+    all_stacks = "".join(
+        s for w in stacks.values() if "stacks" in w
+        for s in w["stacks"].values())
+    assert "spin_away" in all_stacks, "stack dump missed the busy method"
+    cpu_text = " ".join(s["stack"] for w in cpu.values()
+                        if "stacks" in w for s in w["stacks"])
+    assert "spin_away" in cpu_text, "CPU samples missed the busy method"
+    assert ray_tpu.get(ref, timeout=60) > 0
+    ray_tpu.kill(sp)

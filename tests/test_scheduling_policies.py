@@ -1,0 +1,135 @@
+"""Scheduling policies: hybrid top-k scorer, task-level SPREAD /
+node-affinity / node-label routing.
+
+Reference model: src/ray/raylet/scheduling/policy/ —
+hybrid_scheduling_policy.h:50 (pack below the utilization threshold via
+top-k, spread above), spread/node_affinity/node_label policies, and
+lease_policy.cc (the submitter picks the target raylet).
+"""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import scheduling_policy as policy
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy)
+
+
+# ------------------------------------------------------------- unit ----
+
+
+def test_hybrid_packs_below_threshold():
+    # Two nodes, both under the 0.5 threshold after placement: pack onto
+    # the MORE utilized one (binpack), not the emptier one.
+    cands = [
+        ("busy", {"CPU": 10.0}, {"CPU": 6.0}),    # util after +1: 0.5
+        ("idle", {"CPU": 10.0}, {"CPU": 10.0}),   # util after +1: 0.1
+    ]
+    picks = {policy.hybrid_pick(cands, {"CPU": 1.0},
+                                rng=random.Random(i)) for i in range(8)}
+    assert picks == {"busy"}
+
+
+def test_hybrid_spreads_above_threshold():
+    # Every node lands above the threshold: least utilized wins.
+    cands = [
+        ("hot", {"CPU": 10.0}, {"CPU": 1.0}),     # util after +1: 1.0
+        ("warm", {"CPU": 10.0}, {"CPU": 4.0}),    # util after +1: 0.7
+    ]
+    assert policy.hybrid_pick(cands, {"CPU": 1.0}) == "warm"
+
+
+def test_hybrid_feasibility_and_empty():
+    cands = [("full", {"CPU": 4.0}, {"CPU": 0.0})]
+    assert policy.hybrid_pick(cands, {"CPU": 1.0}) is None
+    assert policy.hybrid_pick([], {"CPU": 1.0}) is None
+
+
+def test_critical_utilization_uses_worst_dim():
+    u = policy.critical_utilization(
+        {"CPU": 10.0, "TPU": 4.0}, {"CPU": 9.0, "TPU": 1.0},
+        {"CPU": 1.0, "TPU": 1.0})
+    assert u == pytest.approx(1.0)    # TPU dim: (4-1+1)/4
+
+def test_label_filter_hard_and_soft():
+    cands = [("a", {"zone": "z1"}), ("b", {"zone": "z2", "gen": "v5e"}),
+             ("c", {"zone": "z2"})]
+    assert policy.label_filter(cands, {"zone": "z2"}) == ["b", "c"]
+    assert policy.label_filter(cands, None, {"gen": "v5e"})[0] == "b"
+    assert policy.label_filter(cands, {"zone": "z3"}) == []
+
+
+# ---------------------------------------------------------- cluster ----
+
+
+@pytest.fixture
+def labeled_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    n2 = cluster.add_node(num_cpus=4, labels={"tier": "compute"})
+    n3 = cluster.add_node(num_cpus=4, labels={"tier": "memory"})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    yield cluster, n2, n3
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def _where():
+    import time
+    time.sleep(0.3)     # hold the slot so spreads can't all reuse one
+    return ray_tpu.get_runtime_context().node_id
+
+
+def test_spread_strategy_uses_multiple_nodes(labeled_cluster):
+    refs = [_where.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(6)]
+    nodes = set(ray_tpu.get(refs, timeout=120))
+    assert len(nodes) >= 2, f"SPREAD stayed on {len(nodes)} node"
+
+
+def test_node_affinity_hard_pins(labeled_cluster):
+    _, n2, _ = labeled_cluster
+    strat = NodeAffinitySchedulingStrategy(n2.node_id, soft=False)
+    nodes = set(ray_tpu.get(
+        [_where.options(scheduling_strategy=strat).remote()
+         for _ in range(4)], timeout=120))
+    assert nodes == {n2.node_id}
+
+
+def test_node_affinity_hard_dead_node_fails(labeled_cluster):
+    cluster, _, n3 = labeled_cluster
+    cluster.remove_node(n3)
+    import time
+    time.sleep(1.0)
+    strat = NodeAffinitySchedulingStrategy(n3.node_id, soft=False)
+    with pytest.raises(ray_tpu.exceptions.RayError,
+                       match="satisfiable"):
+        ray_tpu.get(_where.options(scheduling_strategy=strat).remote(),
+                    timeout=60)
+
+
+def test_node_affinity_soft_falls_back(labeled_cluster):
+    cluster, n2, n3 = labeled_cluster
+    cluster.remove_node(n3)
+    import time
+    time.sleep(1.0)
+    strat = NodeAffinitySchedulingStrategy(n3.node_id, soft=True)
+    got = ray_tpu.get(_where.options(scheduling_strategy=strat).remote(),
+                      timeout=60)
+    assert got != n3.node_id    # ran somewhere alive
+
+
+def test_node_label_hard_selects(labeled_cluster):
+    _, n2, _ = labeled_cluster
+    strat = NodeLabelSchedulingStrategy(hard={"tier": "compute"})
+    nodes = set(ray_tpu.get(
+        [_where.options(scheduling_strategy=strat).remote()
+         for _ in range(3)], timeout=120))
+    assert nodes == {n2.node_id}

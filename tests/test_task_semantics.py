@@ -173,3 +173,56 @@ def test_killed_actor_releases_cached_leases(ray_start_regular):
         time.sleep(0.25)
     assert avail == total, \
         f"leases leaked: {total - avail} CPUs still held after kill"
+
+
+def test_out_of_order_actor_submit_queue(ray_start_regular):
+    """allow_out_of_order_execution (reference:
+    out_of_order_actor_submit_queue.cc): a call whose args are ready is
+    pushed immediately instead of queueing behind an earlier call still
+    resolving a slow dependency; the default sequential queue preserves
+    call order."""
+    import time
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(2.0)
+        return "slow"
+
+    def _actor_cls():
+        class Eater:
+            def __init__(self):
+                self.order = []
+
+            async def eat(self, v):
+                self.order.append(v)
+                return v
+
+            async def get_order(self):
+                return list(self.order)
+        return Eater
+
+    OoO = ray_tpu.remote(max_concurrency=4,
+                         allow_out_of_order_execution=True)(_actor_cls())
+    a = OoO.remote()
+    t0 = time.monotonic()
+    r1 = a.eat.remote(slow_value.remote())   # dep resolves in ~2s
+    r2 = a.eat.remote("fast")
+    assert ray_tpu.get(r2, timeout=5) == "fast"
+    assert time.monotonic() - t0 < 1.8, \
+        "out-of-order call was head-of-line blocked behind the slow dep"
+    assert ray_tpu.get(r1, timeout=30) == "slow"
+    assert ray_tpu.get(a.get_order.remote(), timeout=10) == \
+        ["fast", "slow"]
+    ray_tpu.kill(a)
+
+    # Control: the DEFAULT sequential queue keeps call order even when
+    # the earlier call's dependency is slow.
+    Seq = ray_tpu.remote(max_concurrency=4)(_actor_cls())
+    b = Seq.remote()
+    s1 = b.eat.remote(slow_value.remote())
+    s2 = b.eat.remote("fast")
+    assert ray_tpu.get(s1, timeout=30) == "slow"
+    assert ray_tpu.get(s2, timeout=30) == "fast"
+    assert ray_tpu.get(b.get_order.remote(), timeout=10) == \
+        ["slow", "fast"]
+    ray_tpu.kill(b)
