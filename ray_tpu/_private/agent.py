@@ -507,16 +507,32 @@ class NodeAgent:
                 return {"granted": False, "spillback": spill}
             return {"granted": False, "reason": "infeasible",
                     "retry_after_ms": 100}
+        # Runtime-env materialization NEVER blocks the grant RPC: a pip
+        # install can take minutes while the client's lease timeout is
+        # ~130s — a blocked handler whose client gave up would still
+        # grant, leaking the lease (reference: the raylet delegates to
+        # the runtime-env agent and retries the lease).
+        status, payload = self.uri_cache.poll_setup(
+            self.gcs, p.get("runtime_env"))
+        if status == "pending":
+            self._release_resources(resources, bundle_key)
+            return {"granted": False,
+                    "reason": "runtime env setup in progress",
+                    "retry_after_ms": 1000}
+        if status == "failed":
+            self._release_resources(resources, bundle_key)
+            return {"granted": False,
+                    "reason": f"runtime env setup failed: {payload}",
+                    "retry_after_ms": 200}
+        env_extra, cwd = payload
+        env_extra = dict(env_extra)
         try:
-            env_extra, cwd = await self.uri_cache.setup(
-                self.gcs, p.get("runtime_env"))
             if p.get("env"):
                 env_extra.update(p["env"])
             wh = await self._pop_worker(
                 env_extra or None, needs_tpu=_needs_tpu(resources), cwd=cwd)
         except Exception as e:
-            # Anything (env materialization TimeoutError, corrupt package,
-            # spawn failure) must release the acquired resources.
+            # A spawn failure must release the acquired resources.
             self._release_resources(resources, bundle_key)
             return {"granted": False, "reason": str(e), "retry_after_ms": 200}
         if conn.closed:
@@ -665,10 +681,18 @@ class NodeAgent:
             acquired = self._try_acquire(resources)
         if not acquired:
             raise rpc.RpcError("insufficient resources for actor")
+        # Same non-blocking env contract as h_request_lease: the GCS
+        # scheduler retries while a pip install runs in the background.
+        status, payload = self.uri_cache.poll_setup(
+            self.gcs, p.get("runtime_env"))
+        if status != "ready":
+            self._release_resources(resources, bundle_key)
+            raise rpc.RpcError(
+                "runtime env setup in progress" if status == "pending"
+                else f"runtime env setup failed: {payload}")
+        env_extra, cwd = payload
         try:
-            env_extra, cwd = await self.uri_cache.setup(
-                self.gcs, p.get("runtime_env"))
-            wh = await self._pop_worker(env_extra or None,
+            wh = await self._pop_worker(dict(env_extra) or None,
                                         needs_tpu=_needs_tpu(resources),
                                         cwd=cwd)
         except Exception:

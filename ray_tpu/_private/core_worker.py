@@ -1582,6 +1582,14 @@ class CoreWorker:
                 self._pump(key, state)
             return
         if not res.get("granted"):
+            reason = res.get("reason") or ""
+            if "runtime env setup failed" in reason:
+                # A broken env spec (bad package, dead find_links) can
+                # never succeed by retrying — surface it on the tasks
+                # (reference: RuntimeEnvSetupError fails the task).
+                state.pending_lease_requests -= 1
+                self._fail_queued_tasks(state, exc.RayError(reason))
+                return
             if is_pg and "bundle" in (res.get("reason") or ""):
                 # Bundle gone or exhausted at the routed node: drop the
                 # cached table so the next attempt re-resolves (and notices
@@ -1630,12 +1638,12 @@ class CoreWorker:
         self._pump(key, state)
         self._spawn(self._lease_reaper(key, state, lease))
 
-    async def _cluster_nodes(self):
+    async def _cluster_nodes(self, force: bool = False):
         """GCS node view, cached briefly (strategy routing must not add
         a GCS round trip per lease request)."""
         now = time.monotonic()
         cached = getattr(self, "_nodes_cache", None)
-        if cached is not None and now - cached[0] < 2.0:
+        if not force and cached is not None and now - cached[0] < 2.0:
             return cached[1]
         nodes = await self.gcs.call("get_nodes", {})
         self._nodes_cache = (now, nodes)
@@ -1651,7 +1659,6 @@ class CoreWorker:
         listed-alive node refusing connections — death-lag), or
         'infeasible' when a HARD constraint is unsatisfiable per the
         authoritative GCS view (target dead/absent, no label match)."""
-        from . import scheduling_policy as policy
         hard = ((strat.get("type") == "node_affinity"
                  and not strat.get("soft"))
                 or (strat.get("type") == "node_label"
@@ -1661,6 +1668,24 @@ class CoreWorker:
         except (rpc.RpcError, asyncio.TimeoutError):
             # Never silently violate a hard constraint on a GCS blip.
             return (None, "retry") if hard else (self.agent, "ok")
+        conn, verdict = await self._route_on_view(strat, resources, nodes,
+                                                  hard)
+        if verdict == "infeasible":
+            # The cached view can be up to 2s stale — a node that just
+            # registered must not get its hard-pinned tasks wrongly
+            # failed.  Re-evaluate against a FRESH view before declaring
+            # the constraint unsatisfiable.
+            try:
+                nodes = [n for n in await self._cluster_nodes(force=True)
+                         if n["alive"]]
+            except (rpc.RpcError, asyncio.TimeoutError):
+                return None, "retry"
+            conn, verdict = await self._route_on_view(strat, resources,
+                                                      nodes, hard)
+        return conn, verdict
+
+    async def _route_on_view(self, strat: dict, resources, nodes, hard):
+        from . import scheduling_policy as policy
         typ = strat.get("type")
 
         async def _connect(addr):

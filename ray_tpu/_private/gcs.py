@@ -698,6 +698,12 @@ class GcsServer:
                                                   timeout=120)
                     break
                 except (rpc.RpcError, asyncio.TimeoutError) as e:
+                    if "setup in progress" in str(e):
+                        # The node is actively materializing this actor's
+                        # runtime env (pip installs can take minutes) —
+                        # that's forward progress, not a stall: keep the
+                        # deadline fresh like a new-capacity event.
+                        deadline = time.monotonic() + timeout_s
                     logger.warning("actor creation on %s failed: %s; retrying",
                                    node.node_id.hex()[:8], str(e).split("\n")[0])
             await asyncio.sleep(0.2)

@@ -134,6 +134,8 @@ class UriCache:
     def __init__(self, cache_root: str):
         self.cache_root = cache_root
         self._inflight: Dict[str, "asyncio.Future"] = {}
+        # runtime_env hash -> asyncio.Task running setup() (poll_setup)
+        self._setups: Dict[bytes, "asyncio.Task"] = {}
 
     async def ensure(self, gcs_conn, uri: str) -> str:
         """Download+extract `gcs://<digest>` once; concurrent callers for
@@ -210,7 +212,11 @@ class UriCache:
                         "runtime_env['uv'] requested but no `uv` binary "
                         "is on PATH on this node; use the 'pip' plugin "
                         "or install uv")
-                cmd = [uv, "pip", "install", "--target"]
+                # --python pins resolution to the interpreter the workers
+                # actually run; without it uv discovers whatever venv the
+                # agent's shell had (or errors with none).
+                cmd = [uv, "pip", "install", "--python", sys.executable,
+                       "--target"]
             else:
                 cmd = [sys.executable, "-m", "pip", "install",
                        "--no-warn-script-location", "--target"]
@@ -247,6 +253,39 @@ class UriCache:
             self._inflight.pop(key, None)
             if not fut.done():
                 fut.cancel()
+
+    def poll_setup(self, gcs_conn, runtime_env: Optional[dict]):
+        """Non-blocking env materialization for the lease-grant path
+        (reference: the raylet asks its runtime-env agent and retries the
+        lease rather than blocking the grant RPC on a pip install).
+
+        Returns (status, payload): ('ready', (env_extra, cwd)) when every
+        piece is materialized; ('pending', None) with the setup running
+        in the background; ('failed', error_str) when setup errored (the
+        failure is consumed — a later poll retries)."""
+        import asyncio
+        if not runtime_env or (not runtime_env.get("working_dir_uri")
+                               and not runtime_env.get("py_modules_uris")
+                               and not runtime_env.get("pip")
+                               and not runtime_env.get("uv")):
+            # Only env_vars (or nothing): pure dict-building, no IO —
+            # answer inline so the common case stays single-round-trip.
+            env_extra = {k: str(v) for k, v in
+                         (runtime_env or {}).get("env_vars", {}).items()}
+            return "ready", (env_extra, None)
+        key = runtime_env_hash(runtime_env)
+        task = self._setups.get(key)
+        if task is None:
+            task = asyncio.ensure_future(self.setup(gcs_conn, runtime_env))
+            self._setups[key] = task
+            return "pending", None
+        if not task.done():
+            return "pending", None
+        if task.exception() is not None:
+            err = str(task.exception())
+            del self._setups[key]    # a later poll starts a fresh attempt
+            return "failed", err
+        return "ready", task.result()
 
     async def setup(self, gcs_conn, runtime_env: Optional[dict]
                     ) -> Tuple[Dict[str, str], Optional[str]]:

@@ -1,0 +1,56 @@
+"""Batch-format conversion: numpy dicts <-> Arrow tables <-> pandas.
+
+Reference surface: python/ray/data/block.py + _internal/arrow_block.py —
+the reference's native block format is Arrow and map_batches/iter_batches
+accept batch_format="numpy"|"pyarrow"|"pandas".  This runtime's native
+block is a dict of numpy columns (zero-copy through the shm object
+store); Arrow/pandas are conversion views at the batch boundary, which
+is exactly where the reference converts for batch_format="numpy" too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+BATCH_FORMATS = ("numpy", "pyarrow", "pandas", "default")
+
+
+def to_batch_format(block: Dict[str, np.ndarray], batch_format: str):
+    """Convert a native numpy-dict block into the requested view."""
+    if batch_format in ("numpy", "default", None):
+        return block
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+        return pa.table({k: pa.array(np.asarray(v))
+                         for k, v in block.items()})
+    if batch_format == "pandas":
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if np.asarray(v).ndim > 1 else v
+                             for k, v in block.items()})
+    raise ValueError(
+        f"unknown batch_format {batch_format!r}; one of {BATCH_FORMATS}")
+
+
+def from_batch_output(res: Any) -> Dict[str, np.ndarray]:
+    """Normalize a user fn's output (numpy dict, Arrow table, or pandas
+    DataFrame) back to the native block format."""
+    try:
+        import pyarrow as pa
+        if isinstance(res, pa.Table):
+            return {name: np.asarray(res.column(name))
+                    for name in res.column_names}
+    except ImportError:      # pragma: no cover - pyarrow ships in-image
+        pass
+    try:
+        import pandas as pd
+        if isinstance(res, pd.DataFrame):
+            return {c: res[c].to_numpy() for c in res.columns}
+    except ImportError:      # pragma: no cover
+        pass
+    if isinstance(res, dict):
+        return {k: np.asarray(v) for k, v in res.items()}
+    raise TypeError(
+        "map_batches functions must return a dict of arrays, a "
+        f"pyarrow.Table, or a pandas.DataFrame; got {type(res).__name__}")

@@ -72,7 +72,16 @@ class Plan:
 
 def make_map_batches(fn: Callable, batch_size: Optional[int],
                      fn_kwargs: Dict[str, Any],
-                     fn_args: tuple = ()) -> Callable:
+                     fn_args: tuple = (),
+                     batch_format: str = "numpy") -> Callable:
+    from ._formats import from_batch_output, to_batch_format
+
+    def _is_single_batch(res) -> bool:
+        if isinstance(res, dict):
+            return True
+        cls = type(res).__name__
+        return cls in ("Table", "DataFrame")   # pyarrow / pandas outputs
+
     def transform(block: Block):
         """Generator: each produced batch flows downstream immediately —
         load-bearing for streaming consumption (iter_batches gets batch
@@ -80,12 +89,13 @@ def make_map_batches(fn: Callable, batch_size: Optional[int],
         pieces = (split_block(block, batch_size) if batch_size
                   else ([block] if block_num_rows(block) else []))
         for piece in pieces:
-            res = fn(piece, *fn_args, **fn_kwargs)
-            if isinstance(res, dict):
-                yield {k: np.asarray(v) for k, v in res.items()}
-            else:  # generator of batches
+            res = fn(to_batch_format(piece, batch_format),
+                     *fn_args, **fn_kwargs)
+            if _is_single_batch(res):
+                yield from_batch_output(res)
+            else:   # any iterable of batches (generator, list, ...)
                 for b in res:
-                    yield {k: np.asarray(v) for k, v in b.items()}
+                    yield from_batch_output(b)
     return transform
 
 

@@ -44,13 +44,18 @@ class Dataset:
 
     def map_batches(self, fn, *, batch_size: Optional[int] = None,
                     compute: Optional[str] = None,
+                    batch_format: str = "numpy",
                     fn_args: tuple = (), fn_kwargs: Optional[Dict] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[Dict] = None,
                     concurrency: Optional[int] = None,
                     num_cpus: float = 1.0) -> "Dataset":
-        """fn: Dict[str, np.ndarray] -> Dict[str, np.ndarray] (or a class
-        whose instances are such callables → runs on an actor pool).
+        """fn: batch -> batch (or a class whose instances are such
+        callables → runs on an actor pool).  batch_format selects the
+        view fn receives — "numpy" dict (native), "pyarrow" Table, or
+        "pandas" DataFrame; outputs of any of the three are accepted
+        (reference: dataset.py map_batches batch_format /
+        _internal/arrow_block.py).
         Reference: dataset.py map_batches / operators/map_operator.py."""
         fn_kwargs = fn_kwargs or {}
         if isinstance(fn, type):
@@ -61,7 +66,8 @@ class Dataset:
                 name=f"MapBatches({fn.__name__})",
                 transform_from_fn=functools.partial(
                     _plan.make_map_batches, batch_size=batch_size,
-                    fn_kwargs=fn_kwargs, fn_args=fn_args),
+                    fn_kwargs=fn_kwargs, fn_args=fn_args,
+                    batch_format=batch_format),
                 fn_constructor=ctor,
                 compute=compute or "actors",
                 actor_pool_size=concurrency or 2,
@@ -69,8 +75,9 @@ class Dataset:
         else:
             op = Operator(
                 name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
-                transform=_plan.make_map_batches(fn, batch_size,
-                                                 fn_kwargs, fn_args),
+                transform=_plan.make_map_batches(
+                    fn, batch_size, fn_kwargs, fn_args,
+                    batch_format=batch_format),
                 compute=compute or "tasks", num_cpus=num_cpus)
         return self._with_op(op)
 
@@ -281,9 +288,12 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: int = 256,
                      drop_last: bool = False,
+                     batch_format: str = "numpy",
                      local: bool = False) -> Iterator[Block]:
-        yield from _rebatch(self.iter_internal_blocks(local=local),
-                            batch_size, drop_last)
+        from ._formats import to_batch_format
+        for b in _rebatch(self.iter_internal_blocks(local=local),
+                          batch_size, drop_last):
+            yield to_batch_format(b, batch_format)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for b in self.iter_internal_blocks():
@@ -384,13 +394,16 @@ class DataIterator:
         self._limit = limit
 
     def iter_batches(self, *, batch_size: int = 256,
-                     drop_last: bool = False) -> Iterator[Block]:
+                     drop_last: bool = False,
+                     batch_format: str = "numpy") -> Iterator[Block]:
         """Runs the shard pipeline inline in this process — a TPU host
         feeds itself; no driver round-trip."""
+        from ._formats import to_batch_format
         it = execute_local(self._plan)
         if self._limit is not None:
             it = _limit_blocks(it, self._limit)
-        yield from _rebatch(it, batch_size, drop_last)
+        for b in _rebatch(it, batch_size, drop_last):
+            yield to_batch_format(b, batch_format)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for b in self.iter_batches(batch_size=4096):

@@ -10,12 +10,12 @@ from ._private.batching import batch
 from ._private.multiplex import get_multiplexed_model_id, multiplexed
 from ._private.proxy import Request
 from .api import (Application, Deployment, DeploymentHandle,
-                  DeploymentResponse, deployment, get_deployment_handle,
-                  run, shutdown, start)
+                  DeploymentResponse, delete, deployment,
+                  get_deployment_handle, run, shutdown, start, status)
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
-    "DeploymentResponse", "run", "start", "shutdown",
+    "DeploymentResponse", "run", "start", "shutdown", "status", "delete",
     "get_deployment_handle", "batch", "Request",
     "multiplexed", "get_multiplexed_model_id",
 ]

@@ -380,6 +380,31 @@ class ServeControllerImpl:
                 break
         return self._table(name)
 
+    async def get_status(self) -> Dict[str, Any]:
+        """Aggregate deployment/replica health (reference: serve.status()
+        -> ServeStatus: per-deployment status + replica states)."""
+        out: Dict[str, Any] = {"proxies": {}, "applications": {}}
+        for name, dep in self.deployments.items():
+            replicas = []
+            for r in dep["replicas"]:
+                rid = r._actor_id
+                replicas.append({
+                    "replica_id": rid.hex()[:12],
+                    "state": ("RUNNING" if rid in self._confirmed
+                              else "STARTING"),
+                })
+            target = dep["num_replicas"]
+            healthy = sum(1 for r in replicas if r["state"] == "RUNNING")
+            status = ("HEALTHY" if healthy >= target
+                      else "UPDATING" if replicas else "DEPLOYING")
+            out["applications"][name] = {
+                "status": status,
+                "target_num_replicas": target,
+                "replicas": replicas,
+                "autoscaling": bool(dep.get("autoscale")),
+            }
+        return out
+
     async def list_deployments(self) -> List[str]:
         return sorted(self.deployments)
 

@@ -262,6 +262,19 @@ def run(app: Application, *, name: Optional[str] = None,
     return handle
 
 
+def status() -> dict:
+    """Cluster-wide Serve status (reference: serve.status() — per-app
+    deployment status + replica states)."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.get_status.remote(), timeout=60)
+
+
+def delete(name: str) -> None:
+    """Tear one deployment down (reference: serve.delete)."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
 def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
     return DeploymentHandle(deployment_name)
 

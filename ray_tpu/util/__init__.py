@@ -5,6 +5,7 @@ Reference: python/ray/util/__init__.py surface.
 """
 
 from . import metrics, state
+from .actor_pool import ActorPool
 from .placement_group import (PlacementGroup, get_current_placement_group,
                               placement_group, placement_group_table,
                               remove_placement_group)
@@ -16,5 +17,5 @@ __all__ = [
     "PlacementGroup", "placement_group", "placement_group_table",
     "remove_placement_group", "get_current_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "NodeLabelSchedulingStrategy", "metrics", "state",
+    "NodeLabelSchedulingStrategy", "metrics", "state", "ActorPool",
 ]

@@ -1,0 +1,137 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference surface: python/ray/util/actor_pool.py — map/map_unordered
+(generators), submit/get_next/get_next_unordered, has_next,
+has_free/pop_idle/push.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+__all__ = ["ActorPool"]
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor: dict = {}     # ref -> (index, actor)
+        self._index_to_future: dict = {}     # submit index -> ref
+        self._next_task_index = 0            # next submit's index
+        self._next_return_index = 0          # next ordered get_next
+        self._pending: List[tuple] = []      # (fn, value) waiting for actor
+
+    # ------------------------------------------------------------- submit --
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef (reference: actor_pool.submit)."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            i = self._next_task_index
+            self._next_task_index += 1
+            self._future_to_actor[ref] = (i, actor)
+            self._index_to_future[i] = ref
+        else:
+            self._pending.append((fn, value))
+            self._next_task_index += 1
+            # Index assignment happens when an actor frees up; record the
+            # placeholder order.
+
+    def _drain_pending(self, actor) -> None:
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            ref = fn(actor, value)
+            # Pending submissions keep their original order: their index
+            # is the smallest unassigned one.
+            assigned = set(self._index_to_future)
+            i = min(j for j in range(self._next_task_index)
+                    if j not in assigned and j >= self._next_return_index)
+            self._future_to_actor[ref] = (i, actor)
+            self._index_to_future[i] = ref
+        else:
+            self._idle.append(actor)
+
+    # ------------------------------------------------------------- results --
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        i = self._next_return_index
+        while i not in self._index_to_future:
+            # The submission is still pending an actor; results must
+            # exist before they can be awaited.
+            if not self._future_to_actor:
+                raise StopIteration("No more results to get")
+            # Wait for anything to finish, freeing an actor.
+            ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                    num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out")
+            self._on_done(ready[0], keep=True)
+        ref = self._index_to_future[i]
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._on_done(ref)     # no-op if the wait loop already freed it
+        del self._index_to_future[i]
+        self._next_return_index += 1
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next COMPLETED result, any order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        i, _ = self._future_to_actor[ref]
+        value = ray_tpu.get(ref)
+        self._on_done(ref)
+        self._index_to_future.pop(i, None)
+        self._next_return_index = max(self._next_return_index, i + 1)
+        return value
+
+    def _on_done(self, ref, keep: bool = False) -> None:
+        entry = self._future_to_actor.pop(ref, None)
+        if entry is None:
+            return
+        _, actor = entry
+        self._drain_pending(actor)
+
+    # ----------------------------------------------------------------- map --
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]):
+        """Ordered results generator (reference: actor_pool.map)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ------------------------------------------------------------ idle mgmt --
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self.has_free() else None
+
+    def push(self, actor: Any) -> None:
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle or actor in busy:
+            raise ValueError("actor already belongs to this pool")
+        self._idle.append(actor)
+        if self._pending:
+            self._drain_pending(self._idle.pop())

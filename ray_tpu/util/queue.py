@@ -1,0 +1,154 @@
+"""Distributed FIFO queue backed by an async actor.
+
+Reference surface: python/ray/util/queue.py — Queue with
+put/get (blocking with timeout), put_nowait/get_nowait, put_nowait_batch/
+get_nowait_batch, qsize/empty/full, maxsize backpressure, and Empty/Full
+exceptions compatible with the stdlib queue module's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from queue import Empty, Full
+from typing import Any, List, Optional
+
+import ray_tpu
+
+__all__ = ["Queue", "Empty", "Full"]
+
+
+@ray_tpu.remote(num_cpus=0, max_concurrency=64)
+class _QueueActor:
+    """The queue state lives in one async actor; blocking put/get are
+    coroutines suspended on the actor's event loop (reference:
+    util/queue.py _QueueActor over asyncio.Queue)."""
+
+    def __init__(self, maxsize: int):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            await self.q.put(item)
+            return True
+        try:
+            await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return True, await self.q.get()
+        try:
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item) -> bool:
+        try:
+            self.q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def put_nowait_batch(self, items: List[Any]) -> bool:
+        if self.q.maxsize and \
+                self.q.qsize() + len(items) > self.q.maxsize:
+            return False
+        for it in items:
+            self.q.put_nowait(it)
+        return True
+
+    async def get_nowait(self):
+        try:
+            return True, self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def get_nowait_batch(self, num_items: int):
+        if self.q.qsize() < num_items:
+            return False, None
+        return True, [self.q.get_nowait() for _ in range(num_items)]
+
+    async def qsize(self) -> int:
+        return self.q.qsize()
+
+    async def empty(self) -> bool:
+        return self.q.empty()
+
+    async def full(self) -> bool:
+        return self.q.full()
+
+
+class Queue:
+    """Driver/worker-side handle (reference: util/queue.py Queue).
+
+    All methods are synchronous from the caller's point of view; the
+    `actor_options` kwarg places the backing actor (e.g. on a specific
+    node via scheduling strategies)."""
+
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        cls = _QueueActor
+        if actor_options:
+            cls = _QueueActor.options(**actor_options)
+        self.actor = cls.remote(maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            return self.put_nowait(item)
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        ok = ray_tpu.get(self.actor.put.remote(item, timeout))
+        if not ok:
+            raise Full
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            return self.get_nowait()
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item) -> None:
+        if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+            raise Full
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(list(items))):
+            raise Full(
+                f"Cannot add {len(items)} items to queue of size "
+                f"{self.maxsize}")
+
+    def get_nowait(self) -> Any:
+        ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+        if not ok:
+            raise Empty
+        return item
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        ok, items = ray_tpu.get(
+            self.actor.get_nowait_batch.remote(num_items))
+        if not ok:
+            raise Empty(f"Cannot get {num_items} items from the queue")
+        return items
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

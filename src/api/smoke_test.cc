@@ -24,6 +24,10 @@ int main(int argc, char** argv) {
   if (!buf) { fprintf(stderr, "create failed\n"); return 1; }
   memcpy(buf, msg, sizeof msg);
   if (!store.Seal(id)) { fprintf(stderr, "seal failed\n"); return 1; }
+  // Create leaves the writer's pin; drop it after sealing (plasma-like
+  // contract — Delete defers while ANY pin is held, so a leaked create
+  // pin would keep the extent doomed until the process exits).
+  store.Release(id);
   uint64_t size = 0;
   const uint8_t* rd = store.Get(id, &size, 1000);
   if (!rd || size != sizeof msg || memcmp(rd, msg, size) != 0) {

@@ -51,10 +51,13 @@ def test_cpp_object_visible_to_python(smoke_bin, ray_start_regular):
     buf = store.create_buffer(oid, 5)
     buf[:] = b"12345"
     store.seal(oid)
+    store.release(oid)          # drop the create pin (plasma contract)
     data = store.get(oid)
     assert bytes(data) == b"12345"
+    data.release()
     store.release(oid)
     store.delete(oid)
+    assert not store.contains(oid)
 
 
 def test_cgroup_binding_degrades_gracefully():

@@ -173,3 +173,47 @@ def test_pip_spec_normalization():
         _normalize_pkg_spec([], "pip")
     with pytest.raises(ValueError, match="non-empty"):
         _normalize_pkg_spec({"find_links": "/x"}, "pip")
+
+
+def test_poll_setup_never_blocks_grant_path(tmp_path):
+    """The lease-grant path polls env readiness instead of blocking the
+    RPC on a pip install (reference: the raylet delegates env creation to
+    the runtime-env agent and retries the lease)."""
+    import asyncio
+
+    from ray_tpu._private.runtime_env import UriCache
+
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _build_wheel(wheels)
+    cache = UriCache(str(tmp_path / "cache"))
+
+    async def main():
+        # Trivial env: answered inline, zero extra round trips.
+        st, payload = cache.poll_setup(None, {"env_vars": {"A": "1"}})
+        assert st == "ready" and payload[0] == {"A": "1"}
+
+        renv = {"pip": {"packages": ["tinypkg"],
+                        "find_links": str(wheels)}}
+        st, _ = cache.poll_setup(None, renv)
+        assert st == "pending"            # install runs in background
+        for _ in range(600):
+            await asyncio.sleep(0.1)
+            st, payload = cache.poll_setup(None, renv)
+            if st != "pending":
+                break
+        assert st == "ready", st
+        env_extra, cwd = payload
+        assert "pkg_envs" in env_extra["PYTHONPATH"]
+
+        bad = {"pip": {"packages": ["definitely-not-real-xyz"],
+                       "find_links": str(wheels)}}
+        st, _ = cache.poll_setup(None, bad)
+        for _ in range(600):
+            if st != "pending":
+                break
+            await asyncio.sleep(0.1)
+            st, payload = cache.poll_setup(None, bad)
+        assert st == "failed" and "pip install failed" in payload
+
+    asyncio.run(main())

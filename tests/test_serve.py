@@ -180,3 +180,28 @@ def test_batched_transformer_inference(serve_cluster):
     outs = [r.result(timeout_s=120) for r in responses]
     assert len(outs) == 4
     assert all(0 <= t < 512 for t in outs)
+
+
+def test_serve_status_and_delete(ray_start_regular):
+    """serve.status() aggregates per-deployment replica health;
+    serve.delete() tears one deployment down (reference: serve.status /
+    serve.delete)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), name="echo_status")
+    assert h.remote("hi").result(timeout_s=60) == "hi"
+    st = serve.status()
+    app = st["applications"]["echo_status"]
+    assert app["target_num_replicas"] == 2
+    assert app["status"] in ("HEALTHY", "UPDATING")
+    assert len(app["replicas"]) >= 1
+
+    serve.delete("echo_status")
+    st = serve.status()
+    assert "echo_status" not in st["applications"]
+    serve.shutdown()

@@ -1,0 +1,159 @@
+"""Utility APIs: ray_tpu.util.queue.Queue, ActorPool, Data batch formats.
+
+Reference model: python/ray/util/queue.py, util/actor_pool.py, and
+data batch_format="pyarrow"/"pandas" (block.py + arrow_block.py).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# --------------------------------------------------------------- queue ----
+
+
+def test_queue_fifo_and_batches(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=4)
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3 and not q.empty() and not q.full()
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait_batch([1, 2, 3])
+    with pytest.raises(Full):
+        q.put_nowait_batch([4, 5])          # 3 + 2 > maxsize 4
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    q.put(9)
+    assert q.get(timeout=5) == 9
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_producer_consumer_across_actors(ray_start_regular):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue(maxsize=8)
+
+    @ray_tpu.remote
+    def produce(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consume(q, n):
+        return sum(q.get(timeout=30) for _ in range(n))
+
+    p = produce.remote(q, 20)
+    c = consume.remote(q, 20)
+    assert ray_tpu.get(c, timeout=60) == sum(range(20))
+    assert ray_tpu.get(p, timeout=60) == 20
+    q.shutdown()
+
+
+# ----------------------------------------------------------- actor pool ----
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        import time
+        time.sleep(0.05)
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered_and_submit(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(8)))
+    assert out == sorted(2 * i for i in range(8))
+    pool.submit(lambda a, v: a.double.remote(v), 21)
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 42
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next_unordered()
+
+
+def test_actor_pool_idle_management(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a, b])
+    assert pool.has_free()
+    with pytest.raises(ValueError):
+        pool.push(a)                 # already belongs to the pool
+    popped = pool.pop_idle()
+    assert popped is not None
+    pool.push(popped)
+    out = list(pool.map(lambda ac, v: ac.double.remote(v), range(4)))
+    assert out == [0, 2, 4, 6]
+
+
+# -------------------------------------------------------- batch formats ----
+
+
+def test_map_batches_pyarrow_format(ray_start_regular):
+    import pyarrow as pa
+
+    import ray_tpu.data as data
+
+    ds = data.range(100)
+
+    def arrow_fn(table):
+        assert isinstance(table, pa.Table)
+        import pyarrow.compute as pc
+        return table.set_column(
+            table.schema.get_field_index("id"), "id",
+            pc.multiply(table.column("id"), 3))
+
+    out = ds.map_batches(arrow_fn, batch_format="pyarrow",
+                         batch_size=32).take_all()
+    assert sorted(r["id"] for r in out) == [3 * i for i in range(100)]
+
+
+def test_map_batches_pandas_format(ray_start_regular):
+    import pandas as pd
+
+    import ray_tpu.data as data
+
+    def pd_fn(df):
+        assert isinstance(df, pd.DataFrame)
+        df = df.copy()
+        df["id"] = df["id"] + 1000
+        return df
+
+    out = data.range(10).map_batches(
+        pd_fn, batch_format="pandas").take_all()
+    assert sorted(r["id"] for r in out) == list(range(1000, 1010))
+
+
+def test_iter_batches_formats(ray_start_regular):
+    import pandas as pd
+    import pyarrow as pa
+
+    import ray_tpu.data as data
+
+    ds = data.range(64)
+    tables = list(ds.iter_batches(batch_size=32, batch_format="pyarrow"))
+    assert all(isinstance(t, pa.Table) for t in tables)
+    assert sum(t.num_rows for t in tables) == 64
+    dfs = list(ds.iter_batches(batch_size=32, batch_format="pandas"))
+    assert all(isinstance(d, pd.DataFrame) for d in dfs)
+    with pytest.raises(ValueError, match="unknown batch_format"):
+        list(ds.iter_batches(batch_format="polars"))
