@@ -589,7 +589,10 @@ class GcsServer:
         ok = await self._schedule_actor(actor)
         if not ok and actor.state == protocol.ACTOR_PENDING:
             actor.state = protocol.ACTOR_DEAD
-            actor.death_cause = "scheduling failed: no feasible node"
+            # Keep a more specific cause if the scheduler recorded one
+            # (e.g. a runtime-env install failure).
+            actor.death_cause = (actor.death_cause
+                                 or "scheduling failed: no feasible node")
             if actor.name and \
                     self.named_actors.get(actor.name) == actor.actor_id:
                 del self.named_actors[actor.name]
@@ -698,14 +701,23 @@ class GcsServer:
                                                   timeout=120)
                     break
                 except (rpc.RpcError, asyncio.TimeoutError) as e:
-                    if "setup in progress" in str(e):
+                    msg = str(e)
+                    if "runtime env setup failed" in msg:
+                        # A broken env spec never succeeds by retrying —
+                        # bury the actor with the installer's error (the
+                        # task path fails fast the same way); retrying
+                        # would livelock: each fresh install's "in
+                        # progress" polls keep the deadline alive.
+                        actor.death_cause = msg.split("\n")[0]
+                        return False
+                    if "setup in progress" in msg:
                         # The node is actively materializing this actor's
                         # runtime env (pip installs can take minutes) —
                         # that's forward progress, not a stall: keep the
                         # deadline fresh like a new-capacity event.
                         deadline = time.monotonic() + timeout_s
                     logger.warning("actor creation on %s failed: %s; retrying",
-                                   node.node_id.hex()[:8], str(e).split("\n")[0])
+                                   node.node_id.hex()[:8], msg.split("\n")[0])
             await asyncio.sleep(0.2)
         else:
             logger.warning(

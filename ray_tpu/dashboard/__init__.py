@@ -95,16 +95,7 @@ def prometheus_text(metrics) -> str:
     return "\n".join(lines) + "\n"
 
 
-_INDEX = """<!doctype html><title>ray_tpu dashboard</title>
-<h1>ray_tpu dashboard</h1><ul>
-<li><a href=/api/cluster>/api/cluster</a></li>
-<li><a href=/api/nodes>/api/nodes</a> <a href=/api/actors>/api/actors</a>
-    <a href=/api/jobs>/api/jobs</a>
-    <a href=/api/placement_groups>/api/placement_groups</a></li>
-<li><a href=/api/tasks>/api/tasks</a>
-    <a href=/api/timeline>/api/timeline</a> (load in Perfetto)</li>
-<li><a href=/api/demand>/api/demand</a></li>
-<li><a href=/metrics>/metrics</a> (Prometheus)</li></ul>"""
+from ._ui import INDEX_HTML as _INDEX
 
 
 class DashboardHead:

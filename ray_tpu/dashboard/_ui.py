@@ -1,0 +1,114 @@
+"""Self-contained dashboard web UI: one HTML file, vanilla JS, zero
+external assets (the cluster has no egress).
+
+Reference: python/ray/dashboard/client/ — the reference ships a React
+SPA; this is the same information surface (cluster summary, nodes,
+actors, tasks, placement groups, autoscaler demand) rendered by a
+single template polling the dashboard's JSON API.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 13px/1.5 system-ui, sans-serif; margin: 0; }
+  header { padding: 10px 18px; background: #20242c; color: #eee;
+           display: flex; gap: 24px; align-items: baseline; }
+  header h1 { font-size: 16px; margin: 0 12px 0 0; }
+  .tile b { font-size: 15px; }
+  main { padding: 12px 18px; max-width: 1200px; }
+  h2 { font-size: 14px; margin: 18px 0 6px; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0;
+           border-bottom: 1px solid #8884; font-variant-numeric: tabular-nums; }
+  th { font-weight: 600; opacity: .7; }
+  .ok { color: #2da44e; } .bad { color: #d1242f; }
+  .mut { opacity: .6; }
+  nav a { margin-right: 14px; }
+  code { font-size: 12px; }
+</style></head><body>
+<header><h1>ray_tpu</h1>
+  <span class="tile">nodes <b id="t-nodes">–</b></span>
+  <span class="tile">CPU <b id="t-cpu">–</b></span>
+  <span class="tile">TPU <b id="t-tpu">–</b></span>
+  <span class="tile">actors <b id="t-actors">–</b></span>
+  <span class="tile mut" id="t-upd"></span>
+</header>
+<main>
+<nav>
+  <a href="/api/timeline">timeline (Perfetto)</a>
+  <a href="/metrics">prometheus</a>
+  <a href="/api/profile?kind=stacks">stack dump</a>
+  <a href="/api/demand">demand</a>
+</nav>
+<h2>Nodes</h2><table id="nodes"><thead><tr>
+  <th>node</th><th>state</th><th>address</th><th>CPU</th><th>TPU</th>
+  <th>labels</th></tr></thead><tbody></tbody></table>
+<h2>Actors</h2><table id="actors"><thead><tr>
+  <th>actor</th><th>class</th><th>state</th><th>name</th><th>node</th>
+  <th>restarts</th></tr></thead><tbody></tbody></table>
+<h2>Placement groups</h2><table id="pgs"><thead><tr>
+  <th>pg</th><th>state</th><th>strategy</th><th>bundles</th>
+  </tr></thead><tbody></tbody></table>
+<h2>Recent tasks</h2><table id="tasks"><thead><tr>
+  <th>task</th><th>name</th><th>event</th><th>when</th>
+  </tr></thead><tbody></tbody></table>
+</main>
+<script>
+const $ = id => document.getElementById(id);
+const fmt = (a, t) => (t === undefined || t === 0) ? "–"
+    : `${(t - (a ?? t)).toFixed(0)}/${t.toFixed(0)} used`;
+function fill(tbl, rows) {
+  const tb = $(tbl).tBodies[0];
+  tb.innerHTML = rows.map(r => "<tr>" +
+      r.map(c => `<td>${c}</td>`).join("") + "</tr>").join("");
+}
+async function j(p) { const r = await fetch(p); return r.json(); }
+async function tick() {
+  try {
+    const c = await j("/api/cluster");
+    $("t-nodes").textContent = c.alive_nodes;
+    $("t-cpu").textContent = fmt(c.resources_available.CPU,
+                                 c.resources_total.CPU);
+    $("t-tpu").textContent = fmt(c.resources_available.TPU,
+                                 c.resources_total.TPU);
+    const nodes = await j("/api/nodes");
+    fill("nodes", nodes.map(n => [
+        `<code>${(n.node_id || "").slice(0, 12)}</code>`,
+        n.alive ? '<span class="ok">ALIVE</span>'
+                : '<span class="bad">DEAD</span>',
+        (n.address || []).join(":"),
+        fmt(n.resources_available?.CPU, n.resources_total?.CPU),
+        fmt(n.resources_available?.TPU, n.resources_total?.TPU),
+        Object.entries(n.labels || {}).map(kv => kv.join("=")).join(" "),
+    ]));
+    const actors = await j("/api/actors");
+    $("t-actors").textContent =
+        actors.filter(a => a.state === "ALIVE").length;
+    fill("actors", actors.slice(0, 200).map(a => [
+        `<code>${(a.actor_id || "").slice(0, 12)}</code>`,
+        a.class_name || "", a.state === "ALIVE"
+            ? '<span class="ok">ALIVE</span>'
+            : `<span class="bad">${a.state}</span>`,
+        a.name || "", `<code>${(a.node_id || "").slice(0, 12)}</code>`,
+        a.restarts ?? 0,
+    ]));
+    const pgs = await j("/api/placement_groups");
+    fill("pgs", pgs.map(p => [
+        `<code>${(p.pg_id || "").slice(0, 12)}</code>`, p.state || "",
+        p.strategy || "", (p.bundles || []).length,
+    ]));
+    const tasks = await j("/api/tasks");
+    fill("tasks", tasks.slice(-60).reverse().map(t => [
+        `<code>${(t.task_id || "").slice(0, 12)}</code>`,
+        t.name || "", t.event || "",
+        t.ts ? new Date(t.ts * 1000).toLocaleTimeString() : "",
+    ]));
+    $("t-upd").textContent =
+        "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    $("t-upd").textContent = "refresh failed: " + e;
+  }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""

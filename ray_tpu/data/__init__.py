@@ -5,12 +5,14 @@ from_*); execution model per _internal/execution/streaming_executor.py:67.
 """
 
 from ._executor import DataContext
-from .dataset import (DataIterator, Dataset, GroupedData, from_blocks,
+from .dataset import (DataIterator, Dataset, GroupedData, from_arrow,
+                      from_blocks, from_pandas,
                       from_items, from_numpy, range, read_csv, read_json,
                       read_numpy, read_parquet)
 
 __all__ = [
     "DataContext", "DataIterator", "Dataset", "GroupedData", "from_blocks",
-    "from_items", "from_numpy", "range", "read_csv", "read_json",
+    "from_items", "from_numpy", "from_pandas", "from_arrow", "range",
+    "read_csv", "read_json",
     "read_numpy", "read_parquet",
 ]

@@ -33,6 +33,26 @@ def to_batch_format(block: Dict[str, np.ndarray], batch_format: str):
         f"unknown batch_format {batch_format!r}; one of {BATCH_FORMATS}")
 
 
+def is_batch(res: Any) -> bool:
+    """True for any value from_batch_output can normalize as ONE batch
+    (numpy dict, Arrow Table, pandas DataFrame)."""
+    if isinstance(res, dict):
+        return True
+    try:
+        import pyarrow as pa
+        if isinstance(res, pa.Table):
+            return True
+    except ImportError:      # pragma: no cover
+        pass
+    try:
+        import pandas as pd
+        if isinstance(res, pd.DataFrame):
+            return True
+    except ImportError:      # pragma: no cover
+        pass
+    return False
+
+
 def from_batch_output(res: Any) -> Dict[str, np.ndarray]:
     """Normalize a user fn's output (numpy dict, Arrow table, or pandas
     DataFrame) back to the native block format."""

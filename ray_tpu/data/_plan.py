@@ -74,13 +74,7 @@ def make_map_batches(fn: Callable, batch_size: Optional[int],
                      fn_kwargs: Dict[str, Any],
                      fn_args: tuple = (),
                      batch_format: str = "numpy") -> Callable:
-    from ._formats import from_batch_output, to_batch_format
-
-    def _is_single_batch(res) -> bool:
-        if isinstance(res, dict):
-            return True
-        cls = type(res).__name__
-        return cls in ("Table", "DataFrame")   # pyarrow / pandas outputs
+    from ._formats import from_batch_output, is_batch, to_batch_format
 
     def transform(block: Block):
         """Generator: each produced batch flows downstream immediately —
@@ -91,7 +85,7 @@ def make_map_batches(fn: Callable, batch_size: Optional[int],
         for piece in pieces:
             res = fn(to_batch_format(piece, batch_format),
                      *fn_args, **fn_kwargs)
-            if _is_single_batch(res):
+            if is_batch(res):
                 yield from_batch_output(res)
             else:   # any iterable of batches (generator, list, ...)
                 for b in res:

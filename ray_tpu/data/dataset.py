@@ -324,6 +324,26 @@ class Dataset:
         """Execute now; the result reads from in-memory blocks."""
         return from_blocks(list(self.iter_internal_blocks()))
 
+    def to_pandas(self):
+        """reference: Dataset.to_pandas — materializes on the driver."""
+        import pandas as pd
+        from ._formats import to_batch_format
+        frames = [to_batch_format(b, "pandas")
+                  for b in self.iter_internal_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_arrow(self):
+        """reference: Dataset.to_arrow_refs, collapsed to one Table."""
+        import pyarrow as pa
+        from ._formats import to_batch_format
+        tables = [to_batch_format(b, "pyarrow")
+                  for b in self.iter_internal_blocks()]
+        if not tables:
+            return pa.table({})
+        return pa.concat_tables(tables)
+
     def num_blocks(self) -> int:
         return len(self._plan.read_tasks)
 
@@ -486,6 +506,43 @@ def from_items(items: List[Any], *, parallelism: int = 16) -> Dataset:
 def from_numpy(arr: np.ndarray, *, parallelism: int = 16) -> Dataset:
     chunks = np.array_split(arr, max(1, min(parallelism, len(arr) or 1)))
     return from_blocks([{"data": c} for c in chunks if len(c)])
+
+
+def _split_rows(block: Block, parts: int) -> List[Block]:
+    n = block_num_rows(block)
+    parts = max(1, min(parts, n or 1))
+    if parts == 1:
+        return [block]
+    step = -(-n // parts)
+    # NB: builtin range is shadowed by data.range in this module.
+    return [{k: v[s:s + step] for k, v in block.items()}
+            for s in np.arange(0, n, step)]
+
+
+def from_pandas(df, *, parallelism: int = 16) -> Dataset:
+    """reference: ray.data.from_pandas — a DataFrame (or list of them)
+    becomes column blocks, row-chunked by `parallelism` so downstream
+    operators fan out (mirrors from_numpy)."""
+    from ._formats import from_batch_output
+    dfs = df if isinstance(df, (list, tuple)) else [df]
+    blocks = [chunk
+              for d in dfs if len(d)
+              for chunk in _split_rows(
+                  from_batch_output(d),
+                  max(1, parallelism // max(1, len(dfs))))]
+    return from_blocks(blocks)
+
+
+def from_arrow(table, *, parallelism: int = 16) -> Dataset:
+    """reference: ray.data.from_arrow — a pyarrow Table (or list)."""
+    from ._formats import from_batch_output
+    tables = table if isinstance(table, (list, tuple)) else [table]
+    blocks = [chunk
+              for t in tables if t.num_rows
+              for chunk in _split_rows(
+                  from_batch_output(t),
+                  max(1, parallelism // max(1, len(tables))))]
+    return from_blocks(blocks)
 
 
 def _expand(paths) -> List[str]:

@@ -9,10 +9,11 @@ CheckpointConfig, Result, DataParallelTrainer) and train/v2/jax
 from ._checkpoint import Checkpoint, CheckpointManager
 from ._session import (TrainContext, get_checkpoint, get_context,
                        get_dataset_shard, report)
-from .backend import Backend, BackendConfig, JaxConfig
+from .backend import Backend, BackendConfig, JaxConfig, TorchConfig
 from .callbacks import UserCallback
 from .trainer import (CheckpointConfig, DataParallelTrainer, FailureConfig,
-                      JaxTrainer, Result, RunConfig, ScalingConfig)
+                      JaxTrainer, Result, RunConfig, ScalingConfig,
+                      TorchTrainer)
 from .worker_group import WorkerGroup
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "Checkpoint",
     "CheckpointManager", "Backend", "BackendConfig", "JaxConfig",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
-    "Result", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",
+    "Result", "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
+    "TorchConfig", "WorkerGroup",
     "UserCallback",
 ]

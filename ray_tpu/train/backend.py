@@ -117,3 +117,59 @@ class _JaxBackend(Backend):
             except Exception:
                 pass
             self._initialized = False
+
+
+class TorchConfig(BackendConfig):
+    """reference: train/torch/config.py:43 TorchConfig — CPU/gloo process
+    groups (the reference's nccl path has no TPU analogue; torch models
+    on this runtime train with gloo across hosts, or convert to JAX for
+    the accelerator path)."""
+
+    def __init__(self, backend: str = "gloo",
+                 init_timeout_s: float = 120.0):
+        if backend not in ("gloo",):
+            raise ValueError(
+                f"torch backend {backend!r} not supported here: no "
+                "CUDA/NCCL on TPU hosts — use 'gloo' (reference: "
+                "train/torch/config.py nccl/gloo selection)")
+        self.backend = backend
+        self.init_timeout_s = init_timeout_s
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+class _TorchBackend(Backend):
+    """Forms the torch.distributed world on every worker (reference:
+    train/torch/config.py:73-119 _setup_torch_process_group:
+    init_process_group(backend, init_method='tcp://master:port',
+    rank, world_size))."""
+
+    def __init__(self, config: TorchConfig):
+        self.config = config
+        self._initialized = False
+
+    def on_start(self, worker_ctx: Dict[str, Any]) -> None:
+        if worker_ctx["world_size"] <= 1:
+            return
+        import datetime
+
+        import torch.distributed as dist
+        dist.init_process_group(
+            backend=self.config.backend,
+            init_method=(f"tcp://{worker_ctx['master_addr']}:"
+                         f"{worker_ctx['master_port']}"),
+            rank=worker_ctx["world_rank"],
+            world_size=worker_ctx["world_size"],
+            timeout=datetime.timedelta(
+                seconds=self.config.init_timeout_s))
+        self._initialized = True
+
+    def on_shutdown(self) -> None:
+        if self._initialized:
+            import torch.distributed as dist
+            try:
+                dist.destroy_process_group()
+            except Exception:
+                pass
+            self._initialized = False

@@ -147,3 +147,28 @@ class JaxTrainer(DataParallelTrainer):
             backend_config=jax_config or JaxConfig(
                 use_tpu=scaling_config.use_tpu),
             datasets=datasets)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Data-parallel torch training over gloo process groups (reference:
+    python/ray/train/torch/torch_trainer.py TorchTrainer; the v2
+    controller architecture is shared with JaxTrainer).  Workers call
+    torch.distributed collectives / DistributedDataParallel as usual;
+    there is no CUDA on TPU hosts, so this is the CPU/gloo path — models
+    that need the accelerator should use JaxTrainer."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 torch_config: Optional["TorchConfig"] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        from .backend import TorchConfig
+        scaling_config = scaling_config or ScalingConfig(use_tpu=False)
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            backend_config=torch_config or TorchConfig(),
+            datasets=datasets)

@@ -23,35 +23,33 @@ class ActorPool:
         self._index_to_future: dict = {}     # submit index -> ref
         self._next_task_index = 0            # next submit's index
         self._next_return_index = 0          # next ordered get_next
-        self._pending: List[tuple] = []      # (fn, value) waiting for actor
+        self._pending: List[tuple] = []      # (index, fn, value) queued
+        # get_next() after get_next_unordered() would have to skip the
+        # indices the unordered path already consumed — the reference
+        # forbids the mix outright (actor_pool.py), and so do we.
+        self._unordered_used = False
 
     # ------------------------------------------------------------- submit --
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """fn(actor, value) -> ObjectRef (reference: actor_pool.submit)."""
+        i = self._next_task_index
+        self._next_task_index += 1
         if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            i = self._next_task_index
-            self._next_task_index += 1
-            self._future_to_actor[ref] = (i, actor)
-            self._index_to_future[i] = ref
+            self._dispatch(i, fn, value, self._idle.pop())
         else:
-            self._pending.append((fn, value))
-            self._next_task_index += 1
-            # Index assignment happens when an actor frees up; record the
-            # placeholder order.
+            # Index assigned at submission time: dispatch on drain is
+            # O(1) (no scan for the smallest unassigned index).
+            self._pending.append((i, fn, value))
+
+    def _dispatch(self, i: int, fn, value, actor) -> None:
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = (i, actor)
+        self._index_to_future[i] = ref
 
     def _drain_pending(self, actor) -> None:
         if self._pending:
-            fn, value = self._pending.pop(0)
-            ref = fn(actor, value)
-            # Pending submissions keep their original order: their index
-            # is the smallest unassigned one.
-            assigned = set(self._index_to_future)
-            i = min(j for j in range(self._next_task_index)
-                    if j not in assigned and j >= self._next_return_index)
-            self._future_to_actor[ref] = (i, actor)
-            self._index_to_future[i] = ref
+            i, fn, value = self._pending.pop(0)
+            self._dispatch(i, fn, value, actor)
         else:
             self._idle.append(actor)
 
@@ -61,6 +59,10 @@ class ActorPool:
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
         """Next result in SUBMISSION order."""
+        if self._unordered_used:
+            raise ValueError(
+                "get_next() cannot be used after get_next_unordered() "
+                "(reference: actor_pool.py forbids mixing the modes)")
         if not self.has_next():
             raise StopIteration("No more results to get")
         i = self._next_return_index
@@ -74,7 +76,7 @@ class ActorPool:
                                     num_returns=1, timeout=timeout)
             if not ready:
                 raise TimeoutError("get_next timed out")
-            self._on_done(ready[0], keep=True)
+            self._on_done(ready[0])
         ref = self._index_to_future[i]
         value = ray_tpu.get(ref, timeout=timeout)
         self._on_done(ref)     # no-op if the wait loop already freed it
@@ -90,15 +92,19 @@ class ActorPool:
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
+        self._unordered_used = True
         ref = ready[0]
         i, _ = self._future_to_actor[ref]
         value = ray_tpu.get(ref)
         self._on_done(ref)
         self._index_to_future.pop(i, None)
-        self._next_return_index = max(self._next_return_index, i + 1)
+        if not self.has_next():
+            # Fully drained: ordered consumption may start fresh.
+            self._unordered_used = False
+            self._next_return_index = self._next_task_index
         return value
 
-    def _on_done(self, ref, keep: bool = False) -> None:
+    def _on_done(self, ref) -> None:
         entry = self._future_to_actor.pop(ref, None)
         if entry is None:
             return

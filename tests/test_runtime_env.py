@@ -217,3 +217,20 @@ def test_poll_setup_never_blocks_grant_path(tmp_path):
         assert st == "failed" and "pip install failed" in payload
 
     asyncio.run(main())
+
+
+def test_actor_env_failure_buries_actor(ray_start_regular, tmp_path):
+    """A broken env spec fails the ACTOR fast with the installer's error
+    instead of livelocking pip-install retries (task path already fails
+    fast; reference: RuntimeEnvSetupError)."""
+    @ray_tpu.remote
+    class A:
+        def hi(self):
+            return 1
+
+    a = A.options(runtime_env={
+        "pip": {"packages": ["no-such-pkg-zzz"],
+                "find_links": str(tmp_path)}}).remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                       match="runtime env setup failed"):
+        ray_tpu.get(a.hi.remote(), timeout=120)

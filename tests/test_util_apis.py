@@ -157,3 +157,23 @@ def test_iter_batches_formats(ray_start_regular):
     assert all(isinstance(d, pd.DataFrame) for d in dfs)
     with pytest.raises(ValueError, match="unknown batch_format"):
         list(ds.iter_batches(batch_format="polars"))
+
+
+def test_data_pandas_arrow_converters(ray_start_regular):
+    import pandas as pd
+    import pyarrow as pa
+
+    import ray_tpu.data as data
+
+    df = pd.DataFrame({"x": range(10), "y": [i * 2 for i in range(10)]})
+    ds = data.from_pandas(df)
+    assert ds.count() == 10
+    back = ds.to_pandas()
+    assert sorted(back["y"]) == [i * 2 for i in range(10)]
+
+    table = pa.table({"a": list(range(6))})
+    ds2 = data.from_arrow(table)
+    assert ds2.count() == 6
+    t2 = ds2.to_arrow()
+    assert isinstance(t2, pa.Table)
+    assert sorted(t2.column("a").to_pylist()) == list(range(6))
