@@ -801,7 +801,13 @@ class GcsServer:
             ok = await self._schedule_actor(actor)
             if ok:
                 return
-            reason = f"{reason}; restart failed"
+            # Keep a specific cause the scheduler recorded during the
+            # failed restart (e.g. a runtime-env install error) — that is
+            # the actionable diagnosis, not the original death reason.
+            if actor.death_cause:
+                reason = f"{reason}; restart failed: {actor.death_cause}"
+            else:
+                reason = f"{reason}; restart failed"
         actor.state = protocol.ACTOR_DEAD
         actor.death_cause = reason
         actor.address = None

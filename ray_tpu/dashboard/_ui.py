@@ -56,6 +56,10 @@ INDEX_HTML = """<!doctype html>
 </main>
 <script>
 const $ = id => document.getElementById(id);
+// Every API string renders through esc(): actor/task names and labels
+// are user-controlled — unescaped innerHTML would be stored XSS.
+const esc = v => String(v).replace(/[&<>"']/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const fmt = (a, t) => (t === undefined || t === 0) ? "–"
     : `${(t - (a ?? t)).toFixed(0)}/${t.toFixed(0)} used`;
 function fill(tbl, rows) {
@@ -77,31 +81,34 @@ async function tick() {
         `<code>${(n.node_id || "").slice(0, 12)}</code>`,
         n.alive ? '<span class="ok">ALIVE</span>'
                 : '<span class="bad">DEAD</span>',
-        (n.address || []).join(":"),
+        esc((n.address || []).join(":")),
         fmt(n.resources_available?.CPU, n.resources_total?.CPU),
         fmt(n.resources_available?.TPU, n.resources_total?.TPU),
-        Object.entries(n.labels || {}).map(kv => kv.join("=")).join(" "),
+        esc(Object.entries(n.labels || {})
+            .map(kv => kv.join("=")).join(" ")),
     ]));
     const actors = await j("/api/actors");
     $("t-actors").textContent =
         actors.filter(a => a.state === "ALIVE").length;
     fill("actors", actors.slice(0, 200).map(a => [
         `<code>${(a.actor_id || "").slice(0, 12)}</code>`,
-        a.class_name || "", a.state === "ALIVE"
+        esc(a.class_name || ""), a.state === "ALIVE"
             ? '<span class="ok">ALIVE</span>'
-            : `<span class="bad">${a.state}</span>`,
-        a.name || "", `<code>${(a.node_id || "").slice(0, 12)}</code>`,
+            : `<span class="bad">${esc(a.state)}</span>`,
+        esc(a.name || ""),
+        `<code>${esc((a.node_id || "").slice(0, 12))}</code>`,
         a.restarts ?? 0,
     ]));
     const pgs = await j("/api/placement_groups");
     fill("pgs", pgs.map(p => [
-        `<code>${(p.pg_id || "").slice(0, 12)}</code>`, p.state || "",
-        p.strategy || "", (p.bundles || []).length,
+        `<code>${esc((p.pg_id || "").slice(0, 12))}</code>`,
+        esc(p.state || ""), esc(p.strategy || ""),
+        (p.bundles || []).length,
     ]));
     const tasks = await j("/api/tasks");
     fill("tasks", tasks.slice(-60).reverse().map(t => [
-        `<code>${(t.task_id || "").slice(0, 12)}</code>`,
-        t.name || "", t.event || "",
+        `<code>${esc((t.task_id || "").slice(0, 12))}</code>`,
+        esc(t.name || ""), esc(t.event || ""),
         t.ts ? new Date(t.ts * 1000).toLocaleTimeString() : "",
     ]));
     $("t-upd").textContent =

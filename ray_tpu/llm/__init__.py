@@ -11,7 +11,9 @@ structurally.
 
 from .batch import ProcessorConfig, build_llm_processor
 from .engine import LLMEngine, SamplingParams
+from .openai_api import (ByteTokenizer, OpenAIServer, build_openai_app)
 from .serve_patterns import build_dp_deployment, run_pd_app
 
 __all__ = ["LLMEngine", "SamplingParams", "ProcessorConfig",
+           "ByteTokenizer", "OpenAIServer", "build_openai_app",
            "build_llm_processor", "build_dp_deployment", "run_pd_app"]

@@ -1,0 +1,161 @@
+"""OpenAI-compatible serving API over the native engine.
+
+Reference surface: python/ray/llm/_internal/serve/ — the reference's
+`build_openai_app` exposes vLLM engines behind /v1/models,
+/v1/completions and /v1/chat/completions with the OpenAI JSON shapes.
+TPU-native: the same routes over the continuous-batching JAX engine
+(engine.py), as a Serve ingress deployment (HTTP proxy -> router ->
+replicas, all the usual autoscaling/multiplexing machinery applies).
+
+Tokenization is pluggable (`tokenizer=`): pass anything with
+encode(str)->List[int] / decode(List[int])->str (e.g. a transformers
+tokenizer).  The default is a dependency-free reversible byte-level
+tokenizer — real deployments supply their model's tokenizer; tests and
+air-gapped smoke runs work out of the box.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import serve
+from ..models import PRESETS
+from .engine import LLMEngine, SamplingParams
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: token = byte + offset (ids 0..2
+    reserved for pad/bos/eos)."""
+
+    OFFSET = 3
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        return bytes(max(0, min(255, t - self.OFFSET))
+                     for t in tokens if t >= self.OFFSET
+                     ).decode("utf-8", errors="replace")
+
+
+class OpenAIServer:
+    """Ingress deployment: routes the OpenAI surface onto the engine."""
+
+    def __init__(self, preset: str = "tiny", model_name: str = "ray-tpu",
+                 max_batch: int = 4, max_len: int = 128,
+                 tokenizer: Any = None, seed: int = 0):
+        cfg = PRESETS[preset]
+        self.model_name = model_name
+        self.max_len = max_len
+        self.engine = LLMEngine(cfg, max_batch=max_batch,
+                                max_len=max_len, seed=seed)
+        self.tokenizer = tokenizer or ByteTokenizer(cfg.vocab_size)
+        self._created = int(time.time())
+
+    # ------------------------------------------------------------ helpers --
+    def _completion(self, prompt: str, max_tokens: int,
+                    temperature: float) -> Dict[str, Any]:
+        toks = self.tokenizer.encode(prompt)[: self.max_len - 2]
+        params = SamplingParams(max_tokens=max_tokens,
+                                temperature=temperature)
+        out = self.engine.generate([toks], params)[0]
+        return {
+            "text": self.tokenizer.decode(out),
+            "prompt_tokens": len(toks),
+            "completion_tokens": len(out),
+        }
+
+    @staticmethod
+    def _error(code: int, msg: str):
+        # A real HTTP status (not 200 + error body): OpenAI SDK clients
+        # key their exception types off the status code.
+        return serve.HTTPResponse(code, {
+            "error": {"message": msg, "type": "invalid_request_error",
+                      "code": code}})
+
+    # --------------------------------------------------------------- routes --
+    def __call__(self, request):
+        path = request.path
+        if path.endswith("/models"):
+            return {"object": "list", "data": [{
+                "id": self.model_name, "object": "model",
+                "created": self._created, "owned_by": "ray_tpu"}]}
+        if request.method != "POST":
+            return self._error(405, f"method {request.method} not allowed")
+        try:
+            body = request.json() or {}
+        except ValueError:
+            return self._error(400, "invalid JSON body")
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        if path.endswith("/chat/completions"):
+            msgs = body.get("messages") or []
+            if not msgs:
+                return self._error(400, "messages is required")
+            # The canonical role-tagged flattening (reference renders a
+            # chat template; the pluggable tokenizer may bring one).
+            prompt = "\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in msgs) + "\nassistant:"
+            res = self._completion(prompt, max_tokens, temperature)
+            return {
+                "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_name),
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant",
+                                         "content": res["text"]},
+                             "finish_reason": "length"}],
+                "usage": {
+                    "prompt_tokens": res["prompt_tokens"],
+                    "completion_tokens": res["completion_tokens"],
+                    "total_tokens": res["prompt_tokens"]
+                    + res["completion_tokens"]},
+            }
+        if path.endswith("/completions"):
+            prompt = body.get("prompt")
+            if prompt is None:
+                return self._error(400, "prompt is required")
+            prompts = prompt if isinstance(prompt, list) else [prompt]
+            choices, pt, ct = [], 0, 0
+            for i, p in enumerate(prompts):
+                res = self._completion(str(p), max_tokens, temperature)
+                pt += res["prompt_tokens"]
+                ct += res["completion_tokens"]
+                choices.append({"index": i, "text": res["text"],
+                                "finish_reason": "length"})
+            return {
+                "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_name),
+                "choices": choices,
+                "usage": {"prompt_tokens": pt, "completion_tokens": ct,
+                          "total_tokens": pt + ct},
+            }
+        return self._error(404, f"no route for {path}")
+
+
+def build_openai_app(preset: str = "tiny", *,
+                     model_name: str = "ray-tpu",
+                     num_replicas: int = 1,
+                     max_batch: int = 4, max_len: int = 128,
+                     tokenizer: Any = None,
+                     ray_actor_options: Optional[dict] = None):
+    """`serve.run(build_openai_app(...), route_prefix="/v1")` and any
+    OpenAI client pointed at the proxy works (reference:
+    llm/_internal/serve build_openai_app)."""
+    dep = serve.deployment(
+        OpenAIServer, name=f"openai_{model_name}",
+        num_replicas=num_replicas,
+        ray_actor_options=ray_actor_options or {"num_cpus": 1},
+        route_prefix="/v1")
+    return dep.bind(preset=preset, model_name=model_name,
+                    max_batch=max_batch, max_len=max_len,
+                    tokenizer=tokenizer)

@@ -8,6 +8,7 @@ learner_group.py:101), PPO (algorithms/ppo/ppo.py).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .appo import APPO, APPOConfig, AppoLearner
 from .dqn import DQN, DQNConfig, DQNLearner
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import (IMPALA, AggregatorActor, IMPALAConfig, ImpalaLearner,
@@ -19,7 +20,8 @@ from .replay_buffers import (EpisodeReplayBuffer, PrioritizedReplayBuffer,
 from .rl_module import RLModule, RLModuleSpec
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "AggregatorActor", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "AggregatorActor", "APPO",
+    "APPOConfig", "AppoLearner", "DQN", "DQNConfig",
     "DQNLearner", "EnvRunner", "EnvRunnerGroup", "EpisodeReplayBuffer",
     "IMPALA", "IMPALAConfig", "ImpalaLearner", "Learner", "LearnerGroup",
     "PrioritizedReplayBuffer", "ReplayBuffer", "compute_gae", "PPO",

@@ -81,12 +81,18 @@ class ImpalaLearner(Learner):
             self.cfg.get("gamma", 0.99),
             self.cfg.get("vtrace_clip_rho_threshold", 1.0),
             self.cfg.get("vtrace_clip_c_threshold", 1.0))
-        pg_loss = -(pg_adv * logp).mean()
+        pg_loss = self._pg_loss(rhos, pg_adv, logp)
         vf_loss = 0.5 * ((vs - values) ** 2).mean()
         total = (pg_loss + self.cfg.get("vf_loss_coeff", 0.5) * vf_loss
                  - self.cfg.get("entropy_coeff", 0.01) * entropy)
         return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                        "entropy": entropy}
+
+    def _pg_loss(self, rhos, pg_adv, logp):
+        """Policy-gradient term: plain V-trace PG here; APPO overrides
+        with the PPO clipped surrogate (the only difference between the
+        two learners)."""
+        return -(pg_adv * logp).mean()
 
     def _impala_step(self, params, opt_state, batch):
         import jax

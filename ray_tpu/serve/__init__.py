@@ -8,7 +8,7 @@ long_poll.py:228, batching.py).
 
 from ._private.batching import batch
 from ._private.multiplex import get_multiplexed_model_id, multiplexed
-from ._private.proxy import Request
+from ._private.proxy import HTTPResponse, Request
 from .api import (Application, Deployment, DeploymentHandle,
                   DeploymentResponse, delete, deployment,
                   get_deployment_handle, run, shutdown, start, status)
@@ -16,6 +16,6 @@ from .api import (Application, Deployment, DeploymentHandle,
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "run", "start", "shutdown", "status", "delete",
-    "get_deployment_handle", "batch", "Request",
+    "get_deployment_handle", "batch", "Request", "HTTPResponse",
     "multiplexed", "get_multiplexed_model_id",
 ]

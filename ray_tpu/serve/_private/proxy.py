@@ -20,6 +20,36 @@ import ray_tpu
 logger = logging.getLogger("ray_tpu.serve")
 
 
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 422: "Unprocessable Entity",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+
+
+class HTTPResponse:
+    """Deployment return value carrying an explicit status code
+    (reference: starlette JSONResponse(status_code=...) returns from
+    Serve ingress deployments).  body: dict/list (JSON), str, or
+    bytes."""
+
+    def __init__(self, status: int, body, content_type: str = None):
+        self.status = int(status)
+        self.body = body
+        self.content_type = content_type
+
+    def render(self):
+        reason = _REASONS.get(self.status, "Status")
+        status = f"{self.status} {reason}"
+        if isinstance(self.body, bytes):
+            return status, self.body, (self.content_type
+                                       or "application/octet-stream")
+        if isinstance(self.body, str):
+            return status, self.body.encode(), (self.content_type
+                                                or "text/plain")
+        return (status, json.dumps(self.body).encode(),
+                self.content_type or "application/json")
+
+
 class Request:
     """What an ingress deployment's __call__ receives for an HTTP request
     (a plain object, not ASGI: no starlette dependency)."""
@@ -54,6 +84,9 @@ class ProxyActor:
     async def _start(self):
         self._server = await asyncio.start_server(
             self._serve_conn, self.host, self.port)
+        # port=0 = OS-assigned: record the bound port so ready() reports
+        # something connectable.
+        self.port = self._server.sockets[0].getsockname()[1]
 
     def set_routes(self, routes: Dict[str, str]) -> bool:
         self.routes = dict(routes)
@@ -131,6 +164,8 @@ class ProxyActor:
                 None,
                 lambda: self._router_for(dep).assign("__call__", (req,), {}))
             result = await ref
+            if isinstance(result, HTTPResponse):
+                return result.render()
             if isinstance(result, bytes):
                 return "200 OK", result, "application/octet-stream"
             if isinstance(result, str):

@@ -77,12 +77,13 @@ class ActorPool:
             if not ready:
                 raise TimeoutError("get_next timed out")
             self._on_done(ready[0])
-        ref = self._index_to_future[i]
-        value = ray_tpu.get(ref, timeout=timeout)
-        self._on_done(ref)     # no-op if the wait loop already freed it
-        del self._index_to_future[i]
+        ref = self._index_to_future.pop(i)
         self._next_return_index += 1
-        return value
+        # Free the actor BEFORE get(): a task that raised must still
+        # return its actor to the pool and advance the cursor, or every
+        # failure permanently shrinks the pool and wedges the iterator.
+        self._on_done(ref)     # no-op if the wait loop already freed it
+        return ray_tpu.get(ref, timeout=timeout)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next COMPLETED result, any order."""
@@ -95,9 +96,9 @@ class ActorPool:
         self._unordered_used = True
         ref = ready[0]
         i, _ = self._future_to_actor[ref]
-        value = ray_tpu.get(ref)
-        self._on_done(ref)
+        self._on_done(ref)          # free the actor even if get() raises
         self._index_to_future.pop(i, None)
+        value = ray_tpu.get(ref)
         if not self.has_next():
             # Fully drained: ordered consumption may start fresh.
             self._unordered_used = False

@@ -184,3 +184,27 @@ def test_dqn_save_restore_keeps_target_net(ray_start_regular, tmp_path):
             state["updates"]
     finally:
         algo2.stop()
+
+
+def test_appo_cartpole_learns(ray_start_regular):
+    """APPO gate (reference: algorithms/appo — IMPALA's async machinery
+    with the PPO clipped surrogate on V-trace advantages)."""
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=64)
+            .training(lr=6e-4, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build_algo())
+    try:
+        best = 0.0
+        for _ in range(90):
+            m = algo.train()
+            best = max(best, m["episode_return_mean"])
+            if m["episode_return_mean"] >= 120:
+                break
+        assert best >= 120, f"APPO failed to learn CartPole (best={best:.1f})"
+    finally:
+        algo.stop()
