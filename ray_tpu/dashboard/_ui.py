@@ -78,7 +78,7 @@ async function tick() {
                                  c.resources_total.TPU);
     const nodes = await j("/api/nodes");
     fill("nodes", nodes.map(n => [
-        `<code>${(n.node_id || "").slice(0, 12)}</code>`,
+        `<code>${esc((n.node_id || "").slice(0, 12))}</code>`,
         n.alive ? '<span class="ok">ALIVE</span>'
                 : '<span class="bad">DEAD</span>',
         esc((n.address || []).join(":")),
@@ -91,7 +91,7 @@ async function tick() {
     $("t-actors").textContent =
         actors.filter(a => a.state === "ALIVE").length;
     fill("actors", actors.slice(0, 200).map(a => [
-        `<code>${(a.actor_id || "").slice(0, 12)}</code>`,
+        `<code>${esc((a.actor_id || "").slice(0, 12))}</code>`,
         esc(a.class_name || ""), a.state === "ALIVE"
             ? '<span class="ok">ALIVE</span>'
             : `<span class="bad">${esc(a.state)}</span>`,

@@ -91,8 +91,14 @@ class OpenAIServer:
             body = request.json() or {}
         except ValueError:
             return self._error(400, "invalid JSON body")
-        max_tokens = int(body.get("max_tokens", 16))
-        temperature = float(body.get("temperature", 0.0))
+        try:
+            # Clients serializing unset fields as null must get a 400,
+            # not a 500 from int(None).
+            max_tokens = int(body.get("max_tokens") or 16)
+            temperature = float(body.get("temperature") or 0.0)
+        except (TypeError, ValueError):
+            return self._error(
+                400, "max_tokens/temperature must be numbers")
         if path.endswith("/chat/completions"):
             msgs = body.get("messages") or []
             if not msgs:

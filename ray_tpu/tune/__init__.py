@@ -9,8 +9,8 @@ from ..train._session import get_checkpoint
 from ..train._session import report as _session_report
 from .schedulers import (ASHAScheduler, FIFOScheduler,
                          PopulationBasedTraining)
-from .search import (choice, grid_search, loguniform, randint, uniform,
-                     generate_variants)
+from .search import (BayesOptSearch, Searcher, choice, grid_search,
+                     loguniform, randint, uniform, generate_variants)
 from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneController,
                     Tuner)
 
@@ -26,4 +26,5 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "generate_variants", "ASHAScheduler", "FIFOScheduler",
     "PopulationBasedTraining", "report", "get_checkpoint",
+    "BayesOptSearch", "Searcher",
 ]

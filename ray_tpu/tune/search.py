@@ -123,3 +123,108 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
                     cfg_flat[p] = v
             variants.append(_unflatten(cfg_flat))
     return variants
+
+
+# ---------------------------------------------------------------- searchers
+
+
+class Searcher:
+    """Sequential suggestion interface (reference: tune/search/searcher.py
+    Searcher — suggest(trial_id) -> config, on_trial_complete feeding the
+    model).  Plugged in via TuneConfig(search_alg=...); the controller
+    requests one config per trial slot as it frees up."""
+
+    def suggest(self, trial_id: str):
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Dict[str, Any]) -> None:
+        pass
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization with expected improvement
+    (reference surface: tune/search/bayesopt/bayesopt_search.py, which
+    wraps the `bayesian-optimization` package; that dependency isn't in
+    the image, so the GP+EI loop is implemented natively on
+    scikit-learn).
+
+    space: flat-or-nested dict of NUMERIC Domains (uniform/loguniform/
+    randint).  Categorical dimensions belong to grid/random search.
+    """
+
+    def __init__(self, space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", n_initial_points: int = 5,
+                 candidate_pool: int = 512,
+                 seed: int | None = None):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial_points
+        self.candidate_pool = candidate_pool
+        self._rng = random.Random(seed)
+        self._flat = _flatten(space)
+        for path, dom in self._flat.items():
+            if not isinstance(dom, (Uniform, LogUniform, Randint)):
+                raise ValueError(
+                    f"BayesOptSearch supports numeric domains only; "
+                    f"{'.'.join(path)} is {type(dom).__name__}")
+        self._dims = sorted(self._flat)
+        self._live: Dict[str, List[float]] = {}   # trial -> unit point
+        self._X: List[List[float]] = []           # observed unit points
+        self._y: List[float] = []                 # signed objective
+
+    # ------------------------------------------------------ unit warping --
+    def _from_unit(self, path, u: float):
+        dom = self._flat[path]
+        if isinstance(dom, LogUniform):
+            import math as m
+            return m.exp(m.log(dom.low)
+                         + u * (m.log(dom.high) - m.log(dom.low)))
+        v = dom.low + u * (dom.high - dom.low)
+        if isinstance(dom, Randint):
+            return max(dom.low, min(dom.high - 1, int(v)))
+        return v
+
+    def _point_to_config(self, point: List[float]) -> Dict[str, Any]:
+        return _unflatten({p: self._from_unit(p, u)
+                           for p, u in zip(self._dims, point)})
+
+    # -------------------------------------------------------------- api --
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if len(self._X) < self.n_initial:
+            point = [self._rng.random() for _ in self._dims]
+        else:
+            point = self._ei_argmax()
+        self._live[trial_id] = point
+        return self._point_to_config(point)
+
+    def _ei_argmax(self) -> List[float]:
+        import numpy as np
+        from scipy import stats
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-6, normalize_y=True,
+            random_state=self._rng.randrange(2**31))
+        gp.fit(X, y)
+        cand = np.asarray([[self._rng.random() for _ in self._dims]
+                           for _ in range(self.candidate_pool)])
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = y.max()
+        sigma = np.maximum(sigma, 1e-9)
+        z = (mu - best) / sigma
+        ei = (mu - best) * stats.norm.cdf(z) + sigma * stats.norm.pdf(z)
+        return list(cand[int(np.argmax(ei))])
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Dict[str, Any]) -> None:
+        point = self._live.pop(trial_id, None)
+        v = (result or {}).get(self.metric)
+        if point is None or v is None:
+            return
+        self._X.append(point)
+        self._y.append(float(v) if self.mode == "max" else -float(v))

@@ -47,6 +47,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    # Sequential searcher (reference: tune_config.search_alg — e.g.
+    # BayesOptSearch): suggests one config per freed trial slot instead
+    # of the up-front variant expansion.
+    search_alg: Any = None
     seed: Optional[int] = None
     resources_per_trial: Optional[Dict[str, float]] = None
 
@@ -237,6 +241,7 @@ class TuneController:
                  poll_interval_s: float = 0.2):
         self.trainable = trainable
         self.tc = tune_config
+        self.param_space = param_space or {}
         self.exp_dir = exp_dir
         self.poll_interval_s = poll_interval_s
         self.scheduler = self.tc.scheduler or FIFOScheduler()
@@ -244,6 +249,8 @@ class TuneController:
         self.state_file = os.path.join(exp_dir, "experiment_state.json")
         if restore and os.path.exists(self.state_file):
             self.trials = self._load_state()
+        elif self.tc.search_alg is not None:
+            self.trials = []          # trials minted by the searcher
         else:
             variants = generate_variants(param_space, self.tc.num_samples,
                                          seed=self.tc.seed)
@@ -333,12 +340,19 @@ class TuneController:
                 pass
             trial.actor = None
         # Every exit path notifies the scheduler so population-based
-        # schedulers drop dead trials from their quantile bookkeeping.
+        # schedulers drop dead trials from their quantile bookkeeping —
+        # and the searcher, so its model sees the final observation.
         try:
             self.scheduler.on_trial_complete(trial.trial_id,
                                              trial.last_metrics)
         except Exception:
             pass
+        if self.tc.search_alg is not None:
+            try:
+                self.tc.search_alg.on_trial_complete(trial.trial_id,
+                                                     trial.last_metrics)
+            except Exception:
+                pass
 
     def _ingest(self, trial: Trial, poll: Dict[str, Any]):
         for rep in poll["reports"]:
@@ -393,10 +407,38 @@ class TuneController:
             return
         trial.restarted_this_poll = True
 
+    def _mint_searcher_trials(self, max_conc: int):
+        """Ask the searcher for configs while slots + budget allow
+        (reference: tune_controller driving search_alg.suggest)."""
+        if self.tc.search_alg is None:
+            return
+        unfinished = [t for t in self.trials
+                      if t.status in (PENDING, RUNNING)]
+        while (len(self.trials) < self.tc.num_samples
+               and len(unfinished) < max_conc):
+            tid = f"trial_{len(self.trials):05d}"
+            cfg = self.tc.search_alg.suggest(tid)
+            if cfg is None:
+                break
+            # With a searcher, param_space carries CONSTANTS only (the
+            # sampled space lives in the searcher); unsampled Domains /
+            # grid markers at ANY nesting depth must not leak into a
+            # trial config.
+            from .search import Domain, _flatten, _is_grid, _unflatten
+            flat = {path: v for path, v in
+                    _flatten(self.param_space or {}).items()
+                    if not isinstance(v, Domain) and not _is_grid(v)}
+            merged = _unflatten(flat)
+            merged.update(cfg)
+            t = Trial(tid, merged)
+            self.trials.append(t)
+            unfinished.append(t)
+
     def run(self) -> ResultGrid:
         max_conc = self.tc.max_concurrent_trials or 4
         try:
             while True:
+                self._mint_searcher_trials(max_conc)
                 running = [t for t in self.trials if t.status == RUNNING]
                 pending = [t for t in self.trials if t.status == PENDING]
                 for t in pending[:max(0, max_conc - len(running))]:

@@ -77,13 +77,19 @@ class ActorPool:
             if not ready:
                 raise TimeoutError("get_next timed out")
             self._on_done(ready[0])
-        ref = self._index_to_future.pop(i)
+        ref = self._index_to_future[i]
+        # Readiness first (a timeout must NOT consume the slot)...
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        # ...then consume state BEFORE get(): a task that RAISED must
+        # still return its actor to the pool and advance the cursor, or
+        # every failure permanently shrinks the pool and wedges the
+        # iterator.
+        del self._index_to_future[i]
         self._next_return_index += 1
-        # Free the actor BEFORE get(): a task that raised must still
-        # return its actor to the pool and advance the cursor, or every
-        # failure permanently shrinks the pool and wedges the iterator.
         self._on_done(ref)     # no-op if the wait loop already freed it
-        return ray_tpu.get(ref, timeout=timeout)
+        return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next COMPLETED result, any order."""
@@ -96,8 +102,8 @@ class ActorPool:
         self._unordered_used = True
         ref = ready[0]
         i, _ = self._future_to_actor[ref]
-        self._on_done(ref)          # free the actor even if get() raises
-        self._index_to_future.pop(i, None)
+        self._on_done(ref)          # ready: free the actor even if the
+        self._index_to_future.pop(i, None)      # task raised
         value = ray_tpu.get(ref)
         if not self.has_next():
             # Fully drained: ordered consumption may start fresh.

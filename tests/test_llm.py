@@ -137,7 +137,6 @@ def test_openai_compatible_api(ray_start_regular):
     from ray_tpu import serve
     from ray_tpu.llm import build_openai_app
 
-    port_holder = {}
     serve.start(http_port=0)
     from ray_tpu.serve import api as serve_api
     serve.run(build_openai_app(preset="tiny", model_name="tiny-chat"),
@@ -145,6 +144,16 @@ def test_openai_compatible_api(ray_start_regular):
     import ray_tpu as rt
     proxy_port = rt.get(serve_api._proxy.ready.remote(), timeout=60)
     base = f"http://127.0.0.1:{proxy_port}/v1"
+
+    try:
+        _run_openai_assertions(base)
+    finally:
+        serve.shutdown()
+
+
+def _run_openai_assertions(base):
+    import json
+    import urllib.request
 
     def call(path, payload=None):
         if payload is None:
@@ -182,4 +191,3 @@ def test_openai_compatible_api(ray_start_regular):
     except urllib.error.HTTPError as e:
         assert e.code == 400
         assert "messages" in json.loads(e.read())["error"]["message"]
-    serve.shutdown()
