@@ -113,7 +113,7 @@ def main():
         import subprocess
         proc = subprocess.run(
             [sys.executable, "-m", "ray_tpu.util.perf", "--compact",
-             "--min-time-s", "1.0"],
+             "--min-time-s", "2.0"],
             capture_output=True, text=True, timeout=300,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         line = proc.stdout.strip().splitlines()[-1]

@@ -56,6 +56,64 @@ def _read_file(path: str) -> bytes:
         return f.read()
 
 
+class _ForkedProc:
+    """Popen-shaped handle for a zygote-forked worker. The zygote (not
+    the agent) is the child's parent and reaps it on SIGCHLD, so death is
+    observed via /proc rather than waitpid; signals go by pid."""
+
+    @staticmethod
+    def _stat_fields(pid: int):
+        """(state, starttime) from /proc/<pid>/stat; None if gone. comm may
+        itself contain ')', so split on the LAST one."""
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                rest = f.read().rsplit(b")", 1)[1].split()
+            return rest[0], rest[19]   # fields 3 and 22 (1-indexed)
+        except (OSError, IndexError):
+            return None
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: int | None = None
+        st = self._stat_fields(pid)
+        # starttime pins identity: a recycled pid after death+reap must
+        # not make a dead worker look alive (or get SIGTERMed by proxy).
+        self._starttime = st[1] if st else None
+
+    def poll(self):
+        if self.returncode is not None:
+            return self.returncode
+        st = self._stat_fields(self.pid)
+        if st is not None and st[0] != b"Z" and st[1] == self._starttime:
+            return None
+        self.returncode = 0
+        return self.returncode
+
+    def send_signal(self, sig):
+        st = self._stat_fields(self.pid)
+        if st is None or st[1] != self._starttime:
+            return              # pid recycled: never signal a stranger
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def terminate(self):
+        self.send_signal(signal.SIGTERM)
+
+    def kill(self):
+        self.send_signal(signal.SIGKILL)
+
+    def wait(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("zygote-forked-worker",
+                                                timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
 class WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -122,6 +180,12 @@ class NodeAgent:
         self._pull_seq = 0
         self._chunk_bytes = cfg.object_transfer_chunk_bytes
         self._max_pulls = cfg.max_concurrent_pulls
+        # Parked lease requests: (params, conn, reply_future, deadline),
+        # FIFO-granted by _parked_lease_loop as resources free (reference:
+        # ClusterLeaseManager's lease queue).
+        from collections import deque as _dq
+        self._parked_leases: _dq = _dq()
+        self._park_event = asyncio.Event()
         self._server = rpc.RpcServer(self._handlers(), name="agent",
                                      on_client_close=self._on_client_close)
         self.gcs: Optional[rpc.Connection] = None
@@ -197,7 +261,12 @@ class NodeAgent:
             on_reconnect=_register)
         await self.gcs.ensure()
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
+        self._tasks.append(asyncio.ensure_future(self._parked_lease_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if get_config().worker_fork_server:
+            # Warm the fork-server immediately: its one-time heavy import
+            # runs while the node finishes bootstrapping.
+            self._ensure_zygote()
         self._tasks.append(asyncio.ensure_future(self._prestart_workers()))
         self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info("agent %s on %s, store %s",
@@ -332,6 +401,13 @@ class NodeAgent:
         self._shutdown = True
         for t in self._tasks:
             t.cancel()
+        z = getattr(self, "_zygote", None)
+        if z is not None and z.poll() is None:
+            try:
+                z.stdin.close()          # zygote exits on EOF
+                z.terminate()
+            except OSError:
+                pass
         for wh in list(self.workers.values()):
             try:
                 wh.proc.terminate()
@@ -361,6 +437,49 @@ class NodeAgent:
             pass
 
     # ------------------------------------------------------------- workers --
+    def _zygote_env(self) -> Dict[str, str]:
+        """Env for the fork-server: identical to a default CPU worker's
+        (sitecustomize stripped, CPU-only jax) so forked children need no
+        import-time env fixups."""
+        from .node import child_env
+        env = child_env(None)
+        strip = get_config().worker_pythonpath_strip_cpu
+        if strip:
+            parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p and strip not in p]
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        if env.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+            env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    def _ensure_zygote(self) -> Optional[subprocess.Popen]:
+        z = getattr(self, "_zygote", None)
+        if z is not None and z.poll() is None:
+            return z
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        errf = open(os.path.join(log_dir, "zygote.err"), "ab")
+        try:
+            self._zygote = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                env=self._zygote_env(), stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, stderr=errf,
+                cwd=os.getcwd(), start_new_session=True)
+        except OSError:
+            self._zygote = None
+        return self._zygote
+
+    def _zygote_fork(self, req: dict) -> int:
+        """Blocking fork request (call via run_in_executor under
+        _spawn_lock — the pipe protocol is strictly serial)."""
+        z = self._zygote
+        z.stdin.write(json.dumps(req).encode() + b"\n")
+        z.stdin.flush()
+        line = z.stdout.readline()
+        if not line:
+            raise rpc.RpcError("worker fork-server died")
+        return json.loads(line)["pid"]
+
     async def _spawn_worker(self, env_extra: Dict[str, str] | None = None,
                             needs_tpu: bool = False,
                             cwd: str | None = None) -> WorkerHandle:
@@ -390,12 +509,34 @@ class NodeAgent:
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
-        err = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=out, stderr=err,
-            cwd=cwd or os.getcwd(), start_new_session=True)
+        out_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out")
+        err_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err")
+        proc = None
+        if (not needs_tpu and env_extra is None and cwd is None
+                and get_config().worker_fork_server):
+            # Default-env CPU worker: fork from the warm zygote (~100ms)
+            # instead of exec+reimport (~seconds on small hosts).
+            z = self._ensure_zygote()
+            if z is not None:
+                req = {"env": {k: env[k] for k in env
+                               if k.startswith(("RAY_TPU_", "JAX_"))},
+                       "cwd": os.getcwd(),
+                       "stdout": out_path, "stderr": err_path}
+                loop = asyncio.get_running_loop()
+                try:
+                    async with self._spawn_lock:
+                        pid = await loop.run_in_executor(
+                            None, self._zygote_fork, req)
+                    proc = _ForkedProc(pid)
+                except (rpc.RpcError, OSError, ValueError):
+                    proc = None          # zygote broken: exec fallback
+        if proc is None:
+            out = open(out_path, "ab")
+            err = open(err_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env, stdout=out, stderr=err,
+                cwd=cwd or os.getcwd(), start_new_session=True)
         if self._worker_cgroup is not None:
             self._worker_cgroup.add(proc.pid)
         wh = WorkerHandle(worker_id, proc)
@@ -472,12 +613,33 @@ class NodeAgent:
             # comes back here.
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+        self._kick_parked()
 
     # -------------------------------------------------------------- leasing --
     async def h_request_lease(self, conn, p):
-        """Grant a worker lease or reply spillback with a better node
+        """Grant a worker lease, reply spillback with a better node, or —
+        when this node is saturated with a feasible shape and nowhere to
+        spill — PARK the request and reply when resources free up
         (reference: NodeManager::HandleRequestWorkerLease
-        node_manager.cc:1776; spillback in cluster_lease_manager.cc)."""
+        node_manager.cc:1776; the raylet's ClusterLeaseManager queues
+        leases and the RPC replies on grant, it never tells a feasible
+        client to poll)."""
+        if not (self._parked_leases and not p.get("placement_group")):
+            # Fast path only while nobody is parked: a fresh request must
+            # not jump the FIFO, or a stream of small shapes starves a
+            # parked large one forever (the drain loop grants in order).
+            res = await self._try_grant_lease(conn, p)
+            if res is not None:
+                return res
+        fut = asyncio.get_running_loop().create_future()
+        deadline = time.monotonic() + float(p.get("max_park_s", 60.0))
+        self._parked_leases.append((p, conn, fut, deadline))
+        self._kick_parked()
+        return await fut
+
+    async def _try_grant_lease(self, conn, p):
+        """One grant attempt. Returns a reply dict, or None when the
+        request should park (feasible here, saturated, no spillback)."""
         resources = p.get("resources", {})
         pg = p.get("placement_group")
         bundle_key = None
@@ -505,6 +667,9 @@ class NodeAgent:
             spill = await self._find_spillback(resources)
             if spill is not None:
                 return {"granted": False, "spillback": spill}
+            if all(self.resources_total.get(k, 0.0) >= v - 1e-9
+                   for k, v in resources.items() if v > 0):
+                return None          # feasible but busy: park
             return {"granted": False, "reason": "infeasible",
                     "retry_after_ms": 100}
         # Runtime-env materialization NEVER blocks the grant RPC: a pip
@@ -554,6 +719,58 @@ class NodeAgent:
                 "worker_addr": list(wh.address),
                 "worker_id": wh.worker_id}
 
+    def _kick_parked(self):
+        """Resources were released somewhere: let the drain loop retry."""
+        if self._parked_leases:
+            self._park_event.set()
+
+    async def _parked_lease_loop(self):
+        """Single drainer (serialization avoids double-granting the head):
+        grants parked lease requests FIFO as resources free up. Strict
+        FIFO per node matches the reference's queue and avoids starving
+        large shapes behind a stream of small ones."""
+        while not self._shutdown:
+            try:
+                await asyncio.wait_for(self._park_event.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass               # periodic pass: expire deadlines
+            self._park_event.clear()
+            q = self._parked_leases
+            while q:
+                p, conn, fut, deadline = q[0]
+                if fut.done() or conn.closed:
+                    q.popleft()
+                    continue
+                if time.monotonic() > deadline:
+                    q.popleft()
+                    if not fut.done():
+                        fut.set_result({"granted": False,
+                                        "reason": "saturated",
+                                        "retry_after_ms": 100})
+                    continue
+                try:
+                    res = await self._try_grant_lease(conn, p)
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    res = {"granted": False, "reason": str(e),
+                           "retry_after_ms": 200}
+                if res is None:
+                    break          # still saturated: wait for the next kick
+                # The head may have been popped by _on_client_close while
+                # _try_grant_lease awaited; only pop if it's still us.
+                if q and q[0][2] is fut:
+                    q.popleft()
+                if not fut.done():
+                    fut.set_result(res)
+                elif res.get("granted"):
+                    # Nobody is listening for this grant anymore.
+                    wh = self.leases.pop(res["lease_id"], None)
+                    if wh is not None:
+                        self._release_resources(wh.lease_resources,
+                                                wh.lease_bundle)
+                        wh.lease_id = None
+                        wh.lease_owner_conn = None
+                        self._recycle_worker(wh)
+
     def _find_bundle(self, pg_id: bytes, bundle_index: int,
                      resources: Dict[str, float]
                      ) -> Optional[Tuple[bytes, int]]:
@@ -574,12 +791,18 @@ class NodeAgent:
         """Pick a better node from the GCS resource view (stands in for
         the reference's in-raylet cluster view synced by ray_syncer),
         scored by the hybrid top-k policy
-        (reference: hybrid_scheduling_policy.h:50)."""
+        (reference: hybrid_scheduling_policy.h:50). The view is cached
+        ~500ms — under saturation every lease request lands here, and the
+        reference's syncer view is likewise eventually consistent."""
         from . import scheduling_policy as policy
-        try:
-            nodes = await self.gcs.call("get_nodes", {})
-        except rpc.RpcError:
-            return None
+        now = time.monotonic()
+        if now - getattr(self, "_nodes_cache_ts", 0.0) > 0.5:
+            try:
+                self._nodes_cache = await self.gcs.call("get_nodes", {})
+                self._nodes_cache_ts = time.monotonic()
+            except rpc.RpcError:
+                return None
+        nodes = self._nodes_cache
         cands = [(tuple(n["address"]), n["resources_total"],
                   n["resources_available"])
                  for n in nodes
@@ -633,6 +856,12 @@ class NodeAgent:
         """A lease client (driver/worker) disconnected: reclaim every
         lease it still holds — a driver exiting mid-lease must not leak
         node resources (reference: raylet lease cleanup on disconnect)."""
+        for item in self._parked_leases:
+            # Resolve parked requests from this client so their handler
+            # coroutines don't wait forever; the drain loop reaps entries.
+            if item[1] is conn and not item[2].done():
+                item[2].set_result({"granted": False,
+                                    "reason": "client disconnected"})
         for lease_id, wh in list(self.leases.items()):
             if wh.lease_owner_conn is conn:
                 self.leases.pop(lease_id, None)

@@ -54,6 +54,10 @@ _define("worker_pythonpath_strip_cpu", ".axon_site",
 _define("worker_prestart_count", 2,
         "workers spawned at agent boot so first leases don't pay process "
         "startup (reference: worker_pool.cc prestart)")
+_define("worker_fork_server", True,
+        "fork default-env CPU workers from a warm pre-imported zygote "
+        "process (~100ms) instead of exec+reimport (~seconds); TPU and "
+        "runtime-env workers always exec fresh (zygote.py)")
 _define("worker_niceness", 0)
 _define("maximum_gcs_destroyed_actor_cached_count", 100_000)
 _define("task_max_retries_default", 3)

@@ -568,13 +568,11 @@ class CoreWorker:
     # ---------------------------------------------------------- telemetry ---
     def record_task_event(self, task_id: bytes, name: str, event: str,
                           **extra):
-        """Buffer one task status/profile event; any thread."""
-        rec = {"task_id": task_id, "name": name, "event": event,
-               "ts": time.time(), "worker_id": self.worker_id,
-               "node_id": self.node_id, "job_id": self.job_id or b""}
-        if extra:
-            rec.update(extra)
-        self._task_events.append(rec)
+        """Buffer one task status/profile event; any thread. Stored as a
+        tuple — the flush loop expands to the wire dict, so the per-call
+        hot path pays one append instead of a 7-key dict build."""
+        self._task_events.append(
+            (task_id, name, event, time.time(), extra or None))
 
     async def _telemetry_flush_loop(self):
         """Periodic push of buffered task events + metric deltas to the
@@ -585,15 +583,25 @@ class CoreWorker:
         while not self._shutdown:
             await asyncio.sleep(interval)
             if self._task_events:
-                batch = []
+                raw = []
                 while self._task_events:
-                    batch.append(self._task_events.popleft())
+                    raw.append(self._task_events.popleft())
+                wid, nid, jid = self.worker_id, self.node_id, \
+                    self.job_id or b""
+                batch = []
+                for task_id, name, event, ts, extra in raw:
+                    rec = {"task_id": task_id, "name": name, "event": event,
+                           "ts": ts, "worker_id": wid, "node_id": nid,
+                           "job_id": jid}
+                    if extra:
+                        rec.update(extra)
+                    batch.append(rec)
                 try:
                     self.gcs.notify("task_events", {"events": batch})
                 except Exception:
                     # Transient GCS outage: put the batch back for the
                     # next interval (deque maxlen bounds memory).
-                    self._task_events.extendleft(reversed(batch))
+                    self._task_events.extendleft(reversed(raw))
             snap = _metrics.registry_snapshot()
             if snap:
                 try:
@@ -854,8 +862,67 @@ class CoreWorker:
 
     async def _get_many(self, refs: List[ObjectRef], timeout):
         deadline = None if timeout is None else time.monotonic() + timeout
-        return await asyncio.gather(
-            *[self._get_one(r, deadline) for r in refs])
+        if len(refs) < 4:
+            return await asyncio.gather(
+                *[self._get_one(r, deadline) for r in refs])
+        # Batched fast path: every OWNED object is tracked in the memory
+        # store (inline puts, plasma puts via _put_plasma, task returns via
+        # _handle_reply), so one wait_for_many future covers all pending
+        # owned refs — instead of a Task+Event per ref, which dominates
+        # caller-side CPU under fan-out (reference: memory_store.cc GetAsync
+        # registers N callbacks on one request context for the same reason).
+        self_addr = self.address
+        mstore = self.memory_store
+        ctx = get_context()
+        objects = mstore._objects
+        pending = None
+        owned = [r.owner_address is None or tuple(r.owner_address) == self_addr
+                 for r in refs]
+        for r, own in zip(refs, owned):
+            if own:
+                entry = objects.get(r.binary())
+                if entry is None:
+                    if pending is None:
+                        pending = []
+                    pending.append(r.binary())
+                elif entry.is_exception:
+                    # Raise an already-stored error before waiting on
+                    # anything else (gather's first-error semantics).
+                    raise ctx.deserialize(memoryview(entry.data))
+        if pending:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            if not await mstore.wait_for_many(pending, remaining):
+                raise exc.GetTimeoutError(
+                    f"timed out getting {len(pending)} pending objects")
+            # wait_for_many also completes EARLY when any waited-on entry
+            # lands as an error: surface it now rather than decoding/IO-ing
+            # the rest first (stragglers would otherwise block below).
+            for oid in pending:
+                entry = objects.get(oid)
+                if entry is not None and entry.is_exception:
+                    raise ctx.deserialize(memoryview(entry.data))
+        # Inline entries decode in place; anything needing IO (plasma reads,
+        # borrowed refs, recovery) keeps the concurrent per-ref path.
+        out = [None] * len(refs)
+        io_idx = None
+        for i, r in enumerate(refs):
+            entry = objects.get(r.binary()) if owned[i] else None
+            if entry is not None and entry.data is not None:
+                value = ctx.deserialize(memoryview(entry.data))
+                if isinstance(value, exc.RayError):
+                    raise value
+                out[i] = value
+            else:
+                if io_idx is None:
+                    io_idx = []
+                io_idx.append(i)
+        if io_idx:
+            vals = await asyncio.gather(
+                *[self._get_one(refs[i], deadline) for i in io_idx])
+            for i, v in zip(io_idx, vals):
+                out[i] = v
+        return out
 
     async def _get_one(self, ref: ObjectRef, deadline):
         data = await self._fetch_serialized(ref, deadline)
@@ -1291,7 +1358,7 @@ class CoreWorker:
             runtime_env=runtime_env, name=name, streaming=streaming)
         refs = []
         for i in range(num_returns):
-            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            oid = task_id + (i + 1).to_bytes(4, "little")
             self.reference_counter.add_owned(oid, lineage=spec)
             refs.append(ObjectRef(oid, self.address, worker=self))
         if streaming is not None:
@@ -1359,7 +1426,7 @@ class CoreWorker:
             name=name or getattr(fn, "__name__", ""), streaming=streaming)
         refs = []
         for i in range(num_returns):
-            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            oid = task_id + (i + 1).to_bytes(4, "little")
             self.reference_counter.add_owned(oid, lineage=spec)
             refs.append(ObjectRef(oid, self.address, worker=self))
         if streaming is not None:
@@ -1466,12 +1533,16 @@ class CoreWorker:
         # grows.  Long/unknown tasks never deep-pipeline: they must stay
         # queued here so lease growth (and spillback to other nodes) can
         # still spread them.
-        if state.pending_lease_requests > 0:
-            depth_cap = 1
-        elif state.avg_task_s is not None and state.avg_task_s < 0.05:
+        if state.avg_task_s is not None and state.avg_task_s < 0.05:
+            # Short tasks deepen even while lease requests are parked at a
+            # saturated agent: binding a burst of sub-50ms tasks to the
+            # granted leases costs at most a few hundred ms, and a parked
+            # request may not resolve for seconds.
             depth_cap = max(PIPELINE_DEPTH,
                             min(64, len(state.queue)
                                 // max(1, len(state.leases))))
+        elif state.pending_lease_requests > 0:
+            depth_cap = 1
         else:
             depth_cap = PIPELINE_DEPTH
         assign: Dict[int, tuple] = {}
@@ -1630,6 +1701,16 @@ class CoreWorker:
             state.last_demand_report = 0.0
             self._spawn(self.gcs.call("report_demand", {
                 "reporter": self.worker_id + key, "shapes": []}))
+        if not state.queue and not any(ls.inflight for ls in state.leases):
+            # Stale grant: the work this request was made for already
+            # drained (typical when several requests parked at a saturated
+            # agent). Hand the lease straight back — cycling it through
+            # the idle reaper would hold the slot ~0.75s, serializing
+            # OTHER clients' parked requests behind it (reference:
+            # normal_task_submitter.cc cancels unneeded lease requests).
+            self._spawn(agent_conn.call(
+                "return_lease", {"lease_id": res["lease_id"]}))
+            return
         worker_addr = tuple(res["worker_addr"])
         conn = await self._worker_conn(worker_addr)
         lease = _Lease(res["lease_id"], worker_addr, res["worker_id"], conn,
@@ -1788,14 +1869,21 @@ class CoreWorker:
         return conn
 
     async def _lease_reaper(self, key, state, lease: _Lease):
+        # 100ms grace keeps the lease across back-to-back sync submission
+        # loops (gap ~0) but hands the worker back quickly when this key's
+        # queue drains — under saturation other clients' lease requests
+        # are parked at the agent behind this slot (reference:
+        # normal_task_submitter.cc returns the worker when the scheduling
+        # key's queue empties; the raylet's idle pool, not a held lease,
+        # provides reuse).
         while True:
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(0.05)
             if lease.conn.closed:
                 if lease in state.leases:
                     state.leases.remove(lease)
                 return
             if lease.inflight == 0 and not state.queue:
-                if time.monotonic() - lease.idle_since > 0.5:
+                if time.monotonic() - lease.idle_since > 0.1:
                     if lease in state.leases:
                         state.leases.remove(lease)
                     try:
@@ -1984,7 +2072,9 @@ class CoreWorker:
                 self.reference_counter.add_borrower_from_reply(
                     bytes(oid), bytes(reply["borrower_id"]), epoch=epoch)
             for i, entry in enumerate(reply["returns"]):
-                oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+                # ObjectID.for_task_return without the class round-trips:
+                # ids are plain concatenation (ids.py:166).
+                oid = task_id + (i + 1).to_bytes(4, "little")
                 # Refs nested inside this return value: the worker already
                 # escape-pinned each at its owner during serialization; we
                 # record containment so freeing the return releases them
@@ -2294,8 +2384,13 @@ class CoreWorker:
         if out_of_order:
             state.out_of_order = True
         task_id = TaskID.for_actor_task(ActorID(actor_id)).binary()
-        entries, ref_args, borrowed_args, big_puts = \
-            self._build_arg_entries_sync(args, kwargs)
+        if not args and not kwargs:
+            # No-arg fast branch (ping/poll-style calls dominate fan-out
+            # load; skips the arg-entry walk entirely).
+            entries, ref_args, borrowed_args, big_puts = [], [], [], []
+        else:
+            entries, ref_args, borrowed_args, big_puts = \
+                self._build_arg_entries_sync(args, kwargs)
         with self._seq_lock:
             state.seq += 1
             seq = state.seq
@@ -2307,7 +2402,7 @@ class CoreWorker:
             streaming=streaming)
         refs = []
         for i in range(num_returns):
-            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            oid = task_id + (i + 1).to_bytes(4, "little")
             self.reference_counter.add_owned(oid)
             refs.append(ObjectRef(oid, self.address, worker=self))
         if streaming is not None:

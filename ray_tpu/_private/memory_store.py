@@ -28,6 +28,11 @@ class MemoryStore:
     def __init__(self):
         self._objects: Dict[bytes, _Entry] = {}
         self._waiters: Dict[bytes, List[asyncio.Event]] = {}
+        # Group waiters: [future, remaining_count] shared across many ids,
+        # so a get() on N pending refs costs ONE future instead of N
+        # Event+Task pairs (the reference amortizes the same way in C++ —
+        # GetAsync callbacks on a single request context).
+        self._gwaiters: Dict[bytes, List[list]] = {}
 
     def put_inline(self, object_id: bytes, data: bytes, is_exception=False):
         self._objects[object_id] = _Entry(data, is_exception)
@@ -40,6 +45,17 @@ class MemoryStore:
     def _wake(self, object_id: bytes):
         for ev in self._waiters.pop(object_id, []):
             ev.set()
+        gw = self._gwaiters.pop(object_id, None)
+        if gw:
+            entry = self._objects.get(object_id)
+            errored = entry is not None and entry.is_exception
+            for w in gw:
+                w[1] -= 1
+                if (w[1] <= 0 or errored) and not w[0].done():
+                    # An error entry completes the whole batch early: the
+                    # caller surfaces it without waiting for the rest
+                    # (matching gather's raise-on-first-error semantics).
+                    w[0].set_result(True)
 
     def get(self, object_id: bytes) -> Optional[_Entry]:
         return self._objects.get(object_id)
@@ -66,6 +82,38 @@ class MemoryStore:
             if lst and ev in lst:
                 lst.remove(ev)
         return self._objects.get(object_id)
+
+    async def wait_for_many(self, object_ids, timeout: float | None = None
+                            ) -> bool:
+        """Block until every id is present, with ONE future regardless of
+        how many are pending. Returns False on timeout. Loop-thread only
+        (same constraint as wait_for)."""
+        objects = self._objects
+        missing = [o for o in object_ids if o not in objects]
+        if not missing:
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        w = [fut, len(missing)]
+        gw = self._gwaiters
+        for o in missing:
+            gw.setdefault(o, []).append(w)
+        try:
+            if timeout is None:
+                await fut
+            else:
+                await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            if w[1] > 0:
+                # Timed out / cancelled with ids still pending: unregister.
+                for o in missing:
+                    lst = gw.get(o)
+                    if lst is not None and w in lst:
+                        lst.remove(w)
+                        if not lst:
+                            del gw[o]
 
     def delete(self, object_id: bytes):
         self._objects.pop(object_id, None)

@@ -52,6 +52,11 @@ class Executor:
         self._group_sems: dict = {}
         self._max_concurrency = 1
         self._actor_is_async = False
+        # method name -> bound sync method (or None) / is-coroutine flag:
+        # avoids a getattr + iscoroutinefunction walk per call on the
+        # actor hot path.
+        self._sync_method_cache: Dict[str, Any] = {}
+        self._coro_method_cache: Dict[str, bool] = {}
         self._running: Dict[bytes, tuple] = {}  # task_id -> (task, is_async)
         self._running_threads: Dict[bytes, int] = {}  # sync task -> thread id
         self._thread_guard = threading.Lock()
@@ -208,9 +213,15 @@ class Executor:
         method, ref args)."""
         if self.actor is None or spec.get("streaming"):
             return None
-        m = getattr(self.actor, spec["method"], None)
-        if (m is None or asyncio.iscoroutinefunction(m)
-                or not all("v" in e for e in spec["args"])):
+        name = spec["method"]
+        try:
+            m = self._sync_method_cache[name]
+        except KeyError:
+            m = getattr(self.actor, name, None)
+            if m is not None and asyncio.iscoroutinefunction(m):
+                m = None
+            self._sync_method_cache[name] = m
+        if m is None or not all("v" in e for e in spec["args"]):
             return None
         return m
 
@@ -240,8 +251,15 @@ class Executor:
                     "error": get_context().dumps_code(e),
                     "traceback": str(e)}
         if self._actor_is_async:
-            method = getattr(self.actor, spec["method"], None)
-            if method is not None and asyncio.iscoroutinefunction(method):
+            name = spec["method"]
+            try:
+                is_coro = self._coro_method_cache[name]
+            except KeyError:
+                method = getattr(self.actor, name, None)
+                is_coro = method is not None and \
+                    asyncio.iscoroutinefunction(method)
+                self._coro_method_cache[name] = is_coro
+            if is_coro:
                 async with sem:
                     return await self._execute(spec)
         if self._group_sems and sem is not self._actor_sem:
@@ -814,6 +832,8 @@ class Executor:
         loop = asyncio.get_running_loop()
         self.actor = await loop.run_in_executor(
             self.core.executor, lambda: cls(*args, **kwargs))
+        self._sync_method_cache.clear()
+        self._coro_method_cache.clear()
         self.actor_id = spec["actor_id"]
         self.core.current_actor_id = spec["actor_id"]
         max_conc = spec.get("max_concurrency", 1) or 1

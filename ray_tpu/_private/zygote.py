@@ -1,0 +1,102 @@
+"""Worker fork-server: spawn default-env CPU workers in ~100ms.
+
+Interpreter startup plus the numpy/msgpack/cloudpickle imports cost
+seconds on small hosts — paid by EVERY exec'd worker. The agent keeps one
+of these processes warm and forks each new worker from it, inheriting the
+warmed ``sys.modules``; the child then imports
+``ray_tpu._private.worker_main`` fresh (~50ms) with the worker's env
+applied post-fork, so config singletons bind the right values and id
+minting reseeds via the ``os.register_at_fork`` hook in ids.py.
+
+This is the same trick as CPython's ``multiprocessing`` *forkserver*
+start method and plays the role of the reference's worker prestart
+(reference: src/ray/raylet/worker_pool.cc PrestartWorkers — amortizing
+worker startup cost off the task critical path).
+
+TPU workers never fork from here: the zygote deliberately runs with
+``JAX_PLATFORMS=cpu`` and must never touch chip state (one client per
+chip; reference analogue: train/v2/jax/jax_trainer.py:92-94 warns even
+the *driver* must not initialize the TPU client).
+
+Protocol: line-delimited JSON over stdin/stdout.
+  request:  {"env": {...}, "cwd": str|null, "stdout": path, "stderr": path}
+  reply:    {"pid": int}
+The zygote reaps its forked children on SIGCHLD so a dead worker's
+``/proc/<pid>`` entry disappears promptly (the agent's handle polls it).
+Closing stdin shuts the zygote down; workers survive it (their lifetime
+is managed by the agent via signals).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+
+def _reap(*_):
+    try:
+        while True:
+            pid, _ = os.waitpid(-1, os.WNOHANG)
+            if pid == 0:
+                break
+    except ChildProcessError:
+        pass
+
+
+def _child(req: dict) -> None:
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+    os.setsid()
+    fd_out = os.open(req["stdout"],
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    fd_err = os.open(req["stderr"],
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(fd_out, 1)
+    os.dup2(fd_err, 2)
+    os.close(fd_out)
+    os.close(fd_err)
+    # Detach from the zygote's request pipe.
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+    for k, v in (req.get("env") or {}).items():
+        os.environ[k] = v
+    if req.get("cwd"):
+        os.chdir(req["cwd"])
+    from ray_tpu._private import worker_main
+    worker_main.main()
+
+
+def main() -> None:
+    # Preload the expensive imports ONCE; forked children inherit them.
+    # ray_tpu itself is NOT imported: its config/env must bind after the
+    # fork, when the worker's env vars are in place.
+    import numpy          # noqa: F401
+    import msgpack        # noqa: F401
+    import cloudpickle    # noqa: F401
+    signal.signal(signal.SIGCHLD, _reap)
+    inp, out = sys.stdin.buffer, sys.stdout.buffer
+    while True:
+        line = inp.readline()
+        if not line:
+            return                      # agent closed the pipe
+        try:
+            req = json.loads(line)
+        except ValueError:
+            continue
+        pid = os.fork()
+        if pid == 0:
+            try:
+                _child(req)
+                os._exit(0)
+            except BaseException:       # noqa: BLE001 — child must exit
+                import traceback
+                traceback.print_exc()
+                os._exit(1)
+        out.write(json.dumps({"pid": pid}).encode() + b"\n")
+        out.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -94,7 +94,8 @@ class OpenAIServer:
         try:
             # Clients serializing unset fields as null must get a 400,
             # not a 500 from int(None).
-            max_tokens = int(body.get("max_tokens") or 16)
+            mt = body.get("max_tokens")
+            max_tokens = 16 if mt is None else int(mt)
             temperature = float(body.get("temperature") or 0.0)
         except (TypeError, ValueError):
             return self._error(

@@ -428,8 +428,11 @@ class TuneController:
             flat = {path: v for path, v in
                     _flatten(self.param_space or {}).items()
                     if not isinstance(v, Domain) and not _is_grid(v)}
+            # Merge in FLAT space: a shallow dict.update would clobber a
+            # whole nested constants subtree whenever it shares a top-level
+            # key with a searched dimension.
+            flat.update(_flatten(cfg))
             merged = _unflatten(flat)
-            merged.update(cfg)
             t = Trial(tid, merged)
             self.trials.append(t)
             unfinished.append(t)

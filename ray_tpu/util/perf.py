@@ -312,6 +312,10 @@ def run_microbenchmarks(min_time_s: float = 1.0,
     for name, fn in BENCHES.items():
         if only and name not in only:
             continue
+        # Settle: let the previous bench's lease returns / worker recycling
+        # finish so its cleanup doesn't steal CPU from this measurement
+        # (ordering effects dominated run-to-run variance on small hosts).
+        time.sleep(0.4)
         rate = fn(min_time_s)
         results[name] = {
             "value": round(rate, 2),

@@ -188,8 +188,13 @@ def test_iter_batches_streams_blocks(ray_start_regular):
         _time.sleep(0.05)
         return {"id": b["id"] * 2}
 
-    ds = rdata.range(4000, parallelism=4).map_batches(slow_double,
-                                                      batch_size=100)
+    # 16 pipelines > the 8-pipeline in-flight window: the tail half can't
+    # even LAUNCH until earlier pipelines are consumed, so a non-streaming
+    # consumer (materialize-then-yield) would put the first batch near
+    # dt_all no matter how contended the host is — the margin survives
+    # 1-core CI boxes where worker spawns compete with the pipelines.
+    ds = rdata.range(16000, parallelism=16).map_batches(slow_double,
+                                                        batch_size=100)
     t0 = _time.monotonic()
     it = ds.iter_batches(batch_size=100)
     first = next(it)
@@ -200,4 +205,4 @@ def test_iter_batches_streams_blocks(ray_start_regular):
     assert dt_first < dt_all * 0.6, (
         f"first batch at {dt_first:.2f}s of {dt_all:.2f}s — not streaming")
     total = sum(len(b["id"]) for b in rest) + len(first["id"])
-    assert total == 4000
+    assert total == 16000
