@@ -131,6 +131,11 @@ class WorkerHandle:
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
         self.spawned_at = time.monotonic()
+        # Blocked-get CPU release (reference: NodeManager::
+        # HandleNotifyDirectCallTaskBlocked, node_manager.cc — a worker
+        # blocked in ray.get releases its CPU so queued work can run).
+        self.blocked_depth = 0        # concurrent blocked gets in this worker
+        self.blocked_cpus = 0.0       # CPU amount currently released
 
 
 class NodeAgent:
@@ -232,6 +237,8 @@ class NodeAgent:
             "list_objects": self.h_list_objects,
             "ping": lambda conn, p: "pong",
             "worker_fate": self.h_worker_fate,
+            "worker_blocked": self.h_worker_blocked,
+            "worker_unblocked": self.h_worker_unblocked,
             "profile_worker": self.h_profile_worker,
             "shutdown": self.h_shutdown,
         }
@@ -377,7 +384,8 @@ class NodeAgent:
         if wh in self.idle_tpu_workers:
             self.idle_tpu_workers.remove(wh)
         if wh.lease_id is not None:
-            self._release_resources(wh.lease_resources, wh.lease_bundle)
+            self._release_resources(self._settle_lease_release(wh),
+                                    wh.lease_bundle)
             self.leases.pop(wh.lease_id, None)
         logger.warning("worker %s (pid %s) died", wh.worker_id.hex()[:8],
                        wh.proc.pid)
@@ -876,11 +884,64 @@ class NodeAgent:
         wh = self.leases.pop(p["lease_id"], None)
         if wh is None:
             return False
-        self._release_resources(wh.lease_resources, wh.lease_bundle)
+        self._release_resources(self._settle_lease_release(wh),
+                                wh.lease_bundle)
         wh.lease_id = None
         wh.lease_resources = {}
         wh.lease_bundle = None
         self._recycle_worker(wh)
+        return True
+
+    def _settle_lease_release(self, wh: WorkerHandle) -> Dict[str, float]:
+        """Resources a finishing/dying lease should hand back: the lease's
+        grant minus any CPU already released by a blocked get still
+        outstanding (a worker can die mid-get; its CPU must not be
+        returned twice)."""
+        res = wh.lease_resources
+        if wh.blocked_depth > 0 and wh.blocked_cpus:
+            res = dict(res)
+            res["CPU"] = res.get("CPU", 0.0) - wh.blocked_cpus
+        wh.blocked_depth = 0
+        wh.blocked_cpus = 0.0
+        return res
+
+    async def h_worker_blocked(self, conn, p):
+        """A leased worker blocked inside ray_tpu.get: release its CPU so
+        queued/parked work (often the very task it waits on) can run here
+        (reference: NotifyDirectCallTaskBlocked — the raylet releases CPU
+        but never accelerators; a TPU worker blocked in get keeps its
+        chip)."""
+        wh = self.workers.get(p["worker_id"])
+        if wh is None or wh.lease_id is None:
+            return False
+        wh.blocked_depth += 1
+        if wh.blocked_depth == 1:
+            cpus = wh.lease_resources.get("CPU", 0.0)
+            if cpus > 0:
+                wh.blocked_cpus = cpus
+                self._release_resources({"CPU": cpus}, wh.lease_bundle)
+        return True
+
+    async def h_worker_unblocked(self, conn, p):
+        """The blocked get returned: take the CPU back. The pool may go
+        NEGATIVE here (other work was granted the freed CPU meanwhile) —
+        that's deliberate oversubscription-then-backpressure, matching the
+        reference: no new grants until the pool recovers, but the resumed
+        task is never made to wait for its own CPU (deadlock)."""
+        wh = self.workers.get(p["worker_id"])
+        if wh is None or wh.blocked_depth <= 0:
+            return False
+        wh.blocked_depth -= 1
+        if wh.blocked_depth == 0 and wh.blocked_cpus:
+            cpus, wh.blocked_cpus = wh.blocked_cpus, 0.0
+            pool = None
+            if wh.lease_bundle is not None:
+                bundle = self.bundles.get(wh.lease_bundle)
+                if bundle is not None:
+                    pool = bundle["available"]
+            if pool is None:
+                pool = self.resources_available
+            pool["CPU"] = pool.get("CPU", 0.0) - cpus
         return True
 
     # --------------------------------------------------------------- actors --

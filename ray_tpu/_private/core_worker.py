@@ -48,6 +48,13 @@ from .streaming import ObjectRefGenerator, StreamState, item_object_id
 
 logger = logging.getLogger("ray_tpu.core_worker")
 
+# Set (by worker_main._run_sync) on executor threads while USER task code
+# runs: a get()/wait() that is about to block on such a thread notifies
+# the node agent so the lease's CPU is released for queued work
+# (reference: NotifyDirectCallTaskBlocked, core_worker.cc — deadlock
+# avoidance for tasks that block on results of tasks they submitted).
+task_exec_tls = threading.local()
+
 # In-flight pushes per leased worker.  A granted lease still RUNS one
 # task at a time (the worker's task lock serializes execution, matching
 # reference semantics); a small pipeline hides the push/reply round trip
@@ -164,6 +171,9 @@ class CoreWorker:
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
         self._recovering: Dict[bytes, asyncio.Future] = {}
         self._cancelled: set = set()               # task ids cancelled
+        # task_id -> asyncio.Task finishing a deferred submission (fn
+        # export / dep resolution); _cancel interrupts these directly.
+        self._resolving: Dict[bytes, asyncio.Task] = {}
         # task_id -> StreamState for in-flight streaming generators we own.
         self._streams: Dict[bytes, StreamState] = {}
         self._inflight_tasks: Dict[bytes, _Lease] = {}        # normal tasks
@@ -761,7 +771,7 @@ class CoreWorker:
 
     def _next_put_id(self) -> bytes:
         # Minted from the driver thread (submit_actor_task) and the loop
-        # thread (put/_resolve_args) alike: always under the lock.
+        # thread (put/_store_big_puts) alike: always under the lock.
         with self._seq_lock:
             self._put_counter += 1
             idx = self._put_counter
@@ -850,8 +860,36 @@ class CoreWorker:
             raise TypeError(
                 f"get() accepts ObjectRef or a list of ObjectRefs; got "
                 f"{type(bad[0]).__name__}")
-        values = self._run(self._get_many(refs, timeout))
+        release = self._maybe_release_cpu(refs)
+        try:
+            values = self._run(self._get_many(refs, timeout))
+        finally:
+            if release:
+                self._notify_agent_blocked(False)
         return values[0] if single else values
+
+    def _maybe_release_cpu(self, refs) -> bool:
+        """In-task blocking get/wait on an executor thread: tell the agent
+        to free this lease's CPU while we wait (reference:
+        NotifyDirectCallTaskBlocked).  Only when some ref isn't already
+        local — an all-hit get never round-trips the agent."""
+        if not getattr(task_exec_tls, "active", False):
+            return False
+        if all(self.memory_store.contains(r.binary()) for r in refs):
+            return False
+        return self._notify_agent_blocked(True)
+
+    def _notify_agent_blocked(self, blocked: bool) -> bool:
+        agent = getattr(self, "agent", None)
+        if agent is None or agent.closed:
+            return False
+        method = "worker_blocked" if blocked else "worker_unblocked"
+        try:
+            asyncio.run_coroutine_threadsafe(
+                agent.call(method, {"worker_id": self.worker_id}), self.loop)
+        except RuntimeError:          # loop shutting down
+            return False
+        return True
 
     async def get_async(self, ref: ObjectRef, timeout=None):
         return (await self._get_many([ref], timeout))[0]
@@ -1195,7 +1233,14 @@ class CoreWorker:
 
     # ----------------------------------------------------------------- wait --
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
-        return self._run(self._wait(refs, num_returns, timeout))
+        # timeout=0 polls return immediately — never worth the agent
+        # round trip (and repeated release/reacquire churn).
+        release = timeout != 0 and self._maybe_release_cpu(refs)
+        try:
+            return self._run(self._wait(refs, num_returns, timeout))
+        finally:
+            if release:
+                self._notify_agent_blocked(False)
 
     async def _wait(self, refs, num_returns, timeout):
         """Event-driven wait (reference: raylet WaitManager — no polling):
@@ -1289,10 +1334,17 @@ class CoreWorker:
                     fn_blob: Optional[bytes] = None,
                     generator_backpressure: int = 0,
                     sched_key: Optional[bytes] = None) -> List[ObjectRef]:
+        """Submit a normal task. NEVER blocks on dependencies: refs are
+        minted and returned immediately; pending ObjectRef args resolve on
+        the io loop and the task joins the lease queue when they're ready
+        (reference: normal_task_submitter.cc + dependency_resolver.cc —
+        submission is asynchronous end to end). Sync-safe from any thread,
+        including the event loop."""
         num_returns, streaming = self._parse_streaming(
             num_returns, generator_backpressure)
         if sched_key is None:
-            # Caller didn't pre-package: do it here (memoized).
+            # Caller didn't pre-package: do it here (memoized; raises on
+            # the loop thread only for not-yet-cached working_dir uploads).
             runtime_env = self.package_runtime_env_cached(runtime_env)
         refs = self._try_submit_fast(
             fn_id=fn_id, args=args, kwargs=kwargs, num_returns=num_returns,
@@ -1302,12 +1354,12 @@ class CoreWorker:
             sched_key=sched_key)
         if refs is not None:
             return refs
-        return self._run(self.submit_task_async(
+        return self._submit_task_deferred(
             fn=fn, fn_id=fn_id, args=args, kwargs=kwargs,
             num_returns=num_returns, resources=resources,
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env, name=name, fn_blob=fn_blob,
-            streaming=streaming))
+            streaming=streaming, sched_key=sched_key)
 
     def _try_submit_fast(self, *, fn_id, args, kwargs, num_returns,
                          resources, max_retries, scheduling_strategy,
@@ -1329,7 +1381,8 @@ class CoreWorker:
                 return None          # dependency resolution needs the loop
             # Best-effort size probe before pickling: buffers/arrays/
             # strings that can't inline would otherwise be serialized
-            # here AND again by _resolve_args on the slow path.  (Large
+            # here AND again by _build_arg_entries_sync on the slow path.
+            # (Large
             # containers without a cheap size still pay double pickling.)
             approx = (len(a) if isinstance(a, (bytes, bytearray, str))
                       else getattr(a, "nbytes", 0))
@@ -1402,25 +1455,31 @@ class CoreWorker:
             state.pump_scheduled = True
             self.loop.call_soon(self._deferred_pump, key, state)
 
-    async def submit_task_async(self, *, fn, fn_id, args, kwargs, num_returns,
-                                resources, max_retries,
-                                scheduling_strategy=None, runtime_env=None,
-                                name="", fn_blob=None,
-                                streaming=None,
-                                generator_backpressure: int = 0
-                                ) -> List[ObjectRef]:
-        if streaming is None:
-            num_returns, streaming = self._parse_streaming(
-                num_returns, generator_backpressure)
+    def _submit_task_deferred(self, *, fn, fn_id, args, kwargs, num_returns,
+                              resources, max_retries, scheduling_strategy,
+                              runtime_env, name, fn_blob, streaming,
+                              sched_key) -> List[ObjectRef]:
+        """Slow-path submission (ref args / oversized args / unexported
+        fn) without blocking the caller: args serialize on the CALLING
+        thread (post-call mutation is safe, matching the fast path and
+        actor submission), refs return immediately, and a loop coroutine
+        exports the function, stores oversized args, awaits pending deps,
+        then enqueues + pumps (reference: dependency_resolver.cc — the
+        task enters the lease queue only once its deps exist)."""
+        ctx = get_context()
         if fn_id is None or fn_id not in self._fn_cache:
-            fn_id = await self._export_function(fn, fn_id=fn_id,
-                                                blob=fn_blob)
+            if fn_blob is None:
+                fn_blob = ctx.dumps_code(fn)
+                fn_id = protocol.function_id(fn_blob)
+        export = (fn, fn_id, fn_blob) if fn_id not in self._fn_cache \
+            else None
+        entries, ref_args, borrowed_args, big_puts = \
+            self._build_arg_entries_sync(args, kwargs)
         task_id = TaskID.for_normal_task(JobID(self.job_id)).binary()
-        arg_entries, ref_args, borrowed_args = await self._resolve_args(
-            args, kwargs)
         spec = protocol.make_task_spec(
             task_id=task_id, job_id=self.job_id, fn_id=fn_id,
-            args=arg_entries, nreturns=num_returns, owner_addr=list(self.address),
+            args=entries, nreturns=num_returns,
+            owner_addr=list(self.address),
             resources=resources, retries_left=max_retries,
             scheduling_strategy=scheduling_strategy, runtime_env=runtime_env,
             name=name or getattr(fn, "__name__", ""), streaming=streaming)
@@ -1433,17 +1492,60 @@ class CoreWorker:
             self.register_stream(task_id, streaming["bp"],
                                  expected_attempt=max_retries)
             refs = [ObjectRefGenerator(self, task_id, refs[0])]
-        for oid in ref_args:
-            self.reference_counter.add_submitted(oid)
-        key = protocol.scheduling_key(fn_id, resources, scheduling_strategy,
-                                      runtime_env)
-        state = self._keys.get(key)
-        if state is None:
-            state = self._keys[key] = _KeyState(resources, scheduling_strategy,
-                                                runtime_env)
-        state.queue.append(_PendingTask(spec, ref_args, borrowed_args))
-        self._pump(key, state)
+        key = sched_key if sched_key is not None else \
+            protocol.scheduling_key(fn_id, resources, scheduling_strategy,
+                                    runtime_env)
+        task = _PendingTask(spec, ref_args, borrowed_args)
         self.record_task_event(task_id, spec["name"], "SUBMITTED")
+
+        async def _finish():
+            try:
+                if export is not None:
+                    try:
+                        await self._export_function(
+                            export[0], fn_id=export[1], blob=export[2])
+                    except Exception as e:
+                        self._store_task_exception(spec, exc.RayError(
+                            f"function export failed: {e}"))
+                        self._release_task_pins(task)
+                        return
+                if big_puts or any("ref" in e for e in spec["args"]):
+                    if not await self._resolve_task_args(spec, task,
+                                                         big_puts):
+                        return
+            except asyncio.CancelledError:
+                # ray_tpu.cancel() while deps were resolving (_cancel
+                # cancels this coroutine): resolve the returns NOW instead
+                # of whenever the dep lands.
+                self._store_task_exception(spec, exc.TaskCancelledError(
+                    f"{spec['name']} cancelled"))
+                self._release_task_pins(task)
+                return
+            finally:
+                self._resolving.pop(task_id, None)
+            if task_id in self._cancelled:
+                # Cancelled while deps were resolving: never enqueue.
+                self._cancelled.discard(task_id)
+                self._store_task_exception(spec, exc.TaskCancelledError(
+                    f"{spec['name']} cancelled"))
+                self._release_task_pins(task)
+                return
+            state = self._keys.get(key)
+            if state is None:
+                state = self._keys[key] = _KeyState(
+                    resources, scheduling_strategy, runtime_env)
+            state.queue.append(task)
+            self._schedule_pump(key, state)
+
+        def _start():
+            # Registered BEFORE the coroutine first runs (same loop tick):
+            # _cancel finds in-resolution tasks here.
+            self._resolving[task_id] = self._spawn(_finish())
+
+        if self._on_loop_thread():
+            _start()
+        else:
+            self._post_to_loop(_start)
         return refs
 
     async def _export_function(self, fn, fn_id=None, blob=None) -> bytes:
@@ -1457,65 +1559,6 @@ class CoreWorker:
                 "overwrite": False})
             self._fn_cache[fn_id] = fn
         return fn_id
-
-    async def _resolve_args(self, args, kwargs
-                            ) -> Tuple[list, List[bytes], List[tuple]]:
-        """Inline small/available values; pass big ones by reference
-        (reference: dependency_resolver.cc inlining rules). Refs nested
-        inside arg values are pinned for the task's flight: owned ones as
-        submitted pins, borrowed ones via escape_pin at their owner."""
-        entries = []
-        ref_args: List[bytes] = []
-        borrowed_args: List[tuple] = []
-        ctx = get_context()
-        items = [("", a) for a in args] + list(kwargs.items())
-        for kw, a in items:
-            if isinstance(a, ObjectRef):
-                resolved = await self._resolve_ref_arg(a)
-                entry = dict(resolved)
-                if "ref" in entry:
-                    ref_args.append(a.binary())
-            else:
-                ctx.capture = captured = []
-                try:
-                    parts = ctx.serialize(a)
-                finally:
-                    ctx.capture = None
-                size = ctx.total_size(parts)
-                if size <= self._inline_limit:
-                    entry = {"v": protocol.concat_parts(parts)}
-                    for noid, nowner in captured:
-                        if nowner is None:
-                            ref_args.append(noid)  # caller adds submitted pin
-                        else:
-                            self._notify_owner(nowner, "escape_pin", noid)
-                            borrowed_args.append((noid, nowner))
-                else:
-                    oid = self._next_put_id()
-                    self.reference_counter.add_owned(oid)
-                    self._record_contained(oid, captured)
-                    await self._put_plasma(oid, parts)
-                    entry = {"ref": [oid, list(self.address),
-                                     list(self.agent_address)]}
-                    ref_args.append(oid)
-            if kw:
-                entry["kw"] = kw
-            entries.append(entry)
-        return entries, ref_args, borrowed_args
-
-    async def _resolve_ref_arg(self, ref: ObjectRef) -> dict:
-        oid = ref.binary()
-        owner = ref.owner_address or self.address
-        if tuple(owner) == self.address:
-            entry = await self.memory_store.wait_for(oid)  # waits for pending
-            if entry.is_exception:
-                # Dependency failed: propagate the stored exception by value.
-                return {"v": entry.data}
-            if entry.data is not None:
-                return {"v": entry.data}
-            return {"ref": [oid, list(owner), list(entry.plasma_node)]}
-        # Borrowed ref: let the executor resolve it via the owner.
-        return {"ref": [oid, list(owner), None]}
 
     def _pump(self, key: bytes, state: _KeyState):
         """Dispatch queued tasks onto leased workers; grow leases on demand
@@ -2167,6 +2210,13 @@ class CoreWorker:
                 "force=True is not supported for actor tasks (it would kill "
                 "the whole actor); use ray_tpu.kill(actor) instead")
         self._cancelled.add(task_id)
+        # Still resolving dependencies: cancel the deferred-submission
+        # coroutine; its CancelledError path stores TaskCancelledError.
+        fin = self._resolving.pop(task_id, None)
+        if fin is not None:
+            self._cancelled.discard(task_id)
+            fin.cancel()
+            return True
         # Still queued at the owner: drop it before it ever dispatches.
         for state in self._keys.values():
             for t in list(state.queue):
@@ -2499,7 +2549,7 @@ class CoreWorker:
                         self._resolve_and_push_actor_task(state, spec,
                                                           task, big_puts))
                     continue
-                if not await self._resolve_actor_task_args(spec, task,
+                if not await self._resolve_task_args(spec, task,
                                                            big_puts):
                     continue
                 self._spawn(
@@ -2512,14 +2562,14 @@ class CoreWorker:
             if state.submit_queue:
                 self._schedule_actor_drain(state)
 
-    async def _resolve_actor_task_args(self, spec, task, big_puts) -> bool:
-        """Submitter-side dependency resolution for owned ref args
-        (reference: dependency_resolver.cc — the task is not pushed until
-        its deps exist): pending results are awaited, small values
-        inlined, plasma locations stamped.  Keeps the callee's execution
-        slot free while deps materialize and removes the callee-side
-        fetch timeout from the path.  Returns False (task failed) on a
-        put/resolve error."""
+    async def _resolve_task_args(self, spec, task, big_puts) -> bool:
+        """Submitter-side dependency resolution for owned ref args, shared
+        by normal-task and actor-task submission (reference:
+        dependency_resolver.cc — the task is not pushed until its deps
+        exist): pending results are awaited, small values inlined, plasma
+        locations stamped.  Keeps the callee's execution slot free while
+        deps materialize and removes the callee-side fetch timeout from
+        the path.  Returns False (task failed) on a put/resolve error."""
         try:
             await self._store_big_puts(spec["args"], big_puts)
             for e in spec["args"]:
@@ -2541,7 +2591,7 @@ class CoreWorker:
                     e["ref"][2] = list(entry.plasma_node)
         except Exception as e:  # put/resolve failed: fail this task
             self._store_task_exception(spec, exc.RayError(
-                f"failed to resolve actor-task arg: {e}"))
+                f"failed to resolve task arg: {e}"))
             self._release_task_pins(task)
             return False
         return True
@@ -2550,7 +2600,7 @@ class CoreWorker:
                                            big_puts):
         """Out-of-order path: resolve deps independently, push when
         ready."""
-        if await self._resolve_actor_task_args(spec, task, big_puts):
+        if await self._resolve_task_args(spec, task, big_puts):
             await self._push_actor_task(state, spec, task)
 
     async def _actor_conn(self, state: _ActorState) -> rpc.Connection:

@@ -68,6 +68,10 @@ class Executor:
         # Normal-task queue (chunked execution under the task lock).
         self._task_q: deque = deque()
         self._task_draining = False
+        # Chunks popped from the queues and currently executing: cancel
+        # must still find their not-yet-started entries (see
+        # _resolve_queued_cancel).
+        self._active_chunks: list = []
 
     # ------------------------------------------------------------ helpers ---
     async def _load_function(self, fn_id: bytes):
@@ -340,6 +344,7 @@ class Executor:
                     chunk.append((spec, fut))
                 if not chunk:
                     continue
+                self._active_chunks.append(chunk)
                 try:
                     async with self._task_lock:
                         replies = await self._execute_chunk(chunk, gate)
@@ -348,6 +353,8 @@ class Executor:
                     # still resolve every popped future, or the submitter's
                     # push RPCs hang forever with their lease slots held.
                     replies = [self._error_reply(e)] * len(chunk)
+                finally:
+                    self._active_chunks.remove(chunk)
                 for (spec, fut), reply in zip(chunk, replies):
                     if not fut.done():
                         fut.set_result(reply)
@@ -453,6 +460,8 @@ class Executor:
         next Python bytecode, not inside a blocking C call)."""
         with self._thread_guard:
             self._running_threads[task_id] = threading.get_ident()
+        from .core_worker import task_exec_tls
+        task_exec_tls.active = True     # blocking get/wait here releases CPU
         try:
             if spec is not None and spec.get("trace"):
                 # Span set HERE (the executing thread), not around the
@@ -474,6 +483,7 @@ class Executor:
                 "cancellation exception delivered to an uncancelled task "
                 "(thread-reuse race)") from None
         finally:
+            task_exec_tls.active = False
             with self._thread_guard:
                 self._running_threads.pop(task_id, None)
 
@@ -892,6 +902,31 @@ class Executor:
         return {"pid": os.getpid(), "samples": samples,
                 "stacks": [{"stack": k, "count": v} for k, v in top]}
 
+    def _resolve_queued_cancel(self, task_id: bytes) -> bool:
+        """Pull a still-queued task out of the chunked-drain queues and
+        resolve its push future as cancelled. True if found."""
+        for q in (self._task_q, self._serial_q):
+            for item in q:
+                if item[0]["task_id"] == task_id:
+                    q.remove(item)
+                    if not item[1].done():
+                        item[1].set_result({"status": "cancelled"})
+                    return True
+        # Already popped into an executing chunk but not yet started:
+        # resolve the push reply NOW (the caller must not wait for the
+        # chunk's long predecessors) and leave a _cancel_requested mark so
+        # the chunk's executor-thread check skips the body.  Best-effort
+        # race like the reference's: a task between that check and its
+        # _running registration may still run, with its reply discarded.
+        for chunk in self._active_chunks:
+            for spec, fut in chunk:
+                if spec["task_id"] == task_id and not fut.done() \
+                        and task_id not in self._running:
+                    self._cancel_requested.add(task_id)
+                    fut.set_result({"status": "cancelled"})
+                    return True
+        return False
+
     async def h_cancel_task(self, conn, p):
         """Cancel a task (reference: CoreWorkerService CancelTask,
         core_worker.proto:531). Async actor methods: cancel the coroutine.
@@ -905,16 +940,21 @@ class Executor:
             if task_id in self._running:
                 asyncio.get_running_loop().call_later(
                     0.05, lambda: os._exit(1))
-            else:
+            elif not self._resolve_queued_cancel(task_id):
                 # Not dispatched yet: honor the cancel at dispatch instead
                 # of letting the task run after cancel() returned True.
                 self._cancel_requested.add(task_id)
             return True
         entry = self._running.get(task_id)
         if entry is None:
-            # Not running yet (queued behind the lock / semaphore, or the
-            # push hasn't arrived): mark for cancellation at dispatch.
-            self._cancel_requested.add(task_id)
+            # Queued behind the serial lock / pipelined behind a long
+            # task: resolve its push reply NOW — the caller's get() must
+            # not wait for the drain to reach it behind a 30s task
+            # (reference: queued tasks cancel immediately out of the
+            # scheduling queue, task_receiver.cc). Otherwise (push not
+            # arrived yet) mark for cancellation at dispatch.
+            if not self._resolve_queued_cancel(task_id):
+                self._cancel_requested.add(task_id)
             return True
         task, is_async = entry
         if is_async:

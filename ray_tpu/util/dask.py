@@ -1,0 +1,178 @@
+"""Dask-on-ray_tpu scheduler shim.
+
+Equivalent of the reference's Dask-on-Ray scheduler (reference:
+python/ray/util/dask/scheduler.py — `ray_dask_get` plugs into
+``dask.compute(..., scheduler=ray_dask_get)``): each task in a dask graph
+becomes one framework task, graph edges become ObjectRef dependencies, and
+results flow through the object store instead of the dask callback pool.
+
+The dask graph protocol is plain data (dict of key -> computation, where a
+computation is a ``(callable, *args)`` tuple, a key reference, a literal,
+or a nested list of computations — see docs.dask.org/en/stable/spec.html),
+so this module has NO import-time dask dependency: it works with
+hand-written graphs in environments without dask and with real dask
+collections when dask is installed (``dask.compute(x, scheduler=ray_dask_get)``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+from ray_tpu.object_ref import ObjectRef
+
+__all__ = ["ray_dask_get"]
+
+
+def _istask(x: Any) -> bool:
+    return isinstance(x, tuple) and bool(x) and callable(x[0])
+
+
+def _iskey(x: Any, dsk: Dict) -> bool:
+    try:
+        return x in dsk
+    except TypeError:
+        return False
+
+
+class _Dep:
+    """Placeholder for an upstream-key ObjectRef hoisted to a top-level
+    task arg: the submitter resolves top-level refs BEFORE dispatch
+    (core_worker._resolve_task_args), so the worker never blocks inside
+    the task on ray_tpu.get — the reference's dask scheduler unpacks refs
+    the same way so Ray's dependency tracking sees them."""
+    __slots__ = ("i",)
+
+    def __init__(self, i: int):
+        self.i = i
+
+
+@ray_tpu.remote
+def _dask_task(fn, args: List[Any], *deps):
+    """Execute one dask task: splice hoisted dependency values back into
+    the arg structure and inline nested sub-tasks, exactly like dask's
+    local scheduler walks SubgraphCallable args."""
+
+    def _res(x):
+        if isinstance(x, _Dep):
+            return deps[x.i]
+        if isinstance(x, ObjectRef):
+            return ray_tpu.get(x)    # ref smuggled in a literal (rare)
+        if _istask(x):
+            return x[0](*[_res(a) for a in x[1:]])
+        if isinstance(x, list):
+            return [_res(i) for i in x]
+        if isinstance(x, tuple):
+            return tuple(_res(i) for i in x)
+        return x
+
+    return fn(*[_res(a) for a in args])
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_kwargs):
+    """Compute dask graph ``dsk`` for ``keys`` on the cluster.
+
+    Matches the dask ``get`` signature so it drops into
+    ``dask.compute(..., scheduler=ray_dask_get)`` / ``DataFrame.compute``;
+    extra dask kwargs are accepted and ignored. ``keys`` may be a single
+    key or (arbitrarily nested) lists of keys, per the dask spec.
+    """
+    refs: Dict[Hashable, Any] = {}
+
+    def _dep_scan(x, acc: set):
+        """Keys referenced by a computation (structure-depth recursion
+        only — nested literals are shallow; KEY-chain depth is handled
+        iteratively below, so thousand-key linear graphs don't blow the
+        interpreter recursion limit)."""
+        if _iskey(x, dsk):
+            acc.add(x)
+        elif _istask(x):
+            for a in x[1:]:
+                _dep_scan(a, acc)
+        elif isinstance(x, (list, tuple)):
+            for i in x:
+                _dep_scan(i, acc)
+
+    def _subst(x):
+        """Replace key references with their built ObjectRefs/literals
+        (all deps are present by post-order); nested task tuples stay
+        intact for in-task inlining."""
+        if _iskey(x, dsk):
+            return refs[x]
+        if _istask(x):
+            return (x[0],) + tuple(_subst(a) for a in x[1:])
+        if isinstance(x, list):
+            return [_subst(i) for i in x]
+        if isinstance(x, tuple):
+            return tuple(_subst(i) for i in x)
+        return x
+
+    def _hoist(x, deps: List[Any]):
+        """Replace graph-dep ObjectRefs in the substituted structure with
+        _Dep placeholders, collecting the refs as top-level args (resolved
+        pre-dispatch by the submitter, so workers never block on them)."""
+        if isinstance(x, ObjectRef):
+            deps.append(x)
+            return _Dep(len(deps) - 1)
+        if _istask(x):
+            return (x[0],) + tuple(_hoist(a, deps) for a in x[1:])
+        if isinstance(x, list):
+            return [_hoist(i, deps) for i in x]
+        if isinstance(x, tuple):
+            return tuple(_hoist(i, deps) for i in x)
+        return x
+
+    def _submit(comp) -> Any:
+        if _istask(comp):
+            deps: List[Any] = []
+            args = [_hoist(_subst(a), deps) for a in comp[1:]]
+            return _dask_task.remote(comp[0], args, *deps)
+        if _iskey(comp, dsk):
+            return refs[comp]
+        if isinstance(comp, list):
+            return [_submit(c) for c in comp]
+        return comp                      # literal
+
+    def _build(key) -> Any:
+        """Iterative post-order DFS: explicit stack instead of recursion
+        so linear key chains of arbitrary length schedule fine."""
+        if key in refs:
+            return refs[key]
+        gray: set = set()                # on the current DFS path
+        stack = [(key, False)]
+        while stack:
+            k, processed = stack.pop()
+            if k in refs:
+                continue
+            if processed:
+                gray.discard(k)
+                refs[k] = _submit(dsk[k])
+                continue
+            if k in gray:
+                raise ValueError(f"cycle in dask graph at {k!r}")
+            gray.add(k)
+            stack.append((k, True))
+            deps: set = set()
+            _dep_scan(dsk[k], deps)
+            for d in deps:
+                if d not in refs:
+                    stack.append((d, False))
+        return refs[key]
+
+    def _fetch(x):
+        if isinstance(x, ObjectRef):
+            return ray_tpu.get(x)
+        if isinstance(x, list):
+            return [_fetch(i) for i in x]
+        return x
+
+    single = not isinstance(keys, list)
+    want = [keys] if single else keys
+
+    def _result(k):
+        if isinstance(k, list):          # nested key lists (dask spec)
+            return [_result(i) for i in k]
+        return _fetch(_build(k))
+
+    out = [_result(k) for k in want]
+    return out[0] if single else out

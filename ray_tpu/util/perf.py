@@ -234,6 +234,21 @@ def bench_put_gigabytes(min_time_s: float,
     return chunks_per_s * chunk_mb / 1024.0
 
 
+def bench_get_containing_10k_refs(min_time_s: float,
+                                  n_refs: int = 10_000) -> float:
+    """Gets/s of ONE object whose value contains 10k ObjectRefs
+    (reference: ray_perf.py 'single client get object containing 10k
+    refs') — exercises nested-ref deserialization + containment pins."""
+    refs = [ray_tpu.put(i) for i in range(n_refs)]
+    container = ray_tpu.put(refs)
+
+    def run():
+        inner = ray_tpu.get(container)
+        assert len(inner) == n_refs
+        return 1
+    return _timeit(run, min_time_s)
+
+
 def bench_wait_many_refs(min_time_s: float, n_refs: int = 1000) -> float:
     refs = [ray_tpu.put(i) for i in range(n_refs)]
 
@@ -270,6 +285,7 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "single_client_get_calls": bench_get_calls,
     "single_client_put_gigabytes": bench_put_gigabytes,
     "single_client_wait_1k_refs": bench_wait_many_refs,
+    "single_client_get_object_containing_10k_refs": bench_get_containing_10k_refs,
     "placement_group_create_removal": bench_pg_create_removal,
 }
 
@@ -288,6 +304,7 @@ BASELINE = {
     "single_client_get_calls": 4031.0,
     "single_client_put_gigabytes": 18.3,
     "single_client_wait_1k_refs": 4.4,
+    "single_client_get_object_containing_10k_refs": 11.3,
     "placement_group_create_removal": 666.0,
 }
 
@@ -295,6 +312,7 @@ UNITS = {
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "single_client_wait_1k_refs": "waits/s (1k refs)",
+    "single_client_get_object_containing_10k_refs": "gets/s (10k refs)",
     "placement_group_create_removal": "pg/s",
 }
 

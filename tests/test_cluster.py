@@ -32,7 +32,12 @@ def test_two_nodes_spillback(cluster):
         time.sleep(t)
         return os.getpid()
 
-    pids = set(ray_tpu.get([hold.options(num_cpus=2).remote(0.5)
+    # 1.5s holds: even on a loaded 1-core CI host the saturated first
+    # node's parked requests get several 1s spillback re-evaluations
+    # while the first wave still runs, so the overflow reliably reaches
+    # node 2 (0.5s holds could drain entirely on node 1 via fast
+    # lease turnover before its agent ever looked sideways).
+    pids = set(ray_tpu.get([hold.options(num_cpus=2).remote(1.5)
                             for _ in range(4)], timeout=60))
     assert len(pids) >= 2   # ran on both nodes' workers
 

@@ -1,0 +1,67 @@
+"""Scalability-envelope smoke tests (reference: release/benchmarks single
+node suite — BASELINE.md 'scalability envelope': 1M+ queued tasks, 10k+
+object args, 3k+ returns from one task, 10k+ plasma objects in one get).
+
+Scaled to CI budgets but structurally identical: each test exercises the
+same pressure point (submission queue growth, arg-spec fan-in, multi-return
+bookkeeping, many-object get) — the knobs are counts, not mechanisms, so a
+regression that breaks the envelope shows up here as a timeout/error rather
+than a slow nightly.
+"""
+
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _noop(*args):
+    return None
+
+
+def test_many_queued_tasks(ray_start_regular):
+    """50k tasks queued at once on one node drain without error
+    (reference envelope: 1M tasks on a 64-core node in 186.8s)."""
+    t0 = time.monotonic()
+    refs = [_noop.remote() for _ in range(50_000)]
+    ray_tpu.get(refs, timeout=600)
+    dt = time.monotonic() - t0
+    # Generous ceiling: catches O(n^2) queue behavior, not slow hosts.
+    assert dt < 300, f"50k queued tasks took {dt:.0f}s"
+
+
+def test_many_object_args_single_task(ray_start_regular):
+    """One task taking 2k ObjectRef args (reference envelope: 10k+ args,
+    18s) — exercises per-arg dependency resolution + pinning."""
+    args = [ray_tpu.put(i) for i in range(2_000)]
+
+    @ray_tpu.remote
+    def count(*xs):
+        return len(xs)
+
+    assert ray_tpu.get(count.remote(*args), timeout=300) == 2_000
+
+
+def test_many_returns_single_task(ray_start_regular):
+    """One task with 1k return objects (reference envelope: 3k+ returns,
+    6.4s)."""
+    n = 1_000
+
+    @ray_tpu.remote
+    def burst():
+        return tuple(range(n))
+
+    refs = burst.options(num_returns=n).remote()
+    vals = ray_tpu.get(list(refs), timeout=300)
+    assert vals == list(range(n))
+
+
+def test_get_many_small_objects(ray_start_regular):
+    """ray.get of 10k put objects in one call (reference envelope: 10k+
+    plasma objects in one get, 25.5s)."""
+    refs = [ray_tpu.put(i) for i in range(10_000)]
+    t0 = time.monotonic()
+    vals = ray_tpu.get(refs, timeout=300)
+    dt = time.monotonic() - t0
+    assert vals == list(range(10_000))
+    assert dt < 60, f"10k-object get took {dt:.0f}s"

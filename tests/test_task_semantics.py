@@ -21,10 +21,39 @@ def test_cancel_queued_task(ray_start_regular):
     # Saturate the 4 CPUs, then queue one more and cancel it.
     blockers = [slow.options(num_cpus=1).remote() for _ in range(4)]
     victim = slow.options(num_cpus=1).remote()
-    time.sleep(0.5)
+    time.sleep(1.5)   # let the blockers actually dispatch on slow CI hosts
     ray_tpu.cancel(victim)
     with pytest.raises(exc.TaskCancelledError):
-        ray_tpu.get(victim, timeout=10)
+        ray_tpu.get(victim, timeout=20)
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
+
+
+def test_cancel_task_pipelined_behind_long_task(ray_start_regular):
+    """A task PUSHED to a worker but queued behind a long-running one must
+    cancel immediately — the worker pulls it out of its serial queue and
+    resolves the push reply, instead of replying only when the drain
+    reaches it 30s later (reference: queued tasks cancel straight out of
+    the scheduling queue, task_receiver.cc)."""
+    @ray_tpu.remote
+    def napper(t):
+        time.sleep(t)
+        return t
+
+    # Prime the scheduling key's latency EMA with fast calls so the
+    # submitter deep-pipelines subsequent ones onto the same lease.
+    ray_tpu.get([napper.remote(0.001) for _ in range(30)])
+    blockers = [napper.options(num_cpus=1).remote(30)
+                for _ in range(4)]
+    victims = [napper.options(num_cpus=1).remote(30) for _ in range(4)]
+    time.sleep(1.0)
+    t0 = time.monotonic()
+    for v in victims:
+        ray_tpu.cancel(v)
+    for v in victims:
+        with pytest.raises(exc.TaskCancelledError):
+            ray_tpu.get(v, timeout=15)
+    assert time.monotonic() - t0 < 15, "cancel waited for the blocker"
     for b in blockers:
         ray_tpu.cancel(b, force=True)
 
@@ -164,7 +193,7 @@ def test_killed_actor_releases_cached_leases(ray_start_regular):
     # The burst makes the actor's core worker cache several leases.
     assert ray_tpu.get(b.burst.remote(20), timeout=120) == 20
     ray_tpu.kill(b)
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 60
     avail = None
     while time.monotonic() < deadline:
         avail = ray_tpu.available_resources().get("CPU")
@@ -226,3 +255,84 @@ def test_out_of_order_actor_submit_queue(ray_start_regular):
     assert ray_tpu.get(b.get_order.remote(), timeout=10) == \
         ["slow", "fast"]
     ray_tpu.kill(b)
+
+
+def test_submit_never_blocks_on_pending_dep(ray_start_regular):
+    """.remote(pending_ref) must return immediately — dependency
+    resolution happens on the io loop, not the calling thread
+    (reference: dependency_resolver.cc; submission is async end to
+    end)."""
+    @ray_tpu.remote
+    def slow_src():
+        time.sleep(5)
+        return 1
+
+    @ray_tpu.remote
+    def add1(x):
+        return x + 1
+
+    src = slow_src.remote()
+    t0 = time.monotonic()
+    out = add1.remote(src)
+    assert time.monotonic() - t0 < 1.0, "submission blocked on the dep"
+    # A whole chain hanging off the pending source also submits instantly.
+    t0 = time.monotonic()
+    for _ in range(50):
+        out = add1.remote(out)
+    assert time.monotonic() - t0 < 1.0
+    assert ray_tpu.get(out, timeout=60) == 52
+
+
+def test_cancel_while_dep_resolving(ray_start_regular):
+    @ray_tpu.remote
+    def slow_src():
+        time.sleep(30)
+        return 1
+
+    @ray_tpu.remote
+    def add1(x):
+        return x + 1
+
+    src = slow_src.remote()
+    victim = add1.remote(src)
+    assert ray_tpu.cancel(victim)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(victim, timeout=15)
+    ray_tpu.cancel(src, force=True)
+
+
+def test_blocked_get_releases_cpu():
+    """In-task ray_tpu.get releases the worker's CPU so the child can run
+    on a fully-saturated node (reference: NotifyDirectCallTaskBlocked —
+    classic nested-task deadlock avoidance)."""
+    import ray_tpu as rt
+    if rt.is_initialized():
+        rt.shutdown()            # needs its OWN 1-CPU cluster
+    rt.init(num_cpus=1)
+    try:
+        @rt.remote
+        def child():
+            return 42
+
+        @rt.remote
+        def parent():
+            return rt.get(child.remote(), timeout=30)
+
+        @rt.remote
+        def grandparent():
+            return rt.get(parent.remote(), timeout=40) + 1
+
+        assert rt.get(parent.remote(), timeout=60) == 42
+        # Two levels of nesting on one CPU: two concurrent releases.
+        assert rt.get(grandparent.remote(), timeout=60) == 43
+        # The ledger balances once everything unwinds.
+        deadline = time.monotonic() + 30
+        avail = None
+        while time.monotonic() < deadline:
+            avail = rt.available_resources().get("CPU")
+            if avail == 1.0:
+                break
+            time.sleep(0.25)
+        assert avail == 1.0, f"CPU accounting drifted: {avail}"
+    finally:
+        rt.shutdown()
