@@ -219,6 +219,7 @@ class NodeAgent:
             "create_actor_worker": self.h_create_actor_worker,
             "actor_worker_died": self.h_actor_worker_died,
             "prepare_bundle": self.h_prepare_bundle,
+            "reserve_bundles": self.h_reserve_bundles,
             "commit_bundle": self.h_commit_bundle,
             "return_bundle": self.h_return_bundle,
             "pin_object": self.h_pin_object,
@@ -1012,14 +1013,42 @@ class NodeAgent:
         return True
 
     # ------------------------------------------------------ placement groups --
-    async def h_prepare_bundle(self, conn, p):
-        key = (p["pg_id"], p["bundle_index"])
+    def _reserve_one(self, pg_id: bytes, bundle_index: int,
+                     resources: Dict[str, float]) -> Optional[bool]:
+        """Acquire + record ONE PG bundle reservation. Returns True on a
+        fresh reservation, None when already present (idempotent retry),
+        False when resources don't fit."""
+        key = (pg_id, bundle_index)
         if key in self.bundles:
-            return True
-        if not self._try_acquire(p["resources"]):
+            return None
+        if not self._try_acquire(resources):
             return False
-        self.bundles[key] = {"total": dict(p["resources"]),
-                             "available": dict(p["resources"])}
+        self.bundles[key] = {"total": dict(resources),
+                             "available": dict(resources)}
+        return True
+
+    async def h_prepare_bundle(self, conn, p):
+        return self._reserve_one(p["pg_id"], p["bundle_index"],
+                                 p["resources"]) is not False
+
+    async def h_reserve_bundles(self, conn, p):
+        """Single-node PG fast path: prepare+commit every bundle in ONE
+        RPC.  The two-phase protocol exists for cross-node atomicity
+        (reference: node_manager.proto:471-476); with all bundles on one
+        node there is no second participant, so the round trips collapse.
+        All-or-nothing: a failed acquire rolls back this call's own
+        reservations (bundles already present from a retried call are
+        kept)."""
+        acquired = []
+        for b in p["bundles"]:
+            got = self._reserve_one(p["pg_id"], b["bundle_index"],
+                                    b["resources"])
+            if got is False:
+                for k in acquired:
+                    self._release_resources(self.bundles.pop(k)["total"])
+                return False
+            if got:
+                acquired.append((p["pg_id"], b["bundle_index"]))
         return True
 
     async def h_commit_bundle(self, conn, p):

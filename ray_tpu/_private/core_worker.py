@@ -26,6 +26,7 @@ bridges via run_coroutine_threadsafe; workers run the loop in the foreground
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import os
 import threading
@@ -861,12 +862,54 @@ class CoreWorker:
                 f"get() accepts ObjectRef or a list of ObjectRefs; got "
                 f"{type(bad[0]).__name__}")
         release = self._maybe_release_cpu(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
         try:
+            if single:
+                hit, value = self._try_get_sync(refs[0], timeout)
+                if hit:
+                    return value
+                # Fallback continues on the SAME deadline — the sync wait
+                # above already consumed part of the caller's budget.
+                if deadline is not None:
+                    timeout = max(0.0, deadline - time.monotonic())
             values = self._run(self._get_many(refs, timeout))
         finally:
             if release:
                 self._notify_agent_blocked(False)
         return values[0] if single else values
+
+    def _try_get_sync(self, ref: ObjectRef, timeout) -> Tuple[bool, Any]:
+        """Loop-free get of ONE owned inline object: park the calling
+        thread on a concurrent future the memory store resolves directly
+        from its _wake — no call_soon_threadsafe wake, no Task, no gather
+        (reference: the Cython get blocks on a C++ future; this is the
+        Python-plane equivalent of that zero-loop hop).  Returns
+        (False, None) to fall back for anything needing loop IO (plasma
+        reads, borrowed refs, recovery)."""
+        if self._on_loop_thread():
+            return False, None           # must not block the loop
+        owner = ref.owner_address
+        if owner is not None and tuple(owner) != self.address:
+            return False, None           # borrowed: owner RPC path
+        ms = self.memory_store
+        oid = ref.binary()
+        entry = ms.get(oid)
+        if entry is None:
+            fut = ms.add_sync_waiter(oid)
+            if fut is not None:
+                try:
+                    fut.result(timeout)
+                except concurrent.futures.TimeoutError:
+                    ms.discard_sync_waiter(oid, fut)
+                    raise exc.GetTimeoutError(
+                        f"timed out getting {oid.hex()}") from None
+            entry = ms.get(oid)
+        if entry is None or entry.data is None:
+            return False, None           # plasma-resident: loop IO path
+        value = get_context().deserialize(memoryview(entry.data))
+        if isinstance(value, exc.RayError):
+            raise value
+        return True, value
 
     def _maybe_release_cpu(self, refs) -> bool:
         """In-task blocking get/wait on an executor thread: tell the agent

@@ -847,11 +847,54 @@ class GcsServer:
         reference keeps infeasible PGs pending forever too)."""
         pg_id = entry["pg_id"]
         bundles = entry["bundle_specs"]
+
+        async def _return(idx, node):
+            try:
+                await node.conn.call("return_bundle", {
+                    "pg_id": pg_id, "bundle_index": idx})
+            except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
+                pass
+
+        async def _finalize(chosen) -> None:
+            """Every bundle is reserved on its node: flip to CREATED — or,
+            if the PG was removed mid-placement, hand everything back."""
+            if entry["state"] != "PENDING":
+                await asyncio.gather(
+                    *[_return(i, n) for i, n in enumerate(chosen)])
+                return
+            entry["bundles"] = [
+                {"node_id": n.node_id, "resources": b,
+                 "node_addr": list(n.address)}
+                for b, n in zip(bundles, chosen)]
+            entry["state"] = "CREATED"
+            self._log("pg", entry)
+            self._pg_event(pg_id).set()
+            self._publish(protocol.CH_PG,
+                          {"event": "created", "pg_id": pg_id})
+
         while entry["state"] == "PENDING":
             chosen = self._place_bundles(bundles, entry["strategy"])
             if chosen is None:
                 await asyncio.sleep(0.2)
                 continue
+            if len({n.node_id for n in chosen}) == 1:
+                # Single-node placement: prepare+commit collapse into ONE
+                # agent RPC (no cross-node atomicity to coordinate) — the
+                # dominant shape for small PGs and single-host gangs.
+                node = chosen[0]
+                try:
+                    ok = await node.conn.call("reserve_bundles", {
+                        "pg_id": pg_id,
+                        "bundles": [{"bundle_index": i, "resources": b}
+                                    for i, b in enumerate(bundles)]},
+                        timeout=30)
+                except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
+                    ok = False
+                if not ok:
+                    await asyncio.sleep(0.2)
+                    continue
+                await _finalize(chosen)
+                return
             # Phase 1: prepare on every node IN PARALLEL; roll back on any
             # failure (a 64-bundle Train worker group pays one agent round
             # trip, not 64).
@@ -862,13 +905,6 @@ class GcsServer:
                         "resources": bundle}, timeout=30)
                 except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
                     return False
-
-            async def _return(idx, node):
-                try:
-                    await node.conn.call("return_bundle", {
-                        "pg_id": pg_id, "bundle_index": idx})
-                except (rpc.RpcError, AttributeError, asyncio.TimeoutError):
-                    pass
 
             oks = await asyncio.gather(
                 *[_prepare(i, b, n)
@@ -898,23 +934,7 @@ class GcsServer:
                 await asyncio.gather(*[_return(i, n) for i, n in prepared])
                 await asyncio.sleep(0.2)
                 continue
-            if entry["state"] != "PENDING":     # removed mid-placement
-                for idx, node in prepared:
-                    try:
-                        await node.conn.call("return_bundle", {
-                            "pg_id": pg_id, "bundle_index": idx})
-                    except rpc.RpcError:
-                        pass
-                return
-            entry["bundles"] = [
-                {"node_id": n.node_id, "resources": b,
-                 "node_addr": list(n.address)}
-                for b, n in zip(bundles, chosen)]
-            entry["state"] = "CREATED"
-            self._log("pg", entry)
-            self._pg_event(pg_id).set()
-            self._publish(protocol.CH_PG,
-                          {"event": "created", "pg_id": pg_id})
+            await _finalize(chosen)
             return
 
     def _place_bundles(self, bundles, strategy) -> Optional[List[NodeInfo]]:

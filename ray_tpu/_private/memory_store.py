@@ -11,6 +11,8 @@ the hot path. Supports async waiters so `get` (and owner-served
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import threading
 from typing import Dict, List, Optional, Tuple
 
 
@@ -33,6 +35,14 @@ class MemoryStore:
         # Event+Task pairs (the reference amortizes the same way in C++ —
         # GetAsync callbacks on a single request context).
         self._gwaiters: Dict[bytes, List[list]] = {}
+        # Cross-THREAD waiters (concurrent.futures, resolved directly from
+        # _wake on the loop thread): a sync get() on one pending owned ref
+        # parks its calling thread here instead of round-tripping a
+        # coroutine through call_soon_threadsafe — saves a self-pipe wake,
+        # a Task, and a gather per call on the 1:1 sync hot path
+        # (reference: the Cython get blocks on a C++ future the same way).
+        self._sync_waiters: Dict[bytes, list] = {}
+        self._sync_lock = threading.Lock()
 
     def put_inline(self, object_id: bytes, data: bytes, is_exception=False):
         self._objects[object_id] = _Entry(data, is_exception)
@@ -45,6 +55,13 @@ class MemoryStore:
     def _wake(self, object_id: bytes):
         for ev in self._waiters.pop(object_id, []):
             ev.set()
+        if self._sync_waiters:
+            with self._sync_lock:
+                sw = self._sync_waiters.pop(object_id, None)
+            if sw:
+                for f in sw:
+                    if not f.done():
+                        f.set_result(True)
         gw = self._gwaiters.pop(object_id, None)
         if gw:
             entry = self._objects.get(object_id)
@@ -59,6 +76,38 @@ class MemoryStore:
 
     def get(self, object_id: bytes) -> Optional[_Entry]:
         return self._objects.get(object_id)
+
+    def add_sync_waiter(self, object_id: bytes
+                        ) -> Optional[concurrent.futures.Future]:
+        """Register a cross-thread waiter for a pending object, or return
+        None when the object is already present (caller re-reads).
+
+        Safe against the lock-free `if self._sync_waiters:` pre-check in
+        _wake: that check can run while this thread is mid-registration
+        (dict still empty) and skip the pop — so after registering we
+        re-check _objects and, if the entry landed meanwhile, withdraw the
+        future and let the caller read directly.  Entries land in _objects
+        BEFORE _wake runs, so one of the two sides always sees the
+        other."""
+        with self._sync_lock:
+            if object_id in self._objects:
+                return None
+            f: concurrent.futures.Future = concurrent.futures.Future()
+            self._sync_waiters.setdefault(object_id, []).append(f)
+        if object_id in self._objects:
+            # Entry landed during registration; _wake may have missed us.
+            self.discard_sync_waiter(object_id, f)
+            return None
+        return f
+
+    def discard_sync_waiter(self, object_id: bytes, fut) -> None:
+        with self._sync_lock:
+            lst = self._sync_waiters.get(object_id)
+            if lst is not None:
+                if fut in lst:
+                    lst.remove(fut)
+                if not lst:
+                    del self._sync_waiters[object_id]
 
     def contains(self, object_id: bytes) -> bool:
         return object_id in self._objects
