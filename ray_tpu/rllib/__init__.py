@@ -9,21 +9,29 @@ learner_group.py:101), PPO (algorithms/ppo/ppo.py).
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .appo import APPO, APPOConfig, AppoLearner
+from .connectors import (ClipRewards, Connector, ConnectorPipeline,
+                         FlattenObs, FrameStack, NormalizeObs)
 from .dqn import DQN, DQNConfig, DQNLearner
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import (IMPALA, AggregatorActor, IMPALAConfig, ImpalaLearner,
                      vtrace)
 from .learner import Learner, LearnerGroup, compute_gae
+from .offline import (BC, MARWIL, BCConfig, BCLearner, MARWILConfig,
+                      episodes_to_batch)
 from .ppo import PPO, PPOConfig
 from .replay_buffers import (EpisodeReplayBuffer, PrioritizedReplayBuffer,
                              ReplayBuffer)
 from .rl_module import RLModule, RLModuleSpec
+from .sac import SAC, SACConfig, SACLearner
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "AggregatorActor", "APPO",
-    "APPOConfig", "AppoLearner", "DQN", "DQNConfig",
+    "APPOConfig", "AppoLearner", "BC", "BCConfig", "BCLearner",
+    "ClipRewards", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
     "DQNLearner", "EnvRunner", "EnvRunnerGroup", "EpisodeReplayBuffer",
-    "IMPALA", "IMPALAConfig", "ImpalaLearner", "Learner", "LearnerGroup",
-    "PrioritizedReplayBuffer", "ReplayBuffer", "compute_gae", "PPO",
+    "FlattenObs", "FrameStack", "IMPALA", "IMPALAConfig", "ImpalaLearner",
+    "Learner", "LearnerGroup", "MARWIL", "MARWILConfig", "NormalizeObs",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "SAC", "SACConfig",
+    "SACLearner", "compute_gae", "episodes_to_batch", "PPO",
     "PPOConfig", "RLModule", "RLModuleSpec", "vtrace",
 ]

@@ -42,6 +42,11 @@ class AlgorithmConfig:
         self.train_config: Dict[str, Any] = {}
         self.hiddens = (64, 64)
         self.seed = 0
+        # Connector pipelines (reference: ConnectorV2): env_to_module
+        # runs in every EnvRunner before inference; learner_connectors
+        # run in the Learner on each sample batch before the update.
+        self.env_to_module = None
+        self.learner_connectors: Optional[list] = None
 
     # ------------------------------------------------------------ sections --
     def environment(self, env: str) -> "AlgorithmConfig":
@@ -50,14 +55,16 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
-                    ) -> "AlgorithmConfig":
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module=None) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module is not None:
+            self.env_to_module = env_to_module
         return self
 
     def training(self, *, lr: Optional[float] = None,
@@ -101,6 +108,8 @@ class AlgorithmConfig:
     def learner_config_dict(self) -> Dict[str, Any]:
         cfg = {"lr": self.lr, "gamma": self.gamma}
         cfg.update(self.train_config)
+        if self.learner_connectors:
+            cfg.setdefault("learner_connectors", self.learner_connectors)
         return cfg
 
 
@@ -127,7 +136,7 @@ class Algorithm:
             num_env_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_env_runner,
             seed=config.seed, runner_resources=config.runner_resources,
-            gamma=config.gamma)
+            gamma=config.gamma, env_to_module=config.env_to_module)
 
     @staticmethod
     def _module_spec_kwargs(config: AlgorithmConfig) -> Dict[str, Any]:
@@ -136,6 +145,9 @@ class Algorithm:
         obs_dim = int(np.prod(probe.observation_space.shape))
         num_actions = int(probe.action_space.n)
         probe.close()
+        if config.env_to_module is not None:
+            # The module sees connector-space observations.
+            obs_dim = config.env_to_module.transform_obs_dim(obs_dim)
         return {"obs_dim": obs_dim, "num_actions": num_actions,
                 "hiddens": config.hiddens}
 

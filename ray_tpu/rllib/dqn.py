@@ -81,6 +81,7 @@ class DQNLearner(Learner):
         import jax
         import jax.numpy as jnp
 
+        batch = self._apply_learner_connectors(batch)
         jb = {
             "obs": jnp.asarray(batch["obs"]),
             "next_obs": jnp.asarray(batch["next_obs"]),

@@ -79,7 +79,8 @@ class EnvRunner:
     """
 
     def __init__(self, env_name: str, spec_kwargs: Dict[str, Any],
-                 num_envs: int, seed: int, gamma: float = 0.99):
+                 num_envs: int, seed: int, gamma: float = 0.99,
+                 env_to_module=None):
         import jax
 
         self.module = RLModuleSpec(**spec_kwargs).build()
@@ -88,39 +89,73 @@ class EnvRunner:
         self.key = jax.random.key(seed)
         self._explore = jax.jit(self.module.forward_exploration)
         self._greedy = jax.jit(self.module.forward_inference)
+        self._sample_pi = jax.jit(self.module.forward_sample)
         self._value_only = jax.jit(
             lambda p, o: self.module.logits_and_value(p, o)[1])
         self._np_rng = np.random.default_rng(seed)
+        # Env-to-module connector pipeline (reference: ConnectorV2):
+        # observations are transformed BEFORE inference and the
+        # TRANSFORMED arrays are what's recorded — module and learner
+        # always see connector-space observations.
+        self.e2m = env_to_module
+        # Dones from the LAST step of the previous fragment: instance
+        # state, so an episode ending on a fragment's final step still
+        # resets stateful connectors at the next fragment's first step.
+        self._last_dones = None
+
+    def _obs_in(self, obs, dones=None) -> np.ndarray:
+        if self.e2m is None:
+            return obs.astype(np.float32)
+        return self.e2m({"obs": obs}, {"dones": dones})["obs"]
+
+    def _obs_peek(self, obs) -> np.ndarray:
+        """Same-episode lookahead transform (bootstrap / next_obs reads):
+        never advances connector state."""
+        if self.e2m is None:
+            return np.asarray(obs, np.float32)
+        return self.e2m.peek({"obs": np.asarray(obs)})["obs"]
 
     def sample(self, weights, rollout_len: int) -> Dict[str, Any]:
         import jax
         import jax.numpy as jnp
 
         obs_l, act_l, logp_l, vf_l, rew_l, done_l = [], [], [], [], [], []
+        bonus_l = []
         obs = self.vec.obs
         for _ in range(rollout_len):
+            t_obs = self._obs_in(obs, self._last_dones)
             self.key, sub = jax.random.split(self.key)
             actions, logp, value = self._explore(
-                weights, jnp.asarray(obs, jnp.float32), sub)
+                weights, jnp.asarray(t_obs), sub)
             actions = np.asarray(actions)
-            obs_l.append(obs.astype(np.float32))
+            obs_l.append(t_obs)
             act_l.append(actions)
             logp_l.append(np.asarray(logp))
             vf_l.append(np.asarray(value))
             obs, rewards, dones, truncs, final_obs = self.vec.step(actions)
+            self._last_dones = dones
+            bonus = np.zeros(len(rewards), np.float32)
             if truncs.any():
-                # Truncation bootstrap: fold gamma * V(s_T) into the final
-                # reward so GAE's terminal cut doesn't bias value targets
-                # toward zero at time limits.
-                fin = np.stack([final_obs[i] for i in np.where(truncs)[0]])
+                # Truncation bootstrap: gamma * V(s_T) at time-limit cuts
+                # so the value target doesn't bias toward zero.  Shipped
+                # SEPARATELY from the raw rewards — learner connectors
+                # (e.g. reward clipping) must see the env's rewards, not
+                # the bootstrap, which the learner adds back after them.
+                # Peek on the FULL [N] batch (stateful connectors keep
+                # [N]-row history), then select the truncated rows.
+                full = obs.astype(np.float32).copy()
+                for i in np.where(truncs)[0]:
+                    full[i] = final_obs[i]
+                fin = self._obs_peek(full)[truncs]
                 v_fin = np.asarray(self._value_only(
                     weights, jnp.asarray(fin, jnp.float32)))
-                rewards = rewards.copy()
-                rewards[truncs] += self.gamma * v_fin
+                bonus[truncs] = self.gamma * v_fin
             rew_l.append(rewards)
+            bonus_l.append(bonus)
             done_l.append(dones)
+        final_t = self._obs_peek(obs)
         bootstrap = np.asarray(self._value_only(
-            weights, jnp.asarray(obs, jnp.float32)))
+            weights, jnp.asarray(final_t, jnp.float32)))
         return {
             # [T, N, ...] time-major stacks
             "obs": np.stack(obs_l),
@@ -128,12 +163,13 @@ class EnvRunner:
             "logp": np.stack(logp_l),
             "vf": np.stack(vf_l),
             "rewards": np.stack(rew_l),
+            "trunc_bonus": np.stack(bonus_l),
             "dones": np.stack(done_l),
             "bootstrap_value": bootstrap,
-            # Raw final observations: off-policy learners (V-trace)
-            # recompute the bootstrap value with CURRENT params instead
-            # of trusting the stale runner-side vf.
-            "final_obs": obs.astype(np.float32),
+            # Final observations (connector space): off-policy learners
+            # (V-trace) recompute the bootstrap value with CURRENT params
+            # instead of trusting the stale runner-side vf.
+            "final_obs": final_t,
             "episode_returns": self.vec.drain_returns(),
         }
 
@@ -152,25 +188,37 @@ class EnvRunner:
         episodes."""
         import jax.numpy as jnp
 
+        import jax
+
         rows_obs, rows_next, rows_act, rows_rew = [], [], [], []
         rows_done, rows_reset = [], []
         obs = self.vec.obs
         n_envs = obs.shape[0]
         rng = self._np_rng
         for _ in range(n_steps):
-            greedy = np.asarray(self._greedy(
-                weights, jnp.asarray(obs, jnp.float32)))
-            explore = rng.random(n_envs) < epsilon
-            actions = np.where(
-                explore, rng.integers(0, self.module.spec.num_actions,
-                                      n_envs), greedy)
-            prev_obs = obs.astype(np.float32)
+            t_obs = self._obs_in(obs, self._last_dones)
+            if epsilon < 0:
+                # Stochastic-policy exploration (SAC): sample from pi
+                # itself; entropy regularization replaces epsilon noise.
+                self.key, sub = jax.random.split(self.key)
+                actions = np.asarray(self._sample_pi(
+                    weights, jnp.asarray(t_obs, jnp.float32), sub))
+            else:
+                greedy = np.asarray(self._greedy(
+                    weights, jnp.asarray(t_obs, jnp.float32)))
+                explore = rng.random(n_envs) < epsilon
+                actions = np.where(
+                    explore, rng.integers(0, self.module.spec.num_actions,
+                                          n_envs), greedy)
             obs, rewards, dones, truncs, final_obs = self.vec.step(actions)
+            self._last_dones = dones
             next_obs = obs.astype(np.float32)  # astype = private copy
             for i in np.where(truncs)[0]:
                 next_obs[i] = final_obs[i]
-            rows_obs.append(prev_obs)
-            rows_next.append(next_obs)
+            # Same-episode lookahead transform: state advances only at the
+            # next iteration's _obs_in (done rows there reset the stack).
+            rows_obs.append(t_obs)
+            rows_next.append(self._obs_peek(next_obs))
             rows_act.append(actions)
             rows_rew.append(rewards)
             rows_done.append(dones & ~truncs)
@@ -196,14 +244,18 @@ class EnvRunnerGroup:
     def __init__(self, *, env_name: str, spec_kwargs: Dict[str, Any],
                  num_env_runners: int, num_envs_per_runner: int, seed: int,
                  runner_resources: Optional[dict] = None,
-                 gamma: float = 0.99):
+                 gamma: float = 0.99, env_to_module=None):
         res = dict(runner_resources or {})
+        # Each runner gets its OWN connector instance (cloudpickled with
+        # the actor args): per-runner state like NormalizeObs statistics
+        # is independent, matching the reference's per-EnvRunner
+        # connector copies.
         self.runners = [
             EnvRunner.options(
                 num_cpus=res.get("num_cpus", 1),
                 resources=res.get("resources")).remote(
                 env_name, spec_kwargs, num_envs_per_runner,
-                seed + 10_000 * i, gamma)
+                seed + 10_000 * i, gamma, env_to_module)
             for i in range(num_env_runners)]
 
     def sample(self, weights_ref, rollout_len: int) -> List[Dict[str, Any]]:

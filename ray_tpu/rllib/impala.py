@@ -109,11 +109,17 @@ class ImpalaLearner(Learner):
         import jax.numpy as jnp
 
         episode_returns = list(batch.pop("episode_returns", []))
+        batch = self._apply_learner_connectors(batch)
+        rewards = batch["rewards"]
+        if "trunc_bonus" in batch:
+            # Re-add the truncation bootstrap AFTER connectors (reward
+            # clipping must never clip the gamma*V(s_T) term).
+            rewards = rewards + batch["trunc_bonus"]
         jb = {
             "obs": jnp.asarray(batch["obs"]),
             "actions": jnp.asarray(batch["actions"]),
             "logp_mu": jnp.asarray(batch["logp"]),
-            "rewards": jnp.asarray(batch["rewards"]),
+            "rewards": jnp.asarray(rewards),
             "dones": jnp.asarray(batch["dones"]),
             "final_obs": jnp.asarray(batch["final_obs"]),
         }
@@ -136,7 +142,8 @@ class AggregatorActor:
         episode_returns: List[float] = []
         for s in samples:
             episode_returns.extend(s.get("episode_returns", []))
-        keys = ("obs", "actions", "logp", "rewards", "dones")
+        keys = ("obs", "actions", "logp", "rewards", "trunc_bonus",
+                "dones")
         out = {k: np.concatenate([s[k] for s in samples], axis=1)
                for k in keys}                      # [T, sum(B), ...]
         out["final_obs"] = np.concatenate(

@@ -87,6 +87,15 @@ class Learner:
         metrics["total_loss"] = loss
         return params, opt_state, metrics
 
+    def _apply_learner_connectors(self, data: Dict[str, Any]
+                                  ) -> Dict[str, Any]:
+        """Learner-side connector pipeline (reference: ConnectorV2 learner
+        pipelines — e.g. reward clipping) applied to each batch before the
+        jitted update."""
+        for c in self.cfg.get("learner_connectors") or []:
+            data = c(data, None)
+        return data
+
     def update(self, samples: List[Dict[str, Any]]) -> Dict[str, float]:
         """One PPO update over the collected rollouts: GAE -> flatten ->
         num_epochs x minibatch SGD (reference: Learner.update driving
@@ -96,8 +105,14 @@ class Learner:
         gamma = self.cfg.get("gamma", 0.99)
         lam = self.cfg.get("lambda_", 0.95)
         obs, actions, logp_old, advs, rets = [], [], [], [], []
+        samples = [self._apply_learner_connectors(s) for s in samples]
         for s in samples:
-            adv, ret = compute_gae(s["rewards"], s["vf"], s["dones"],
+            rewards = s["rewards"]
+            if "trunc_bonus" in s:
+                # Truncation bootstrap re-added AFTER connectors so e.g.
+                # reward clipping never clips the gamma*V(s_T) term.
+                rewards = rewards + s["trunc_bonus"]
+            adv, ret = compute_gae(rewards, s["vf"], s["dones"],
                                    s["bootstrap_value"], gamma, lam)
             obs.append(s["obs"].reshape(-1, s["obs"].shape[-1]))
             actions.append(s["actions"].reshape(-1))

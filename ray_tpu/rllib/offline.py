@@ -1,0 +1,204 @@
+"""Offline RL: behavior cloning (BC) and advantage-weighted imitation
+(MARWIL) over recorded episodes.
+
+Reference surface: python/ray/rllib/algorithms/bc/bc.py and
+algorithms/marwil/marwil.py (+ offline/offline_data.py feeding recorded
+episodes through learner connectors).  TPU-native design: both losses are
+single jitted programs over flat minibatches; the offline data pipeline
+is host-side numpy (episodes -> flat arrays with Monte-Carlo returns
+computed once at load), optionally sourced from a ray_tpu.data.Dataset so
+large corpora stream through the object store instead of the driver.
+
+Episode format: a dict with "obs" [T, D] float, "actions" [T] int, and
+(MARWIL) "rewards" [T] float.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner import Learner
+
+
+def episodes_to_batch(episodes: List[Dict[str, np.ndarray]],
+                      gamma: float) -> Dict[str, np.ndarray]:
+    """Flatten episodes into one supervised batch with per-step
+    Monte-Carlo returns-to-go (the MARWIL advantage baseline target)."""
+    obs, actions, returns = [], [], []
+    for ep in episodes:
+        T = len(ep["actions"])
+        obs.append(np.asarray(ep["obs"], np.float32))
+        actions.append(np.asarray(ep["actions"], np.int64))
+        rew = np.asarray(ep.get("rewards", np.zeros(T)), np.float32)
+        rtg = np.zeros(T, np.float32)
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            acc = rew[t] + gamma * acc
+            rtg[t] = acc
+        returns.append(rtg)
+    return {"obs": np.concatenate(obs),
+            "actions": np.concatenate(actions),
+            "returns": np.concatenate(returns)}
+
+
+class BCLearner(Learner):
+    """Negative-log-likelihood imitation (reference: bc_torch_learner);
+    beta > 0 turns it into MARWIL's exp(beta * advantage) weighting with
+    the value head as the learned baseline (reference:
+    marwil_torch_learner.py loss)."""
+
+    def _loss(self, params, batch):
+        import jax.numpy as jnp
+
+        logp, entropy, value = self.module.forward_train(
+            params, batch["obs"], batch["actions"])
+        beta = self.cfg.get("beta", 0.0)
+        if beta > 0.0:
+            import jax
+            adv = batch["returns"] - value
+            # MARWIL: vf regresses MC returns; the policy imitates with
+            # exp(beta * normalized advantage) weights (stop-grad: the
+            # weight is data, not a gradient path).
+            w = jnp.exp(beta * jax.lax.stop_gradient(
+                adv / (jnp.abs(adv).mean() + 1e-8)))
+            w = jnp.minimum(w, self.cfg.get("max_weight", 20.0))
+            pol = -(w * logp).mean()
+            vf = 0.5 * (adv ** 2).mean()
+        else:
+            pol = -logp.mean()
+            vf = 0.0 * value.mean()   # keep vf params in the graph
+        ent = entropy.mean()
+        total = (pol + self.cfg.get("vf_loss_coeff", 1.0) * vf
+                 - self.cfg.get("entropy_coeff", 0.0) * ent)
+        return total, {"policy_loss": pol, "vf_loss": vf, "entropy": ent}
+
+    def update_offline(self, batch: Dict[str, np.ndarray]
+                       ) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        batch = self._apply_learner_connectors(batch)
+        n = len(batch["actions"])
+        mb = min(self.cfg.get("minibatch_size", 256), n)
+        last: Dict[str, Any] = {}
+        for _ in range(self.cfg.get("num_epochs", 1)):
+            perm = self._rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                jb = {"obs": jnp.asarray(batch["obs"][idx]),
+                      "actions": jnp.asarray(batch["actions"][idx]),
+                      "returns": jnp.asarray(batch["returns"][idx])}
+                self.params, self.opt_state, last = self._step(
+                    self.params, self.opt_state, jb)
+        return {k: float(v) for k, v in last.items()}
+
+
+class BC(Algorithm):
+    """Offline imitation: no env runners; iterations draw minibatches
+    from the recorded corpus (reference: bc.py training_step over
+    OfflineData)."""
+
+    learner_class = BCLearner
+
+    def __init__(self, config: "BCConfig"):
+        # Deliberately NOT calling Algorithm.__init__: offline algorithms
+        # have no env-runner group (reference: BC overrides setup to skip
+        # sampling workers).  The env is probed only for module shapes.
+        self.config = config
+        self.iteration = 0
+        self._episode_returns: List[float] = []
+        from .learner import LearnerGroup
+        spec_kwargs = self._module_spec_kwargs(config)
+        self.learner_group = LearnerGroup(
+            spec_kwargs, config.learner_config_dict(),
+            num_learners=config.num_learners,
+            learner_resources=config.learner_resources, seed=config.seed,
+            learner_cls=self.learner_class)
+        self.env_runner_group = None
+        data = config.offline_data
+        if data is None:
+            raise ValueError("BCConfig.offline_data(...) is required")
+        if hasattr(data, "take_all"):
+            # ray_tpu.data.Dataset of episode rows: materialize through
+            # the object store (reference: OfflineData reads via Ray Data).
+            data = data.take_all()
+        data = list(data)       # materialize ONCE (generators iterate once)
+        self._batch = episodes_to_batch(data, config.gamma)
+        # MC return of each recorded episode, for reporting parity.
+        self._episode_returns = [
+            float(np.sum(np.asarray(ep.get("rewards", [0.0]))))
+            for ep in data]
+
+    def training_step(self) -> Dict[str, Any]:
+        if self.config.num_learners > 0:
+            import ray_tpu
+            return ray_tpu.get(
+                self.learner_group.learner.update_offline.remote(
+                    self._batch), timeout=600)
+        return self.learner_group.learner.update_offline(self._batch)
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy rollout of the learned policy in the probe env
+        (reference: Algorithm.evaluate with evaluation workers)."""
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        spec_kwargs = self._module_spec_kwargs(self.config)
+        from .rl_module import RLModuleSpec
+        module = RLModuleSpec(**spec_kwargs).build()
+        params = self.learner_group.get_weights()
+        greedy = jax.jit(module.forward_inference)
+        env = gym.make(self.config.env)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = int(np.asarray(greedy(
+                    params, jnp.asarray(obs[None], jnp.float32)))[0])
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+    def stop(self):
+        self.learner_group.stop()
+
+
+class BCConfig(AlgorithmConfig):
+    algo_class = BC
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data: Any = None
+        self.lr = 1e-3
+        self.train_config.update({"num_epochs": 1, "minibatch_size": 256,
+                                  "beta": 0.0})
+
+    # Fluent section matching the reference's offline_data() API.
+    def offline(self, data) -> "BCConfig":
+        if not hasattr(data, "take_all") and not isinstance(data, list):
+            # Materialize one-shot iterables NOW: build_algo() deepcopies
+            # the config, and generators can't be copied (or re-read).
+            data = list(data)
+        self.offline_data = data
+        return self
+
+
+class MARWILConfig(BCConfig):
+    """MARWIL = BC with exponential advantage weighting (reference:
+    marwil.py; beta=1 default, beta=0 degrades to plain BC)."""
+
+    def __init__(self):
+        super().__init__()
+        self.train_config.update({"beta": 1.0, "vf_loss_coeff": 1.0,
+                                  "num_epochs": 1})
+
+
+MARWIL = BC      # same driver loop; the loss switches on beta

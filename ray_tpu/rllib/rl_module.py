@@ -83,6 +83,12 @@ class RLModule:
         logits, _ = self.logits_and_value(params, obs)
         return jnp.argmax(logits, axis=-1)
 
+    def forward_sample(self, params, obs, key):
+        """Sample from the policy head ONLY (no value readout): the
+        exploration view for off-policy stochastic-policy algorithms
+        (SAC), whose learner params carry Q networks instead of `vf`."""
+        return jax.random.categorical(key, _mlp(params["pi"], obs))
+
     def forward_train(self, params, obs, actions):
         """(logp(actions), entropy, value) for the PPO loss."""
         logits, value = self.logits_and_value(params, obs)
