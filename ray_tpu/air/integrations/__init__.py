@@ -1,0 +1,13 @@
+"""Experiment-tracker integrations (reference: python/ray/air/integrations
+— wandb.py, mlflow.py, comet.py logger callbacks + setup_* helpers).
+
+Each integration imports its tracker lazily at first use, so the package
+is importable (and the rest of the framework fully functional) without
+any tracker installed.
+"""
+
+from .mlflow import MlflowLoggerCallback, setup_mlflow
+from .wandb import WandbLoggerCallback, setup_wandb
+
+__all__ = ["MlflowLoggerCallback", "WandbLoggerCallback", "setup_mlflow",
+           "setup_wandb"]

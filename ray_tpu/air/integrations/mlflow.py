@@ -1,0 +1,81 @@
+"""MLflow integration (reference: python/ray/air/integrations/mlflow.py —
+MLflowLoggerCallback + setup_mlflow).
+
+Lazy import: the tracker is resolved at setup time so the framework works
+without mlflow installed."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ...train.callbacks import UserCallback
+
+
+def _import_mlflow():
+    try:
+        import mlflow
+    except ImportError:
+        raise ImportError(
+            "mlflow is not installed. Install it (pip install mlflow) to "
+            "use MlflowLoggerCallback / setup_mlflow.") from None
+    return mlflow
+
+
+def setup_mlflow(config: Optional[Dict[str, Any]] = None, *,
+                 experiment_name: Optional[str] = None,
+                 tracking_uri: Optional[str] = None, **kwargs):
+    """Configure mlflow inside a Train worker / Tune trial (reference:
+    setup_mlflow) and start a run; returns the mlflow module."""
+    mlflow = _import_mlflow()
+    if tracking_uri:
+        mlflow.set_tracking_uri(tracking_uri)
+    if experiment_name:
+        mlflow.set_experiment(experiment_name)
+    mlflow.start_run(**kwargs)
+    if config:
+        mlflow.log_params(config)
+    return mlflow
+
+
+class MlflowLoggerCallback(UserCallback):
+    """Driver-side results -> an MLflow run (reference:
+    MLflowLoggerCallback)."""
+
+    def __init__(self, *, experiment_name: Optional[str] = None,
+                 tracking_uri: Optional[str] = None,
+                 tags: Optional[Dict[str, str]] = None,
+                 log_params: Optional[Dict[str, Any]] = None):
+        # Fail fast at construction: on_start exceptions are swallowed by
+        # the controller's best-effort callback dispatch (see wandb.py).
+        _import_mlflow()
+        self.experiment_name = experiment_name
+        self.tracking_uri = tracking_uri
+        self.tags = dict(tags or {})
+        self.log_params = dict(log_params or {})
+        self._mlflow = None
+        self._step = 0
+
+    def on_start(self, *, world_size: int, attempt: int) -> None:
+        if self._mlflow is not None:     # elastic restart: same run
+            return
+        self._mlflow = _import_mlflow()
+        if self.tracking_uri:
+            self._mlflow.set_tracking_uri(self.tracking_uri)
+        if self.experiment_name:
+            self._mlflow.set_experiment(self.experiment_name)
+        self._mlflow.start_run(tags=self.tags or None)
+        params = dict(self.log_params, world_size=world_size)
+        self._mlflow.log_params(params)
+
+    def on_report(self, *, metrics: Dict[str, Any], checkpoint=None
+                  ) -> None:
+        if self._mlflow is not None:
+            self._mlflow.log_metrics(
+                {k: float(v) for k, v in metrics.items()
+                 if isinstance(v, (int, float))}, step=self._step)
+            self._step += 1
+
+    def on_shutdown(self, *, result) -> None:
+        if self._mlflow is not None:
+            self._mlflow.end_run()
+            self._mlflow = None

@@ -1,0 +1,81 @@
+"""Weights & Biases integration (reference:
+python/ray/air/integrations/wandb.py — WandbLoggerCallback logging
+tune/train results, setup_wandb for in-worker use).
+
+The wandb module is imported lazily: constructing the callback without
+wandb installed raises a clear error at setup time, not at import time,
+and the module itself is injectable for tests."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ...train.callbacks import UserCallback
+
+
+def _import_wandb():
+    try:
+        import wandb
+    except ImportError:
+        raise ImportError(
+            "wandb is not installed. Install it (pip install wandb) to "
+            "use WandbLoggerCallback / setup_wandb.") from None
+    return wandb
+
+
+def setup_wandb(config: Optional[Dict[str, Any]] = None, *,
+                project: Optional[str] = None,
+                trial_name: Optional[str] = None, **kwargs):
+    """Initialize a wandb run inside a Train worker / Tune trial
+    (reference: air/integrations/wandb.py setup_wandb).  Returns the run
+    object; pass `rank_zero_only` semantics by calling from rank 0."""
+    wandb = _import_wandb()
+    return wandb.init(project=project, name=trial_name,
+                      config=dict(config or {}), **kwargs)
+
+
+class WandbLoggerCallback(UserCallback):
+    """Driver-side results -> wandb (reference: WandbLoggerCallback).
+
+    Attach via RunConfig(callbacks=[WandbLoggerCallback(project=...)]);
+    every rank-0 report lands as one wandb.log() step."""
+
+    def __init__(self, project: str, *, group: Optional[str] = None,
+                 name: Optional[str] = None, config: Optional[dict] = None,
+                 **init_kwargs):
+        # Fail fast HERE: the controller's callback dispatch is
+        # best-effort (a broken callback never kills the run), so a
+        # missing tracker raising in on_start would be logged and
+        # swallowed — the user must learn at construction time.
+        _import_wandb()
+        self.project = project
+        self.group = group
+        self.name = name
+        self.config = dict(config or {})
+        self.init_kwargs = init_kwargs
+        self._run = None
+        self._wandb = None
+
+    def on_start(self, *, world_size: int, attempt: int) -> None:
+        if self._run is not None:        # elastic restart: keep the run
+            return
+        self._wandb = _import_wandb()
+        self._run = self._wandb.init(
+            project=self.project, group=self.group, name=self.name,
+            config=dict(self.config, world_size=world_size),
+            **self.init_kwargs)
+
+    def on_report(self, *, metrics: Dict[str, Any], checkpoint=None
+                  ) -> None:
+        if self._run is not None:
+            self._wandb.log({k: v for k, v in metrics.items()
+                             if isinstance(v, (int, float))})
+
+    def on_failure(self, *, error: str, failure_count: int) -> None:
+        if self._run is not None:
+            self._wandb.log({"failure_count": failure_count})
+
+    def on_shutdown(self, *, result) -> None:
+        if self._run is not None:
+            self._run.finish()
+            self._run = None

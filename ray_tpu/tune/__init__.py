@@ -8,7 +8,7 @@ schedulers (ASHAScheduler), tune.report, ResultGrid.
 from ..train._session import get_checkpoint
 from ..train._session import report as _session_report
 from .schedulers import (ASHAScheduler, FIFOScheduler,
-                         PopulationBasedTraining)
+                         MedianStoppingRule, PopulationBasedTraining)
 from .search import (BayesOptSearch, Searcher, choice, grid_search,
                      loguniform, randint, uniform, generate_variants)
 from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneController,
@@ -25,6 +25,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "TuneController",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "generate_variants", "ASHAScheduler", "FIFOScheduler",
-    "PopulationBasedTraining", "report", "get_checkpoint",
+    "MedianStoppingRule", "PopulationBasedTraining", "report",
+    "get_checkpoint",
     "BayesOptSearch", "Searcher",
 ]

@@ -16,6 +16,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+
+
+def _metric_value(result: Dict[str, Any], metric: str, mode: str
+                  ) -> Optional[float]:
+    """Normalized metric read shared by every scheduler: None when
+    absent, negated under mode="min" so all comparisons maximize."""
+    v = result.get(metric)
+    if v is None:
+        return None
+    v = float(v)
+    return v if mode == "max" else -v
 # PBT: stop the current actor, clone config+checkpoint from a top trial,
 # restart in place (the controller drives the mechanics).
 EXPLOIT = "EXPLOIT"
@@ -70,11 +81,7 @@ class ASHAScheduler:
         self.rungs.sort(key=lambda r: -r.milestone)   # highest first
 
     def _value(self, result: Dict[str, Any]) -> Optional[float]:
-        v = result.get(self.metric)
-        if v is None:
-            return None
-        v = float(v)
-        return v if self.mode == "max" else -v
+        return _metric_value(result, self.metric, self.mode)
 
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
         t = result.get(self.time_attr)
@@ -95,6 +102,54 @@ class ASHAScheduler:
         return action
 
     def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric falls below the median
+    of other trials' running averages at comparable time (reference:
+    tune/schedulers/median_stopping_rule.py — the Vizier early-stopping
+    rule).  "Comparable time" = each competitor's mean over its FIRST k
+    reports, where k is the judged trial's report count — a late-started
+    trial is never compared against peers' deep-run averages.
+    Conservative by construction: a trial is only judged after
+    `grace_period` of its own time AND once `min_samples_required` other
+    trials have history."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        # trial_id -> list of normalized metric values in report order.
+        self._history: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        import statistics
+        t = result.get(self.time_attr)
+        v = _metric_value(result, self.metric, self.mode)
+        if t is None or v is None:
+            return CONTINUE
+        hist = self._history.setdefault(trial_id, [])
+        hist.append(v)
+        if t < self.grace_period:
+            return CONTINUE
+        k = len(hist)
+        others = [sum(h[:k]) / min(k, len(h))
+                  for tid, h in self._history.items()
+                  if tid != trial_id and h]
+        if len(others) < self.min_samples_required:
+            return CONTINUE
+        median = statistics.median(others)   # interpolated for even counts
+        mean_self = sum(hist) / k
+        return STOP if mean_self < median else CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        # Completed trials keep their history: they ARE the competition.
         pass
 
 
@@ -139,11 +194,7 @@ class PopulationBasedTraining:
 
     # ------------------------------------------------------------ internals
     def _value(self, result: Dict[str, Any]) -> Optional[float]:
-        v = result.get(self.metric)
-        if v is None:
-            return None
-        v = float(v)
-        return v if self.mode == "max" else -v
+        return _metric_value(result, self.metric, self.mode)
 
     def _quantiles(self) -> Tuple[List[str], List[str]]:
         ranked = sorted(self._scores, key=self._scores.__getitem__)
