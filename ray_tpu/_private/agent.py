@@ -1586,7 +1586,19 @@ async def _amain(args):
     stop = asyncio.Event()
     loop.add_signal_handler(signal.SIGTERM, stop.set)
     await stop.wait()
-    await agent.close()
+    # Bounded graceful drain, then hard exit: a SIGTERM'd agent must not
+    # outlive its deadline because some peer keeps a connection open
+    # (reference: raylet's graceful-shutdown deadline before _exit).
+    try:
+        await asyncio.wait_for(agent.close(), timeout=10)
+    except Exception:
+        # The arena unlink is close()'s last step — never skip it, or
+        # repeated agent restarts leak /dev/shm until the tmpfs fills.
+        try:
+            os.unlink(agent.store_path)
+        except OSError:
+            pass
+    os._exit(0)
 
 
 def main():

@@ -1581,9 +1581,14 @@ class CoreWorker:
             self._schedule_pump(key, state)
 
         def _start():
-            # Registered BEFORE the coroutine first runs (same loop tick):
-            # _cancel finds in-resolution tasks here.
-            self._resolving[task_id] = self._spawn(_finish())
+            # Eager task execution can run _finish to completion INSIDE
+            # this _spawn call (everything already resolved, no suspension
+            # point) — its finally-pop would then precede this assignment
+            # and a stale done-task entry would shadow the real pushed
+            # task from _cancel forever. Register only live coroutines.
+            t = self._spawn(_finish())
+            if not t.done():
+                self._resolving[task_id] = t
 
         if self._on_loop_thread():
             _start()
@@ -2255,8 +2260,10 @@ class CoreWorker:
         self._cancelled.add(task_id)
         # Still resolving dependencies: cancel the deferred-submission
         # coroutine; its CancelledError path stores TaskCancelledError.
+        # A done entry means the task moved on (enqueued/pushed) — fall
+        # through to the queue/in-flight paths below.
         fin = self._resolving.pop(task_id, None)
-        if fin is not None:
+        if fin is not None and not fin.done():
             self._cancelled.discard(task_id)
             fin.cancel()
             return True

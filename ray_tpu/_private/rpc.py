@@ -364,9 +364,17 @@ class RpcServer:
     async def close(self):
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # Client connections FIRST: since Python 3.12 wait_closed() waits
+        # for every per-connection handler to finish, and handlers of
+        # still-connected peers (e.g. a live GCS dialing this agent)
+        # otherwise pend forever — SIGTERM'd daemons hung in close().
         for c in list(self.connections):
             await c.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2)
+            except asyncio.TimeoutError:
+                pass
 
 
 class ReconnectingConnection:

@@ -332,11 +332,19 @@ class Executor:
                         if chunk:
                             break          # run the fast chunk first
                         q.popleft()
+                        # Visible to _resolve_queued_cancel while parked
+                        # behind the task lock (same contract as chunks):
+                        # a cancel must resolve the push reply NOW, not
+                        # when the 30s predecessor releases the lock.
+                        entry = [(spec, fut)]
+                        self._active_chunks.append(entry)
                         try:
                             async with self._task_lock:
                                 reply = await self._execute(spec)
                         except BaseException as e:  # noqa: BLE001
                             reply = self._error_reply(e)
+                        finally:
+                            self._active_chunks.remove(entry)
                         if not fut.done():
                             fut.set_result(reply)
                         continue

@@ -117,8 +117,17 @@ class Cluster:
         import ray_tpu
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
-        for node in list(self.nodes):
-            self.remove_node(node, allow_graceful=True)
+        # Parallel: signal every agent first, THEN reap — serial
+        # terminate+wait(5) per node made multi-node teardown O(nodes x
+        # agent-exit-time) and dominated fixture teardown on loaded hosts.
+        nodes, self.nodes = list(self.nodes), []
+        for node in nodes:
+            node.proc.terminate()
+        for node in nodes:
+            try:
+                node.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                node.proc.kill()
         if self.gcs_proc is not None:
             self.gcs_proc.terminate()
             try:
@@ -127,7 +136,7 @@ class Cluster:
                 self.gcs_proc.kill()
         # /dev/shm arenas are unlinked by the agents on SIGTERM; hard-killed
         # agents leave theirs behind until reboot — remove defensively.
-        for node in self.nodes:
+        for node in nodes:
             try:
                 os.unlink(node.store_path)
             except OSError:

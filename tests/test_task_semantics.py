@@ -336,3 +336,36 @@ def test_blocked_get_releases_cpu():
         assert avail == 1.0, f"CPU accounting drifted: {avail}"
     finally:
         rt.shutdown()
+
+
+def test_cancel_singleton_parked_behind_task_lock(ray_start_regular):
+    """A pushed task routed through the worker's SINGLETON execute path
+    (ref args fail the chunk gate) and parked behind the serial task
+    lock must cancel immediately — it is registered in _active_chunks
+    while waiting, so cancel resolves its push reply instead of waiting
+    for the 30s predecessor to release the lock."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def napper2(t, _pad=None):
+        time.sleep(t)
+        return t
+
+    # Warm the fn + prime a fast latency EMA so the submitter pipelines
+    # subsequent calls onto the granted leases.
+    ray_tpu.get([napper2.remote(0.001) for _ in range(20)])
+    big = ray_tpu.put(np.zeros(2_000_000, np.uint8))  # by-ref arg
+    blockers = [napper2.options(num_cpus=1).remote(30) for _ in range(4)]
+    time.sleep(1.0)
+    victims = [napper2.options(num_cpus=1).remote(30, big)
+               for _ in range(2)]
+    time.sleep(1.0)       # pushes land; victims park behind the lock
+    t0 = time.monotonic()
+    for v in victims:
+        ray_tpu.cancel(v)
+    for v in victims:
+        with pytest.raises(exc.TaskCancelledError):
+            ray_tpu.get(v, timeout=15)
+    assert time.monotonic() - t0 < 15, "cancel waited for the lock holder"
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
