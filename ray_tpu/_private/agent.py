@@ -223,6 +223,7 @@ class NodeAgent:
             "commit_bundle": self.h_commit_bundle,
             "return_bundle": self.h_return_bundle,
             "pin_object": self.h_pin_object,
+            "pin_transfer": self.h_pin_transfer,
             "unpin_object": self.h_unpin_object,
             "free_objects": self.h_free_objects,
             "fetch_from_store": self.h_fetch_from_store,
@@ -1078,6 +1079,19 @@ class NodeAgent:
         await self._maybe_spill_to_threshold()
         return True
 
+    async def h_pin_transfer(self, conn, p):
+        """Adopt a writer-held pin (one-way notify from the put/return hot
+        path). The writer stored with keep_pin, so one shm refcount is
+        already in place — this is pure bookkeeping: record it as an owner
+        pin so unpin/free release it, exactly as if h_pin_object had taken
+        it. Spilled-to-disk primaries carry no shm refcount but use the
+        same pinned accounting (h_unpin_object/h_free_objects check
+        self.spilled before touching the store)."""
+        oid = p["object_id"]
+        self.pinned[oid] = self.pinned.get(oid, 0) + 1
+        await self._maybe_spill_to_threshold()
+        return True
+
     async def h_unpin_object(self, conn, p):
         oid = p["object_id"]
         n = self.pinned.get(oid, 0)
@@ -1614,6 +1628,8 @@ def main():
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)
+    from .node import install_daemon_profiler
+    install_daemon_profiler("agent")
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

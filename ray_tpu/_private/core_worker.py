@@ -809,22 +809,28 @@ class CoreWorker:
 
     async def _put_plasma(self, oid: bytes, parts):
         await self.store_with_backpressure(oid, parts)
-        await self.agent.call("pin_object", {"object_id": oid})
         self.memory_store.put_plasma_location(oid, list(self.agent_address))
 
     async def store_with_backpressure(self, oid: bytes, parts):
         """Create-queue backpressure (reference: plasma create_request_queue):
         on ENOMEM, ask the agent to spill pinned primaries and retry; an
         object that can never fit the arena spills straight to disk. Shared
-        by puts and large task returns."""
+        by puts and large task returns.
+
+        Pin transfer: the shm put keeps the writer's refcount and hands it
+        to the agent with a one-way pin_transfer notify — the object is
+        never evictable between seal and the agent's pin bookkeeping (the
+        old blocking pin_object round trip had exactly that window, and
+        cost a full RPC latency per large put)."""
         size = get_context().total_size(parts)
         cfg = get_config()
         deadline = time.monotonic() + cfg.create_backpressure_timeout_s
         stored = False
         while True:
             try:
-                self.store.put(oid, parts)
+                self.store.put(oid, parts, keep_pin=True)
                 stored = True
+                self._send_pin_transfer(oid)
                 break
             except StoreFullError:
                 res = await self.agent.call("ensure_space", {"nbytes": size})
@@ -851,6 +857,29 @@ class CoreWorker:
                                          {"object_id": oid}, timeout=60):
                 raise exc.ObjectStoreFullError(
                     f"object of size {size} does not fit and could not spill")
+            # Disk-spilled primaries carry no shm refcount; the agent still
+            # records the owner pin so free_objects accounting matches.
+            self._send_pin_transfer(oid)
+
+    def _send_pin_transfer(self, oid: bytes) -> None:
+        """Hand the writer-held pin to the agent. Normally a one-way notify
+        on the agent connection (ordered ahead of any later free). If the
+        connection is down the notify raises synchronously — release our
+        pin and let the reconnect path re-pin with a blocking pin_object.
+        An asynchronous loss (frame written, agent died before processing)
+        is node death: the arena dies with the agent, so a leaked refcount
+        in it is moot (workers watching the agent connection exit too)."""
+        try:
+            self.agent.notify("pin_transfer", {"object_id": oid})
+        except rpc.RpcError:
+            self.store.release(oid)
+            rpc.spawn(self._pin_after_reconnect(oid))
+
+    async def _pin_after_reconnect(self, oid: bytes) -> None:
+        try:
+            await self.agent.call("pin_object", {"object_id": oid})
+        except rpc.RpcError:
+            pass
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)

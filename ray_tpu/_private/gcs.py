@@ -1073,6 +1073,8 @@ def main():
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from .node import install_daemon_profiler
+    install_daemon_profiler("gcs")
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

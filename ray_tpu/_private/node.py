@@ -21,6 +21,27 @@ from typing import Dict, Optional, Tuple
 from .ids import NodeID
 
 
+def install_daemon_profiler(tag: str) -> None:
+    """Debug hook: cProfile the whole process, dumped on SIGTERM/exit when
+    RAY_TPU_PROFILE_WORKER_DIR is set (reference: dashboard reporter's
+    py-spy profiling fills this role for live processes). Shared by the
+    worker, GCS and agent mains — lives here so daemons don't have to
+    import each other's stacks for a 15-line debug helper."""
+    prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER_DIR")
+    if not prof_dir:
+        return
+    import atexit
+    import cProfile
+    import signal
+    prof = cProfile.Profile()
+    prof.enable()
+    path = os.path.join(prof_dir, f"{tag}_{os.getpid()}.pstats")
+    atexit.register(lambda: (prof.disable(), prof.dump_stats(path)))
+    signal.signal(signal.SIGTERM,
+                  lambda *a: (prof.disable(), prof.dump_stats(path),
+                              os._exit(0)))
+
+
 def _wait_ready(path: str, proc: subprocess.Popen, timeout: float = 30.0) -> dict:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:

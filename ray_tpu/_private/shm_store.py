@@ -83,7 +83,7 @@ def _load():
     lib.rts_put_iov.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                 ctypes.POINTER(ctypes.c_void_p),
                                 ctypes.POINTER(ctypes.c_uint64),
-                                ctypes.c_int, ctypes.c_int]
+                                ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.rts_put_iov.restype = ctypes.c_int
     lib.rts_chan_init.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                   ctypes.c_uint32, ctypes.c_uint64,
@@ -180,15 +180,18 @@ class ShmStore:
     # Parallel-memcpy width for rts_put_iov (threads engage >= 32 MiB).
     _COPY_THREADS = min(8, os.cpu_count() or 1)
 
-    def put(self, object_id: bytes, payloads) -> None:
-        """Create + copy + seal + drop the writer's pin in one native call.
-        `payloads` is a list of buffer-like chunks concatenated into the
-        object. The whole operation runs in C with the GIL released
+    def put(self, object_id: bytes, payloads, keep_pin: bool = False) -> None:
+        """Create + copy + seal (+ drop the writer's pin) in one native
+        call. `payloads` is a list of buffer-like chunks concatenated into
+        the object. The whole operation runs in C with the GIL released
         (ctypes), so a multi-hundred-MB put no longer stalls the caller's
         event loop; destination pages are batch-faulted and the copy
-        parallelizes for large objects. After this the object is evictable
-        unless pinned via `get` (owner pinning is the object-manager
-        layer's job, as in the reference's raylet PinObjectIDs)."""
+        parallelizes for large objects. With keep_pin=False the object is
+        immediately evictable unless pinned via `get` (owner pinning is
+        the object-manager layer's job, as in the reference's raylet
+        PinObjectIDs); keep_pin=True leaves the writer's refcount in
+        place so the pin can be transferred to the node agent without an
+        evictable window (see core_worker pin-transfer)."""
         import numpy as np
         n = len(payloads)
         ptrs = (ctypes.c_void_p * n)()
@@ -206,7 +209,8 @@ class ShmStore:
             ptrs[i] = a.ctypes.data
             lens[i] = a.nbytes
         rc = self._lib.rts_put_iov(self._h, object_id, ptrs, lens, n,
-                                   self._COPY_THREADS)
+                                   self._COPY_THREADS,
+                                   1 if keep_pin else 0)
         del keepalive
         if rc == -17:  # EEXIST
             raise ObjectExistsError(object_id.hex())

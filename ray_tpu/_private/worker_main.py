@@ -124,8 +124,8 @@ class Executor:
         """Serialize ONE return/stream-item value into a reply entry:
         small -> inline bytes, large -> local shared-memory store via the
         create-backpressure path (reference: core_worker.h:1045
-        AllocateReturnObject — same split).  The caller must pass the
-        entry through _post_serialize to pin any plasma copy."""
+        AllocateReturnObject — same split).  Plasma copies are pinned via
+        pin-transfer inside store_with_backpressure."""
         ctx = get_context()
         ctx.capture = captured = []
         try:
@@ -154,8 +154,10 @@ class Executor:
         if size <= self.core._inline_limit:
             entry = {"inline": protocol.concat_parts(parts)}
         else:
+            # store_with_backpressure pins the plasma copy via pin-transfer;
+            # nothing further for the reply to carry.
             await self.core.store_with_backpressure(oid, parts)
-            entry = {"plasma": list(self.core.agent_address), "pin": oid}
+            entry = {"plasma": list(self.core.agent_address)}
         if nested:
             entry["nested"] = nested
         return entry
@@ -175,12 +177,6 @@ class Executor:
             oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
             out.append(await self._serialize_value(oid, value, caller_addr))
         return out
-
-    async def _post_serialize(self, entries):
-        for e in entries:
-            oid = e.pop("pin", None)
-            if oid is not None:
-                await self.core.agent.call("pin_object", {"object_id": oid})
 
     # ------------------------------------------------------------ handlers --
     async def h_push_task(self, conn, spec):
@@ -444,7 +440,6 @@ class Executor:
                         returns = await self._serialize_returns(
                             tid, spec["nreturns"], payload,
                             caller_addr=spec.get("owner_addr"))
-                        await self._post_serialize(returns)
                         reply = {"status": "ok", "returns": returns}
                         caller = spec.get("owner_addr")
                         if caller is not None:
@@ -736,7 +731,6 @@ class Executor:
         oid = item_object_id(spec["task_id"], index)
         entry = await self._serialize_value(oid, value,
                                             caller_addr=spec.get("owner_addr"))
-        await self._post_serialize([entry])
         reply = await conn.call("stream_item", {
             "task_id": spec["task_id"], "index": index, "entry": entry,
             "attempt": spec.get("retries_left", 0)})
@@ -805,7 +799,6 @@ class Executor:
             returns = await self._serialize_returns(
                 spec["task_id"], spec["nreturns"], result,
                 caller_addr=spec.get("owner_addr"))
-            await self._post_serialize(returns)
             reply = {"status": "ok", "returns": returns}
             caller = spec.get("owner_addr")
             if caller is not None:
@@ -1054,20 +1047,8 @@ async def amain():
 def main():
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
     signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
-    prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER_DIR")
-    if prof_dir:
-        # Debug hook: cProfile the whole worker, dumped on exit (reference:
-        # dashboard reporter's py-spy profiling fills this role for live
-        # processes).
-        import cProfile
-        prof = cProfile.Profile()
-        prof.enable()
-        path = os.path.join(prof_dir, f"worker_{os.getpid()}.pstats")
-        import atexit
-        atexit.register(lambda: (prof.disable(), prof.dump_stats(path)))
-        signal.signal(signal.SIGTERM,
-                      lambda *a: (prof.disable(), prof.dump_stats(path),
-                                  os._exit(0)))
+    from .node import install_daemon_profiler
+    install_daemon_profiler("worker")
     try:
         asyncio.run(amain())
     except KeyboardInterrupt:

@@ -128,12 +128,14 @@ class Cluster:
                 node.proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 node.proc.kill()
+                node.proc.wait()  # reap; also a barrier before the unlink below
         if self.gcs_proc is not None:
             self.gcs_proc.terminate()
             try:
                 self.gcs_proc.wait(timeout=3)
             except subprocess.TimeoutExpired:
                 self.gcs_proc.kill()
+                self.gcs_proc.wait()
         # /dev/shm arenas are unlinked by the agents on SIGTERM; hard-killed
         # agents leave theirs behind until reboot — remove defensively.
         for node in nodes:
