@@ -11,13 +11,16 @@ from .algorithm import Algorithm, AlgorithmConfig
 from .appo import APPO, APPOConfig, AppoLearner
 from .connectors import (ClipRewards, Connector, ConnectorPipeline,
                          FlattenObs, FrameStack, NormalizeObs)
+from .cql import CQL, CQLConfig
 from .dqn import DQN, DQNConfig, DQNLearner
+from .iql import IQL, IQLConfig
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import (IMPALA, AggregatorActor, IMPALAConfig, ImpalaLearner,
                      vtrace)
 from .learner import Learner, LearnerGroup, compute_gae
 from .offline import (BC, MARWIL, BCConfig, BCLearner, MARWILConfig,
-                      episodes_to_batch)
+                      OfflineTransitionAlgorithm, episodes_to_batch,
+                      episodes_to_transitions)
 from .ppo import PPO, PPOConfig
 from .replay_buffers import (EpisodeReplayBuffer, PrioritizedReplayBuffer,
                              ReplayBuffer)
@@ -27,11 +30,13 @@ from .sac import SAC, SACConfig, SACLearner
 __all__ = [
     "Algorithm", "AlgorithmConfig", "AggregatorActor", "APPO",
     "APPOConfig", "AppoLearner", "BC", "BCConfig", "BCLearner",
-    "ClipRewards", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
-    "DQNLearner", "EnvRunner", "EnvRunnerGroup", "EpisodeReplayBuffer",
-    "FlattenObs", "FrameStack", "IMPALA", "IMPALAConfig", "ImpalaLearner",
-    "Learner", "LearnerGroup", "MARWIL", "MARWILConfig", "NormalizeObs",
-    "PrioritizedReplayBuffer", "ReplayBuffer", "SAC", "SACConfig",
-    "SACLearner", "compute_gae", "episodes_to_batch", "PPO",
+    "CQL", "CQLConfig", "ClipRewards", "Connector", "ConnectorPipeline",
+    "DQN", "DQNConfig", "DQNLearner", "EnvRunner", "EnvRunnerGroup",
+    "EpisodeReplayBuffer", "FlattenObs", "FrameStack", "IMPALA",
+    "IMPALAConfig", "IQL", "IQLConfig", "ImpalaLearner", "Learner",
+    "LearnerGroup", "MARWIL", "MARWILConfig", "NormalizeObs",
+    "OfflineTransitionAlgorithm", "PrioritizedReplayBuffer",
+    "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "compute_gae",
+    "episodes_to_batch", "episodes_to_transitions", "PPO",
     "PPOConfig", "RLModule", "RLModuleSpec", "vtrace",
 ]

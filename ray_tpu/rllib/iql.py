@@ -1,0 +1,165 @@
+"""IQL: Implicit Q-Learning over a recorded transition corpus.
+
+Reference surface: python/ray/rllib/algorithms/iql (expectile value
+learning + advantage-weighted policy extraction; Kostrikov et al. 2021).
+Three heads train jointly in one jitted program:
+
+- V via expectile regression toward Q_target(s, a_data): the tau-expectile
+  of the data's Q implicitly performs the max over in-support actions
+  without ever querying out-of-distribution ones.
+- Q via TD toward r + gamma * V(s') (no argmax over actions anywhere —
+  the defining IQL property).
+- pi via advantage-weighted regression: -exp(beta * A) * log pi(a|s),
+  A = Q_target(s,a) - V(s), weights clipped for stability.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .learner import Learner
+from .offline import (OfflineConfigMixin, OfflineTransitionAlgorithm,
+                      TransitionUpdatesMixin)
+from .rl_module import RLModuleSpec, _init_mlp, _mlp
+
+__all__ = ["IQL", "IQLConfig"]
+
+
+class IQLLearner(TransitionUpdatesMixin, Learner):
+    """Expectile-value learner (reference: iql learner losses)."""
+
+    def __init__(self, spec_kwargs, config, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = RLModuleSpec(**spec_kwargs).build()
+        self.cfg = dict(config)
+        spec = self.module.spec
+        kq1, kq2, kv, kpi = jax.random.split(jax.random.key(seed), 4)
+        qsizes = (spec.obs_dim,) + spec.hiddens + (spec.num_actions,)
+        vsizes = (spec.obs_dim,) + spec.hiddens + (1,)
+        self.params = {
+            "q1": _init_mlp(kq1, qsizes),
+            "q2": _init_mlp(kq2, qsizes),
+            "v": _init_mlp(kv, vsizes),
+            "pi": _init_mlp(kpi, qsizes),
+        }
+        self.target = {"q1": jax.tree.map(lambda x: x, self.params["q1"]),
+                       "q2": jax.tree.map(lambda x: x, self.params["q2"])}
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(self.cfg.get("grad_clip", 40.0)),
+            optax.adam(self.cfg.get("lr", 3e-4)),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self._iql = jax.jit(self._iql_step)
+        self._updates = 0
+        self._rng = np.random.default_rng(seed)
+
+    def _loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        n = obs.shape[0]
+        a_idx = (jnp.arange(n), batch["actions"])
+        tau = self.cfg.get("expectile", 0.7)
+        beta = self.cfg.get("beta", 3.0)
+
+        # Q of the DATA action under the frozen target twins: the only
+        # Q readout that feeds V and the policy (never an argmax).
+        q_data = jax.lax.stop_gradient(jnp.minimum(
+            _mlp(target["q1"], obs)[a_idx],
+            _mlp(target["q2"], obs)[a_idx]))
+
+        # --- V: expectile regression of q_data - V(s).
+        v = _mlp(params["v"], obs)[..., 0]
+        diff = q_data - v
+        w_exp = jnp.where(diff > 0, tau, 1.0 - tau)
+        v_loss = (w_exp * diff ** 2).mean()
+
+        # --- Q: one-step TD toward r + gamma * V(s') (V is frozen here).
+        v_next = jax.lax.stop_gradient(_mlp(params["v"], next_obs)[..., 0])
+        y = jax.lax.stop_gradient(
+            batch["rewards"] + self.cfg.get("gamma", 0.99) *
+            (1.0 - batch["dones"].astype(jnp.float32)) * v_next)
+        q1_sel = _mlp(params["q1"], obs)[a_idx]
+        q2_sel = _mlp(params["q2"], obs)[a_idx]
+        q_loss = 0.5 * (((q1_sel - y) ** 2).mean()
+                        + ((q2_sel - y) ** 2).mean())
+
+        # --- pi: advantage-weighted regression (stop-grad weights).
+        adv = jax.lax.stop_gradient(q_data - v)
+        w = jnp.minimum(jnp.exp(beta * adv),
+                        self.cfg.get("max_weight", 100.0))
+        logp = jax.nn.log_softmax(_mlp(params["pi"], obs))[a_idx]
+        pi_loss = -(w * logp).mean()
+
+        total = v_loss + q_loss + pi_loss
+        return total, {"v_loss": v_loss, "q_loss": q_loss,
+                       "pi_loss": pi_loss, "adv_mean": adv.mean(),
+                       "v_mean": v.mean()}
+
+    def _iql_step(self, params, target, opt_state, batch):
+        import jax
+        import optax
+
+        (loss, m), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, target, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        tau = self.cfg.get("tau", 0.005)
+        target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                              target, {"q1": params["q1"],
+                                       "q2": params["q2"]})
+        m["total_loss"] = loss
+        return params, target, opt_state, m
+
+    def update_transitions(self, jb: Dict[str, Any]) -> Dict[str, float]:
+        self.params, self.target, self.opt_state, m = self._iql(
+            self.params, self.target, self.opt_state, jb)
+        self._updates += 1
+        out = {k: float(v) for k, v in m.items()}
+        out["num_updates"] = self._updates
+        return out
+
+    @staticmethod
+    def greedy_fn():
+        """(params, obs) -> actions: the extracted policy's argmax."""
+        import jax.numpy as jnp
+
+        def greedy(params, obs):
+            return jnp.argmax(_mlp(params["pi"], obs), axis=-1)
+        return greedy
+
+    def get_weights(self):
+        return self.params
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "target": self.target,
+                "opt_state": self.opt_state, "updates": self._updates}
+
+    def set_state(self, state: Dict[str, Any]):
+        self.params = state["params"]
+        self.target = state["target"]
+        self.opt_state = state["opt_state"]
+        self._updates = state.get("updates", 0)
+
+
+class IQL(OfflineTransitionAlgorithm):
+    learner_class = IQLLearner
+
+
+class IQLConfig(OfflineConfigMixin, AlgorithmConfig):
+    algo_class = IQL
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data: Any = None
+        self.lr = 3e-4
+        self.train_config.update({
+            "expectile": 0.7, "beta": 3.0, "tau": 0.005,
+            "train_batch_size": 256, "num_updates_per_iteration": 64,
+        })

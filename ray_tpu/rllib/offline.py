@@ -44,6 +44,19 @@ def episodes_to_batch(episodes: List[Dict[str, np.ndarray]],
             "returns": np.concatenate(returns)}
 
 
+class OfflineConfigMixin:
+    """The fluent offline-data section shared by every offline config
+    (reference: AlgorithmConfig.offline_data())."""
+
+    def offline(self, data):
+        if not hasattr(data, "take_all") and not isinstance(data, list):
+            # Materialize one-shot iterables NOW: build_algo() deepcopies
+            # the config, and generators can't be copied (or re-read).
+            data = list(data)
+        self.offline_data = data
+        return self
+
+
 class BCLearner(Learner):
     """Negative-log-likelihood imitation (reference: bc_torch_learner);
     beta > 0 turns it into MARWIL's exp(beta * advantage) weighting with
@@ -142,36 +155,21 @@ class BC(Algorithm):
     def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
         """Greedy rollout of the learned policy in the probe env
         (reference: Algorithm.evaluate with evaluation workers)."""
-        import gymnasium as gym
         import jax
-        import jax.numpy as jnp
 
         spec_kwargs = self._module_spec_kwargs(self.config)
         from .rl_module import RLModuleSpec
         module = RLModuleSpec(**spec_kwargs).build()
         params = self.learner_group.get_weights()
-        greedy = jax.jit(module.forward_inference)
-        env = gym.make(self.config.env)
-        returns = []
-        for ep in range(num_episodes):
-            obs, _ = env.reset(seed=1000 + ep)
-            total, done = 0.0, False
-            while not done:
-                a = int(np.asarray(greedy(
-                    params, jnp.asarray(obs[None], jnp.float32)))[0])
-                obs, r, term, trunc, _ = env.step(a)
-                total += float(r)
-                done = term or trunc
-            returns.append(total)
-        env.close()
-        return {"episode_return_mean": float(np.mean(returns)),
-                "num_episodes": num_episodes}
+        return greedy_rollout(self.config.env,
+                              jax.jit(module.forward_inference),
+                              params, num_episodes)
 
     def stop(self):
         self.learner_group.stop()
 
 
-class BCConfig(AlgorithmConfig):
+class BCConfig(OfflineConfigMixin, AlgorithmConfig):
     algo_class = BC
 
     def __init__(self):
@@ -180,15 +178,6 @@ class BCConfig(AlgorithmConfig):
         self.lr = 1e-3
         self.train_config.update({"num_epochs": 1, "minibatch_size": 256,
                                   "beta": 0.0})
-
-    # Fluent section matching the reference's offline_data() API.
-    def offline(self, data) -> "BCConfig":
-        if not hasattr(data, "take_all") and not isinstance(data, list):
-            # Materialize one-shot iterables NOW: build_algo() deepcopies
-            # the config, and generators can't be copied (or re-read).
-            data = list(data)
-        self.offline_data = data
-        return self
 
 
 class MARWILConfig(BCConfig):
@@ -202,3 +191,143 @@ class MARWILConfig(BCConfig):
 
 
 MARWIL = BC      # same driver loop; the loss switches on beta
+
+
+def episodes_to_transitions(episodes: List[Dict[str, np.ndarray]]
+                            ) -> Dict[str, np.ndarray]:
+    """Flatten episodes into one-step transition arrays (obs, actions,
+    rewards, next_obs, dones) for TD-style offline learners (CQL/IQL).
+
+    Terminal episodes (`terminated` truthy, the default) keep every step;
+    the last one self-pads next_obs, which the done mask zeroes out of the
+    TD target.  Truncated episodes (`terminated=False`: the recorder hit
+    its horizon) DROP the final step — its true next_obs was never
+    observed, and self-padding it with done=0 would train Q toward a
+    bootstrapped self-loop (fixed point r/(1-gamma))."""
+    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    for ep in episodes:
+        o = np.asarray(ep["obs"], np.float32)
+        a = np.asarray(ep["actions"], np.int64)
+        r = np.asarray(ep.get("rewards", np.zeros(len(a))), np.float32)
+        T = len(a)
+        terminated = bool(ep.get("terminated", True))
+        if not terminated:
+            if T < 2:
+                continue     # a single truncated step carries no target
+            obs.append(o[:-1])
+            actions.append(a[:-1])
+            rewards.append(r[:-1])
+            next_obs.append(o[1:])
+            dones.append(np.zeros(T - 1, np.float32))
+            continue
+        obs.append(o)
+        actions.append(a)
+        rewards.append(r)
+        next_obs.append(np.concatenate([o[1:], o[-1:]]))
+        d = np.zeros(T, np.float32)
+        d[-1] = 1.0
+        dones.append(d)
+    return {"obs": np.concatenate(obs),
+            "actions": np.concatenate(actions),
+            "rewards": np.concatenate(rewards),
+            "next_obs": np.concatenate(next_obs),
+            "dones": np.concatenate(dones)}
+
+
+def greedy_rollout(env_name: str, greedy, params,
+                   num_episodes: int) -> Dict[str, float]:
+    """Roll a jitted (params, obs[1,D]) -> action fn greedily in a fresh
+    env; the evaluation loop every offline algorithm shares."""
+    import gymnasium as gym
+    import jax.numpy as jnp
+
+    env = gym.make(env_name)
+    returns = []
+    for ep in range(num_episodes):
+        obs, _ = env.reset(seed=1000 + ep)
+        total, done = 0.0, False
+        while not done:
+            a = int(np.asarray(greedy(
+                params, jnp.asarray(obs[None], jnp.float32)))[0])
+            obs, r, term, trunc, _ = env.step(a)
+            total += float(r)
+            done = term or trunc
+        returns.append(total)
+    env.close()
+    return {"episode_return_mean": float(np.mean(returns)),
+            "num_episodes": num_episodes}
+
+
+class TransitionUpdatesMixin:
+    """Learner-side minibatch loop over a transition corpus: the corpus
+    ships ONCE (by ref for remote learners) and every gradient update
+    samples locally — no per-update driver round-trips (same shape as
+    BC.update_offline above)."""
+
+    def run_updates(self, transitions: Dict[str, np.ndarray],
+                    num_updates: int, batch_size: int) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        n = len(transitions["actions"])
+        last: Dict[str, float] = {}
+        for _ in range(num_updates):
+            idx = self._rng.integers(0, n, min(batch_size, n))
+            jb = {k: jnp.asarray(v[idx]) for k, v in transitions.items()}
+            last = self.update_transitions(jb)
+        return last
+
+
+class OfflineTransitionAlgorithm(Algorithm):
+    """Driver loop shared by transition-based offline algorithms
+    (CQL/IQL): no env runners; each iteration runs
+    `num_updates_per_iteration` learner-side minibatch updates over the
+    recorded transition corpus (reference: cql.py / iql.py training_step
+    over OfflineData sample batches)."""
+
+    learner_class: type = None
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._episode_returns: List[float] = []
+        from .learner import LearnerGroup
+        spec_kwargs = self._module_spec_kwargs(config)
+        self._spec_kwargs = spec_kwargs
+        self.learner_group = LearnerGroup(
+            spec_kwargs, config.learner_config_dict(),
+            num_learners=config.num_learners,
+            learner_resources=config.learner_resources, seed=config.seed,
+            learner_cls=self.learner_class)
+        self.env_runner_group = None
+        data = config.offline_data
+        if data is None:
+            raise ValueError("config.offline(...) is required")
+        if hasattr(data, "take_all"):
+            data = data.take_all()
+        self._transitions = episodes_to_transitions(list(data))
+        self._corpus_ref = None     # lazily put once for remote learners
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config.train_config
+        bs = cfg.get("train_batch_size", 256)
+        n_upd = cfg.get("num_updates_per_iteration", 64)
+        learner = self.learner_group.learner
+        if self.config.num_learners > 0:
+            import ray_tpu
+            if self._corpus_ref is None:
+                self._corpus_ref = ray_tpu.put(self._transitions)
+            return ray_tpu.get(
+                learner.run_updates.remote(self._corpus_ref, n_upd, bs),
+                timeout=600)
+        return learner.run_updates(self._transitions, n_upd, bs)
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy rollout of the learned policy in the probe env."""
+        import jax
+        params = self.learner_group.get_weights()
+        return greedy_rollout(self.config.env,
+                              jax.jit(self.learner_class.greedy_fn()),
+                              params, num_episodes)
+
+    def stop(self):
+        self.learner_group.stop()
