@@ -147,6 +147,12 @@ class GcsServer:
         from collections import deque as _deque
         from .config import get_config as _gc
         self.task_events: _deque = _deque(maxlen=_gc().gcs_task_events_max)
+        # Opaque pre-packed event batches (count, blob): workers pack the
+        # batch once, we store it without decoding (queries expand
+        # lazily).  _te_blob_total tracks the event count for eviction.
+        self._te_blobs: _deque = _deque()
+        self._te_blob_total = 0
+        self._te_blob_max = _gc().gcs_task_events_max
         # (name, labels_tuple) -> {"type", "value"/"sum"/"buckets", ...}
         self.metrics: Dict[tuple, dict] = {}
         # Resource demand reported by core workers whose lease requests
@@ -196,11 +202,33 @@ class GcsServer:
 
     # ----------------------------------------------------------- telemetry --
     async def h_task_events(self, conn, p):
+        blob = p.get("blob")
+        if blob is not None:
+            # Opaque batch: one bin decode on the RPC frame instead of
+            # thousands of per-event map decodes on the GCS loop.
+            n = p.get("n", 0)
+            self._te_blobs.append((n, blob))
+            self._te_blob_total += n
+            while (self._te_blob_total > self._te_blob_max
+                   and len(self._te_blobs) > 1):
+                dn, _ = self._te_blobs.popleft()
+                self._te_blob_total -= dn
+            return True
         self.task_events.extend(p["events"])
         return True
 
+    def _expanded_task_events(self):
+        if self._te_blobs:
+            # Expand accumulated blobs into the row ring (query-time cost;
+            # queries are dashboard/state-API rate, not hot-path rate).
+            blobs, self._te_blobs = list(self._te_blobs), type(self._te_blobs)()
+            self._te_blob_total = 0
+            for _n, blob in blobs:
+                self.task_events.extend(rpc._unpack(blob))
+        return self.task_events
+
     async def h_get_task_events(self, conn, p):
-        out = list(self.task_events)
+        out = list(self._expanded_task_events())
         if p.get("job_id"):
             out = [e for e in out if e.get("job_id") == p["job_id"]]
         if p.get("task_id"):

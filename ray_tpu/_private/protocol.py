@@ -76,9 +76,18 @@ def make_task_spec(
     }
 
 
+_tracing = None
+
+
 def _trace_inject():
-    from ..util import tracing
-    return tracing.inject()
+    # Module cached on first use (util.tracing has no _private imports, but
+    # a top-level import would still cycle through ray_tpu/__init__): the
+    # per-submit cost is one contextvar read.
+    global _tracing
+    if _tracing is None:
+        from ..util import tracing as _t
+        _tracing = _t
+    return _tracing.inject()
 
 
 def scheduling_key(fn_id: bytes, resources: Dict[str, float],

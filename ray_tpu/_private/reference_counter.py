@@ -53,6 +53,11 @@ class ReferenceCounter:
     def __init__(self, on_zero: Callable[[bytes, Optional[tuple]], None],
                  on_borrow: Callable[[bytes, tuple], None] | None = None):
         self._refs: Dict[bytes, _Ref] = {}
+        # Count of refs with registered=True: borrowed_from() is called on
+        # EVERY task reply (it piggybacks retained borrows) and would scan
+        # the whole ref table each time; the common case — this process
+        # borrows nothing — must be O(1).
+        self._registered_n = 0
         # (oid, worker_id) -> (release time, max released epoch);
         # insertion-ordered for pruning.
         self._release_tombstones: Dict[Tuple[bytes, bytes],
@@ -131,6 +136,8 @@ class ReferenceCounter:
             ref.borrowers.discard(worker_id)
             if ref.freeable():
                 del self._refs[object_id]
+                if ref.registered:
+                    self._registered_n -= 1
                 fire = True
         if fire:
             self._fire(object_id, ref)
@@ -155,6 +162,7 @@ class ReferenceCounter:
             ref.owner_addr = tuple(owner_addr)
             if not ref.registered:
                 ref.registered = True
+                self._registered_n += 1
                 self._borrow_epoch += 1
                 ref.borrow_epoch = self._borrow_epoch
                 return ref.borrow_epoch
@@ -188,8 +196,10 @@ class ReferenceCounter:
         of retained borrows in-band, strictly before it releases the task's
         submitted arg pins (reference: PushTaskReply borrowed-ref
         metadata)."""
-        owner = tuple(owner_addr)
         with self._lock:
+            if not self._registered_n:
+                return []
+            owner = tuple(owner_addr)
             return [(oid, r.borrow_epoch) for oid, r in self._refs.items()
                     if r.registered and r.owner_addr == owner]
 
@@ -203,6 +213,8 @@ class ReferenceCounter:
             setattr(ref, field, getattr(ref, field) - 1)
             if ref.freeable():
                 del self._refs[object_id]
+                if ref.registered:
+                    self._registered_n -= 1
                 fire = True
         if fire:
             self._fire(object_id, ref)

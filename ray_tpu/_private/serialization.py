@@ -67,7 +67,25 @@ class SerializationContext:
     def total_size(self, parts) -> int:
         return sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
 
+    _NONE_BLOB: bytes | None = None  # wire form of None (constant)
+
+    def none_blob(self) -> bytes:
+        """The constant wire form of a serialized None.  Shared by the
+        serialize-side fast path (worker reply construction) and the
+        deserialize-side compare below so the two can't drift."""
+        blob = SerializationContext._NONE_BLOB
+        if blob is None:
+            parts = self.serialize(None)
+            blob = b"".join(bytes(p) for p in parts)
+            SerializationContext._NONE_BLOB = blob
+        return blob
+
     def deserialize(self, data: memoryview) -> Any:
+        # None dominates reply payloads under fan-out load (pings,
+        # fire-and-forget mutations); its wire form is a constant, so one
+        # bytes-compare replaces an unpickle.
+        if data == self.none_blob():
+            return None
         data = memoryview(data)
         (hlen,) = struct.unpack_from("<Q", data, 0)
         header = data[8:8 + hlen]

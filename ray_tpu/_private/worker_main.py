@@ -57,6 +57,7 @@ class Executor:
         # actor hot path.
         self._sync_method_cache: Dict[str, Any] = {}
         self._coro_method_cache: Dict[str, bool] = {}
+        self._fast_method_ok: Dict[str, bool] = {}  # fast-dispatch gate
         self._running: Dict[bytes, tuple] = {}  # task_id -> (task, is_async)
         self._running_threads: Dict[bytes, int] = {}  # sync task -> thread id
         self._thread_guard = threading.Lock()
@@ -126,6 +127,11 @@ class Executor:
         create-backpressure path (reference: core_worker.h:1045
         AllocateReturnObject — same split).  Plasma copies are pinned via
         pin-transfer inside store_with_backpressure."""
+        if value is None:
+            # None is the overwhelmingly common return under fan-out load
+            # (pings, fire-and-forget mutations); its pickle is constant
+            # and carries no nested refs, so skip the serializer.
+            return {"inline": get_context().none_blob()}
         ctx = get_context()
         ctx.capture = captured = []
         try:
@@ -174,9 +180,51 @@ class Executor:
                     f"{len(results)}")
         out = []
         for i, value in enumerate(results):
-            oid = ObjectID.for_task_return(TaskID(task_id), i + 1).binary()
+            # Layout matches ObjectID.for_task_return without the wrapper
+            # round-trip (reply hot path).
+            oid = task_id + (i + 1).to_bytes(4, "little")
             out.append(await self._serialize_value(oid, value, caller_addr))
         return out
+
+    # ------------------------------------------------------ fast handlers --
+    # SYNC enqueue variants registered as rpc fast_handlers: the recv loop
+    # calls them inline (no Task per request) and sends the reply from a
+    # done-callback on the returned future.  Semantics identical to the
+    # coroutine handlers below — conditional branches fall back.
+
+    def f_push_task(self, conn, spec):
+        fut = asyncio.get_running_loop().create_future()
+        self._task_q.append((spec, fut))
+        if not self._task_draining:
+            self._task_draining = True
+            rpc.spawn(self._drain_chunked(self._task_q, "_task_draining",
+                                          self._task_gate))
+        return fut
+
+    def f_push_actor_task(self, conn, spec):
+        if (self._actor_is_async or self._group_sems
+                or self._max_concurrency > 1 or _TRACE_EXEC
+                or spec.get("method") == "__ray_dag_serve__"):
+            return rpc.FAST_FALLBACK
+        if self.actor is not None:
+            # Methods tagged with a concurrency group must go through
+            # _sem_for_method's misconfiguration check in the slow path.
+            name = spec["method"]
+            ok = self._fast_method_ok.get(name)
+            if ok is None:
+                m = getattr(type(self.actor), name, None)
+                ok = getattr(m, "__ray_concurrency_group__", None) is None
+                self._fast_method_ok[name] = ok
+            if not ok:
+                return rpc.FAST_FALLBACK
+        fut = asyncio.get_running_loop().create_future()
+        self._serial_q.append((spec, fut))
+        if not self._serial_draining:
+            self._serial_draining = True
+            rpc.spawn(self._drain_chunked(self._serial_q,
+                                          "_serial_draining",
+                                          self._actor_gate))
+        return fut
 
     # ------------------------------------------------------------ handlers --
     async def h_push_task(self, conn, spec):
@@ -845,6 +893,7 @@ class Executor:
             self.core.executor, lambda: cls(*args, **kwargs))
         self._sync_method_cache.clear()
         self._coro_method_cache.clear()
+        self._fast_method_ok.clear()
         self.actor_id = spec["actor_id"]
         self.core.current_actor_id = spec["actor_id"]
         max_conc = spec.get("max_concurrency", 1) or 1
@@ -1014,6 +1063,13 @@ async def amain():
         "cpu_profile": executor.h_cpu_profile,
     }
     core._server.handlers.update(exec_handlers)
+    fast_handlers = {
+        "push_task": executor.f_push_task,
+        "push_actor_task": executor.f_push_actor_task,
+    }
+    core._server.fast_handlers = fast_handlers
+    for c in core._server.connections:
+        c.fast_handlers = fast_handlers
     # Register with the agent over a dedicated connection that stays open —
     # the agent uses its closure to detect worker death, and sends actor_init
     # over it, so it must carry the executor handlers too.

@@ -227,6 +227,10 @@ def episodes_to_transitions(episodes: List[Dict[str, np.ndarray]]
         d = np.zeros(T, np.float32)
         d[-1] = 1.0
         dones.append(d)
+    if not obs:
+        raise ValueError(
+            "offline corpus contains no usable transitions (empty corpus, "
+            "or every episode is truncated with fewer than 2 steps)")
     return {"obs": np.concatenate(obs),
             "actions": np.concatenate(actions),
             "rewards": np.concatenate(rewards),
