@@ -2799,25 +2799,30 @@ class CoreWorker:
 
             def _one_cb(fut, spec, task):
                 nonlocal n_left
-                tid = spec["task_id"]
-                self._inflight_actor_tasks.pop(tid, None)
+                # The n_left decrement must be unconditional: an exception
+                # escaping a done-callback goes to the loop's exception
+                # handler, and a skipped decrement would leave all_done
+                # unresolved — wedging this actor's submit pipeline.
                 try:
-                    reply = fut.result()
-                except rpc.ConnectionLost:
-                    lost.append((spec, task))
-                except Exception as e:  # infra-level RemoteError: fail task
-                    self._store_task_exception(spec, exc.RayError(
-                        f"actor push failed: {e}"))
-                    self._release_task_pins(task)
-                else:
+                    tid = spec["task_id"]
+                    self._inflight_actor_tasks.pop(tid, None)
                     try:
+                        reply = fut.result()
+                    except rpc.ConnectionLost:
+                        lost.append((spec, task))
+                    except Exception as e:  # infra RemoteError: fail task
+                        self._store_task_exception(spec, exc.RayError(
+                            f"actor push failed: {e}"))
+                        self._release_task_pins(task)
+                    else:
                         self._handle_reply(spec, task, reply)
-                    except Exception:
-                        logger.exception("reply handling failed for %s",
-                                         spec.get("method"))
-                n_left -= 1
-                if n_left == 0 and not all_done.done():
-                    all_done.set_result(None)
+                except Exception:
+                    logger.exception("reply handling failed for %s",
+                                     spec.get("method"))
+                finally:
+                    n_left -= 1
+                    if n_left == 0 and not all_done.done():
+                        all_done.set_result(None)
 
             for (s, t), f in zip(pending, futs):
                 f.add_done_callback(

@@ -209,7 +209,11 @@ class GcsServer:
             n = p.get("n", 0)
             self._te_blobs.append((n, blob))
             self._te_blob_total += n
-            while (self._te_blob_total > self._te_blob_max
+            # Bound COMBINED retention (expanded ring + queued blobs) to
+            # gcs_task_events_max — each side capped independently would
+            # allow ~2x the documented limit after a query expands blobs.
+            budget = max(self._te_blob_max - len(self.task_events), 0)
+            while (self._te_blob_total > budget
                    and len(self._te_blobs) > 1):
                 dn, _ = self._te_blobs.popleft()
                 self._te_blob_total -= dn
@@ -224,7 +228,13 @@ class GcsServer:
             blobs, self._te_blobs = list(self._te_blobs), type(self._te_blobs)()
             self._te_blob_total = 0
             for _n, blob in blobs:
-                self.task_events.extend(rpc._unpack(blob))
+                try:
+                    self.task_events.extend(rpc._unpack(blob))
+                except Exception:
+                    # One corrupt blob (sender died mid-notify) must not
+                    # fail the query or discard the healthy blobs.
+                    logger.warning("dropping undecodable task-event blob "
+                                   "(%d events)", _n)
         return self.task_events
 
     async def h_get_task_events(self, conn, p):

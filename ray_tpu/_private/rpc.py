@@ -164,6 +164,12 @@ class Connection:
         # spending ~3/4 of its samples in per-frame socket sends.
         self._wbuf: list = []
         self._flush_scheduled = False
+        # Reply coalescing: response frames queued in one loop tick leave
+        # as ONE __batch_resp__ frame — one msgpack pack here and one
+        # frame decode on the peer instead of K of each (a chunk of K
+        # actor calls resolves K replies in the same tick).
+        self._resp_buf: list = []
+        self._resp_scheduled = False
         self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     @property
@@ -179,6 +185,18 @@ class Connection:
                     continue
                 mid, a, b = msg
                 if isinstance(a, str):  # request [mid, method, payload]
+                    if a == "__batch_resp__":
+                        # Coalesced responses (see _send_reply): resolve
+                        # each pending future in arrival order.
+                        pend = self._pending
+                        for sub in b:
+                            fut = pend.pop(sub[0], None)
+                            if fut is not None and not fut.done():
+                                if sub[1] == 0:
+                                    fut.set_result(sub[2])
+                                else:
+                                    fut.set_exception(RemoteError(sub[2]))
+                        continue
                     if a == "__batch__":
                         # Multi-call frame: K independent requests in one
                         # frame (see call_many). Each dispatches separately
@@ -272,7 +290,27 @@ class Connection:
         if _chaos and _chaos.should_fail(method, "resp"):
             return
         if not self._closed:
-            self._send_frame([mid, status, body])
+            self._send_reply(mid, status, body)
+
+    def _send_reply(self, mid: int, status: int, body) -> None:
+        """Queue one response; all replies of the current loop tick leave
+        as a single __batch_resp__ frame (see _resp_buf)."""
+        self._resp_buf.append([mid, status, body])
+        if not self._resp_scheduled:
+            self._resp_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_resp)
+
+    def _flush_resp(self) -> None:
+        self._resp_scheduled = False
+        buf = self._resp_buf
+        if self._closed or not buf:
+            buf.clear()
+            return
+        self._resp_buf = []
+        if len(buf) == 1:
+            self._send_frame(buf[0])
+        else:
+            self._send_frame([0, "__batch_resp__", buf])
 
     async def _dispatch(self, mid: int, method: str, payload,
                         skip_req_chaos: bool = False):
@@ -292,10 +330,7 @@ class Connection:
             status, body = 1, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
         if mid == 0:
             return  # one-way
-        if _chaos and _chaos.should_fail(method, "resp"):
-            return
-        if not self._closed:
-            self._send_frame([mid, status, body])
+        self._maybe_reply(mid, method, status, body)
 
     async def call(self, method: str, payload=None, timeout: float | None = None):
         if self._closed:
@@ -385,6 +420,7 @@ class Connection:
         # Push out coalesced frames before tearing down — a notify()
         # immediately followed by close() (e.g. the GCS's kill delivery)
         # must still reach the peer.
+        self._flush_resp()
         self._flush_wbuf()
         if not self._closed:
             try:

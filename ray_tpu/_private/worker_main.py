@@ -201,6 +201,16 @@ class Executor:
                                           self._task_gate))
         return fut
 
+    def _enqueue_serial(self, spec):
+        fut = asyncio.get_running_loop().create_future()
+        self._serial_q.append((spec, fut))
+        if not self._serial_draining:
+            self._serial_draining = True
+            rpc.spawn(self._drain_chunked(self._serial_q,
+                                          "_serial_draining",
+                                          self._actor_gate))
+        return fut
+
     def f_push_actor_task(self, conn, spec):
         if (self._actor_is_async or self._group_sems
                 or self._max_concurrency > 1 or _TRACE_EXEC
@@ -217,28 +227,16 @@ class Executor:
                 self._fast_method_ok[name] = ok
             if not ok:
                 return rpc.FAST_FALLBACK
-        fut = asyncio.get_running_loop().create_future()
-        self._serial_q.append((spec, fut))
-        if not self._serial_draining:
-            self._serial_draining = True
-            rpc.spawn(self._drain_chunked(self._serial_q,
-                                          "_serial_draining",
-                                          self._actor_gate))
-        return fut
+        return self._enqueue_serial(spec)
 
     # ------------------------------------------------------------ handlers --
     async def h_push_task(self, conn, spec):
         # Normal tasks execute one-at-a-time per worker; a burst pushed by
         # the submitter's per-lease multi-call frame drains through the
         # same chunked path as serial actor calls (one executor hop per
-        # chunk, replies coalesced).
-        fut = asyncio.get_running_loop().create_future()
-        self._task_q.append((spec, fut))
-        if not self._task_draining:
-            self._task_draining = True
-            rpc.spawn(self._drain_chunked(self._task_q, "_task_draining",
-                                          self._task_gate))
-        return await fut
+        # chunk, replies coalesced).  Single enqueue implementation: the
+        # fast handler IS the path; this wrapper only awaits it.
+        return await self.f_push_task(conn, spec)
 
     def _task_gate(self, spec):
         """Chunk-eligibility for a normal task: the cached sync function,
@@ -324,13 +322,8 @@ class Executor:
         # thread-pool hop, and their replies resolve in one loop tick (so
         # the response frames coalesce into one socket write). Order is
         # the FIFO arrival order, exactly as the task-lock queue gave.
-        fut = asyncio.get_running_loop().create_future()
-        self._serial_q.append((spec, fut))
-        if not self._serial_draining:
-            self._serial_draining = True
-            rpc.spawn(self._drain_chunked(self._serial_q, "_serial_draining",
-                                          self._actor_gate))
-        return await fut
+        # Shared enqueue implementation with the fast path:
+        return await self._enqueue_serial(spec)
 
     def _sem_for_method(self, method_name: str):
         m = getattr(type(self.actor), method_name, None)

@@ -332,8 +332,10 @@ def run_microbenchmarks(min_time_s: float = 1.0,
             continue
         # Settle: let the previous bench's lease returns / worker recycling
         # finish so its cleanup doesn't steal CPU from this measurement
-        # (ordering effects dominated run-to-run variance on small hosts).
-        time.sleep(0.4)
+        # (ordering effects dominated run-to-run variance on small hosts —
+        # killed bench actors respawn pool workers via the zygote, and on
+        # a 1-core host that churn overlaps the next bench's warmup).
+        time.sleep(2.0)
         rate = fn(min_time_s)
         results[name] = {
             "value": round(rate, 2),
