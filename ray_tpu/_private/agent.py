@@ -543,9 +543,17 @@ class NodeAgent:
         if proc is None:
             out = open(out_path, "ab")
             err = open(err_path, "ab")
+            # Interpreter override (conda runtime env) / container launch
+            # (container runtime env) — both set by UriCache.setup.
+            py = env.pop("RAY_TPU_WORKER_PYTHON", None) or sys.executable
+            cmd = [py, "-m", "ray_tpu._private.worker_main"]
+            container = env.pop("RAY_TPU_WORKER_CONTAINER", None)
+            if container:
+                cmd = self._container_cmd(json.loads(container), env,
+                                          cwd or os.getcwd(),
+                                          set(env_extra or ()))
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                env=env, stdout=out, stderr=err,
+                cmd, env=env, stdout=out, stderr=err,
                 cwd=cwd or os.getcwd(), start_new_session=True)
         if self._worker_cgroup is not None:
             self._worker_cgroup.add(proc.pid)
@@ -554,6 +562,34 @@ class NodeAgent:
         wh.has_env = bool(env_extra) or cwd is not None
         self.workers[worker_id] = wh
         return wh
+
+    def _container_cmd(self, spec: dict, env: Dict[str, str],
+                       cwd: str, extra_keys: set = frozenset()) -> list:
+        """Worker launch line for a container runtime env (reference:
+        runtime_env/container.py — podman run with the session mounted).
+        Host IPC + host network keep the shm object store and the TCP
+        control plane working unchanged inside the container; the ray_tpu
+        package and session dir are bind-mounted so the image only needs
+        a python. The runtime binary is injectable ('runtime' in the
+        spec), which is also how tests exercise this path without a
+        container engine."""
+        runtime = spec["runtime"]
+        cmd = [runtime, "run", "--rm", "--ipc=host", "--network=host",
+               "-w", cwd]
+        mounts = {self.session_dir, spec.get("pkg_root") or "", cwd}
+        for m in sorted(m for m in mounts if m):
+            cmd += ["-v", f"{m}:{m}"]
+        for k, v in sorted(env.items()):
+            # Runtime plumbing + the user's own runtime_env env_vars
+            # (extra_keys) — a dropped user var would fail silently
+            # inside the container.
+            if (k.startswith(("RAY_TPU_", "JAX_")) or k == "PYTHONPATH"
+                    or k in extra_keys):
+                cmd += ["-e", f"{k}={v}"]
+        cmd += spec.get("run_options", [])
+        cmd += [spec["image"], "python", "-m",
+                "ray_tpu._private.worker_main"]
+        return cmd
 
     async def h_register_worker(self, conn, p):
         wh = self.workers.get(p["worker_id"])

@@ -11,9 +11,16 @@ install a spec's packages into a per-node content-addressed target dir
 (`pip install --target`), prepended to the worker's PYTHONPATH — cached
 by spec hash so N workers pay one install.  Air-gapped clusters pass
 `find_links` (a local wheel dir) and installs run `--no-index`, which is
-also how the tests exercise the plugin without network.  Unsupported
-plugins (conda/container) still raise up front rather than silently
-no-op.
+also how the tests exercise the plugin without network.
+
+Interpreter plugins: `conda` (reference: runtime_env/conda.py) switches
+the worker to an existing named/prefix env's python, or creates a
+content-addressed env from a spec dict; `container` (reference:
+runtime_env/container.py) launches the worker through podman/docker run
+with the session bind-mounted and host IPC/network (so the shm store and
+TCP control plane work unchanged).  Both route through env_extra keys
+(RAY_TPU_WORKER_PYTHON / RAY_TPU_WORKER_CONTAINER) the agent's spawn
+path consumes.
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ _MAX_PKG_BYTES = 512 * 1024 * 1024
 
 _SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules",
                    "working_dir_uri", "py_modules_uris", "config",
-                   "pip", "uv"}
+                   "pip", "uv", "conda", "container"}
 
 
 def _normalize_pkg_spec(spec, kind: str) -> dict:
@@ -90,8 +97,7 @@ def package_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
     if unknown:
         raise ValueError(
             f"unsupported runtime_env key(s) {sorted(unknown)}; supported: "
-            f"{sorted(_SUPPORTED_KEYS)} (pip/conda/container are not "
-            "available in this runtime)")
+            f"{sorted(_SUPPORTED_KEYS)}")
     out = dict(runtime_env)
     wd = out.pop("working_dir", None)
     if wd:
@@ -113,6 +119,74 @@ def package_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
     for kind in ("pip", "uv"):
         if kind in out:
             out[kind] = _normalize_pkg_spec(out[kind], kind)
+    if "conda" in out:
+        if "pip" in out or "uv" in out:
+            # Same exclusivity as the reference (conda envs carry their
+            # own pip section; reference: runtime_env/validation.py).
+            raise ValueError(
+                "runtime_env cannot combine 'conda' with 'pip'/'uv' — "
+                "put pip packages inside the conda spec's dependencies")
+        if "container" in out:
+            raise ValueError(
+                "runtime_env cannot combine 'conda' with 'container' — "
+                "the container runs the image's interpreter; bake the "
+                "env into the image instead")
+        out["conda"] = _normalize_conda_spec(out["conda"])
+    if "container" in out:
+        out["container"] = _normalize_container_spec(out["container"])
+    return out
+
+
+def _normalize_conda_spec(spec) -> dict:
+    """str -> existing named/prefix env; dict -> env created from the
+    spec's dependencies (reference: runtime_env/conda.py — named env
+    reuse, or create-from-yaml with a nested pip section)."""
+    if isinstance(spec, str):
+        if not spec:
+            raise ValueError("runtime_env['conda'] name must be non-empty")
+        return {"name": spec}
+    if isinstance(spec, dict):
+        deps = spec.get("dependencies")
+        if not deps or not isinstance(deps, (list, tuple)):
+            raise ValueError(
+                "runtime_env['conda'] dict must carry a non-empty "
+                "'dependencies' list (conda yaml shape)")
+        norm: List = []
+        for d in deps:
+            if isinstance(d, dict):
+                pip = d.get("pip")
+                if not isinstance(pip, (list, tuple)):
+                    raise ValueError(
+                        "nested conda dependency dicts must be "
+                        "{'pip': [...]}")
+                norm.append({"pip": sorted(str(p) for p in pip)})
+            else:
+                norm.append(str(d))
+        return {"dependencies":
+                sorted(norm, key=lambda d: json.dumps(d, sort_keys=True))}
+    raise ValueError(
+        "runtime_env['conda'] must be an env name (str) or a spec dict")
+
+
+def _normalize_container_spec(spec) -> dict:
+    """{'image': ..., 'run_options': [...], 'runtime': binary} (reference:
+    runtime_env/container.py + image_uri.py — podman run with the session
+    mounted; 'runtime' selects the engine binary and exists mainly so
+    tests can inject a fake)."""
+    if isinstance(spec, str):
+        spec = {"image": spec}
+    if not isinstance(spec, dict) or not spec.get("image"):
+        raise ValueError(
+            "runtime_env['container'] must be an image name or "
+            "{'image': ..., 'run_options': [...]}")
+    out = {"image": str(spec["image"])}
+    ro = spec.get("run_options")
+    if ro:
+        if not isinstance(ro, (list, tuple)):
+            raise ValueError("container run_options must be a list")
+        out["run_options"] = [str(o) for o in ro]
+    if spec.get("runtime"):
+        out["runtime"] = str(spec["runtime"])
     return out
 
 
@@ -254,6 +328,117 @@ class UriCache:
             if not fut.done():
                 fut.cancel()
 
+    @staticmethod
+    def _resolve_conda_python(name: str) -> str:
+        """Python executable of an existing conda env, by name or prefix
+        path (reference: conda.py get_conda_env_dir — $CONDA_PREFIX/envs,
+        the conda base install, ~/.conda/envs).  RAY_TPU_CONDA_ROOT lets
+        tests (and nonstandard installs) add a search root."""
+        import shutil
+        candidates: List[str] = []
+        if os.path.isabs(name):
+            candidates.append(name)
+        else:
+            roots: List[str] = []
+            if os.environ.get("RAY_TPU_CONDA_ROOT"):
+                roots.append(os.path.join(
+                    os.environ["RAY_TPU_CONDA_ROOT"], "envs"))
+            if os.environ.get("CONDA_PREFIX"):
+                base = os.environ["CONDA_PREFIX"]
+                # CONDA_PREFIX is the ACTIVE env; envs live in base/envs.
+                roots.append(os.path.join(base, "envs"))
+                roots.append(os.path.join(os.path.dirname(
+                    os.path.dirname(base)), "envs"))
+            conda_exe = os.environ.get("CONDA_EXE") or shutil.which("conda")
+            if conda_exe:
+                roots.append(os.path.join(os.path.dirname(
+                    os.path.dirname(conda_exe)), "envs"))
+            roots.append(os.path.expanduser("~/.conda/envs"))
+            candidates.extend(os.path.join(r, name) for r in roots)
+        for prefix in candidates:
+            py = os.path.join(prefix, "bin", "python")
+            if os.path.exists(py):
+                return py
+        raise RuntimeError(
+            f"runtime_env['conda'] env {name!r} not found on this node "
+            f"(searched {candidates})")
+
+    async def ensure_conda(self, spec: dict) -> str:
+        """Python executable for a conda runtime env: named envs resolve
+        in place; dict specs create a content-addressed env once per node
+        (reference: conda.py create-from-yaml + cache)."""
+        import asyncio
+        import shutil
+        import subprocess
+
+        if "name" in spec:
+            return self._resolve_conda_python(spec["name"])
+        digest = hashlib.sha1(
+            json.dumps(spec, sort_keys=True).encode()).hexdigest()
+        dest = os.path.join(self.cache_root, "conda_envs", digest)
+        py = os.path.join(dest, "bin", "python")
+        if os.path.exists(py):
+            return py
+        key = f"conda:{digest}"
+        fut = self._inflight.get(key)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[key] = fut
+        try:
+            conda = os.environ.get("CONDA_EXE") or shutil.which("conda")
+            if conda is None:
+                raise RuntimeError(
+                    "runtime_env['conda'] spec requires the `conda` "
+                    "binary on this node; name an existing env or use "
+                    "the 'pip' plugin")
+            pkgs = [d for d in spec["dependencies"] if isinstance(d, str)]
+            pips: List[str] = []
+            for d in spec["dependencies"]:
+                if isinstance(d, dict):
+                    pips.extend(d["pip"])
+
+            def _create():
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                tmp = dest + f".tmp{os.getpid()}"
+                try:
+                    proc = subprocess.run(
+                        [conda, "create", "-y", "-p", tmp] + pkgs,
+                        capture_output=True, text=True, timeout=1800)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"conda create failed: "
+                            f"{proc.stderr.strip()[-2000:]}")
+                    if not os.path.exists(
+                            os.path.join(tmp, "bin", "python")):
+                        raise RuntimeError(
+                            "conda env spec produced no bin/python — "
+                            "include 'python' (e.g. 'python=3.12') in "
+                            f"dependencies: {spec['dependencies']}")
+                    if pips:
+                        proc = subprocess.run(
+                            [os.path.join(tmp, "bin", "python"), "-m",
+                             "pip", "install"] + pips,
+                            capture_output=True, text=True, timeout=1800)
+                        if proc.returncode != 0:
+                            raise RuntimeError(
+                                f"conda env pip install failed: "
+                                f"{proc.stderr.strip()[-2000:]}")
+                    os.replace(tmp, dest)
+                except BaseException:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise
+            await asyncio.get_running_loop().run_in_executor(None, _create)
+            fut.set_result(py)
+            return py
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._inflight.pop(key, None)
+            if not fut.done():
+                fut.cancel()
+
     def poll_setup(self, gcs_conn, runtime_env: Optional[dict]):
         """Non-blocking env materialization for the lease-grant path
         (reference: the raylet asks its runtime-env agent and retries the
@@ -264,10 +449,10 @@ class UriCache:
         in the background; ('failed', error_str) when setup errored (the
         failure is consumed — a later poll retries)."""
         import asyncio
-        if not runtime_env or (not runtime_env.get("working_dir_uri")
-                               and not runtime_env.get("py_modules_uris")
-                               and not runtime_env.get("pip")
-                               and not runtime_env.get("uv")):
+        if not runtime_env or not any(
+                runtime_env.get(k) for k in
+                ("working_dir_uri", "py_modules_uris", "pip", "uv",
+                 "conda", "container")):
             # Only env_vars (or nothing): pure dict-building, no IO —
             # answer inline so the common case stays single-round-trip.
             env_extra = {k: str(v) for k, v in
@@ -306,6 +491,32 @@ class UriCache:
             if renv.get(kind):
                 py_paths.append(
                     await self.ensure_packages(renv[kind], kind))
+        if renv.get("conda"):
+            # Interpreter override: the spawned worker execs with the
+            # env's python (the zygote fork path is skipped for env'd
+            # workers, so the override always takes effect).  ray_tpu
+            # itself rides PYTHONPATH into the foreign interpreter.
+            env_extra["RAY_TPU_WORKER_PYTHON"] = \
+                await self.ensure_conda(renv["conda"])
+            import ray_tpu
+            py_paths.append(os.path.dirname(
+                os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+        if renv.get("container"):
+            spec = dict(renv["container"])
+            runtime = spec.get("runtime")
+            if not runtime:
+                import shutil as _sh
+                runtime = _sh.which("podman") or _sh.which("docker")
+                if runtime is None:
+                    raise RuntimeError(
+                        "runtime_env['container'] requires podman or "
+                        "docker on this node (or an explicit 'runtime' "
+                        "binary)")
+                spec["runtime"] = runtime
+            import ray_tpu
+            spec["pkg_root"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+            env_extra["RAY_TPU_WORKER_CONTAINER"] = json.dumps(spec)
         if py_paths:
             existing = env_extra.get("PYTHONPATH",
                                      os.environ.get("PYTHONPATH", ""))

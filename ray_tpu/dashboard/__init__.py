@@ -214,10 +214,20 @@ class DashboardHead:
             await gcs.call("ping", {})
             return 200, "text/plain", b"ok"
         if path == "/metrics":
+            from .grafana import cluster_series_text
             gcs = await self._gcs()
-            metrics = await gcs.call("get_metrics", {})
-            return (200, "text/plain; version=0.0.4",
-                    prometheus_text(metrics).encode())
+            metrics, nodes, actors, pgs = await asyncio.gather(
+                gcs.call("get_metrics", {}),
+                gcs.call("get_nodes", {}),
+                gcs.call("list_actors", {}),
+                gcs.call("list_placement_groups", {}))
+            body = (prometheus_text(metrics)
+                    + cluster_series_text(nodes, actors, pgs))
+            return 200, "text/plain; version=0.0.4", body.encode()
+        if path == "/api/grafana/dashboard":
+            from .grafana import dashboard_json
+            return (200, "application/json",
+                    json.dumps(dashboard_json()).encode())
         if path == "/api/timeline":
             from .._private.timeline import chrome_trace_events
             gcs = await self._gcs()

@@ -1,0 +1,177 @@
+"""Grafana provisioning: default dashboard + datasource configs.
+
+Reference surface: dashboard/modules/metrics/ — Ray generates Grafana
+dashboard JSON from panel factories
+(grafana_dashboard_factory.py / dashboards/default_dashboard_panels.py)
+and writes provisioning files so a Grafana pointed at the session dir
+auto-loads them.  TPU-native equivalent: the same two artifacts, built
+from this framework's Prometheus exposition (the dashboard head's
+/metrics route — cluster-state series below plus user metrics from
+ray_tpu.util.metrics).
+
+Usage:
+    from ray_tpu.dashboard.grafana import provision
+    provision("/tmp/grafana", prom_url="http://127.0.0.1:9090")
+    # -> grafana/provisioning/{datasources,dashboards}/*.yml + json
+
+or fetch the dashboard JSON live from the head:
+    GET /api/grafana/dashboard
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+# Cluster-state series the dashboard head derives from GCS state on each
+# scrape (names follow the reference's ray_* conventions).
+CLUSTER_SERIES = [
+    ("ray_tpu_cluster_nodes_alive", "gauge", "live nodes"),
+    ("ray_tpu_cluster_actors", "gauge", "actors by state (label: state)"),
+    ("ray_tpu_cluster_placement_groups", "gauge",
+     "placement groups by state"),
+    ("ray_tpu_cluster_resource_total", "gauge",
+     "cluster resource capacity (label: resource)"),
+    ("ray_tpu_cluster_resource_available", "gauge",
+     "cluster resource headroom (label: resource)"),
+]
+
+
+def _panel(pid: int, title: str, exprs: List[tuple], y: int, x: int = 0,
+           w: int = 12, h: int = 8, unit: str = "short") -> Dict[str, Any]:
+    return {
+        "id": pid,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "ray_tpu_prom"},
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+
+
+def dashboard_json() -> Dict[str, Any]:
+    """The default cluster dashboard (reference:
+    grafana_dashboard_factory.py generating default_grafana_dashboard —
+    panels keyed on the runtime's exposition names)."""
+    panels = [
+        _panel(1, "Live nodes",
+               [("ray_tpu_cluster_nodes_alive", "nodes")], y=0, x=0),
+        _panel(2, "Actors by state",
+               [('sum by (state) (ray_tpu_cluster_actors)',
+                 "{{state}}")], y=0, x=12),
+        _panel(3, "Cluster CPU",
+               [('ray_tpu_cluster_resource_total{resource="CPU"}',
+                 "total"),
+                ('ray_tpu_cluster_resource_available{resource="CPU"}',
+                 "available")], y=8, x=0),
+        _panel(4, "Cluster TPU",
+               [('ray_tpu_cluster_resource_total{resource="TPU"}',
+                 "total"),
+                ('ray_tpu_cluster_resource_available{resource="TPU"}',
+                 "available")], y=8, x=12),
+        _panel(5, "Placement groups",
+               [('sum by (state) (ray_tpu_cluster_placement_groups)',
+                 "{{state}}")], y=16, x=0),
+        _panel(6, "Object store bytes in use",
+               [('ray_tpu_cluster_resource_total{resource="object_store_'
+                 'memory"} - ray_tpu_cluster_resource_available{resource='
+                 '"object_store_memory"}', "bytes in use")],
+               y=16, x=12, unit="bytes"),
+    ]
+    return {
+        "uid": "ray_tpu_default",
+        "title": "ray_tpu cluster",
+        "timezone": "browser",
+        "refresh": "5s",
+        "schemaVersion": 39,
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+        "templating": {"list": []},
+        "annotations": {"list": []},
+    }
+
+
+def provision(root: str, prom_url: str = "http://127.0.0.1:9090") -> str:
+    """Write Grafana provisioning files under `root` (reference: the
+    metrics module writing grafana_ini / provisioning into the session
+    dir so `grafana-server --config ...` auto-loads Ray's dashboards).
+    Returns the provisioning directory."""
+    prov = os.path.join(root, "provisioning")
+    dash_dir = os.path.join(prov, "dashboards")
+    ds_dir = os.path.join(prov, "datasources")
+    os.makedirs(dash_dir, exist_ok=True)
+    os.makedirs(ds_dir, exist_ok=True)
+    with open(os.path.join(ds_dir, "ray_tpu_prometheus.yml", ), "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "datasources:\n"
+            "  - name: ray_tpu_prom\n"
+            "    uid: ray_tpu_prom\n"
+            "    type: prometheus\n"
+            f"    url: {prom_url}\n"
+            "    isDefault: true\n"
+            "    access: proxy\n")
+    with open(os.path.join(dash_dir, "ray_tpu_dashboards.yml"), "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "providers:\n"
+            "  - name: ray_tpu\n"
+            "    folder: ray_tpu\n"
+            "    type: file\n"
+            "    options:\n"
+            f"      path: {dash_dir}\n")
+    with open(os.path.join(dash_dir, "ray_tpu_default.json"), "w") as f:
+        json.dump(dashboard_json(), f, indent=1)
+    return prov
+
+
+def cluster_series_text(nodes: list, actors: list, pgs: list) -> str:
+    """Prometheus exposition of the CLUSTER_SERIES gauges, derived from
+    GCS state (appended to the /metrics route's user-metric text)."""
+    from . import _prom_escape
+    out: List[str] = []
+
+    def emit(name, help_, samples):
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lab = ("{" + ",".join(
+                f'{k}="{_prom_escape(str(v))}"'
+                for k, v in sorted(labels.items())) + "}"
+                   if labels else "")
+            out.append(f"{name}{lab} {value}")
+
+    emit("ray_tpu_cluster_nodes_alive", "live nodes",
+         [({}, sum(1 for n in nodes if n.get("alive")))])
+    by_state: Dict[str, int] = {"ALIVE": 0}  # baseline: series always exist
+    for a in actors:
+        s = a.get("state", "?")
+        s = s if isinstance(s, str) else str(s)
+        by_state[s] = by_state.get(s, 0) + 1
+    emit("ray_tpu_cluster_actors", "actors by state",
+         [({"state": s}, c) for s, c in sorted(by_state.items())])
+    pg_state: Dict[str, int] = {"CREATED": 0}
+    for p in pgs:
+        s = str(p.get("state", "?"))
+        pg_state[s] = pg_state.get(s, 0) + 1
+    emit("ray_tpu_cluster_placement_groups", "placement groups by state",
+         [({"state": s}, c) for s, c in sorted(pg_state.items())])
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for n in nodes:
+        if not n.get("alive"):
+            continue
+        for k, v in (n.get("resources_total") or {}).items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    emit("ray_tpu_cluster_resource_total", "cluster resource capacity",
+         [({"resource": k}, v) for k, v in sorted(total.items())])
+    emit("ray_tpu_cluster_resource_available", "cluster resource headroom",
+         [({"resource": k}, v) for k, v in sorted(avail.items())])
+    return "\n".join(out) + "\n"

@@ -158,3 +158,36 @@ def test_profile_endpoint_with_query_params(dashboard):
                if isinstance(w, dict))
     assert ray_tpu.get(ref, timeout=60) > 0
     ray_tpu.kill(b)
+
+
+def test_grafana_dashboard_and_cluster_series(dashboard, tmp_path):
+    """Grafana factory (reference: modules/metrics/
+    grafana_dashboard_factory.py): /api/grafana/dashboard serves panel
+    JSON whose exprs resolve against /metrics' cluster series, and
+    provision() writes a loadable provisioning tree."""
+    status, ctype, body = _get(dashboard, "/api/grafana/dashboard")
+    assert status == 200 and "json" in ctype
+    dash = json.loads(body)
+    assert dash["uid"] == "ray_tpu_default" and dash["panels"]
+
+    status, _, body = _get(dashboard, "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "ray_tpu_cluster_nodes_alive 1" in text
+    assert "ray_tpu_cluster_resource_total" in text
+    # Panel exprs must be built on series the exposition actually emits.
+    series = {line.split("{")[0].split(" ")[0]
+              for line in text.splitlines()
+              if line and not line.startswith("#")}
+    for panel in dash["panels"]:
+        for target in panel["targets"]:
+            expr = target["expr"]
+            assert any(s in expr for s in series), (panel["title"], expr)
+
+    from ray_tpu.dashboard.grafana import provision
+    prov = provision(str(tmp_path), prom_url="http://127.0.0.1:9999")
+    import os
+    assert os.path.exists(
+        os.path.join(prov, "datasources", "ray_tpu_prometheus.yml"))
+    dash_file = os.path.join(prov, "dashboards", "ray_tpu_default.json")
+    assert json.load(open(dash_file))["uid"] == "ray_tpu_default"

@@ -73,7 +73,7 @@ def test_unsupported_plugin_rejected(ray_start_regular):
         return 1
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        noop.options(runtime_env={"conda": {"dependencies": []}}).remote()
+        noop.options(runtime_env={"nsight": {"t": 1}}).remote()
 
 
 def test_uri_cache_reuses_package(ray_start_regular, tmp_path):
@@ -234,3 +234,113 @@ def test_actor_env_failure_buries_actor(ray_start_regular, tmp_path):
     with pytest.raises(ray_tpu.exceptions.ActorDiedError,
                        match="runtime env setup failed"):
         ray_tpu.get(a.hi.remote(), timeout=120)
+
+
+def test_conda_named_env_switches_interpreter(ray_start_regular, tmp_path):
+    """runtime_env={'conda': name}: the worker execs with the env's
+    python (reference: runtime_env/conda.py named-env reuse).  A fake
+    conda root with bin/python symlinked to the live interpreter proves
+    the interpreter override end-to-end without a conda install."""
+    envdir = tmp_path / "conda" / "envs" / "myenv" / "bin"
+    envdir.mkdir(parents=True)
+    fake_py = envdir / "python"
+    # A wrapper (not a bare symlink: symlinked interpreters lose their
+    # venv's site-packages to pyvenv.cfg resolution) that stamps a marker
+    # then execs the real interpreter — proving the worker launched
+    # through THIS env's python.
+    fake_py.write_text(
+        f"#!/bin/sh\nexport RENV_CONDA_MARK=myenv\n"
+        f'exec {sys.executable} "$@"\n')
+    fake_py.chmod(0o755)
+
+    @ray_tpu.remote
+    def conda_mark():
+        import os
+        return os.environ.get("RENV_CONDA_MARK")
+
+    # Absolute prefix path (also a reference shape): resolvable by the
+    # agent regardless of its own environment.
+    mark = ray_tpu.get(
+        conda_mark.options(
+            runtime_env={"conda": str(tmp_path / "conda" / "envs"
+                                      / "myenv")}).remote(), timeout=120)
+    assert mark == "myenv"
+
+
+def test_conda_missing_env_fails_actionably(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RayError,
+                       match="not found on this node"):
+        ray_tpu.get(f.options(
+            runtime_env={"conda": "no-such-env-zzz"}).remote(), timeout=120)
+
+
+def test_conda_spec_validation():
+    from ray_tpu._private.runtime_env import _normalize_conda_spec
+    assert _normalize_conda_spec("base") == {"name": "base"}
+    spec = _normalize_conda_spec(
+        {"dependencies": ["numpy", {"pip": ["chex"]}]})
+    assert spec == {"dependencies": ["numpy", {"pip": ["chex"]}]}
+    with pytest.raises(ValueError, match="dependencies"):
+        _normalize_conda_spec({})
+    with pytest.raises(ValueError, match="conda.*with.*pip|combine"):
+        from ray_tpu._private.runtime_env import package_runtime_env
+        package_runtime_env(None, {"conda": "base", "pip": ["x"]})
+
+
+def test_container_runtime_env(ray_start_regular, tmp_path):
+    """runtime_env={'container': {...}}: the worker launches through the
+    container engine command line (reference: runtime_env/container.py
+    podman run).  A fake engine binary records its argv — proving the
+    mount/env/image plumbing — then execs the worker locally, proving
+    the spawned process still registers and executes tasks."""
+    log = tmp_path / "engine_argv.json"
+    fake = tmp_path / "fake_engine.py"
+    fake.write_text(f"""#!{sys.executable}
+import json, os, sys
+with open({str(log)!r}, "w") as f:
+    json.dump(sys.argv, f)
+os.execv({sys.executable!r},
+         [{sys.executable!r}, "-m", "ray_tpu._private.worker_main"])
+""")
+    fake.chmod(0o755)
+
+    @ray_tpu.remote
+    def in_container():
+        return os.getpid()
+
+    pid = ray_tpu.get(in_container.options(runtime_env={
+        "container": {"image": "myrepo/myimage:1",
+                      "runtime": str(fake),
+                      "run_options": ["--annotation", "x=y"]}}).remote(),
+        timeout=120)
+    assert isinstance(pid, int)
+    import json as _json
+    argv = _json.loads(log.read_text())
+    # Engine line shape: run --rm --ipc=host --network=host, mounts,
+    # RAY_TPU_*/PYTHONPATH -e flags, run_options, image, worker module.
+    assert argv[1] == "run" and "--rm" in argv
+    assert "--ipc=host" in argv and "--network=host" in argv
+    assert "myrepo/myimage:1" in argv
+    assert "--annotation" in argv and "x=y" in argv
+    assert any(a.startswith("RAY_TPU_WORKER_ID=") for a in argv)
+    i = argv.index("myrepo/myimage:1")
+    assert argv[i + 1:] == ["python", "-m", "ray_tpu._private.worker_main"]
+
+
+def test_container_missing_engine_fails_actionably(ray_start_regular,
+                                                   monkeypatch):
+    import shutil as _sh
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    monkeypatch.setattr(_sh, "which", lambda *_: None)
+    with pytest.raises(ray_tpu.exceptions.RayError,
+                       match="podman or docker"):
+        ray_tpu.get(f.options(
+            runtime_env={"container": "img:1"}).remote(), timeout=120)
