@@ -1233,16 +1233,23 @@ class CoreWorker:
             return view
         if tuple(agent_addr) == self.agent_address:
             # Spilled primaries restore on demand (reference: raylet
-            # RestoreSpilledObject on the get path).
-            if await self.agent.call("restore_object", {"object_id": oid},
-                                     timeout=120):
-                view = self.store.get(oid, timeout_ms=0)
-                if view is not None:
-                    return view
-            else:
+            # RestoreSpilledObject on the get path).  Bounded retry: a
+            # restore can succeed (or report already-in-store) and the
+            # object be EVICTED again before this process maps it — under
+            # memory pressure with concurrent restores the window is
+            # real, and without the retry the tail wait below can never
+            # bring the object back (nothing re-restores it).
+            for _ in range(4):
+                if await self.agent.call("restore_object",
+                                         {"object_id": oid}, timeout=120):
+                    view = self.store.get(oid, timeout_ms=0)
+                    if view is not None:
+                        return view
+                    continue
                 spilled = await self._read_spilled(self.agent, oid)
                 if spilled is not None:
                     return spilled
+                break
             timeout_ms = 5_000 if deadline is None else int(
                 min(5.0, max(0.0, deadline - time.monotonic())) * 1000)
             view = self.store.get(oid, timeout_ms=timeout_ms)

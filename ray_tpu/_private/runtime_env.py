@@ -146,6 +146,14 @@ def _normalize_conda_spec(spec) -> dict:
             raise ValueError("runtime_env['conda'] name must be non-empty")
         return {"name": spec}
     if isinstance(spec, dict):
+        unknown = set(spec) - {"dependencies", "channels", "name"}
+        if unknown:
+            # Same strictness as top-level runtime_env keys: silently
+            # dropping e.g. a misspelled 'channels' would change which
+            # packages resolve with no error.
+            raise ValueError(
+                f"unsupported conda spec key(s) {sorted(unknown)}; "
+                "supported: dependencies, channels, name")
         deps = spec.get("dependencies")
         if not deps or not isinstance(deps, (list, tuple)):
             raise ValueError(
@@ -162,8 +170,11 @@ def _normalize_conda_spec(spec) -> dict:
                 norm.append({"pip": sorted(str(p) for p in pip)})
             else:
                 norm.append(str(d))
-        return {"dependencies":
-                sorted(norm, key=lambda d: json.dumps(d, sort_keys=True))}
+        out = {"dependencies":
+               sorted(norm, key=lambda d: json.dumps(d, sort_keys=True))}
+        if spec.get("channels"):
+            out["channels"] = [str(c) for c in spec["channels"]]
+        return out
     raise ValueError(
         "runtime_env['conda'] must be an env name (str) or a spec dict")
 
@@ -397,13 +408,16 @@ class UriCache:
             for d in spec["dependencies"]:
                 if isinstance(d, dict):
                     pips.extend(d["pip"])
+            chans: List[str] = []
+            for c in spec.get("channels") or []:
+                chans += ["-c", c]
 
             def _create():
                 os.makedirs(os.path.dirname(dest), exist_ok=True)
                 tmp = dest + f".tmp{os.getpid()}"
                 try:
                     proc = subprocess.run(
-                        [conda, "create", "-y", "-p", tmp] + pkgs,
+                        [conda, "create", "-y", "-p", tmp] + chans + pkgs,
                         capture_output=True, text=True, timeout=1800)
                     if proc.returncode != 0:
                         raise RuntimeError(

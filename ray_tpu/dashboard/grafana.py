@@ -25,17 +25,18 @@ import os
 from typing import Any, Dict, List
 
 # Cluster-state series the dashboard head derives from GCS state on each
-# scrape (names follow the reference's ray_* conventions).
-CLUSTER_SERIES = [
-    ("ray_tpu_cluster_nodes_alive", "gauge", "live nodes"),
-    ("ray_tpu_cluster_actors", "gauge", "actors by state (label: state)"),
-    ("ray_tpu_cluster_placement_groups", "gauge",
-     "placement groups by state"),
-    ("ray_tpu_cluster_resource_total", "gauge",
-     "cluster resource capacity (label: resource)"),
-    ("ray_tpu_cluster_resource_available", "gauge",
-     "cluster resource headroom (label: resource)"),
-]
+# scrape (names follow the reference's ray_* conventions).  Single source
+# of truth: cluster_series_text emits exactly these, with these HELP
+# strings, and the dashboard panels key on these names.
+CLUSTER_SERIES = {
+    "ray_tpu_cluster_nodes_alive": "live nodes",
+    "ray_tpu_cluster_actors": "actors by state (label: state)",
+    "ray_tpu_cluster_placement_groups": "placement groups by state",
+    "ray_tpu_cluster_resource_total":
+        "cluster resource capacity (label: resource)",
+    "ray_tpu_cluster_resource_available":
+        "cluster resource headroom (label: resource)",
+}
 
 
 def _panel(pid: int, title: str, exprs: List[tuple], y: int, x: int = 0,
@@ -136,8 +137,8 @@ def cluster_series_text(nodes: list, actors: list, pgs: list) -> str:
     from . import _prom_escape
     out: List[str] = []
 
-    def emit(name, help_, samples):
-        out.append(f"# HELP {name} {help_}")
+    def emit(name, samples):
+        out.append(f"# HELP {name} {CLUSTER_SERIES[name]}")
         out.append(f"# TYPE {name} gauge")
         for labels, value in samples:
             lab = ("{" + ",".join(
@@ -146,20 +147,20 @@ def cluster_series_text(nodes: list, actors: list, pgs: list) -> str:
                    if labels else "")
             out.append(f"{name}{lab} {value}")
 
-    emit("ray_tpu_cluster_nodes_alive", "live nodes",
+    emit("ray_tpu_cluster_nodes_alive",
          [({}, sum(1 for n in nodes if n.get("alive")))])
     by_state: Dict[str, int] = {"ALIVE": 0}  # baseline: series always exist
     for a in actors:
         s = a.get("state", "?")
         s = s if isinstance(s, str) else str(s)
         by_state[s] = by_state.get(s, 0) + 1
-    emit("ray_tpu_cluster_actors", "actors by state",
+    emit("ray_tpu_cluster_actors",
          [({"state": s}, c) for s, c in sorted(by_state.items())])
     pg_state: Dict[str, int] = {"CREATED": 0}
     for p in pgs:
         s = str(p.get("state", "?"))
         pg_state[s] = pg_state.get(s, 0) + 1
-    emit("ray_tpu_cluster_placement_groups", "placement groups by state",
+    emit("ray_tpu_cluster_placement_groups",
          [({"state": s}, c) for s, c in sorted(pg_state.items())])
     total: Dict[str, float] = {}
     avail: Dict[str, float] = {}
@@ -170,8 +171,8 @@ def cluster_series_text(nodes: list, actors: list, pgs: list) -> str:
             total[k] = total.get(k, 0.0) + v
         for k, v in (n.get("resources_available") or {}).items():
             avail[k] = avail.get(k, 0.0) + v
-    emit("ray_tpu_cluster_resource_total", "cluster resource capacity",
+    emit("ray_tpu_cluster_resource_total",
          [({"resource": k}, v) for k, v in sorted(total.items())])
-    emit("ray_tpu_cluster_resource_available", "cluster resource headroom",
+    emit("ray_tpu_cluster_resource_available",
          [({"resource": k}, v) for k, v in sorted(avail.items())])
     return "\n".join(out) + "\n"

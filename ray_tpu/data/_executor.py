@@ -125,13 +125,63 @@ def _run_pipeline_streaming(read_task, ops: List[Operator]):
     yield from gen
 
 
+class _ActorPool:
+    """Per-op actor pool with load-driven autoscaling (reference:
+    _internal/actor_autoscaler/ + actor_pool_map_operator.py).  pick()
+    routes to the least-loaded actor; when EVERY actor already carries
+    >= _SATURATED in-flight blocks and the pool is below max, a new
+    actor spawns first.
+
+    Load accounting is by outstanding result refs, reconciled lazily at
+    the next pick() with a zero-timeout non-fetching wait — block VALUES
+    never transit the driver (the module's no-driver-copy invariant),
+    and everything runs on the caller's thread (no cross-thread counter
+    races)."""
+
+    _SATURATED = 2
+
+    def __init__(self, op):
+        self.op = op
+        self.max_size = op.actor_pool_max or op.actor_pool_size
+        self.actors = [_MapActor.remote(op)
+                       for _ in range(op.actor_pool_size)]
+        self.outstanding = [[] for _ in self.actors]
+
+    def _reconcile(self) -> None:
+        for i, refs in enumerate(self.outstanding):
+            if refs:
+                _done, rest = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=0,
+                    fetch_local=False)
+                self.outstanding[i] = rest
+
+    def pick(self) -> int:
+        self._reconcile()
+        i = min(range(len(self.actors)),
+                key=lambda j: len(self.outstanding[j]))
+        if (len(self.outstanding[i]) >= self._SATURATED
+                and len(self.actors) < self.max_size):
+            self.actors.append(_MapActor.remote(self.op))
+            self.outstanding.append([])
+            i = len(self.actors) - 1
+        return i
+
+    def apply(self, ref):
+        i = self.pick()
+        out = self.actors[i].apply.remote(ref)
+        self.outstanding[i].append(out)
+        return out
+
+    def size(self) -> int:
+        return len(self.actors)
+
+
 def _build_pipeline_launcher(plan: Plan, pools: dict):
     def launch(idx: int):
         ref = _run_read.remote(plan.read_tasks[idx])
         for i, op in enumerate(plan.ops):
             if i in pools:
-                pool = pools[i]
-                ref = pool[idx % len(pool)].apply.remote(ref)
+                ref = pools[i].apply(ref)
             else:
                 ref = _run_op.remote(op, ref)
         return ref
@@ -139,12 +189,8 @@ def _build_pipeline_launcher(plan: Plan, pools: dict):
 
 
 def _make_actor_pools(plan: Plan) -> dict:
-    pools = {}
-    for i, op in enumerate(plan.ops):
-        if op.compute == "actors":
-            pools[i] = [_MapActor.remote(op)
-                        for _ in range(op.actor_pool_size)]
-    return pools
+    return {i: _ActorPool(op) for i, op in enumerate(plan.ops)
+            if op.compute == "actors"}
 
 
 def execute_streaming(plan: Plan,
@@ -201,7 +247,7 @@ def execute_streaming(plan: Plan,
             yield from blocks
     finally:
         for pool in pools.values():
-            for a in pool:
+            for a in pool.actors:
                 try:
                     ray_tpu.kill(a)
                 except Exception:
@@ -229,7 +275,7 @@ def execute_to_refs(plan: Plan) -> List:
                 pending, num_returns=len(pending), timeout=600,
                 fetch_local=False)
         for pool in pools.values():
-            for a in pool:
+            for a in pool.actors:
                 try:
                     ray_tpu.kill(a)
                 except Exception:

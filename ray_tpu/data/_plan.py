@@ -40,6 +40,10 @@ class Operator:
     fn_constructor: Optional[Callable[[], Any]] = None
     compute: str = "tasks"
     actor_pool_size: int = 2
+    # None = fixed pool; an int caps load-driven upscaling (reference:
+    # _internal/actor_autoscaler/ — per-op pools grow toward max while
+    # every actor is saturated, via concurrency=(min, max)).
+    actor_pool_max: Optional[int] = None
     num_cpus: float = 1.0
 
     def resolve_transform(self) -> Callable[[Block], List[Block]]:

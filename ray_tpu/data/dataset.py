@@ -62,6 +62,20 @@ class Dataset:
             ctor_kwargs = fn_constructor_kwargs or {}
             ctor = functools.partial(fn, *fn_constructor_args,
                                      **ctor_kwargs)
+            # concurrency=(min, max) -> autoscaling pool (reference:
+            # ActorPoolStrategy(min_size, max_size) /
+            # concurrency tuples in dataset.py map_batches).
+            pool_min, pool_max = concurrency or 2, None
+            if isinstance(concurrency, (tuple, list)):
+                if len(concurrency) != 2:
+                    raise ValueError(
+                        f"concurrency must be an int or a (min, max) "
+                        f"pair, got {concurrency!r}")
+                pool_min, pool_max = int(concurrency[0]), int(concurrency[1])
+                if not 0 < pool_min <= pool_max:
+                    raise ValueError(
+                        f"concurrency=(min, max) requires 0 < min <= max, "
+                        f"got {concurrency}")
             op = Operator(
                 name=f"MapBatches({fn.__name__})",
                 transform_from_fn=functools.partial(
@@ -70,7 +84,8 @@ class Dataset:
                     batch_format=batch_format),
                 fn_constructor=ctor,
                 compute=compute or "actors",
-                actor_pool_size=concurrency or 2,
+                actor_pool_size=pool_min,
+                actor_pool_max=pool_max,
                 num_cpus=num_cpus)
         else:
             op = Operator(

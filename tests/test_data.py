@@ -196,3 +196,60 @@ def test_dataset_feeds_jax_trainer(ray_start_regular):
     assert result.error is None, result.error
     # each worker saw half the rows, twice (2 epochs)
     assert result.metrics["rows"] == 32
+
+
+def test_actor_pool_autoscaling_unit():
+    """Load-driven pool growth (reference: _internal/actor_autoscaler/):
+    pick() routes to the least-loaded actor and grows only when every
+    actor is saturated and the pool is below max.  Loads are simulated
+    through `outstanding`; _reconcile is stubbed (no cluster)."""
+    from ray_tpu.data._executor import _ActorPool
+
+    class FakePool(_ActorPool):
+        def __init__(self, min_size, max_size):
+            self.op = None
+            self.max_size = max_size
+            self.actors = list(range(min_size))
+            self.outstanding = [[] for _ in range(min_size)]
+
+        def _reconcile(self):
+            pass                       # loads are set by hand below
+
+    import ray_tpu.data._executor as ex
+    orig = ex._MapActor
+
+    class _Stub:
+        @staticmethod
+        def remote(op):
+            return object()
+    ex._MapActor = _Stub
+    try:
+        pool = FakePool(1, 3)
+        assert pool.pick() == 0
+        pool.outstanding[0] = ["a", "b"]   # actor 0 saturated -> grow
+        assert pool.pick() == 1 and pool.size() == 2
+        pool.outstanding[1] = ["c", "d"]   # both saturated -> grow to max
+        assert pool.pick() == 2 and pool.size() == 3
+        pool.outstanding[2] = list("vwxyz")  # at max: pick least-loaded
+        pool.outstanding[0] = ["a"]
+        assert pool.pick() == 0 and pool.size() == 3
+    finally:
+        ex._MapActor = orig
+
+
+def test_map_batches_concurrency_tuple(ray_start_regular):
+    """concurrency=(min, max) runs correctly end-to-end through the
+    autoscaling pool (results identical to a fixed pool)."""
+    import ray_tpu.data as rdata
+
+    class AddOne:
+        def __call__(self, batch):
+            return {"id": batch["id"] + 1}
+
+    ds = rdata.range(40, parallelism=8).map_batches(
+        AddOne, concurrency=(1, 3), batch_size=5)
+    vals = sorted(int(r["id"]) for r in ds.take_all())
+    assert vals == list(range(1, 41))
+
+    with pytest.raises(ValueError, match="min <= max"):
+        rdata.range(4).map_batches(AddOne, concurrency=(3, 1))

@@ -331,16 +331,17 @@ os.execv({sys.executable!r},
     assert argv[i + 1:] == ["python", "-m", "ray_tpu._private.worker_main"]
 
 
-def test_container_missing_engine_fails_actionably(ray_start_regular,
-                                                   monkeypatch):
+def test_container_missing_engine_fails_actionably(tmp_path, monkeypatch):
+    """Engine lookup runs in UriCache.setup (agent-side in production);
+    unit-test it directly so the check is deterministic regardless of
+    whether the host happens to have podman/docker installed."""
+    import asyncio
     import shutil as _sh
 
-    @ray_tpu.remote
-    def f():
-        return 1
+    from ray_tpu._private.runtime_env import UriCache, package_runtime_env
 
     monkeypatch.setattr(_sh, "which", lambda *_: None)
-    with pytest.raises(ray_tpu.exceptions.RayError,
-                       match="podman or docker"):
-        ray_tpu.get(f.options(
-            runtime_env={"container": "img:1"}).remote(), timeout=120)
+    renv = package_runtime_env(None, {"container": "img:1"})
+    cache = UriCache(str(tmp_path))
+    with pytest.raises(RuntimeError, match="podman or docker"):
+        asyncio.run(cache.setup(None, renv))
