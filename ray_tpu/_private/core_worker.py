@@ -1246,10 +1246,13 @@ class CoreWorker:
                     if view is not None:
                         return view
                     continue
-                spilled = await self._read_spilled(self.agent, oid)
-                if spilled is not None:
-                    return spilled
                 break
+            # Restore failed — or succeeded 4x with the copy evicted (and
+            # re-spilled) before this process mapped it.  Either way the
+            # spill file is the durable copy: read it directly.
+            spilled = await self._read_spilled(self.agent, oid)
+            if spilled is not None:
+                return spilled
             timeout_ms = 5_000 if deadline is None else int(
                 min(5.0, max(0.0, deadline - time.monotonic())) * 1000)
             view = self.store.get(oid, timeout_ms=timeout_ms)

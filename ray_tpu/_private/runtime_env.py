@@ -155,10 +155,19 @@ def _normalize_conda_spec(spec) -> dict:
                 f"unsupported conda spec key(s) {sorted(unknown)}; "
                 "supported: dependencies, channels, name")
         deps = spec.get("dependencies")
+        if not deps and spec.get("name"):
+            # {'name': ...} alone = reuse an existing env, same as the
+            # plain-string shape (environment.yml carries a name).
+            return {"name": str(spec["name"])}
         if not deps or not isinstance(deps, (list, tuple)):
             raise ValueError(
                 "runtime_env['conda'] dict must carry a non-empty "
-                "'dependencies' list (conda yaml shape)")
+                "'dependencies' list (conda yaml shape), or just a "
+                "'name' to reuse an existing env")
+        chans = spec.get("channels")
+        if chans is not None and not isinstance(chans, (list, tuple)):
+            raise ValueError(
+                "conda spec 'channels' must be a list of channel names")
         norm: List = []
         for d in deps:
             if isinstance(d, dict):
@@ -172,8 +181,11 @@ def _normalize_conda_spec(spec) -> dict:
                 norm.append(str(d))
         out = {"dependencies":
                sorted(norm, key=lambda d: json.dumps(d, sort_keys=True))}
-        if spec.get("channels"):
-            out["channels"] = [str(c) for c in spec["channels"]]
+        if chans:
+            out["channels"] = [str(c) for c in chans]
+        # A 'name' alongside dependencies is cosmetic in conda yaml (the
+        # env here is content-addressed); keep it out of the normalized
+        # spec so identical dependency sets share one cached env.
         return out
     raise ValueError(
         "runtime_env['conda'] must be an env name (str) or a spec dict")

@@ -6,8 +6,9 @@ is importable (and the rest of the framework fully functional) without
 any tracker installed.
 """
 
+from .comet import CometLoggerCallback
 from .mlflow import MlflowLoggerCallback, setup_mlflow
 from .wandb import WandbLoggerCallback, setup_wandb
 
-__all__ = ["MlflowLoggerCallback", "WandbLoggerCallback", "setup_mlflow",
-           "setup_wandb"]
+__all__ = ["CometLoggerCallback", "MlflowLoggerCallback",
+           "WandbLoggerCallback", "setup_mlflow", "setup_wandb"]

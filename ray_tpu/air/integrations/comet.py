@@ -1,0 +1,75 @@
+"""Comet ML integration (reference:
+python/ray/air/integrations/comet.py — CometLoggerCallback logging
+tune/train results).
+
+Same lazy-import contract as the wandb/mlflow integrations: comet_ml is
+resolved at construction time with a clear error, and the module is
+injectable for tests."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ...train.callbacks import UserCallback
+
+
+def _import_comet():
+    try:
+        import comet_ml
+    except ImportError:
+        raise ImportError(
+            "comet_ml is not installed. Install it (pip install comet-ml) "
+            "to use CometLoggerCallback.") from None
+    return comet_ml
+
+
+class CometLoggerCallback(UserCallback):
+    """Driver-side results -> a Comet experiment (reference:
+    CometLoggerCallback).  Attach via
+    RunConfig(callbacks=[CometLoggerCallback(project_name=...)]); every
+    rank-0 report lands as one log_metrics() step."""
+
+    def __init__(self, project_name: Optional[str] = None, *,
+                 workspace: Optional[str] = None,
+                 tags: Optional[list] = None,
+                 config: Optional[dict] = None, **experiment_kwargs):
+        # Fail fast at construction (see WandbLoggerCallback: the
+        # controller's callback dispatch is best-effort).
+        _import_comet()
+        self.project_name = project_name
+        self.workspace = workspace
+        self.tags = list(tags or [])
+        self.config = dict(config or {})
+        self.experiment_kwargs = experiment_kwargs
+        self._exp = None
+        self._step = 0
+
+    def on_start(self, *, world_size: int, attempt: int) -> None:
+        if self._exp is not None:        # elastic restart: keep the exp
+            return
+        comet_ml = _import_comet()
+        self._exp = comet_ml.Experiment(
+            project_name=self.project_name, workspace=self.workspace,
+            **self.experiment_kwargs)
+        for t in self.tags:
+            self._exp.add_tag(t)
+        if self.config:
+            self._exp.log_parameters(self.config)
+        self._exp.log_parameter("world_size", world_size)
+
+    def on_report(self, *, metrics: Dict[str, Any], checkpoint=None
+                  ) -> None:
+        if self._exp is not None:
+            self._step += 1
+            self._exp.log_metrics(
+                {k: v for k, v in metrics.items()
+                 if isinstance(v, (int, float))}, step=self._step)
+
+    def on_failure(self, *, error: str, failure_count: int) -> None:
+        if self._exp is not None:
+            self._exp.log_other("failure_count", failure_count)
+
+    def on_shutdown(self, *, result) -> None:
+        if self._exp is not None:
+            self._exp.end()
+            self._exp = None

@@ -88,6 +88,14 @@ class Dataset:
                 actor_pool_max=pool_max,
                 num_cpus=num_cpus)
         else:
+            if isinstance(concurrency, (tuple, list)):
+                # Reference semantics: tuple concurrency configures an
+                # autoscaling ACTOR pool and requires a callable class.
+                raise ValueError(
+                    "concurrency=(min, max) requires `fn` to be a "
+                    "callable class (it configures an actor pool); "
+                    "plain functions run as tasks whose parallelism "
+                    "follows the block/pipeline windows")
             op = Operator(
                 name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
                 transform=_plan.make_map_batches(

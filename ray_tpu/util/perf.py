@@ -282,15 +282,18 @@ def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
 
 
 BENCHES: Dict[str, Callable[[float], float]] = {
-    # name -> bench fn; units live in UNITS, reference values in BASELINE
+    # name -> bench fn; units live in UNITS, reference values in BASELINE.
+    # Ordering is deliberate on small hosts: the multi-client benches run
+    # BEFORE n_n (whose end-of-bench actor kills trigger zygote pool
+    # respawns that otherwise overlap the next measurement).
     "single_client_tasks_sync": bench_tasks_sync,
     "single_client_tasks_async": bench_tasks_async,
     "1_1_actor_calls_sync": bench_actor_calls_sync,
     "1_1_actor_calls_async": bench_actor_calls_async,
-    "n_n_actor_calls_async": bench_n_n_actor_calls,
     "multi_client_tasks_async": bench_multi_client_tasks_async,
     "multi_client_put_calls": bench_multi_client_put_calls,
     "multi_client_put_gigabytes": bench_multi_client_put_gigabytes,
+    "n_n_actor_calls_async": bench_n_n_actor_calls,
     "single_client_put_calls": bench_put_calls,
     "single_client_get_calls": bench_get_calls,
     "single_client_put_gigabytes": bench_put_gigabytes,
@@ -340,12 +343,16 @@ def run_microbenchmarks(min_time_s: float = 1.0,
     for name, fn in BENCHES.items():
         if only and name not in only:
             continue
-        # Settle: let the previous bench's lease returns / worker recycling
-        # finish so its cleanup doesn't steal CPU from this measurement
-        # (ordering effects dominated run-to-run variance on small hosts —
-        # killed bench actors respawn pool workers via the zygote, and on
-        # a 1-core host that churn overlaps the next bench's warmup).
-        time.sleep(2.0)
+        # Quiesce: let the previous bench's lease returns / worker
+        # respawns finish so its cleanup doesn't steal CPU from this
+        # measurement (ordering effects dominated run-to-run variance on
+        # small hosts — killed bench actors respawn pool workers via the
+        # zygote, and on a 1-core host that churn overlaps the next
+        # bench's warmup).  The noop round forces pool restock to
+        # COMPLETE rather than guessing a sleep long enough.
+        time.sleep(1.0)
+        warmup_cluster(40)
+        time.sleep(1.0)
         rate = fn(min_time_s)
         results[name] = {
             "value": round(rate, 2),

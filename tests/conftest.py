@@ -28,6 +28,17 @@ if os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu") == "cpu":
         # tests that need the 8-device mesh will fail loudly instead of the
         # whole session aborting at collection.
         pass
+    # Persistent compilation cache: the model/collective tests recompile
+    # identical jaxprs every run (the suite's biggest wall-time sink on
+    # small hosts); cache them across tests AND runs.  Workers spawned by
+    # the runtime inherit the env var.
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu_jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except (RuntimeError, AttributeError):
+        pass
 
 import pytest
 

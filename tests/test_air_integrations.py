@@ -127,3 +127,44 @@ def test_train_runconfig_accepts_integration_callback(fake_wandb,
     trainer.fit()
     names = [c[0] for c in fake_wandb.calls]
     assert names.count("log") == 3 and names[-1] == "finish"
+
+
+@pytest.fixture
+def fake_comet(monkeypatch):
+    rec = _Recorder()
+
+    class _Exp:
+        def __init__(self, **kw):
+            rec.calls.append(("Experiment", (), kw))
+
+        def __getattr__(self, name):
+            def method(*a, **kw):
+                rec.calls.append((name, a, kw))
+            return method
+
+    mod = types.ModuleType("comet_ml")
+    mod.Experiment = _Exp
+    monkeypatch.setitem(sys.modules, "comet_ml", mod)
+    return rec
+
+
+def test_comet_callback_lifecycle(fake_comet):
+    from ray_tpu.air.integrations import CometLoggerCallback
+    cb = CometLoggerCallback(project_name="p", tags=["t1"],
+                             config={"lr": 0.1})
+    cb.on_start(world_size=4, attempt=0)
+    cb.on_report(metrics={"loss": 1.5, "note": "skip-me"})
+    cb.on_shutdown(result=None)
+    names = [c[0] for c in fake_comet.calls]
+    assert names == ["Experiment", "add_tag", "log_parameters",
+                     "log_parameter", "log_metrics", "end"]
+    assert fake_comet.calls[0][2]["project_name"] == "p"
+    # Non-numeric metrics filtered; step attached.
+    args, kw = fake_comet.calls[4][1], fake_comet.calls[4][2]
+    assert args[0] == {"loss": 1.5} and kw["step"] == 1
+    # Elastic restart keeps the experiment.
+    cb2 = CometLoggerCallback(project_name="p")
+    cb2.on_start(world_size=4, attempt=0)
+    n_exp = [c[0] for c in fake_comet.calls].count("Experiment")
+    cb2.on_start(world_size=2, attempt=1)
+    assert [c[0] for c in fake_comet.calls].count("Experiment") == n_exp
