@@ -2195,12 +2195,13 @@ class CoreWorker:
         self._handle_reply(spec, task, reply)
         self._schedule_pump(key, state)
 
+    _REPLY_EVENT = {"ok": "FINISHED", "cancelled": "CANCELLED"}
+
     def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
         task_id = spec["task_id"]
         self.record_task_event(
             task_id, spec.get("name") or spec.get("method", ""),
-            {"ok": "FINISHED", "cancelled": "CANCELLED"}.get(
-                reply.get("status"), "FAILED"))
+            self._REPLY_EVENT.get(reply.get("status"), "FAILED"))
         if reply.get("status") == "ok":
             # In-band borrow registration (see worker_main: reply["borrows"])
             # — must precede _release_task_pins below so a stored arg ref

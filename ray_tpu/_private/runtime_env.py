@@ -158,6 +158,11 @@ def _normalize_conda_spec(spec) -> dict:
         if not deps and spec.get("name"):
             # {'name': ...} alone = reuse an existing env, same as the
             # plain-string shape (environment.yml carries a name).
+            if spec.get("channels"):
+                raise ValueError(
+                    "conda spec 'channels' has no effect when reusing an "
+                    "existing env by name — drop it, or provide "
+                    "'dependencies' to build an env")
             return {"name": str(spec["name"])}
         if not deps or not isinstance(deps, (list, tuple)):
             raise ValueError(

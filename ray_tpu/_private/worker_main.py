@@ -363,9 +363,11 @@ class Executor:
         try:
             while q:
                 chunk = []
+                fns = []          # gate-resolved callable per chunk item
                 while q and len(chunk) < 128:
                     spec, fut = q[0]
-                    if gate(spec) is None:
+                    fn = gate(spec)
+                    if fn is None:
                         if chunk:
                             break          # run the fast chunk first
                         q.popleft()
@@ -387,12 +389,13 @@ class Executor:
                         continue
                     q.popleft()
                     chunk.append((spec, fut))
+                    fns.append(fn)
                 if not chunk:
                     continue
                 self._active_chunks.append(chunk)
                 try:
                     async with self._task_lock:
-                        replies = await self._execute_chunk(chunk, gate)
+                        replies = await self._execute_chunk(chunk, fns)
                 except BaseException as e:  # noqa: BLE001 — an infra
                     # failure (executor shutdown, drain cancellation) must
                     # still resolve every popped future, or the submitter's
@@ -411,11 +414,12 @@ class Executor:
                 setattr(self, flag, True)
                 rpc.spawn(self._drain_chunked(q, flag, gate))
 
-    async def _execute_chunk(self, chunk, resolve_fn):
+    async def _execute_chunk(self, chunk, fns):
         """Execute a burst of inline-arg sync functions: per-task
         bookkeeping matches _execute (events, cancel semantics, borrow
         metadata), but all user functions run in a single executor
-        submission. resolve_fn maps spec -> callable (the drain gate)."""
+        submission. fns[i] is the gate-resolved callable for chunk[i]
+        (resolved once in the drain loop)."""
         loop = asyncio.get_running_loop()
         replies: list = [None] * len(chunk)
         runnable = []                      # (i, tid, method, args, kwargs)
@@ -428,12 +432,14 @@ class Executor:
             self.core.record_task_event(
                 tid, spec.get("name") or spec.get("method", ""), "RUNNING")
             try:
-                args, kwargs = await self._resolve_arg_entries(spec["args"])
-                method = resolve_fn(spec)
-                if method is None:
-                    raise exc.RayError(
-                        f"chunk spec no longer resolvable: "
-                        f"{spec.get('name') or spec.get('method', '')}")
+                if spec["args"]:
+                    args, kwargs = await self._resolve_arg_entries(
+                        spec["args"])
+                else:
+                    # No-arg fast path (ping/fan-out load): skip the
+                    # resolver coroutine round trip entirely.
+                    args, kwargs = (), {}
+                method = fns[i]
                 runnable.append((i, tid, method, args, kwargs, spec))
             except Exception as e:  # noqa: BLE001
                 replies[i] = self._error_reply(e)
@@ -478,9 +484,16 @@ class Executor:
                     prev = self.core.current_task_id
                     self.core.current_task_id = tid
                     try:
-                        returns = await self._serialize_returns(
-                            tid, spec["nreturns"], payload,
-                            caller_addr=spec.get("owner_addr"))
+                        if payload is None and spec["nreturns"] == 1:
+                            # Constant wire form, no nested refs, no
+                            # plasma: skip two coroutine round trips on
+                            # the dominant fan-out reply shape.
+                            returns = [{"inline":
+                                        get_context().none_blob()}]
+                        else:
+                            returns = await self._serialize_returns(
+                                tid, spec["nreturns"], payload,
+                                caller_addr=spec.get("owner_addr"))
                         reply = {"status": "ok", "returns": returns}
                         caller = spec.get("owner_addr")
                         if caller is not None:

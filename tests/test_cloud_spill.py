@@ -89,18 +89,35 @@ def test_spill_uploads_durable_copies(cloud_spill_cluster):
     bucket = cloud_spill_cluster
     arrays = [np.full(4 << 20, i, dtype=np.uint8) for i in range(16)]
     refs = [ray_tpu.put(a) for a in arrays]   # 64 MiB >> 32 MiB arena
-    import time
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline and len(_mock_files(bucket)) == 0:
-        time.sleep(0.2)
-    assert _mock_files(bucket), "no durable copies were uploaded"
-
-    # Destroy the CURRENT session's local spill files — only the cloud
-    # tier remains (scoped: other sessions' leftovers are not ours).
     import glob
-    session = max(glob.glob("/tmp/ray_tpu/session_*"),
-                  key=os.path.getmtime)
-    for f in glob.glob(os.path.join(session, "spill", "*", "*")):
+    import time
+
+    from ray_tpu._private.worker import global_runtime
+    session = global_runtime().session_dir
+    spill_glob = os.path.join(session, "spill", "*", "*")
+
+    # Uploads are asynchronous: before destroying the local spill files,
+    # wait until EVERY spilled object has its durable copy (waiting for
+    # just one upload raced the deletion against in-flight uploads under
+    # load and lost objects for real).
+    deadline = time.monotonic() + 60
+    local: list = []
+    while time.monotonic() < deadline:
+        # Sample local BEFORE the bucket: coverage of a stale local
+        # snapshot can only be an underestimate, never a false positive,
+        # and asserting on the SAME snapshot that satisfied the loop
+        # avoids re-racing in-flight spills.
+        local = glob.glob(spill_glob)
+        if local and len(_mock_files(bucket)) >= len(local):
+            break
+        time.sleep(0.2)
+    assert local, "nothing spilled"
+    assert len(_mock_files(bucket)) >= len(local), \
+        "durable copies did not cover the local spill set"
+
+    # Destroy the session's local spill files — only the cloud tier
+    # remains (= the spiller's disk is gone).
+    for f in local:
         os.unlink(f)
     for i, ref in enumerate(refs):
         got = ray_tpu.get(ref, timeout=60)
