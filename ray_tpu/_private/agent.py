@@ -242,6 +242,8 @@ class NodeAgent:
             "worker_blocked": self.h_worker_blocked,
             "worker_unblocked": self.h_worker_unblocked,
             "profile_worker": self.h_profile_worker,
+            "list_logs": self.h_list_logs,
+            "read_log": self.h_read_log,
             "shutdown": self.h_shutdown,
         }
 
@@ -511,6 +513,11 @@ class NodeAgent:
             # → no accelerator; jax_trainer.py:92-94 driver warning).
             if env.get("JAX_PLATFORMS", "") not in ("", "cpu"):
                 env["JAX_PLATFORMS"] = "cpu"
+        chaos_spec = get_config().rpc_chaos
+        if chaos_spec:
+            # Chaos must reach worker processes too (their config builds
+            # from env; _system_config stops at the daemons' argv).
+            env.setdefault("RAY_TPU_rpc_chaos", chaos_spec)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
@@ -590,6 +597,51 @@ class NodeAgent:
         cmd += [spec["image"], "python", "-m",
                 "ray_tpu._private.worker_main"]
         return cmd
+
+    # --- log access (reference: dashboard state head log streaming;
+    # `ray logs` lists/reads node log files via the node's agent) -------
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    async def h_list_logs(self, conn, p):
+        """Log filenames on this node, with sizes (reference: state API
+        list_logs — per-node file listing, optionally glob-filtered)."""
+        import fnmatch
+        pat = (p or {}).get("glob") or "*"
+        log_dir = self._log_dir()
+        out = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                if fnmatch.fnmatch(name, pat):
+                    try:
+                        size = os.path.getsize(os.path.join(log_dir, name))
+                    except OSError:
+                        continue
+                    out.append({"name": name, "size": size})
+        except FileNotFoundError:
+            pass
+        return out
+
+    async def h_read_log(self, conn, p):
+        """Tail of one log file (reference: state API get_log).  `lines`
+        caps the tail; reads are bounded to 4 MiB so a runaway log can't
+        blow the RPC frame."""
+        name = os.path.basename(p["name"])    # no path traversal
+        path = os.path.join(self._log_dir(), name)
+        lines = int(p.get("lines", 1000))
+        if lines <= 0:
+            return ""                 # [-0:] would be the WHOLE file
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                cap = 4 << 20
+                if size > cap:
+                    f.seek(size - cap)
+                data = f.read(cap)
+        except OSError:
+            return None
+        text = data.decode("utf-8", "replace")
+        return "\n".join(text.splitlines()[-lines:])
 
     async def h_register_worker(self, conn, p):
         wh = self.workers.get(p["worker_id"])
@@ -1618,6 +1670,9 @@ class NodeAgent:
 async def _amain(args):
     rpc.enable_eager_tasks()
     set_config(Config(json.loads(args.system_config) if args.system_config else None))
+    chaos_spec = get_config().rpc_chaos
+    if chaos_spec:
+        rpc.enable_chaos(chaos_spec)
     agent = NodeAgent(
         gcs_address=json.loads(args.gcs_address),
         session_dir=args.session_dir,

@@ -244,6 +244,13 @@ class CoreWorker:
         await self._connect()
 
     async def _connect(self):
+        # Chaos wiring: the rpc_chaos config ('Method=N:req%:resp%',
+        # reference: rpc_chaos.cc RAY_testing_rpc_failure) applies to
+        # every process whose config carries it — set
+        # RAY_TPU_rpc_chaos in the environment to inject cluster-wide.
+        chaos_spec = get_config().rpc_chaos
+        if chaos_spec:
+            rpc.enable_chaos(chaos_spec)
         self._server = rpc.RpcServer(self._handlers(), name=f"cw-{self.mode}")
         self.address = await self._server.start_tcp("127.0.0.1", 0)
         # Reconnecting: calls issued across a GCS restart re-dial and

@@ -1091,6 +1091,12 @@ class GcsServer:
 
 async def _amain(args):
     rpc.enable_eager_tasks()
+    from .config import Config, get_config as _gcfg, set_config
+    if args.system_config:
+        set_config(Config(json.loads(args.system_config)))
+    chaos_spec = _gcfg().rpc_chaos
+    if chaos_spec:
+        rpc.enable_chaos(chaos_spec)
     server = GcsServer(port=args.port,
                        journal_path=args.journal or None)
     addr = await server.start()
@@ -1109,6 +1115,7 @@ def main():
     parser.add_argument("--ready-file", default="")
     parser.add_argument("--journal", default="")
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument("--system-config", default="")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     from .node import install_daemon_profiler

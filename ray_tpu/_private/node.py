@@ -93,7 +93,9 @@ def _spawn(args, session_dir: str, tag: str) -> subprocess.Popen:
                             start_new_session=True, env=child_env())
 
 
-def start_gcs(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen, tuple]:
+def start_gcs(session_dir: str, port: int = 0,
+              system_config: Optional[dict] = None
+              ) -> Tuple[subprocess.Popen, tuple]:
     """Spawn the GCS with its journal in the session dir; restarting it
     with the same session_dir + port replays the journal (reference:
     Redis-backed GCS restart, gcs_init_data.cc)."""
@@ -101,7 +103,9 @@ def start_gcs(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen, tuple]
     proc = _spawn(
         [sys.executable, "-m", "ray_tpu._private.gcs",
          "--port", str(port), "--ready-file", ready,
-         "--journal", os.path.join(session_dir, "gcs_journal.msgpack")],
+         "--journal", os.path.join(session_dir, "gcs_journal.msgpack"),
+         "--system-config",
+         json.dumps(system_config) if system_config else ""],
         session_dir, "gcs")
     info = _wait_ready(ready, proc)
     return proc, tuple(info["address"])

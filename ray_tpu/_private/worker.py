@@ -109,7 +109,8 @@ def init(address: Optional[str] = None, *,
                 "`python -m ray_tpu start --head`")
     if address is None:
         rt.session_dir = node_mod.new_session_dir()
-        gcs_proc, gcs_addr = node_mod.start_gcs(rt.session_dir)
+        gcs_proc, gcs_addr = node_mod.start_gcs(
+            rt.session_dir, system_config=_system_config)
         rt.procs.append(gcs_proc)
         store_cap = object_store_memory or _auto_store_bytes(cfg)
         res = node_mod.default_resources(num_cpus, num_tpus, resources)

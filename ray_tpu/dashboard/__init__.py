@@ -173,6 +173,23 @@ class DashboardHead:
         finally:
             writer.close()
 
+    async def _node_agent(self, query):
+        """Agent connection for the node the `node=<hex prefix>` query
+        selects (first live node if absent); None when no live node
+        matches.  Caller closes the connection."""
+        from .._private import rpc as rpc_mod
+        gcs = await self._gcs()
+        nodes = await gcs.call("get_nodes", {})
+        want = query.get("node", [None])[0]
+        node = next(
+            (n for n in nodes if n["alive"] and
+             (want is None or bytes(n["node_id"]).hex()
+              .startswith(want))), None)
+        if node is None:
+            return None
+        return await rpc_mod.connect(tuple(node["address"]),
+                                     name="dash->agent")
+
     async def _route(self, method: str, path: str):
         if method != "GET":
             return 404, "text/plain", b"only GET"
@@ -185,18 +202,9 @@ class DashboardHead:
             # Live profiling (reference: dashboard reporter module's
             # py-spy/memray endpoints): /api/profile?node=<hex>&
             # kind=stacks|cpu_profile&duration=5[&worker=<hex>]
-            from .._private import rpc as rpc_mod
-            gcs = await self._gcs()
-            nodes = await gcs.call("get_nodes", {})
-            want = query.get("node", [None])[0]
-            node = next(
-                (n for n in nodes if n["alive"] and
-                 (want is None or bytes(n["node_id"]).hex()
-                  .startswith(want))), None)
-            if node is None:
+            agent = await self._node_agent(query)
+            if agent is None:
                 return 404, "text/plain", b"no such live node"
-            agent = await rpc_mod.connect(tuple(node["address"]),
-                                          name="dash->agent")
             try:
                 wid = query.get("worker", [None])[0]
                 res = await agent.call("profile_worker", {
@@ -228,6 +236,30 @@ class DashboardHead:
             from .grafana import dashboard_json
             return (200, "application/json",
                     json.dumps(dashboard_json()).encode())
+        if path == "/api/logs":
+            # /api/logs?node=<hex>[&glob=pat] — list; add &name=<file>
+            # [&lines=N] to read a tail (reference: dashboard state head
+            # log endpoints behind `ray logs`).
+            agent = await self._node_agent(query)
+            if agent is None:
+                return 404, "text/plain", b"no such live node"
+            try:
+                name = query.get("name", [None])[0]
+                if name:
+                    text = await agent.call("read_log", {
+                        "name": name,
+                        "lines": int(query.get("lines", ["1000"])[0]),
+                    }, timeout=30)
+                    if text is None:
+                        return 404, "text/plain", b"no such log file"
+                    return 200, "text/plain", text.encode()
+                files = await agent.call(
+                    "list_logs",
+                    {"glob": query.get("glob", [None])[0]}, timeout=30)
+            finally:
+                await agent.close()
+            return (200, "application/json",
+                    json.dumps(_hexify(files)).encode())
         if path == "/api/timeline":
             from .._private.timeline import chrome_trace_events
             gcs = await self._gcs()

@@ -179,6 +179,25 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """List or tail node log files (reference: `ray logs` — list when no
+    filename, stream/tail a file when given one)."""
+    _connect(args)
+    from ray_tpu.util import state
+    if not args.filename:
+        files = state.list_logs(node_id=args.node, glob=args.glob)
+        for f in files:
+            print(f"{f['size']:>12}  {f['name']}")
+        return 0
+    try:
+        print(state.get_log(args.filename, node_id=args.node,
+                            tail=args.tail))
+    except FileNotFoundError as e:
+        print(str(e))
+        return 1
+    return 0
+
+
 def cmd_timeline(args) -> int:
     ray_tpu = _connect(args)
     out = args.output or f"/tmp/ray_tpu/timeline-{int(time.time())}.json"
@@ -256,6 +275,16 @@ def main(argv=None) -> int:
     p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",
                                     "placement-groups", "jobs"])
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("logs", help="list / tail node log files")
+    p.add_argument("filename", nargs="?", default=None,
+                   help="log file to tail (omit to list)")
+    p.add_argument("--node", default=None,
+                   help="node id hex prefix (default: first live node)")
+    p.add_argument("--glob", default=None, help="filter listing")
+    p.add_argument("--tail", type=int, default=1000,
+                   help="lines from the end")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("timeline", help="dump a chrome trace")
     p.add_argument("--output", "-o", default=None)

@@ -126,3 +126,50 @@ def summarize_tasks() -> Dict[str, int]:
     for t in list_tasks(limit=100_000):
         counts[t.get("state", "?")] = counts.get(t.get("state", "?"), 0) + 1
     return counts
+
+
+def _agent_call(node: dict, method: str, payload: dict, timeout: int = 30):
+    import ray_tpu._private.rpc as rpc
+    core = ray_tpu._core()
+
+    async def go():
+        conn = await rpc.connect(tuple(node["address"]),
+                                 name="state->agent", retries=2)
+        try:
+            return await conn.call(method, payload, timeout=timeout)
+        finally:
+            await conn.close()
+
+    return core._run(go(), timeout=timeout + 5)
+
+
+def _resolve_node(node_id: Optional[str]) -> dict:
+    nodes = [n for n in _gcs("get_nodes") if n["alive"]]
+    if node_id:
+        nodes = [n for n in nodes
+                 if n["node_id"].hex().startswith(node_id)]
+    if not nodes:
+        raise ValueError(f"no live node matching {node_id!r}")
+    return nodes[0]
+
+
+def list_logs(node_id: Optional[str] = None,
+              glob: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Log files on a node (reference: ray.util.state.list_logs — the
+    state API's per-node log listing, served by that node's agent)."""
+    node = _resolve_node(node_id)
+    files = _agent_call(node, "list_logs", {"glob": glob})
+    return [{"node_id": node["node_id"].hex(), **f} for f in files or []]
+
+
+def get_log(filename: str, node_id: Optional[str] = None,
+            tail: int = 1000) -> str:
+    """Tail of one node log file (reference: ray.util.state.get_log)."""
+    node = _resolve_node(node_id)
+    text = _agent_call(node, "read_log",
+                       {"name": filename, "lines": tail})
+    if text is None:
+        raise FileNotFoundError(
+            f"log file {filename!r} not found on node "
+            f"{node['node_id'].hex()[:12]}")
+    return text

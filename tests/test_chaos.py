@@ -1,0 +1,150 @@
+"""Deterministic RPC fault injection (reference: src/ray/rpc/rpc_chaos.cc
+RAY_testing_rpc_failure + the python chaos tests built on it).
+
+The rpc layer drops requests/responses per 'Method=N:req%:resp%' rules;
+this file proves the machinery end-to-end: rule parsing, request-phase
+and response-phase drops, the budget exhausting (so later calls
+succeed), fast-handler parity (a FAST_FALLBACK re-dispatch must not
+double-charge the budget), and the config wiring that applies the spec
+at process startup."""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._private import rpc
+
+
+@pytest.fixture
+def no_chaos():
+    yield
+    rpc.enable_chaos("")      # never leak injection into later tests
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_chaos_spec_parsing(no_chaos):
+    c = rpc._Chaos("ping=3:100:0,pong=2:0:100")
+    assert c.rules == {"ping": [3, 100, 0], "pong": [2, 0, 100]}
+    # 100% request-drop burns the budget deterministically.
+    assert c.should_fail("ping", "req")
+    assert c.should_fail("ping", "req")
+    assert c.should_fail("ping", "req")
+    assert not c.should_fail("ping", "req")     # budget exhausted
+    assert not c.should_fail("pong", "req")     # wrong phase
+    assert c.should_fail("pong", "resp")
+    assert not c.should_fail("missing", "req")  # no rule
+
+
+def test_request_drops_then_recovers(no_chaos):
+    """First N requests are dropped (caller times out); once the budget
+    exhausts, the same call succeeds — the retry-after-timeout pattern
+    every chaos-hardened subsystem relies on."""
+    async def main():
+        calls = []
+
+        async def h_ping(conn, p):
+            calls.append(p)
+            return {"pong": p}
+
+        server = rpc.RpcServer({"ping": h_ping}, name="chaos-server")
+        addr = await server.start_tcp("127.0.0.1", 0)
+        rpc.enable_chaos("ping=2:100:0")
+        try:
+            conn = await rpc.connect(tuple(addr), name="chaos-client")
+            for _ in range(2):
+                with pytest.raises(asyncio.TimeoutError):
+                    await conn.call("ping", 1, timeout=0.3)
+            assert calls == []                   # dropped pre-handler
+            assert await conn.call("ping", 2, timeout=5) == {"pong": 2}
+            assert calls == [2]
+            await conn.close()
+        finally:
+            rpc.enable_chaos("")
+            await server.close()
+
+    _run(main())
+
+
+def test_response_drops_after_handler_ran(no_chaos):
+    """resp-phase drops lose the reply AFTER the side effect happened —
+    the at-least-once hazard idempotent handlers must absorb."""
+    async def main():
+        calls = []
+
+        async def h_put(conn, p):
+            calls.append(p)
+            return True
+
+        server = rpc.RpcServer({"put": h_put}, name="chaos-server")
+        addr = await server.start_tcp("127.0.0.1", 0)
+        rpc.enable_chaos("put=1:0:100")
+        try:
+            conn = await rpc.connect(tuple(addr), name="chaos-client")
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("put", "x", timeout=0.3)
+            assert calls == ["x"]                # handler DID run
+            assert await conn.call("put", "y", timeout=5)
+            assert calls == ["x", "y"]
+            await conn.close()
+        finally:
+            rpc.enable_chaos("")
+            await server.close()
+
+    _run(main())
+
+
+def test_fast_handler_fallback_single_charge(no_chaos):
+    """A fast handler returning FAST_FALLBACK re-dispatches through the
+    slow path with the request-phase chaos check SKIPPED — the fallback
+    must not double-charge the drop budget (rpc.py _dispatch_fast)."""
+    async def main():
+        async def h_m(conn, p):
+            return "slow"
+
+        def f_m(conn, p):
+            return rpc.FAST_FALLBACK
+
+        server = rpc.RpcServer({"m": h_m}, name="chaos-server",
+                               fast_handlers={"m": f_m})
+        addr = await server.start_tcp("127.0.0.1", 0)
+        # Budget 1 at 100%: exactly ONE call must be dropped.  If the
+        # fallback re-ran the request check, the first surviving call
+        # would be charged again and also dropped.
+        rpc.enable_chaos("m=1:100:0")
+        try:
+            conn = await rpc.connect(tuple(addr), name="chaos-client")
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("m", None, timeout=0.3)
+            assert await conn.call("m", None, timeout=5) == "slow"
+            await conn.close()
+        finally:
+            rpc.enable_chaos("")
+            await server.close()
+
+    _run(main())
+
+
+def test_chaos_config_wires_into_core_worker(ray_start_isolated,
+                                             monkeypatch):
+    """The rpc_chaos config applies at CoreWorker startup: a spec set via
+    _system_config reaches rpc._chaos in the driver process (daemons
+    apply the same spec through their own startup paths)."""
+    import ray_tpu
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"rpc_chaos": "no_such_method=1:100:0"})
+    try:
+        assert rpc._chaos is not None
+        assert rpc._chaos.rules == {"no_such_method": [1, 100, 0]}
+
+        # A rule naming an unused method must not perturb normal traffic.
+        @ray_tpu.remote
+        def f():
+            return 7
+        assert ray_tpu.get(f.remote(), timeout=60) == 7
+    finally:
+        ray_tpu.shutdown()
+        rpc.enable_chaos("")

@@ -103,17 +103,19 @@ def test_spill_uploads_durable_copies(cloud_spill_cluster):
     deadline = time.monotonic() + 60
     local: list = []
     while time.monotonic() < deadline:
-        # Sample local BEFORE the bucket: coverage of a stale local
-        # snapshot can only be an underestimate, never a false positive,
-        # and asserting on the SAME snapshot that satisfied the loop
-        # avoids re-racing in-flight spills.
+        # Name-subset coverage (not counts: a NEWER spill's completed
+        # upload must not stand in for an older spill's in-flight one) —
+        # local spill files and durable copies are both named by the
+        # object id hex.
         local = glob.glob(spill_glob)
-        if local and len(_mock_files(bucket)) >= len(local):
+        durable = {os.path.basename(f) for f in _mock_files(bucket)}
+        if local and all(os.path.basename(f) in durable for f in local):
             break
         time.sleep(0.2)
     assert local, "nothing spilled"
-    assert len(_mock_files(bucket)) >= len(local), \
-        "durable copies did not cover the local spill set"
+    durable = {os.path.basename(f) for f in _mock_files(bucket)}
+    missing = [f for f in local if os.path.basename(f) not in durable]
+    assert not missing, f"no durable copies yet for {missing}"
 
     # Destroy the session's local spill files — only the cloud tier
     # remains (= the spiller's disk is gone).

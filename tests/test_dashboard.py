@@ -191,3 +191,30 @@ def test_grafana_dashboard_and_cluster_series(dashboard, tmp_path):
         os.path.join(prov, "datasources", "ray_tpu_prometheus.yml"))
     dash_file = os.path.join(prov, "dashboards", "ray_tpu_default.json")
     assert json.load(open(dash_file))["uid"] == "ray_tpu_default"
+
+
+def test_logs_endpoints_and_state_api(dashboard):
+    """Log access surface (reference: `ray logs` + state API
+    list_logs/get_log + dashboard log endpoints): list names the session
+    logs, reads return tails, traversal is rejected."""
+    from ray_tpu.util import state
+
+    files = state.list_logs()
+    names = {f["name"] for f in files}
+    assert any(n.startswith("gcs") for n in names), names
+    # Read one known file through the state API.
+    target = sorted(n for n in names if n.endswith(".err"))[0]
+    text = state.get_log(target, tail=5)
+    assert isinstance(text, str)
+    with pytest.raises(FileNotFoundError):
+        state.get_log("no-such-file.log")
+
+    # Same through the dashboard HTTP surface.
+    status, ctype, body = _get(dashboard, "/api/logs")
+    assert status == 200 and "json" in ctype
+    listed = {f["name"] for f in json.loads(body)}
+    assert listed == names
+    status, _, body = _get(dashboard, f"/api/logs?name={target}&lines=3")
+    assert status == 200
+    status, _, _ = _get(dashboard, "/api/logs?name=../../etc/passwd")
+    assert status == 404          # basename()d server-side
