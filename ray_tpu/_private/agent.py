@@ -1086,13 +1086,19 @@ class NodeAgent:
         self.leases[wh.lease_id] = wh
         try:
             await wh.conn.call("actor_init", p, timeout=115)
-        except rpc.RpcError as e:
+        except (rpc.RpcError, asyncio.TimeoutError) as e:
             self._release_resources(resources, bundle_key)
             self.leases.pop(wh.lease_id, None)
-            # Clear lease fields so _on_worker_death doesn't release again.
+            # Clear lease fields so _on_worker_death doesn't release again
+            # — and the ACTOR fields, or the death watcher races this
+            # raise with a generic actor_failed("exited with code 0")
+            # that masks the real __init__ error (e.g. an unimportable
+            # actor class) at the caller.
             wh.lease_id = None
             wh.lease_resources = {}
             wh.lease_bundle = None
+            wh.is_actor = False
+            wh.actor_id = None
             wh.proc.terminate()
             raise rpc.RpcError(f"actor __init__ failed: {e}")
         return {"worker_addr": list(wh.address), "worker_id": wh.worker_id}

@@ -248,9 +248,10 @@ class CoreWorker:
         # reference: rpc_chaos.cc RAY_testing_rpc_failure) applies to
         # every process whose config carries it — set
         # RAY_TPU_rpc_chaos in the environment to inject cluster-wide.
-        chaos_spec = get_config().rpc_chaos
-        if chaos_spec:
-            rpc.enable_chaos(chaos_spec)
+        # Unconditional: an empty spec CLEARS injection, so a chaos-free
+        # init() after a chaos session in the same process doesn't
+        # inherit the old rules through the module global.
+        rpc.enable_chaos(get_config().rpc_chaos)
         self._server = rpc.RpcServer(self._handlers(), name=f"cw-{self.mode}")
         self.address = await self._server.start_tcp("127.0.0.1", 0)
         # Reconnecting: calls issued across a GCS restart re-dial and

@@ -48,8 +48,11 @@ class Cluster:
         self.nodes: List[ClusterNode] = []
         self.head_node: Optional[ClusterNode] = None
         if initialize_head:
+            # The head's _system_config also parameterizes the GCS (e.g.
+            # rpc_chaos must inject in EVERY process, GCS included).
             self.gcs_proc, self.gcs_address = node_mod.start_gcs(
-                self.session_dir)
+                self.session_dir,
+                system_config=(head_node_args or {}).get("_system_config"))
             self.head_node = self.add_node(**(head_node_args or {}))
 
     @property

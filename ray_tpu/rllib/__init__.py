@@ -18,6 +18,8 @@ from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import (IMPALA, AggregatorActor, IMPALAConfig, ImpalaLearner,
                      vtrace)
 from .learner import Learner, LearnerGroup, compute_gae
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                          MultiAgentEnvRunnerGroup)
 from .offline import (BC, MARWIL, BCConfig, BCLearner, MARWILConfig,
                       OfflineTransitionAlgorithm, episodes_to_batch,
                       episodes_to_transitions)
@@ -34,7 +36,8 @@ __all__ = [
     "DQN", "DQNConfig", "DQNLearner", "EnvRunner", "EnvRunnerGroup",
     "EpisodeReplayBuffer", "FlattenObs", "FrameStack", "IMPALA",
     "IMPALAConfig", "IQL", "IQLConfig", "ImpalaLearner", "Learner",
-    "LearnerGroup", "MARWIL", "MARWILConfig", "NormalizeObs",
+    "LearnerGroup", "MARWIL", "MARWILConfig", "MultiAgentEnv",
+    "MultiAgentEnvRunner", "MultiAgentEnvRunnerGroup", "NormalizeObs",
     "OfflineTransitionAlgorithm", "PrioritizedReplayBuffer",
     "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "compute_gae",
     "episodes_to_batch", "episodes_to_transitions", "PPO",

@@ -1,0 +1,230 @@
+"""Multi-agent RL: MultiAgentEnv + per-policy sampling and training.
+
+Reference surface: python/ray/rllib/env/multi_agent_env.py (MultiAgentEnv
+— dict obs/action/reward/termination per agent, "__all__" episode end),
+env/multi_agent_env_runner.py (sampling), and the multi_agent() config
+section (policies + policy_mapping_fn) routing each agent's experience to
+its policy's module/learner (algorithm_config.py multi_agent()).
+
+TPU-first design: simultaneous-action envs with a FIXED agent set map
+onto the same [T, N, ...] column-parallel batch layout the single-agent
+stack uses — each policy's batch carries its agents as extra columns
+(N = num_envs x agents_of_policy), so the existing jitted PPO learner
+updates each policy UNCHANGED, and policies train as independent
+LearnerGroups (the reference's MultiRLModule is a dict of modules the
+same way).  Turn-based / dynamic agent sets are out of scope (the
+reference supports them through per-episode ragged batches, which would
+break the static shapes XLA wants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .rl_module import RLModuleSpec
+
+
+class MultiAgentEnv:
+    """Env contract (reference: multi_agent_env.py MultiAgentEnv).
+
+    Subclasses define:
+      - agents: List[str] — FIXED agent ids, all acting every step
+      - observation_spaces / action_spaces: Dict[agent_id, gym.Space]
+      - reset(seed=None) -> (obs_dict, info)
+      - step(action_dict) -> (obs_dict, rew_dict, terminated_dict,
+        truncated_dict, info); terminated/truncated carry "__all__"
+    """
+
+    agents: List[str] = []
+    observation_spaces: Dict[str, Any] = {}
+    action_spaces: Dict[str, Any] = {}
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class _MultiVec:
+    """num_envs copies of a MultiAgentEnv stepped lockstep with
+    auto-reset on '__all__' (the multi-agent analogue of _VecEnv)."""
+
+    def __init__(self, env_maker: Callable[[], MultiAgentEnv],
+                 num_envs: int, seed: int):
+        self.envs = [env_maker() for _ in range(num_envs)]
+        self.agents = list(self.envs[0].agents)
+        self.obs = [e.reset(seed=seed + i)[0]
+                    for i, e in enumerate(self.envs)]
+        self._ep_ret = np.zeros(num_envs)
+        self.completed_returns: List[float] = []
+
+    def step(self, actions: List[Dict[str, Any]]):
+        """actions[i] is env i's action dict.  Returns per-env obs dicts,
+        reward dicts, done flags (episode end), trunc flags, final obs."""
+        obs_out, rew_out = [], []
+        dones = np.zeros(len(self.envs), bool)
+        truncs = np.zeros(len(self.envs), bool)
+        final_obs: List[Optional[dict]] = [None] * len(self.envs)
+        for i, (env, act) in enumerate(zip(self.envs, actions)):
+            obs, rew, term, trunc, _ = env.step(act)
+            self._ep_ret[i] += sum(rew.values())
+            done = bool(term.get("__all__")) or bool(trunc.get("__all__"))
+            if done:
+                if trunc.get("__all__") and not term.get("__all__"):
+                    truncs[i] = True
+                    final_obs[i] = obs
+                self.completed_returns.append(float(self._ep_ret[i]))
+                self._ep_ret[i] = 0.0
+                obs, _ = env.reset()
+                dones[i] = True
+            obs_out.append(obs)
+            rew_out.append(rew)
+        self.obs = obs_out
+        return obs_out, rew_out, dones, truncs, final_obs
+
+    def drain_returns(self) -> List[float]:
+        out, self.completed_returns = self.completed_returns, []
+        return out
+
+
+@ray_tpu.remote
+class MultiAgentEnvRunner:
+    """Remote multi-agent sampler (reference: multi_agent_env_runner.py).
+
+    Per policy: one inference module; per step, each policy batches the
+    observations of ITS agents across all envs into one forward pass.
+    sample() returns {policy_id: single-agent-shaped batch} — columns are
+    (env, agent) pairs in a fixed order, so GAE in the learner sees
+    correctly chained per-column episodes."""
+
+    def __init__(self, env_maker, policy_specs: Dict[str, dict],
+                 agent_to_policy: Dict[str, str], num_envs: int,
+                 seed: int, gamma: float = 0.99):
+        import jax
+
+        self.vec = _MultiVec(env_maker, num_envs, seed)
+        self.agent_to_policy = dict(agent_to_policy)
+        self.num_envs = num_envs
+        self.gamma = gamma
+        # policy -> its agents, in fixed agent order (column layout).
+        self.policy_agents: Dict[str, List[str]] = {}
+        for a in self.vec.agents:
+            self.policy_agents.setdefault(self.agent_to_policy[a],
+                                          []).append(a)
+        self.modules = {p: RLModuleSpec(**kw).build()
+                        for p, kw in policy_specs.items()}
+        self._explore = {p: jax.jit(m.forward_exploration)
+                         for p, m in self.modules.items()}
+        self._value_only = {
+            p: jax.jit(lambda w, o, m=m: m.logits_and_value(w, o)[1])
+            for p, m in self.modules.items()}
+        self.key = jax.random.key(seed)
+
+    def _policy_obs(self, obs_dicts: List[dict], policy: str) -> np.ndarray:
+        """[num_envs * n_agents, obs_dim]: env-major, agent-minor —
+        matches the column layout of every other field."""
+        rows = [np.asarray(od[a], np.float32)
+                for od in obs_dicts for a in self.policy_agents[policy]]
+        return np.stack(rows)
+
+    def sample(self, weights: Dict[str, Any], rollout_len: int
+               ) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        out = {p: {"obs": [], "actions": [], "logp": [], "vf": [],
+                   "rewards": [], "trunc_bonus": [], "dones": []}
+               for p in self.modules}
+        for _ in range(rollout_len):
+            obs_dicts = self.vec.obs
+            acts_per_env: List[Dict[str, Any]] = [
+                {} for _ in range(self.num_envs)]
+            step_rec = {}
+            for p, mod in self.modules.items():
+                t_obs = self._policy_obs(obs_dicts, p)
+                self.key, sub = jax.random.split(self.key)
+                actions, logp, value = self._explore[p](
+                    weights[p], jnp.asarray(t_obs), sub)
+                actions = np.asarray(actions)
+                step_rec[p] = (t_obs, actions, np.asarray(logp),
+                               np.asarray(value))
+                k = 0
+                for i in range(self.num_envs):
+                    for a in self.policy_agents[p]:
+                        acts_per_env[i][a] = int(actions[k])
+                        k += 1
+            obs_dicts, rew_dicts, dones, truncs, final_obs = \
+                self.vec.step(acts_per_env)
+            for p in self.modules:
+                t_obs, actions, logp, value = step_rec[p]
+                rewards = np.asarray(
+                    [rew_dicts[i][a] for i in range(self.num_envs)
+                     for a in self.policy_agents[p]], np.float32)
+                pdones = np.repeat(dones, len(self.policy_agents[p]))
+                bonus = np.zeros_like(rewards)
+                if truncs.any():
+                    # Time-limit bootstrap per truncated env, per policy.
+                    fin_rows, idxs = [], []
+                    k = 0
+                    for i in range(self.num_envs):
+                        for a in self.policy_agents[p]:
+                            if truncs[i]:
+                                fin_rows.append(np.asarray(
+                                    final_obs[i][a], np.float32))
+                                idxs.append(k)
+                            k += 1
+                    v_fin = np.asarray(self._value_only[p](
+                        weights[p], jnp.asarray(np.stack(fin_rows))))
+                    bonus[np.asarray(idxs)] = self.gamma * v_fin
+                rec = out[p]
+                rec["obs"].append(t_obs)
+                rec["actions"].append(actions)
+                rec["logp"].append(logp)
+                rec["vf"].append(value)
+                rec["rewards"].append(rewards)
+                rec["trunc_bonus"].append(bonus)
+                rec["dones"].append(pdones)
+        batches: Dict[str, Any] = {}
+        for p in self.modules:
+            final_t = self._policy_obs(self.vec.obs, p)
+            bootstrap = np.asarray(self._value_only[p](
+                weights[p], jnp.asarray(final_t)))
+            rec = out[p]
+            batches[p] = {k: np.stack(v) for k, v in rec.items()}
+            batches[p]["bootstrap_value"] = bootstrap
+            batches[p]["final_obs"] = final_t
+        batches["episode_returns"] = self.vec.drain_returns()
+        return batches
+
+
+class MultiAgentEnvRunnerGroup:
+    """Fan-out over remote multi-agent runners (reference:
+    env_runner_group.py with multi-agent runners)."""
+
+    def __init__(self, *, env_maker, policy_specs, agent_to_policy,
+                 num_env_runners: int, num_envs_per_runner: int,
+                 seed: int, gamma: float, runner_resources=None):
+        opts = dict(runner_resources or {})
+        cls = (MultiAgentEnvRunner.options(**opts)
+               if opts else MultiAgentEnvRunner)
+        self.runners = [
+            cls.remote(env_maker, policy_specs, agent_to_policy,
+                       num_envs_per_runner, seed + 1000 * i, gamma)
+            for i in range(num_env_runners)]
+
+    def sample(self, weights_ref, rollout_len: int) -> List[Dict[str, Any]]:
+        return ray_tpu.get(
+            [r.sample.remote(weights_ref, rollout_len)
+             for r in self.runners], timeout=300)
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,135 @@
+"""Multi-agent RL (reference: rllib/env/multi_agent_env.py +
+multi_agent_env_runner.py + AlgorithmConfig.multi_agent): per-policy
+sampling/updating over a fixed simultaneous-action agent set."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentEnv, PPOConfig
+
+# Env classes defined in a test module pickle BY REFERENCE (the module is
+# importable on the driver's sys.path) but workers don't carry tests/ on
+# theirs — ship this module's classes by value instead, the same remedy
+# a user would apply for driver-local env code (or use runtime_env
+# py_modules).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+class TwoCartPoles(MultiAgentEnv):
+    """Two independent CartPole instances as one multi-agent env: the
+    episode ends ('__all__') when either pole falls or time truncates —
+    the standard fixed-agent simultaneous-action shape."""
+
+    agents = ["a0", "a1"]
+
+    def __init__(self):
+        import gymnasium as gym
+        self._envs = {a: gym.make("CartPole-v1") for a in self.agents}
+        self.observation_spaces = {
+            a: e.observation_space for a, e in self._envs.items()}
+        self.action_spaces = {
+            a: e.action_space for a, e in self._envs.items()}
+
+    def reset(self, seed=None):
+        obs = {}
+        for i, (a, e) in enumerate(self._envs.items()):
+            obs[a], _ = e.reset(seed=None if seed is None else seed + i)
+        return obs, {}
+
+    def step(self, action_dict):
+        obs, rew, term, trunc = {}, {}, {}, {}
+        any_term, any_trunc = False, False
+        for a, e in self._envs.items():
+            obs[a], rew[a], t, tr, _ = e.step(action_dict[a])
+            term[a], trunc[a] = t, tr
+            any_term |= t
+            any_trunc |= tr
+        term["__all__"] = any_term
+        trunc["__all__"] = any_trunc and not any_term
+        return obs, rew, term, trunc, {}
+
+
+def _cfg(mapping_fn, policies):
+    return (PPOConfig()
+            .environment(TwoCartPoles)
+            .multi_agent(policies=policies, policy_mapping_fn=mapping_fn)
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                         rollout_fragment_length=32)
+            .training(lr=5e-3, minibatch_size=64, num_epochs=2)
+            .debugging(seed=7))
+
+
+def test_independent_policies_train(ray_start_regular):
+    algo = _cfg(lambda a: {"a0": "p0", "a1": "p1"}[a],
+                ["p0", "p1"]).build_algo()
+    try:
+        w0 = {p: lg.get_weights()
+              for p, lg in algo.learner_groups.items()}
+        results = [algo.train() for _ in range(3)]
+        for r in results:
+            for p in ("p0", "p1"):
+                assert np.isfinite(r[f"{p}/total_loss"]), r
+        assert results[-1]["num_episodes"] > 0
+        assert np.isfinite(results[-1]["episode_return_mean"])
+        # Both policies actually updated, independently.
+        import jax
+        for p in ("p0", "p1"):
+            after = algo.learner_groups[p].get_weights()
+            changed = jax.tree_util.tree_reduce(
+                lambda acc, pair: acc, [
+                    not np.allclose(a, b) for a, b in zip(
+                        jax.tree_util.tree_leaves(w0[p]),
+                        jax.tree_util.tree_leaves(after))], None)
+            assert any(
+                not np.allclose(a, b) for a, b in zip(
+                    jax.tree_util.tree_leaves(w0[p]),
+                    jax.tree_util.tree_leaves(after))), p
+
+        # Save / restore round-trips per-policy learner state.
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            algo.save(d)
+            algo2 = _cfg(lambda a: {"a0": "p0", "a1": "p1"}[a],
+                         ["p0", "p1"]).build_algo()
+            try:
+                algo2.restore(d)
+                assert algo2.iteration == algo.iteration
+                for p in ("p0", "p1"):
+                    for x, y in zip(
+                            jax.tree_util.tree_leaves(
+                                algo.learner_groups[p].get_weights()),
+                            jax.tree_util.tree_leaves(
+                                algo2.learner_groups[p].get_weights())):
+                        np.testing.assert_allclose(x, y)
+            finally:
+                algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_shared_policy_batches_all_agents(ray_start_regular):
+    """Both agents mapped to ONE policy: its batch carries both agents as
+    columns (N = num_envs * 2) — the reference's shared-policy shape."""
+    algo = _cfg(lambda a: "shared", ["shared"]).build_algo()
+    try:
+        r = algo.train()
+        assert np.isfinite(r["shared/total_loss"])
+        assert set(algo.learner_groups) == {"shared"}
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_validation(ray_start_regular):
+    with pytest.raises(ValueError, match="callable"):
+        (PPOConfig().environment("CartPole-v1")
+         .multi_agent(policies=["p"], policy_mapping_fn=lambda a: "p")
+         .build_algo())
+    with pytest.raises(ValueError, match="unknown policies"):
+        (PPOConfig().environment(TwoCartPoles)
+         .multi_agent(policies=["p0"],
+                      policy_mapping_fn=lambda a: "nope")
+         .build_algo())
