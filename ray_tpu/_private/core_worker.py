@@ -1483,6 +1483,11 @@ class CoreWorker:
             # containers without a cheap size still pay double pickling.)
             approx = (len(a) if isinstance(a, (bytes, bytearray, str))
                       else getattr(a, "nbytes", 0))
+            if not isinstance(approx, (int, float)):
+                # Objects with dynamic __getattr__ (e.g. an ActorHandle
+                # answers ANY attribute with an ActorMethod) return
+                # non-numeric "nbytes" — treat as size-unknown.
+                approx = 0
             if approx > self._inline_limit:
                 return None
             ctx.capture = captured = []

@@ -179,6 +179,13 @@ class Algorithm:
                 f"{type(self).__name__} does not support multi_agent() "
                 "on this runtime; use PPO (on-policy, per-policy "
                 "learner groups)")
+        if config.env_to_module is not None:
+            # Silently feeding raw observations while the config names a
+            # connector would train a different model than configured.
+            raise NotImplementedError(
+                "env_to_module connectors are not supported with "
+                "multi_agent() on this runtime; transform observations "
+                "inside the MultiAgentEnv")
         if not callable(config.env):
             raise ValueError(
                 "multi-agent training needs environment(env=<callable "
@@ -193,6 +200,13 @@ class Algorithm:
                 raise ValueError(
                     f"policy_mapping_fn produced unknown policies "
                     f"{unknown}")
+            unmapped = set(config.policies) - set(agent_to_policy.values())
+            if unmapped:
+                # A declared-but-never-mapped policy would silently never
+                # train (and its checkpoint state would be missing).
+                raise ValueError(
+                    f"policies {sorted(unmapped)} are declared but "
+                    "policy_mapping_fn maps no agent to them")
             policy_specs: Dict[str, dict] = {}
             for agent, policy in agent_to_policy.items():
                 obs_dim = int(np.prod(
@@ -235,8 +249,15 @@ class Algorithm:
         for s in samples:
             self._episode_returns.extend(s.pop("episode_returns"))
         t1 = time.monotonic()
-        for p, lg in self.learner_groups.items():
-            pm = lg.update([s[p] for s in samples])
+        # Dispatch every policy's update first, gather after: remote
+        # learner actors then run concurrently (sequential update() would
+        # make learn time the SUM over policies instead of the max).
+        pending = {p: lg.update_async([s[p] for s in samples])
+                   for p, lg in self.learner_groups.items()}
+        from ..object_ref import ObjectRef
+        for p, res in pending.items():
+            pm = (ray_tpu.get(res, timeout=600)
+                  if isinstance(res, ObjectRef) else res)
             metrics.update({f"{p}/{k}": v for k, v in pm.items()})
         metrics["learn_time_s"] = time.monotonic() - t1
         return metrics

@@ -195,6 +195,15 @@ class LearnerGroup:
                                timeout=600)
         return self.learner.update(samples)
 
+    def update_async(self, samples):
+        """Non-blocking variant: returns an ObjectRef for remote learner
+        groups (callers gather several groups' updates concurrently —
+        multi-agent per-policy training) or the finished metrics dict for
+        in-driver groups."""
+        if self.is_remote:
+            return self.learner.update.remote(samples)
+        return self.learner.update(samples)
+
     def get_weights(self):
         if self.is_remote:
             import ray_tpu

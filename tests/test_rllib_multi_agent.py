@@ -79,11 +79,6 @@ def test_independent_policies_train(ray_start_regular):
         import jax
         for p in ("p0", "p1"):
             after = algo.learner_groups[p].get_weights()
-            changed = jax.tree_util.tree_reduce(
-                lambda acc, pair: acc, [
-                    not np.allclose(a, b) for a, b in zip(
-                        jax.tree_util.tree_leaves(w0[p]),
-                        jax.tree_util.tree_leaves(after))], None)
             assert any(
                 not np.allclose(a, b) for a, b in zip(
                     jax.tree_util.tree_leaves(w0[p]),

@@ -13,15 +13,37 @@ from ray_tpu import exceptions as exc
 
 
 def test_cancel_queued_task(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0)
+    class Gate:
+        def __init__(self):
+            self.n = 0
+
+        def arrived(self):
+            self.n += 1
+
+        def count(self):
+            return self.n
+
+    gate = Gate.remote()
+
     @ray_tpu.remote
-    def slow():
+    def slow(g):
+        ray_tpu.get(g.arrived.remote())
         time.sleep(30)
         return 1
 
-    # Saturate the 4 CPUs, then queue one more and cancel it.
-    blockers = [slow.options(num_cpus=1).remote() for _ in range(4)]
-    victim = slow.options(num_cpus=1).remote()
-    time.sleep(1.5)   # let the blockers actually dispatch on slow CI hosts
+    # Saturate the 4 CPUs, then queue one more and cancel it.  Wait for
+    # the blockers to REPORT running (a fixed sleep raced slow hosts:
+    # the victim would dispatch instead and sit in an uninterruptible
+    # time.sleep past the get timeout).
+    blockers = [slow.options(num_cpus=1).remote(gate) for _ in range(4)]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.get(gate.count.remote(), timeout=30) >= 4:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.get(gate.count.remote(), timeout=30) >= 4
+    victim = slow.options(num_cpus=1).remote(gate)
     ray_tpu.cancel(victim)
     with pytest.raises(exc.TaskCancelledError):
         ray_tpu.get(victim, timeout=20)
