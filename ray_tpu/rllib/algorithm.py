@@ -252,12 +252,10 @@ class Algorithm:
         # Dispatch every policy's update first, gather after: remote
         # learner actors then run concurrently (sequential update() would
         # make learn time the SUM over policies instead of the max).
-        pending = {p: lg.update_async([s[p] for s in samples])
+        pending = {p: (lg, lg.update_async([s[p] for s in samples]))
                    for p, lg in self.learner_groups.items()}
-        from ..object_ref import ObjectRef
-        for p, res in pending.items():
-            pm = (ray_tpu.get(res, timeout=600)
-                  if isinstance(res, ObjectRef) else res)
+        for p, (lg, res) in pending.items():
+            pm = ray_tpu.get(res, timeout=600) if lg.is_remote else res
             metrics.update({f"{p}/{k}": v for k, v in pm.items()})
         metrics["learn_time_s"] = time.monotonic() - t1
         return metrics

@@ -189,11 +189,11 @@ class LearnerGroup:
         """samples may contain ObjectRefs; the remote path passes them
         through unresolved (the learner actor pulls the data, the driver
         never materializes it — reference: LearnerGroup async updates)."""
+        res = self.update_async(samples)
         if self.is_remote:
             import ray_tpu
-            return ray_tpu.get(self.learner.update.remote(samples),
-                               timeout=600)
-        return self.learner.update(samples)
+            return ray_tpu.get(res, timeout=600)
+        return res
 
     def update_async(self, samples):
         """Non-blocking variant: returns an ObjectRef for remote learner

@@ -9,7 +9,8 @@ from ..train._session import get_checkpoint
 from ..train._session import report as _session_report
 from .schedulers import (ASHAScheduler, FIFOScheduler,
                          MedianStoppingRule, PopulationBasedTraining)
-from .search import (BayesOptSearch, Searcher, choice, grid_search,
+from .search import (BayesOptSearch, ConcurrencyLimiter, Searcher,
+                     choice, grid_search,
                      loguniform, randint, uniform, generate_variants)
 from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneController,
                     Tuner)
@@ -27,5 +28,5 @@ __all__ = [
     "generate_variants", "ASHAScheduler", "FIFOScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "report",
     "get_checkpoint",
-    "BayesOptSearch", "Searcher",
+    "BayesOptSearch", "ConcurrencyLimiter", "Searcher",
 ]

@@ -228,3 +228,31 @@ class BayesOptSearch(Searcher):
             return
         self._X.append(point)
         self._y.append(float(v) if self.mode == "max" else -float(v))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps a wrapped searcher's in-flight suggestions (reference:
+    tune/search/concurrency_limiter.py ConcurrencyLimiter).  While
+    `max_concurrent` suggested trials are unfinished, suggest() returns
+    None — the controller backs off and retries next poll — so
+    sequential model-based searchers (e.g. BayesOptSearch) observe
+    results before proposing far-ahead points."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

@@ -313,3 +313,46 @@ def test_median_stopping_time_aligned():
     # A late starter far below the early pace is still culled.
     assert sch.on_trial_result(
         "bad", {"training_iteration": 1, "score": 0.1}) == schedulers.STOP
+
+
+def test_concurrency_limiter(ray_start_regular, tmp_path):
+    """ConcurrencyLimiter (reference: search/concurrency_limiter.py):
+    at most max_concurrent suggested trials are in flight, so a
+    sequential searcher sees results before its next proposal."""
+    from ray_tpu import tune
+    from ray_tpu.tune import ConcurrencyLimiter, Searcher
+
+    class Recorder(Searcher):
+        def __init__(self):
+            self.live = 0
+            self.peak = 0
+            self.n = 0
+
+        def suggest(self, trial_id):
+            if self.n >= 3:
+                return None
+            self.n += 1
+            self.live += 1
+            self.peak = max(self.peak, self.live)
+            return {"x": self.n}
+
+        def on_trial_complete(self, trial_id, result):
+            self.live -= 1
+
+    inner = Recorder()
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=3,
+            search_alg=ConcurrencyLimiter(inner, max_concurrent=1)),
+        run_config=RunConfig(name="limiter", storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert inner.n == 3
+    assert inner.peak == 1, f"peak in-flight {inner.peak}"
+    assert len(results) == 3
+    with pytest.raises(ValueError, match="max_concurrent"):
+        ConcurrencyLimiter(inner, max_concurrent=0)
