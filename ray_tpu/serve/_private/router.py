@@ -28,6 +28,10 @@ _FALLBACK_REFRESH_S = 30.0
 
 
 class Router:
+    # Locally-observed-dead replicas stay excluded this long — by then
+    # the controller's 1s health check has pruned them from the table.
+    _DEAD_TTL_S = 10.0
+
     def __init__(self, controller, deployment: str):
         self._controller = controller
         self._deployment = deployment
@@ -36,6 +40,11 @@ class Router:
         self._version = -1
         self._inflight: Dict[bytes, int] = {}
         self._last_refresh = 0.0
+        # actor_id -> observation time of an ActorDiedError from it; the
+        # retry path excludes these (the cached/refetched table can keep
+        # listing a dead replica until the controller's health check
+        # runs, and pow-2 would happily re-pick it).
+        self._dead: Dict[bytes, float] = {}
         self._table_event = threading.Event()   # set on any table update
         self._subscribed = False
         self._channel = f"serve_rt:{deployment}"
@@ -134,11 +143,24 @@ class Router:
     async def assign_async(self, method: str, args: tuple, kwargs: dict,
                            model_id: Optional[str] = None):
         await self._refresh_async()
+        return self._dispatch(method, args, kwargs, model_id)[0]
+
+    async def assign_async_with_origin(self, method: str, args: tuple,
+                                       kwargs: dict,
+                                       model_id: Optional[str] = None):
+        """(ref, replica_actor_id) — callers that retry on replica death
+        pass the id back to exclude()."""
+        await self._refresh_async()
         return self._dispatch(method, args, kwargs, model_id)
 
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: Optional[str] = None):
         """Pick a replica (pow-2, model-affine) and dispatch."""
+        self._refresh()
+        return self._dispatch(method, args, kwargs, model_id)[0]
+
+    def assign_with_origin(self, method: str, args: tuple, kwargs: dict,
+                           model_id: Optional[str] = None):
         self._refresh()
         return self._dispatch(method, args, kwargs, model_id)
 
@@ -157,11 +179,32 @@ class Router:
         return min((a, b),
                    key=lambda r: self._inflight.get(r._actor_id, 0))
 
+    def _alive(self, replicas: List[Any]) -> List[Any]:
+        """Filter out locally-observed-dead replicas (TTL-bounded); fall
+        back to the raw list if that would leave nothing — a stale death
+        record must not make the whole deployment unroutable."""
+        if not self._dead:
+            return replicas
+        cutoff = time.monotonic() - self._DEAD_TTL_S
+        for rid, ts in list(self._dead.items()):
+            if ts < cutoff:
+                del self._dead[rid]
+        if not self._dead:
+            return replicas
+        live = [r for r in replicas if r._actor_id not in self._dead]
+        return live or replicas
+
+    def exclude(self, actor_id: bytes) -> None:
+        """Record an observed replica death (the retry path routes around
+        it until the controller health-checks it out of the table)."""
+        self._dead[actor_id] = time.monotonic()
+        self.invalidate()
+
     def _dispatch(self, method: str, args: tuple, kwargs: dict,
                   model_id: Optional[str] = None):
         # Snapshot: _on_push mutates self._replicas from the core loop
         # thread; the emptiness check and the pick must see one list.
-        replicas = self._replicas
+        replicas = self._alive(self._replicas)
         if not replicas:
             raise RuntimeError(
                 f"no replicas available for deployment "
@@ -177,11 +220,16 @@ class Router:
                 ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             self._inflight[rid] -= 1
-            # Invalidate so the next assign refetches.
-            self._replicas, self._version = [], -1
+            self.invalidate()   # next assign refetches
             raise
         fut = ref.future()
         fut.add_done_callback(
             lambda _: self._inflight.__setitem__(
                 rid, max(0, self._inflight.get(rid, 1) - 1)))
-        return ref
+        return ref, rid
+
+    def invalidate(self) -> None:
+        """Drop the cached routing table (a request just failed with a
+        dead replica): the next assign refetches from the controller,
+        which health-checks replicas out of the table."""
+        self._replicas, self._version = [], -1

@@ -93,22 +93,51 @@ def deployment(_target: Callable = None, *, name: Optional[str] = None,
     return deco
 
 
+_DEATH_RETRIES = 2
+
+
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference:
     handle.DeploymentResponse).  Sync contexts wrap an ObjectRef;
     async contexts (a deployment calling another deployment) wrap an
-    eagerly-scheduled asyncio.Task that resolves to the final value."""
+    eagerly-scheduled asyncio.Task that resolves to the final value.
 
-    def __init__(self, ref=None, task=None):
+    Replica death is retried transparently (reference: the Serve router
+    reassigns requests that failed because their replica actor died —
+    user exceptions are NOT retried): `retry` re-invalidates the routing
+    table and dispatches to another replica, bounded at _DEATH_RETRIES."""
+
+    def __init__(self, ref=None, task=None, retry=None, origin=None):
         self._ref = ref
         self._task = task
+        self._retry = retry      # (dead_actor_id) -> (new ref, new origin)
+        self._origin = origin    # replica actor id the ref dispatched to
 
     def result(self, timeout_s: Optional[float] = None):
         if self._ref is None:
             raise RuntimeError(
                 "DeploymentResponse.result() is not available inside the "
                 "event loop; use `await response` instead")
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        import time as _time
+
+        from ray_tpu.exceptions import ActorDiedError
+        attempts = _DEATH_RETRIES if self._retry is not None else 0
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - _time.monotonic()))
+            try:
+                return ray_tpu.get(self._ref, timeout=remaining)
+            except ActorDiedError:
+                if attempts <= 0:
+                    raise
+                attempts -= 1
+                # Re-dispatch excluding the dead replica, and REPLACE the
+                # stored ref: result() must stay idempotent (a second
+                # call re-reads the successful attempt, never
+                # re-executes the request).
+                self._ref, self._origin = self._retry(self._origin)
 
     def __await__(self):
         if self._task is not None:
@@ -163,9 +192,18 @@ class DeploymentHandle:
         try:
             asyncio.get_running_loop()
         except RuntimeError:
-            ref = self._get_router().assign(
+            router = self._get_router()
+            ref, origin = router.assign_with_origin(
                 self._method, args, kwargs, model_id=self._model_id)
-            return DeploymentResponse(ref=ref)
+
+            def _retry(dead_origin):
+                if dead_origin is not None:
+                    router.exclude(dead_origin)
+                return router.assign_with_origin(
+                    self._method, args, kwargs, model_id=self._model_id)
+
+            return DeploymentResponse(ref=ref, retry=_retry,
+                                      origin=origin)
         # Called from inside the event loop (an async actor / another
         # deployment): dispatch eagerly on the loop, fully async.
         return DeploymentResponse(
@@ -183,9 +221,18 @@ class DeploymentHandle:
             controller = ActorHandle(bytes(info["actor_id"]),
                                      info.get("class_name", ""))
             router = self._get_router(controller)
-        ref = await router.assign_async(self._method, args, kwargs,
-                                        model_id=self._model_id)
-        return await ref
+        from ray_tpu.exceptions import ActorDiedError
+        attempts = _DEATH_RETRIES
+        while True:
+            ref, origin = await router.assign_async_with_origin(
+                self._method, args, kwargs, model_id=self._model_id)
+            try:
+                return await ref
+            except ActorDiedError:
+                if attempts <= 0:
+                    raise
+                attempts -= 1
+                router.exclude(origin)
 
     def __reduce__(self):
         return (DeploymentHandle, (self._deployment, self._method,

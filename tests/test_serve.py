@@ -205,3 +205,37 @@ def test_serve_status_and_delete(ray_start_regular):
     st = serve.status()
     assert "echo_status" not in st["applications"]
     serve.shutdown()
+
+
+def test_replica_death_transparent_retry(serve_cluster):
+    """Requests that fail because their replica DIED are retried on
+    another replica transparently (reference: the Serve router reassigns
+    on replica-actor death; user exceptions are never retried)."""
+    @serve.deployment(num_replicas=2)
+    class Sometimes:
+        def __call__(self, req):
+            return "ok"
+
+    handle = serve.run(Sometimes.bind(), name="sometimes")
+    assert handle.remote(None).result(timeout_s=30) == "ok"
+    # Kill ONE replica directly (NOT through the handle — the handle's
+    # own retry would faithfully re-deliver a poison request to the
+    # surviving replica too), out from under the router's cached table.
+    router = handle._get_router()
+    assert len(router._replicas) == 2
+    ray_tpu.kill(router._replicas[0])
+    time.sleep(0.3)
+    # Requests keep succeeding: hits on the dead entry re-route to the
+    # survivor instead of surfacing ActorDiedError.
+    for _ in range(8):
+        assert handle.remote(None).result(timeout_s=30) == "ok"
+
+    # User exceptions still propagate (never retried).
+    @serve.deployment(num_replicas=1)
+    class Raises:
+        def __call__(self, req):
+            raise ValueError("user error")
+
+    h2 = serve.run(Raises.bind(), name="raises")
+    with pytest.raises(Exception, match="user error"):
+        h2.remote(None).result(timeout_s=30)
