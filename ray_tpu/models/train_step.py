@@ -49,7 +49,11 @@ def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
 def make_train_step(cfg: TransformerConfig, mesh: Mesh,
                     optimizer: Optional[optax.GradientTransformation] = None,
                     rules: Optional[LogicalAxisRules] = None,
-                    donate_state: bool = True) -> TrainStepBundle:
+                    donate_state: bool = True,
+                    num_microbatches: Optional[int] = None) -> TrainStepBundle:
+    """num_microbatches only matters under a pp>1 mesh axis: it sets the
+    pipeline schedule depth (default pp; more microbatches shrink the
+    bubble at the cost of smaller per-tick matmuls)."""
     rules = rules or LogicalAxisRules.default()
     tx = optimizer or make_optimizer()
 
@@ -101,7 +105,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
             if getattr(x, "ndim", 0) >= 1 else x, batch)
 
         def _loss(p):
-            return loss_fn(p, batch, cfg, mesh, rules)
+            return loss_fn(p, batch, cfg, mesh, rules,
+                           num_microbatches=num_microbatches)
 
         loss, grads = jax.value_and_grad(_loss)(state["params"])
         updates, new_opt = tx.update(grads, state["opt_state"],

@@ -328,7 +328,8 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 
 def loss_fn(params, batch, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None,
-            rules: Optional[LogicalAxisRules] = None) -> jax.Array:
+            rules: Optional[LogicalAxisRules] = None,
+            num_microbatches: Optional[int] = None) -> jax.Array:
     """Next-token cross-entropy; batch = {"tokens": (B,S)} or
     {"inputs","targets"}; ignores padding id 0 when targets provided."""
     if "targets" in batch:
@@ -338,7 +339,8 @@ def loss_fn(params, batch, cfg: TransformerConfig,
         toks = batch["tokens"]
         inputs, targets = toks[:, :-1], toks[:, 1:]
         weights = jnp.ones(targets.shape, jnp.float32)
-    logits = forward(params, inputs, cfg, mesh, rules)
+    logits = forward(params, inputs, cfg, mesh, rules,
+                     num_microbatches=num_microbatches)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)

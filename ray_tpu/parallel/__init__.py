@@ -8,11 +8,13 @@ collectives over ICI.
 
 from .mesh import (AXES, MeshSpec, build_mesh, host_local_mesh, mesh_info,
                    single_device_mesh)
+from .planner import MemoryPlan, plan_7b_north_star, plan_train_memory
 from .sharding import (LogicalAxisRules, replicated, shard_batch,
                        tree_shardings, with_logical_constraint)
 
 __all__ = [
     "AXES", "MeshSpec", "build_mesh", "host_local_mesh", "mesh_info",
     "single_device_mesh", "LogicalAxisRules", "replicated", "shard_batch",
-    "tree_shardings", "with_logical_constraint",
+    "tree_shardings", "with_logical_constraint", "MemoryPlan",
+    "plan_7b_north_star", "plan_train_memory",
 ]

@@ -43,11 +43,13 @@ class LogicalAxisRules:
         seq         → sp        (sequence/context parallel)
         embed       → fsdp      (ZeRO-3 style weight sharding on ICI)
         mlp/heads/kv_heads/vocab → tp  (megatron-style tensor parallel)
-        stage       → pp        (pipeline stages)
+        layer/stage → pp        (layer-stack dim stage-sharded: each pp rank
+                                 holds only its stage's params + Adam moments)
         expert      → fsdp+sp   (MoE expert parallel submesh)
         """
         return cls([
             ("batch", ("dp", "fsdp")),
+            ("layer", "pp"),
             ("seq", "sp"),
             ("embed", "fsdp"),
             ("mlp", "tp"),

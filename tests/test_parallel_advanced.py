@@ -153,3 +153,102 @@ def test_pipeline_rejects_bad_microbatching(cpu_mesh_devices):
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_spmd(apply_stage, split_stages(Ws, 4),
                       jnp.zeros((9, 4)), mesh=mesh, num_microbatches=4)
+
+
+def test_pp_training_step_decreases_loss(cpu_mesh_devices):
+    """Full fwd+bwd+optimizer across a pp=2 boundary (VERDICT r3 item 1):
+    stage params + Adam moments shard over pp (layer->pp rule), the pipeline
+    differentiates through the collective-permute rotation, and the loss
+    moves after warmup."""
+    _need_devices(8)
+    from ray_tpu.models import make_train_step
+
+    cfg = PRESETS["tiny"]
+    mesh = build_mesh(MeshSpec(pp=2, dp=2, tp=2), devices=jax.devices()[:8])
+    bundle = make_train_step(cfg, mesh, num_microbatches=4)
+    state = bundle.init(jax.random.key(0))
+    wq = state["params"]["layers"]["attn"]["wq"]
+    assert wq.sharding.spec[0] == "pp", \
+        f"layer stack not stage-sharded: {wq.sharding.spec}"
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (8, 33)),
+        jnp.int32)}
+    losses = []
+    for _ in range(4):
+        state, metrics = bundle.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning across pp boundary: {losses}"
+
+
+def test_pp_training_matches_single_device():
+    """pp=2 pipelined training produces the same loss trajectory as the
+    unsharded step (same init key, same batch)."""
+    _need_devices(2)
+    from ray_tpu.models import make_train_step
+    from ray_tpu.models.train_step import make_optimizer
+
+    cfg = PRESETS["tiny"]
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (4, 33)),
+        jnp.int32)}
+
+    def run(mesh_spec, n):
+        mesh = build_mesh(mesh_spec, devices=jax.devices()[:n])
+        bundle = make_train_step(
+            cfg, mesh, optimizer=make_optimizer(warmup_steps=1),
+            num_microbatches=2)
+        state = bundle.init(jax.random.key(0))
+        out = []
+        for _ in range(3):
+            state, m = bundle.step(state, batch)
+            out.append(float(m["loss"]))
+        return out
+
+    ref = run(MeshSpec(), 1)
+    pp = run(MeshSpec(pp=2), 2)
+    np.testing.assert_allclose(pp, ref, rtol=1e-3)
+
+
+def test_memory_planner_matches_xla_state_bytes(cpu_mesh_devices):
+    """The planner's exact state accounting must agree with what XLA
+    actually materialises (CompiledMemoryStats.argument_size) per device."""
+    _need_devices(8)
+    from ray_tpu.models import make_train_step
+    from ray_tpu.parallel import plan_train_memory
+
+    cfg = PRESETS["tiny"]
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    mesh = build_mesh(spec, devices=jax.devices()[:8])
+    bundle = make_train_step(cfg, mesh)
+    state_shape = jax.eval_shape(bundle.init, jax.random.key(0))
+    state_abs = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        state_shape, bundle.state_shardings)
+    batch_abs = {"tokens": jax.ShapeDtypeStruct((8, 33), jnp.int32)}
+    stats = bundle.step.lower(state_abs, batch_abs).compile().memory_analysis()
+    if stats is None:
+        pytest.skip("backend reports no memory stats")
+
+    plan = plan_train_memory(cfg, spec, global_batch=8, seq_len=32)
+    # argument_size counts params+opt+step+batch per device; the planner's
+    # state_bytes (params+grads+opt) minus grads should sit within 10%.
+    planner_args = plan.params_bytes + plan.opt_bytes
+    assert abs(stats.argument_size_in_bytes - planner_args) \
+        <= 0.1 * stats.argument_size_in_bytes + 16384, \
+        (stats.argument_size_in_bytes, planner_args)
+
+
+def test_7b_north_star_plans_fit():
+    """BASELINE.json north star: Llama-2-7B state+activations fit v5e HBM
+    at n=16 and n=64 under the canonical fsdp x tp=4 mesh."""
+    from ray_tpu.parallel import plan_7b_north_star
+
+    for n in (16, 64):
+        plan = plan_7b_north_star(n)
+        assert plan.fits, plan.table()
+        # exact total param bytes across the mesh ~= param_count * 2 bytes
+        total_params = plan.params_bytes * plan.spec.n_devices
+        expect = plan.cfg.param_count() * 2
+        assert total_params >= expect * 0.98, (total_params, expect)
+        assert total_params <= expect * 1.30, (total_params, expect)
