@@ -5,15 +5,25 @@ Reference surface: python/ray/llm/_internal — the reference wraps vLLM
 stack, so the engine is native JAX on the in-tree flagship transformer
 (models/transformer.py) and is built around XLA's compilation model:
 
-  - ONE compiled decode step for the whole slot batch: static shapes
-    (max_batch × max_len KV cache), per-slot lengths/active masks as
-    data, so admission/retirement of requests never recompiles.
+  - ONE compiled decode step for the whole slot batch: static shapes,
+    per-slot lengths/active masks as data, so admission/retirement of
+    requests never recompiles.
+  - PAGED KV cache (vLLM's PagedAttention storage model, re-done for XLA):
+    a fixed pool of (page_size)-token blocks shared by all slots, indexed
+    through a per-slot page table.  A request only reserves the pages its
+    prompt + max_tokens need, so many short requests fit a pool that a
+    dense (max_batch, max_len) cache could not.  Pages are reserved at
+    admission (no mid-flight exhaustion, no preemption machinery).
   - Prefill is compiled per prompt-length *bucket* (pow-2 padding) —
     a handful of compilations total, amortized across all requests.
-  - KV cache lives on device between steps (no host round-trips in the
+  - KV pool lives on device between steps (no host round-trips in the
     decode loop); only sampled token ids come back per step.
-  - GQA attention against the cache runs as one batched einsum on the
-    MXU; masking handles ragged per-slot prefixes.
+  - Tensor parallelism via GSPMD: pass ``mesh=`` and the engine shards
+    weights (heads/kv_heads/mlp over tp, Megatron layout) and the KV pool
+    (kv_heads over tp) with NamedShardings; XLA inserts the collectives in
+    prefill and the decode step.  The vocab axis stays replicated so the
+    embedding row-gather never forces a resharding round-trip.  Same
+    tokens come out sharded or not (tests/test_llm.py).
 
 vLLM-parity naming: SamplingParams / add_request / step mirror
 vllm's engine surface so reference users can map concepts 1:1.
@@ -22,6 +32,7 @@ vllm's engine surface so reference users can map concepts 1:1.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -29,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import (TransformerConfig, apply_rope, init_params,
-                                  rms_norm, rope_angles)
+                                  param_logical_axes, rms_norm, rope_angles)
 
 
 @dataclasses.dataclass
@@ -46,6 +57,7 @@ class _Request:
     params: SamplingParams
     out: List[int] = dataclasses.field(default_factory=list)
     slot: int = -1
+    pages: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
 
 
@@ -98,7 +110,6 @@ def _prefill_fn(params, tokens, length, cfg: TransformerConfig):
                        lp["attn"]["wo"].astype(cfg.dtype))
         x = _mlp(lp, x + o, cfg)
         return x, (k[0], v[0])              # drop the B=1 dim for the cache
-
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
     last = x[0, length - 1]
@@ -107,30 +118,41 @@ def _prefill_fn(params, tokens, length, cfg: TransformerConfig):
     return logits, ks, vs
 
 
-def _install_fn(cache_k, cache_v, ks, vs, slot, max_len):
-    """Write a prefill's (L, Sb, KV, D) kv into the slot's cache rows."""
-    Sb = ks.shape[1]
-    pad = max_len - Sb
+def _install_fn(pool_k, pool_v, ks, vs, pages, page: int, kv_sharding):
+    """Write a prefill's (L, Sb, KV, D) kv into the slot's reserved pages.
+
+    pages: (P,) int32 physical page ids.  Entries past the slot's reserved
+    count are 0 — the shared scratch page, whose contents are garbage by
+    contract: every read of it is masked (valid = t <= length always stays
+    within the reserved pages) and the allocator never hands page 0 out."""
+    L, Sb, KV, D = ks.shape
+    P = pages.shape[0]
+    pad = P * page - Sb
     if pad > 0:
         ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
         vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    cache_k = jax.lax.dynamic_update_slice(
-        cache_k, ks[:, None], (0, slot, 0, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(
-        cache_v, vs[:, None], (0, slot, 0, 0, 0))
-    return cache_k, cache_v
+    ks = ks.reshape(L, P, page, KV, D)
+    vs = vs.reshape(L, P, page, KV, D)
+    pool_k = pool_k.at[:, pages].set(ks)
+    pool_v = pool_v.at[:, pages].set(vs)
+    if kv_sharding is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, kv_sharding)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, kv_sharding)
+    return pool_k, pool_v
 
 
-def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
-               temps, rng, cfg: TransformerConfig):
-    """One decode step for ALL slots.
+def _decode_fn(params, pool_k, pool_v, tables, last_tokens, lengths, active,
+               temps, rng, cfg: TransformerConfig, page: int, kv_sharding):
+    """One decode step for ALL slots against the paged pool.
 
-    last_tokens (B,) int32; lengths (B,) = tokens already in cache (the
-    new token is written at index lengths); active (B,) bool; temps (B,)
-    f32 sampling temperatures (0 = greedy).  Returns (cache_k', cache_v',
-    next_tokens (B,))."""
+    pool_k/pool_v (L, N, page, KV, D); tables (B, P) physical page ids
+    (page 0 = scratch for inactive slots); lengths (B,) = tokens already
+    in cache (the new token is written at index lengths); active (B,)
+    bool; temps (B,) f32 sampling temperatures (0 = greedy).
+    Returns (pool_k', pool_v', next_tokens (B,))."""
     B = last_tokens.shape[0]
-    T = cache_k.shape[2]
+    P = tables.shape[1]
+    T = P * page
     groups = cfg.num_heads // cfg.num_kv_heads
     x = params["embed"].astype(cfg.dtype)[last_tokens][:, None]   # (B,1,E)
     # Per-slot RoPE at each slot's own position.
@@ -139,7 +161,11 @@ def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
                       / cfg.head_dim_))
     ang = lengths.astype(jnp.float32)[:, None] * freqs[None]      # (B, D/2)
     cos, sin = jnp.cos(ang)[:, None], jnp.sin(ang)[:, None]       # (B,1,D/2)
-    ar_b = jnp.arange(B)
+    # Physical write position of the incoming token for every slot.
+    write_page = jnp.take_along_axis(
+        tables, (lengths // page)[:, None], axis=1)[:, 0]         # (B,)
+    write_page = jnp.where(active, write_page, 0)                 # scratch
+    write_off = lengths % page
 
     def rope1(t):                       # t: (B, 1, H, D)
         t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
@@ -149,12 +175,15 @@ def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
             -1).astype(t.dtype)
 
     def body(x, layer):
-        lp, ck, cv = layer              # ck/cv: (B, T, KV, D)
+        lp, pk, pv = layer              # pk/pv: (N, page, KV, D)
         h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
         q, k, v = _layer_qkv(lp, h, cfg)
         q, k = rope1(q), rope1(k)
-        ck = ck.at[ar_b, lengths].set(k[:, 0])
-        cv = cv.at[ar_b, lengths].set(v[:, 0])
+        pk = pk.at[write_page, write_off].set(k[:, 0])
+        pv = pv.at[write_page, write_off].set(v[:, 0])
+        # Gather each slot's pages: (B, P, page, KV, D) → (B, T, KV, D)
+        ck = pk[tables].reshape(B, T, -1, cfg.head_dim_)
+        cv = pv[tables].reshape(B, T, -1, cfg.head_dim_)
         kr = jnp.repeat(ck, groups, axis=2)                       # (B,T,H,D)
         vr = jnp.repeat(cv, groups, axis=2)
         scores = jnp.einsum("bhd,bthd->bht", q[:, 0], kr) \
@@ -165,10 +194,13 @@ def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
         o = jnp.einsum("bht,bthd->bhd", p, vr)
         o = jnp.einsum("bhd,hde->be", o, lp["attn"]["wo"].astype(cfg.dtype))
         x = _mlp(lp, x + o[:, None], cfg)
-        return x, (ck, cv)
+        return x, (pk, pv)
 
-    x, (cache_k, cache_v) = jax.lax.scan(
-        body, x, (params["layers"], cache_k, cache_v))
+    x, (pool_k, pool_v) = jax.lax.scan(
+        body, x, (params["layers"], pool_k, pool_v))
+    if kv_sharding is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, kv_sharding)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, kv_sharding)
     x = rms_norm(x[:, 0], params["ln_f"], cfg.rms_norm_eps)
     logits = jnp.einsum("be,ev->bv", x, params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
@@ -179,7 +211,7 @@ def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
             key, lg / jnp.maximum(t, 1e-6)))(keys, logits, temps)
     nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
     nxt = jnp.where(active, nxt, 0)
-    return cache_k, cache_v, nxt
+    return pool_k, pool_v, nxt
 
 
 # --------------------------------------------------------------------------
@@ -188,20 +220,54 @@ def _decode_fn(params, cache_k, cache_v, last_tokens, lengths, active,
 
 class LLMEngine:
     """Continuous-batching engine (reference concept: vllm engine wrapped
-    by python/ray/llm/_internal/serve/engines/vllm/; here native JAX)."""
+    by python/ray/llm/_internal/serve/engines/vllm/; here native JAX with
+    paged KV and optional GSPMD tensor parallelism)."""
 
     def __init__(self, cfg: TransformerConfig, params=None, *,
-                 max_batch: int = 4, max_len: int = 256, seed: int = 0):
+                 max_batch: int = 4, max_len: int = 256, seed: int = 0,
+                 mesh=None, rules=None, page_size: int = 64,
+                 kv_pages: Optional[int] = None):
+        """kv_pages sizes the shared pool (default: enough for every slot
+        at max_len — set it lower to oversubscribe: admission then queues
+        until pages free up).  mesh: shard weights + KV over its tp axis."""
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
+        self.mesh = mesh
+        self.page = max(8, min(page_size, max_len))
+        self.pages_per_slot = math.ceil(max_len / self.page)
+        # page 0 is scratch (inactive-slot writes land there); never handed out
+        self.n_pages = 1 + (kv_pages if kv_pages is not None
+                            else max_batch * self.pages_per_slot)
+        L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+
+        self._kv_shd = None
+        param_shd = None
+        if mesh is not None:
+            from ..parallel.sharding import LogicalAxisRules, tree_shardings
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # Megatron layout minus vocab-parallel: replicating the (small)
+            # embed/lm_head keeps token gathers collective-free.
+            rules = rules or LogicalAxisRules.default().with_overrides(
+                ("vocab", None), ("embed", None))
+            if cfg.num_kv_heads % max(dict(mesh.shape).get("tp", 1), 1):
+                raise ValueError(
+                    f"num_kv_heads={cfg.num_kv_heads} not divisible by "
+                    f"tp={dict(mesh.shape).get('tp')}")
+            param_shd = tree_shardings(param_logical_axes(cfg), mesh, rules)
+            self._kv_shd = NamedSharding(mesh, P(None, None, None, "tp"))
         self.params = params if params is not None else \
             init_params(cfg, jax.random.key(seed))
-        L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
-        self._ck = jnp.zeros((L, max_batch, max_len, kvh, d), cfg.dtype)
-        self._cv = jnp.zeros_like(self._ck)
+        if param_shd is not None:
+            self.params = jax.device_put(self.params, param_shd)
+
+        pool_shape = (L, self.n_pages, self.page, kvh, d)
+        self._pk = jnp.zeros(pool_shape, cfg.dtype, device=self._kv_shd)
+        self._pv = jnp.zeros(pool_shape, cfg.dtype, device=self._kv_shd)
         self._rng = jax.random.key(seed + 1)
-        self._free = list(range(max_batch))
+        self._free_slots = list(range(max_batch))
+        self._free_pages = list(range(1, self.n_pages))
+        self._tables = np.zeros((max_batch, self.pages_per_slot), np.int32)
         self._slots: Dict[int, _Request] = {}
         self._waiting: List[_Request] = []
         self._next_id = 0
@@ -209,16 +275,21 @@ class LLMEngine:
         self._lengths = np.zeros(max_batch, np.int32)
         self._temps = np.zeros(max_batch, np.float32)
         self._prefill_jit = {}
+        page, kv_shd = self.page, self._kv_shd
         self._decode_jit = jax.jit(
-            lambda p, ck, cv, lt, ln, ac, tp, rn: _decode_fn(
-                p, ck, cv, lt, ln, ac, tp, rn, cfg),
+            lambda p, pk, pv, tb, lt, ln, ac, tp, rn: _decode_fn(
+                p, pk, pv, tb, lt, ln, ac, tp, rn, cfg, page, kv_shd),
             donate_argnums=(1, 2))
         self._install_jit = jax.jit(
-            lambda ck, cv, ks, vs, slot: _install_fn(
-                ck, cv, ks, vs, slot, max_len),
+            lambda pk, pv, ks, vs, pages: _install_fn(
+                pk, pv, ks, vs, pages, page, kv_shd),
             donate_argnums=(0, 1))
 
     # ------------------------------------------------------------ requests --
+    def _pages_needed(self, req: _Request) -> int:
+        budget = len(req.prompt) + req.params.max_tokens + 1
+        return math.ceil(min(budget, self.max_len) / self.page)
+
     def add_request(self, prompt_tokens: Sequence[int],
                     params: Optional[SamplingParams] = None) -> int:
         if len(prompt_tokens) >= self.max_len:
@@ -226,12 +297,20 @@ class LLMEngine:
                 f"prompt ({len(prompt_tokens)}) >= max_len ({self.max_len})")
         req = _Request(self._next_id, list(prompt_tokens),
                        params or SamplingParams())
+        need = self._pages_needed(req)
+        if need > self.n_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.n_pages - 1} — raise kv_pages or lower max_tokens")
         self._next_id += 1
         self._waiting.append(req)
         return req.req_id
 
     def has_unfinished(self) -> bool:
         return bool(self._waiting or self._slots)
+
+    def kv_pages_free(self) -> int:
+        return len(self._free_pages)
 
     # ---------------------------------------------------------------- step --
     def _bucket(self, n: int) -> int:
@@ -253,20 +332,34 @@ class LLMEngine:
         toks[0, :S] = prompt
         return self._prefill_jit[Sb](self.params, jnp.asarray(toks), S)
 
+    def _reserve(self, req: _Request) -> bool:
+        """Reserve slot + pages for a request; False = wait for capacity."""
+        need = self._pages_needed(req)
+        if not self._free_slots or len(self._free_pages) < need:
+            return False
+        req.slot = self._free_slots.pop(0)
+        req.pages = [self._free_pages.pop(0) for _ in range(need)]
+        row = np.zeros(self.pages_per_slot, np.int32)
+        row[:need] = req.pages
+        self._tables[req.slot] = row
+        return True
+
+    def _install(self, slot: int, ks, vs):
+        pages = jnp.asarray(self._tables[slot])
+        self._pk, self._pv = self._install_jit(
+            self._pk, self._pv, ks, vs, pages)
+
     def _admit(self):
-        while self._waiting and self._free:
+        while self._waiting and self._reserve(self._waiting[0]):
             req = self._waiting.pop(0)
-            slot = self._free.pop(0)
-            req.slot = slot
             S = len(req.prompt)
             logits, ks, vs = self._run_prefill(req.prompt)
-            self._ck, self._cv = self._install_jit(
-                self._ck, self._cv, ks, vs, slot)
+            self._install(req.slot, ks, vs)
             first = self._sample_host(logits, req.params)
-            self._lengths[slot] = S
-            self._last[slot] = first
-            self._temps[slot] = req.params.temperature
-            self._slots[slot] = req
+            self._lengths[req.slot] = S
+            self._last[req.slot] = first
+            self._temps[req.slot] = req.params.temperature
+            self._slots[req.slot] = req
             self._emit(req, int(first))
 
     def _sample_host(self, logits, params: SamplingParams) -> int:
@@ -300,8 +393,8 @@ class LLMEngine:
         for slot in self._slots:
             active[slot] = True
         self._rng, key = jax.random.split(self._rng)
-        self._ck, self._cv, nxt = self._decode_jit(
-            self.params, self._ck, self._cv,
+        self._pk, self._pv, nxt = self._decode_jit(
+            self.params, self._pk, self._pv, jnp.asarray(self._tables),
             jnp.asarray(self._last), jnp.asarray(self._lengths),
             jnp.asarray(active), jnp.asarray(self._temps), key)
         nxt = np.asarray(nxt)
@@ -316,7 +409,11 @@ class LLMEngine:
 
     def _retire(self, slot: int) -> _Request:
         req = self._slots.pop(slot)
-        self._free.append(slot)
+        self._free_slots.append(slot)
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        self._tables[slot] = 0
+        self._lengths[slot] = 0
         return req
 
     # ------------------------------------------------------------ generate --
@@ -337,7 +434,8 @@ class LLMEngine:
         """Prefill-node half of P/D disaggregation (reference pattern:
         llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py):
         returns (kv_blob, first_token) to ship to a decode node via the
-        object store."""
+        object store.  With a sharded engine this is the KV-transfer path:
+        np.asarray gathers the tp-sharded cache to host for the wire."""
         params = params or SamplingParams()
         S = len(prompt_tokens)
         if S >= self.max_len:
@@ -351,24 +449,21 @@ class LLMEngine:
                     params: Optional[SamplingParams] = None) -> List[int]:
         """Decode-node half: install a shipped prefill and run decode."""
         params = params or SamplingParams()
-        if kv_blob["len"] >= self.max_len:
-            raise ValueError(
-                f"prompt ({kv_blob['len']}) >= max_len ({self.max_len})")
-        if not self._free:
-            raise RuntimeError("no free slots on decode engine")
-        slot = self._free.pop(0)
-        req = _Request(self._next_id, [0] * kv_blob["len"], params)
+        S = kv_blob["len"]
+        if S >= self.max_len:
+            raise ValueError(f"prompt ({S}) >= max_len ({self.max_len})")
+        req = _Request(self._next_id, [0] * S, params)
         self._next_id += 1
-        req.slot = slot
+        if not self._reserve(req):
+            raise RuntimeError("no free slots/pages on decode engine")
         ks = jnp.asarray(kv_blob["k"], self.cfg.dtype)
         vs = jnp.asarray(kv_blob["v"], self.cfg.dtype)
-        self._ck, self._cv = self._install_jit(
-            self._ck, self._cv, ks, vs, slot)
-        self._lengths[slot] = kv_blob["len"]
-        self._last[slot] = first_token
-        self._temps[slot] = params.temperature
-        self._slots[slot] = req
+        self._install(req.slot, ks, vs)
+        self._lengths[req.slot] = S
+        self._last[req.slot] = first_token
+        self._temps[req.slot] = params.temperature
+        self._slots[req.slot] = req
         self._emit(req, int(first_token))
-        while slot in self._slots:
+        while req.slot in self._slots:
             self.step()
         return req.out

@@ -191,3 +191,74 @@ def _run_openai_assertions(base):
     except urllib.error.HTTPError as e:
         assert e.code == 400
         assert "messages" in json.loads(e.read())["error"]["message"]
+
+
+def test_tp_sharded_engine_identical_tokens():
+    """VERDICT r3 item 2: a GSPMD tp-sharded decode produces the same
+    tokens as the single-device engine (weights sharded heads/kv/mlp over
+    tp, KV pool sharded on kv_heads)."""
+    import jax
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = build_mesh(MeshSpec(tp=4), devices=jax.devices()[:4])
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13], [21, 22]]
+    sp = SamplingParams(max_tokens=8)
+    ref = LLMEngine(CFG, max_batch=2, max_len=64, seed=0)
+    out_ref = ref.generate(prompts, sp)
+    shd = LLMEngine(CFG, max_batch=2, max_len=64, seed=0, mesh=mesh)
+    wq = shd.params["layers"]["attn"]["wq"]
+    assert "tp" in str(wq.sharding.spec), wq.sharding.spec
+    assert "tp" in str(shd._pk.sharding.spec), shd._pk.sharding.spec
+    out_shd = shd.generate(prompts, sp)
+    assert out_shd == out_ref, (out_shd, out_ref)
+
+
+def test_paged_kv_oversubscribed_pool_queues_and_completes():
+    """A pool smaller than max_batch*max_len still serves every request:
+    admission waits for pages, retirement recycles them."""
+    eng = LLMEngine(CFG, max_batch=4, max_len=64, seed=0,
+                    page_size=16, kv_pages=6)
+    assert eng.n_pages == 7            # 6 usable + scratch
+    sp = SamplingParams(max_tokens=6)
+    # each request needs ceil((3+6+1)/16)=1 page; 8 requests through 6 pages
+    prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+    outs = eng.generate(prompts, sp)
+    assert len(outs) == 8 and all(len(o) == 6 for o in outs)
+    assert eng.kv_pages_free() == 6    # all recycled
+    # parity with an uncontended engine
+    ref = LLMEngine(CFG, max_batch=4, max_len=64, seed=0)
+    assert outs == ref.generate(prompts, sp)
+
+
+def test_pd_kv_transfer_across_sharding_layouts():
+    """P/D disaggregation moves KV between engines with different
+    shardings: unsharded prefill -> tp-sharded decode and back."""
+    import jax
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = build_mesh(MeshSpec(tp=2), devices=jax.devices()[:2])
+    sp = SamplingParams(max_tokens=6)
+    prompt = [4, 8, 15, 16, 23]
+    ref = LLMEngine(CFG, max_batch=1, max_len=64, seed=0)
+    expect = ref.generate([prompt], sp)[0]
+
+    pre = LLMEngine(CFG, max_batch=1, max_len=64, seed=0)
+    dec_shd = LLMEngine(CFG, max_batch=2, max_len=64, seed=0, mesh=mesh)
+    blob, first = pre.prefill_only(prompt, sp)
+    assert dec_shd.decode_from(blob, first, sp) == expect
+
+    pre_shd = LLMEngine(CFG, max_batch=1, max_len=64, seed=0, mesh=mesh)
+    dec = LLMEngine(CFG, max_batch=2, max_len=64, seed=0)
+    blob2, first2 = pre_shd.prefill_only(prompt, sp)
+    assert dec.decode_from(blob2, first2, sp) == expect
+
+
+def test_unserviceable_request_rejected_up_front():
+    eng = LLMEngine(CFG, max_batch=1, max_len=64, seed=0,
+                    page_size=16, kv_pages=2)
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.add_request(list(range(1, 41)), SamplingParams(max_tokens=20))
