@@ -1709,6 +1709,8 @@ async def _amain(args):
             os.unlink(agent.store_path)
         except OSError:
             pass
+    from .node import dump_profile
+    dump_profile()
     os._exit(0)
 
 

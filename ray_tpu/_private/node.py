@@ -36,10 +36,22 @@ def install_daemon_profiler(tag: str) -> None:
     prof = cProfile.Profile()
     prof.enable()
     path = os.path.join(prof_dir, f"{tag}_{os.getpid()}.pstats")
-    atexit.register(lambda: (prof.disable(), prof.dump_stats(path)))
-    signal.signal(signal.SIGTERM,
-                  lambda *a: (prof.disable(), prof.dump_stats(path),
-                              os._exit(0)))
+
+    def _dump(*_a):
+        prof.disable()
+        prof.dump_stats(path)
+
+    # Daemons that install their own SIGTERM handling and leave via
+    # os._exit (the agent's bounded graceful drain) never reach atexit —
+    # dump_profile() lets their exit path flush the profile explicitly.
+    global dump_profile
+    dump_profile = _dump
+    atexit.register(_dump)
+    signal.signal(signal.SIGTERM, lambda *a: (_dump(), os._exit(0)))
+
+
+def dump_profile(*_a) -> None:
+    """No-op unless install_daemon_profiler armed it (see above)."""
 
 
 def _wait_ready(path: str, proc: subprocess.Popen, timeout: float = 30.0) -> dict:

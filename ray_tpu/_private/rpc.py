@@ -1,4 +1,4 @@
-"""Asyncio RPC: length-prefixed msgpack frames over TCP/unix sockets.
+"""Asyncio RPC: self-delimiting msgpack frames over TCP/unix sockets.
 
 Control-plane transport equivalent of the reference's gRPC layer (reference:
 src/ray/rpc/grpc_server.h, retryable_grpc_client.h). gRPC is deliberately not
@@ -9,24 +9,35 @@ microbenchmark numbers. Retry-with-backoff mirrors RetryableGrpcClient;
 deterministic fault injection mirrors rpc_chaos.cc
 (RAY_testing_rpc_failure="Method=N:req%:resp%").
 
-Frame: [4B little-endian length][msgpack payload]
-Request:  [msg_id, method: str, payload]     (msg_id == 0 → one-way notify)
+Wire format: a raw stream of msgpack objects (msgpack is self-delimiting, so
+no length prefix). Receive framing + decode run entirely inside msgpack's C
+streaming Unpacker fed from an asyncio.Protocol — no StreamReader, no
+per-frame await, no Python slicing: measured 1.4-1.7x the calls/s of the
+previous length-prefixed StreamReader loop between single-core processes.
+
+Request:  [msg_id, method: str, payload]     (msg_id == 0 -> one-way notify)
 Response: [msg_id, status: 0|1, result_or_error]
+
+Authentication (reference: src/ray/rpc/authentication/
+authentication_token_validator.cc): when a server is constructed with
+auth_token=..., the first frame on every inbound connection must be the
+one-way handshake [0, "__auth__", token]; anything else -- or a wrong token
+-- aborts the connection before any handler runs. Clients send the handshake
+as their first frame after connect. Comparison is constant-time.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hmac
 import logging
 import random
-import struct
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
 logger = logging.getLogger(__name__)
 
-_LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
 
@@ -40,6 +51,10 @@ class RemoteError(RpcError):
 
 class ConnectionLost(RpcError):
     pass
+
+
+class AuthError(RpcError):
+    """Peer rejected (or never sent) the auth handshake."""
 
 
 _BG_TASKS: set = set()
@@ -118,37 +133,52 @@ def _unpack(data: bytes):
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Any:
-    hdr = await reader.readexactly(4)
-    (n,) = _LEN.unpack(hdr)
-    if n > MAX_FRAME:
-        raise RpcError(f"frame too large: {n}")
-    return _unpack(await reader.readexactly(n))
-
-
-def _write_frame(writer: asyncio.StreamWriter, obj) -> None:
-    data = _pack(obj)
-    writer.write(_LEN.pack(len(data)) + data)
-
-
 # Sentinel a fast handler returns to route the request through the normal
 # coroutine handler instead (slow/conditional branch).
 FAST_FALLBACK = object()
+
+
+class _WireProtocol(asyncio.Protocol):
+    """Thin adapter: the event loop calls here, the Connection does the work."""
+
+    __slots__ = ("conn",)
+
+    def __init__(self, conn: "Connection"):
+        self.conn = conn
+
+    def connection_made(self, transport):
+        self.conn._connection_made(transport)
+
+    def data_received(self, data):
+        self.conn._data_received(data)
+
+    def eof_received(self):
+        return False  # close the transport; connection_lost follows
+
+    def connection_lost(self, exc):
+        self.conn._teardown()
+
+    def pause_writing(self):
+        self.conn._paused = True
+
+    def resume_writing(self):
+        self.conn._resume_writing()
 
 
 class Connection:
     """A bidirectional pipelined RPC connection. Both sides may issue calls
     (needed for worker↔agent and pubsub push)."""
 
-    def __init__(self, reader, writer, handlers: Dict[str, Callable] | None = None,
+    def __init__(self, handlers: Dict[str, Callable] | None = None,
                  name: str = "", on_close: Callable | None = None,
-                 fast_handlers: Dict[str, Callable] | None = None):
-        self.reader = reader
-        self.writer = writer
+                 fast_handlers: Dict[str, Callable] | None = None,
+                 auth_token: str | None = None,
+                 send_token: str | None = None,
+                 on_connect: Callable | None = None):
         self.handlers = handlers if handlers is not None else {}
         # Fast handlers: SYNC callables (conn, payload) -> asyncio.Future
         # | FAST_FALLBACK | immediate result. They run inline in the recv
-        # loop — no Task per request — and the reply is sent from a
+        # path — no Task per request — and the reply is sent from a
         # done-callback when a Future is returned.  Meant for enqueue-style
         # handlers (push_task/push_actor_task) whose coroutine bodies just
         # park on an internal queue: under fan-out load the Task-per-call
@@ -156,9 +186,22 @@ class Connection:
         self.fast_handlers = fast_handlers or {}
         self.name = name
         self.on_close = on_close
+        self.on_connect = on_connect
+        # Server side: require this token before processing any frame.
+        self._auth_token = auth_token
+        self._authed = auth_token is None
+        # Client side: handshake to emit as the very first frame.
+        self._send_token = send_token
+        self.transport: asyncio.Transport | None = None
         self._next_id = 1
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
+        self._paused = False
+        self._drain_waiters: list = []
+        # All receive framing + msgpack decode happens inside this C
+        # streaming unpacker; data_received feeds it raw socket bytes.
+        self._unpacker = msgpack.Unpacker(
+            raw=False, strict_map_key=False, max_buffer_size=MAX_FRAME)
         # Frame coalescing: frames queued in one loop tick go out as ONE
         # transport.write (one syscall) — under task fan-out the loop was
         # spending ~3/4 of its samples in per-frame socket sends.
@@ -170,66 +213,110 @@ class Connection:
         # actor calls resolves K replies in the same tick).
         self._resp_buf: list = []
         self._resp_scheduled = False
-        self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     @property
     def closed(self):
         return self._closed
 
-    async def _recv_loop(self):
+    # Back-compat surface for callers that reached into .writer.transport.
+    @property
+    def writer(self):
+        return self
+
+    def abort(self):
+        if self.transport is not None:
+            self.transport.abort()
+
+    # ---------------------------------------------------------- wire events
+    def _connection_made(self, transport):
+        self.transport = transport
+        if self._send_token is not None:
+            # First frame on the wire, ahead of any queued call: the write
+            # path appends in order, so this is guaranteed to arrive first.
+            self._send_frame([0, "__auth__", self._send_token])
+        if self.on_connect is not None:
+            try:
+                self.on_connect(self)
+            except Exception:
+                logger.exception("on_connect callback failed")
+
+    def _resume_writing(self):
+        self._paused = False
+        waiters, self._drain_waiters = self._drain_waiters, []
+        for w in waiters:
+            if not w.done():
+                w.set_result(None)
+
+    def _data_received(self, data):
         try:
-            while True:
-                msg = await _read_frame(self.reader)
-                if not isinstance(msg, (list, tuple)) or len(msg) != 3:
-                    logger.warning("malformed frame on %s", self.name)
-                    continue
-                mid, a, b = msg
-                if isinstance(a, str):  # request [mid, method, payload]
-                    if a == "__batch_resp__":
-                        # Coalesced responses (see _send_reply): resolve
-                        # each pending future in arrival order.
-                        pend = self._pending
-                        for sub in b:
-                            fut = pend.pop(sub[0], None)
-                            if fut is not None and not fut.done():
-                                if sub[1] == 0:
-                                    fut.set_result(sub[2])
-                                else:
-                                    fut.set_exception(RemoteError(sub[2]))
-                        continue
-                    if a == "__batch__":
-                        # Multi-call frame: K independent requests in one
-                        # frame (see call_many). Each dispatches separately
-                        # and replies with its own response frame, so the
-                        # semantics are identical to K pipelined call()s —
-                        # only the framing overhead is amortized.
-                        fhs = self.fast_handlers
-                        for sub in b:
-                            fh = fhs.get(sub[1])
-                            if fh is not None:
-                                self._dispatch_fast(sub[0], sub[1], fh,
-                                                    sub[2])
-                            else:
-                                spawn(self._dispatch(sub[0], sub[1], sub[2]))
-                        continue
-                    fh = self.fast_handlers.get(a)
-                    if fh is not None:
-                        self._dispatch_fast(mid, a, fh, b)
-                    else:
-                        spawn(self._dispatch(mid, a, b))
-                else:  # response [mid, status, payload]
-                    fut = self._pending.pop(mid, None)
-                    if fut is not None and not fut.done():
-                        if a == 0:
-                            fut.set_result(b)
-                        else:
-                            fut.set_exception(RemoteError(b))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
-        except asyncio.CancelledError:
+            self._unpacker.feed(data)
+            for msg in self._unpacker:
+                self._on_msg(msg)
+        except Exception:
+            # Malformed stream (bad msgpack, oversized buffer): drop peer.
+            logger.warning("malformed stream on %s; closing", self.name,
+                           exc_info=True)
+            self.abort()
+
+    def _on_msg(self, msg):
+        if not isinstance(msg, (list, tuple)) or len(msg) != 3:
+            logger.warning("malformed frame on %s", self.name)
             return
-        finally:
-            self._teardown()
+        mid, a, b = msg
+        if not self._authed:
+            # EVERY frame shape is gated until the handshake lands —
+            # response-shaped frames from an unauthenticated peer could
+            # otherwise resolve/poison pending futures on this connection.
+            if (isinstance(a, str) and a == "__auth__" and
+                    isinstance(b, str) and
+                    hmac.compare_digest(b, self._auth_token)):
+                self._authed = True
+            else:
+                logger.warning("auth failure on %s (first frame %r); "
+                               "dropping connection", self.name, a)
+                self.abort()
+            return
+        if isinstance(a, str):  # request [mid, method, payload]
+            if a == "__auth__":
+                return  # authed already (or server auth disabled): ignore
+            if a == "__batch_resp__":
+                # Coalesced responses (see _send_reply): resolve each
+                # pending future in arrival order.
+                pend = self._pending
+                for sub in b:
+                    fut = pend.pop(sub[0], None)
+                    if fut is not None and not fut.done():
+                        if sub[1] == 0:
+                            fut.set_result(sub[2])
+                        else:
+                            fut.set_exception(RemoteError(sub[2]))
+                return
+            if a == "__batch__":
+                # Multi-call frame: K independent requests in one frame
+                # (see call_many). Each dispatches separately and replies
+                # with its own response frame, so the semantics are
+                # identical to K pipelined call()s — only the framing
+                # overhead is amortized.
+                fhs = self.fast_handlers
+                for sub in b:
+                    fh = fhs.get(sub[1])
+                    if fh is not None:
+                        self._dispatch_fast(sub[0], sub[1], fh, sub[2])
+                    else:
+                        spawn(self._dispatch(sub[0], sub[1], sub[2]))
+                return
+            fh = self.fast_handlers.get(a)
+            if fh is not None:
+                self._dispatch_fast(mid, a, fh, b)
+            else:
+                spawn(self._dispatch(mid, a, b))
+        else:  # response [mid, status, payload]
+            fut = self._pending.pop(mid, None)
+            if fut is not None and not fut.done():
+                if a == 0:
+                    fut.set_result(b)
+                else:
+                    fut.set_exception(RemoteError(b))
 
     def _teardown(self):
         if self._closed:
@@ -239,8 +326,10 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
         self._pending.clear()
+        self._resume_writing()  # unblock drain waiters
         try:
-            self.writer.close()
+            if self.transport is not None:
+                self.transport.close()
         except Exception:
             pass
         if self.on_close:
@@ -332,6 +421,14 @@ class Connection:
             return  # one-way
         self._maybe_reply(mid, method, status, body)
 
+    async def drain(self):
+        """Wait until the transport's write buffer falls below the high
+        watermark (cheap no-op when unpaused — matches StreamWriter.drain)."""
+        if self._paused and not self._closed:
+            w = asyncio.get_running_loop().create_future()
+            self._drain_waiters.append(w)
+            await w
+
     async def call(self, method: str, payload=None, timeout: float | None = None):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
@@ -340,11 +437,11 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
         self._send_frame([mid, method, payload])
-        try:
-            await self.writer.drain()
-        except (ConnectionError, OSError):
-            self._teardown()
+        if self._closed:
+            if fut.done():
+                fut.exception()  # consume, avoid never-retrieved warning
             raise ConnectionLost(f"connection {self.name} lost on send")
+        await self.drain()
         if timeout:
             return await asyncio.wait_for(fut, timeout)
         return await fut
@@ -390,12 +487,10 @@ class Connection:
             if self._closed:
                 return
             try:
-                self.writer.write(_LEN.pack(len(data)))
-                self.writer.write(data)
+                self.transport.write(data)
             except (ConnectionError, OSError):
                 self._teardown()
             return
-        self._wbuf.append(_LEN.pack(len(data)))
         self._wbuf.append(data)
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -409,10 +504,9 @@ class Connection:
         buf, self._wbuf = self._wbuf, []
         try:
             # Always one transport.write: on a drained transport each
-            # write() is an immediate socket send, so writing header and
-            # body separately costs two syscalls per frame.
-            self.writer.write(buf[0] + buf[1] if len(buf) == 2
-                              else b"".join(buf))
+            # write() is an immediate socket send, so per-frame writes
+            # cost a syscall each.
+            self.transport.write(buf[0] if len(buf) == 1 else b"".join(buf))
         except (ConnectionError, OSError):
             self._teardown()
 
@@ -422,12 +516,11 @@ class Connection:
         # must still reach the peer.
         self._flush_resp()
         self._flush_wbuf()
-        if not self._closed:
+        if not self._closed and self.transport is not None:
             try:
-                await self.writer.drain()
-            except (ConnectionError, OSError):
+                self.transport.write_eof()
+            except (OSError, RuntimeError, NotImplementedError):
                 pass
-        self._recv_task.cancel()
         self._teardown()
 
 
@@ -437,10 +530,12 @@ class Connection:
 class RpcServer:
     def __init__(self, handlers: Dict[str, Callable], name: str = "server",
                  on_client_close: Callable | None = None,
-                 fast_handlers: Dict[str, Callable] | None = None):
+                 fast_handlers: Dict[str, Callable] | None = None,
+                 auth_token: str | None = None):
         self.handlers = handlers
         self.fast_handlers = fast_handlers
         self.name = name
+        self.auth_token = auth_token
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
         # Called with the Connection when a client disconnects — lets the
@@ -448,15 +543,7 @@ class RpcServer:
         # returning leases on client disconnect).
         self.on_client_close = on_client_close
 
-    async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
-        self._server = await asyncio.start_server(self._on_conn, host, port)
-        return self._server.sockets[0].getsockname()[:2]
-
-    async def start_unix(self, path: str):
-        self._server = await asyncio.start_unix_server(self._on_conn, path)
-        return path
-
-    async def _on_conn(self, reader, writer):
+    def _factory(self) -> _WireProtocol:
         def _closed(c):
             self.connections.discard(c)
             if self.on_client_close is not None:
@@ -464,18 +551,25 @@ class RpcServer:
                     self.on_client_close(c)
                 except Exception:
                     logger.exception("on_client_close failed")
-        conn = Connection(reader, writer, self.handlers, name=self.name,
-                          on_close=_closed,
-                          fast_handlers=self.fast_handlers)
-        self.connections.add(conn)
+        conn = Connection(self.handlers, name=self.name, on_close=_closed,
+                          fast_handlers=self.fast_handlers,
+                          auth_token=self.auth_token,
+                          on_connect=self.connections.add)
+        return _WireProtocol(conn)
+
+    async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(self._factory, host, port)
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def start_unix(self, path: str):
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_unix_server(self._factory, path)
+        return path
 
     async def close(self):
         if self._server:
             self._server.close()
-        # Client connections FIRST: since Python 3.12 wait_closed() waits
-        # for every per-connection handler to finish, and handlers of
-        # still-connected peers (e.g. a live GCS dialing this agent)
-        # otherwise pend forever — SIGTERM'd daemons hung in close().
         for c in list(self.connections):
             await c.close()
         if self._server:
@@ -496,13 +590,15 @@ class ReconnectingConnection:
     def __init__(self, address, handlers: Dict[str, Callable] | None = None,
                  name: str = "client",
                  on_reconnect: Callable | None = None,
-                 dial_retries: int = 75, retry_delay: float = 0.2):
+                 dial_retries: int = 75, retry_delay: float = 0.2,
+                 auth_token: str | None = None):
         self.address = address
         self.handlers = handlers
         self.name = name
         self.on_reconnect = on_reconnect
         self.dial_retries = dial_retries
         self.retry_delay = retry_delay
+        self.auth_token = auth_token
         self._conn: Connection | None = None
         self._lock = asyncio.Lock()
         self._closed = False
@@ -526,7 +622,8 @@ class ReconnectingConnection:
                 return self._conn
             self._conn = await connect(
                 self.address, self.handlers, retries=self.dial_retries,
-                retry_delay=self.retry_delay, name=self.name)
+                retry_delay=self.retry_delay, name=self.name,
+                auth_token=self.auth_token)
             if self.on_reconnect is not None:
                 res = self.on_reconnect(self._conn)
                 if isinstance(res, Awaitable):
@@ -560,16 +657,22 @@ class ReconnectingConnection:
 # ---------------------------------------------------------------------------
 async def connect(address, handlers: Dict[str, Callable] | None = None,
                   retries: int = 10, retry_delay: float = 0.2,
-                  name: str = "client", on_close: Callable | None = None) -> Connection:
+                  name: str = "client", on_close: Callable | None = None,
+                  auth_token: str | None = None) -> Connection:
     """address: (host, port) tuple or unix socket path str."""
+    loop = asyncio.get_running_loop()
     last_err: Exception | None = None
     for attempt in range(retries):
+        conn = Connection(handlers, name=name, on_close=on_close,
+                          send_token=auth_token)
         try:
             if isinstance(address, str):
-                reader, writer = await asyncio.open_unix_connection(address)
+                await loop.create_unix_connection(
+                    lambda: _WireProtocol(conn), address)
             else:
-                reader, writer = await asyncio.open_connection(address[0], address[1])
-            return Connection(reader, writer, handlers, name=name, on_close=on_close)
+                await loop.create_connection(
+                    lambda: _WireProtocol(conn), address[0], address[1])
+            return conn
         except (ConnectionError, OSError, FileNotFoundError) as e:
             last_err = e
             await asyncio.sleep(min(retry_delay * (1.5 ** attempt), 2.0))

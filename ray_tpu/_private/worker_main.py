@@ -45,6 +45,7 @@ class Executor:
     def __init__(self, core: CoreWorker, agent_conn_holder):
         self.core = core
         self._fn_cache: Dict[bytes, Any] = {}
+        self._fn_coro_cache: Dict[bytes, bool] = {}
         self._task_lock = asyncio.Lock()       # normal tasks: serial
         self.actor: Any = None
         self.actor_id: Optional[bytes] = None
@@ -244,10 +245,18 @@ class Executor:
         _execute loads from the GCS; coroutine fn; ref args; PG-targeted
         tasks, which need the per-task placement-group context _execute
         installs for get_current_placement_group)."""
-        fn = self._fn_cache.get(spec.get("fn_id"))
+        fn_id = spec.get("fn_id")
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            return None
+        # iscoroutinefunction walks code flags through unwrap() — ~8us a
+        # call, paid once per fn_id instead of once per task.
+        is_coro = self._fn_coro_cache.get(fn_id)
+        if is_coro is None:
+            is_coro = self._fn_coro_cache[fn_id] = \
+                asyncio.iscoroutinefunction(fn)
         strat = spec.get("scheduling_strategy") or {}
-        if (fn is None or asyncio.iscoroutinefunction(fn)
-                or spec.get("streaming")
+        if (is_coro or spec.get("streaming")
                 or strat.get("type") == "placement_group"
                 or not all("v" in e for e in spec["args"])):
             return None

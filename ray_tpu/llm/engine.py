@@ -250,12 +250,16 @@ class LLMEngine:
             # embed/lm_head keeps token gathers collective-free.
             rules = rules or LogicalAxisRules.default().with_overrides(
                 ("vocab", None), ("embed", None))
-            if cfg.num_kv_heads % max(dict(mesh.shape).get("tp", 1), 1):
+            has_tp = "tp" in mesh.shape
+            if has_tp and cfg.num_kv_heads % mesh.shape["tp"]:
                 raise ValueError(
                     f"num_kv_heads={cfg.num_kv_heads} not divisible by "
-                    f"tp={dict(mesh.shape).get('tp')}")
+                    f"tp={mesh.shape['tp']}")
             param_shd = tree_shardings(param_logical_axes(cfg), mesh, rules)
-            self._kv_shd = NamedSharding(mesh, P(None, None, None, "tp"))
+            # No tp axis (e.g. a dp-only serving mesh): weights + KV
+            # replicate rather than erroring on the undefined axis name.
+            self._kv_shd = NamedSharding(
+                mesh, P(None, None, None, "tp") if has_tp else P())
         self.params = params if params is not None else \
             init_params(cfg, jax.random.key(seed))
         if param_shd is not None:

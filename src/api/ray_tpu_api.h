@@ -84,6 +84,7 @@ class GcsClient {
  private:
   int fd_ = -1;
   uint32_t next_id_ = 1;
+  std::string rbuf_;  // leftover bytes between incremental frame decodes
 };
 
 // ------------------------------------------------------- ObjectStoreClient --

@@ -1,7 +1,9 @@
 // Implementation of the C++ public API (see ray_tpu_api.h).
-// Wire protocol: 4-byte little-endian length + msgpack [id, method,
-// payload] requests, [id, status, payload] responses — the same frames
-// ray_tpu/_private/rpc.py speaks.
+// Wire protocol: a raw stream of self-delimiting msgpack objects —
+// [id, method, payload] requests, [id, status, payload] responses — the
+// same frames ray_tpu/_private/rpc.py speaks (no length prefix; decode
+// reports truncation, so reads are incremental). If RAY_TPU_AUTH_TOKEN is
+// set, Connect() sends the [0, "__auth__", token] handshake first.
 
 #include "ray_tpu_api.h"
 
@@ -12,6 +14,7 @@
 #include <unistd.h>
 
 #include <cstdio>
+#include <cstdlib>
 
 // store.cc exports (link src/object_store/store.cc alongside).
 extern "C" {
@@ -132,6 +135,11 @@ void encode(const MsgVal& v, std::string* o) {
 struct Reader {
   const uint8_t* p;
   size_t n;
+  // Distinguishes "frame truncated, read more" (malformed=false) from
+  // "bytes can never parse" (malformed=true) for the incremental decode
+  // loop in GcsClient::Call — a permanently undecodable frame must close
+  // the connection, not block in read() forever.
+  bool malformed = false;
   bool take(size_t k, const uint8_t** out) {
     if (n < k) return false;
     *out = p; p += k; n -= k; return true;
@@ -151,7 +159,7 @@ struct Reader {
 };
 
 bool decode(Reader* r, MsgVal* out, int depth = 0) {
-  if (depth > 64) return false;
+  if (depth > 64) { r->malformed = true; return false; }
   uint8_t t;
   if (!r->u8(&t)) return false;
   auto str_of = [&](size_t len, MsgVal::Type ty) {
@@ -226,7 +234,7 @@ bool decode(Reader* r, MsgVal* out, int depth = 0) {
     case 0xdd: if (!r->be(4, &v)) return false; return arr_of(v);
     case 0xde: if (!r->be(2, &v)) return false; return map_of(v);
     case 0xdf: if (!r->be(4, &v)) return false; return map_of(v);
-    default: return false;   // ext types unused by the protocol
+    default: r->malformed = true; return false;  // ext types: unused
   }
 }
 
@@ -284,7 +292,20 @@ bool GcsClient::Connect(const std::string& host, int port) {
     close(fd);
   }
   freeaddrinfo(res);
-  return fd_ >= 0;
+  if (fd_ < 0) return false;
+  rbuf_.clear();
+  const char* tok = getenv("RAY_TPU_AUTH_TOKEN");
+  if (tok && *tok) {
+    // One-way handshake, first frame on the wire (rpc.py auth_token=...).
+    MsgVal hello = MsgVal::Arr({MsgVal::Int(0), MsgVal::Str("__auth__"),
+                                MsgVal::Str(tok)});
+    std::string body = MsgPackEncode(hello);
+    if (!write_all(fd_, (const uint8_t*)body.data(), body.size())) {
+      Close();
+      return false;
+    }
+  }
+  return true;
 }
 
 bool GcsClient::Connected() const { return fd_ >= 0; }
@@ -297,35 +318,56 @@ void GcsClient::Close() {
 bool GcsClient::Call(const std::string& method, const MsgVal& payload,
                      MsgVal* out, std::string* err) {
   if (fd_ < 0) return false;
-  MsgVal frame = MsgVal::Arr({MsgVal::Int(next_id_++), MsgVal::Str(method),
-                              payload});
+  uint32_t want_id = next_id_++;
+  MsgVal frame = MsgVal::Arr({MsgVal::Int((int64_t)want_id),
+                              MsgVal::Str(method), payload});
   std::string body = MsgPackEncode(frame);
-  uint8_t hdr[4];
-  uint32_t n = (uint32_t)body.size();
-  memcpy(hdr, &n, 4);                       // little-endian length prefix
-  if (!write_all(fd_, hdr, 4) ||
-      !write_all(fd_, (const uint8_t*)body.data(), body.size())) {
+  if (!write_all(fd_, (const uint8_t*)body.data(), body.size())) {
     Close();
     return false;
   }
-  // Responses arrive in order on this single-call-at-a-time client; skip
-  // any server-initiated request frames (method at index 1 is a string).
-  for (;;) {
-    if (!read_exact(fd_, hdr, 4)) { Close(); return false; }
-    memcpy(&n, hdr, 4);
-    std::vector<uint8_t> buf(n);
-    if (!read_exact(fd_, buf.data(), n)) { Close(); return false; }
-    MsgVal resp;
-    if (!MsgPackDecode(buf.data(), n, &resp) ||
-        resp.type != MsgVal::ARRAY || resp.arr.size() != 3)
-      continue;
-    if (resp.arr[1].type == MsgVal::STR) continue;  // server push: ignore
-    if (resp.arr[1].i != 0) {
-      if (err) *err = resp.arr[2].s;
+  // Frames are self-delimiting msgpack: decode from the buffered tail,
+  // reading more whenever the decoder reports truncation. Skip any
+  // server-initiated request frames (method at index 1 is a string),
+  // but unpack [0, "__batch_resp__", [...]] reply coalescing.
+  auto finish = [&](MsgVal resp3_status, MsgVal resp3_body) -> bool {
+    if (resp3_status.i != 0) {
+      if (err) *err = resp3_body.s;
       return false;
     }
-    *out = std::move(resp.arr[2]);
+    *out = std::move(resp3_body);
     return true;
+  };
+  for (;;) {
+    MsgVal resp;
+    Reader r{(const uint8_t*)rbuf_.data(), rbuf_.size()};
+    bool got = !rbuf_.empty() && decode(&r, &resp);
+    if (!got && r.malformed) {
+      Close();  // undecodable frame: more bytes can never fix it
+      return false;
+    }
+    if (got) {
+      rbuf_.erase(0, rbuf_.size() - r.n);
+      if (resp.type != MsgVal::ARRAY || resp.arr.size() != 3) continue;
+      if (resp.arr[1].type == MsgVal::STR) {
+        if (resp.arr[1].s == "__batch_resp__" &&
+            resp.arr[2].type == MsgVal::ARRAY) {
+          for (auto& sub : resp.arr[2].arr) {
+            if (sub.type == MsgVal::ARRAY && sub.arr.size() == 3 &&
+                sub.arr[0].i == (int64_t)want_id)
+              return finish(std::move(sub.arr[1]), std::move(sub.arr[2]));
+          }
+        }
+        continue;  // server push: ignore
+      }
+      if (resp.arr[0].i != (int64_t)want_id) continue;  // stale reply
+      return finish(std::move(resp.arr[1]), std::move(resp.arr[2]));
+    }
+    if (rbuf_.size() > (64u << 20)) { Close(); return false; }  // malformed
+    char chunk[16384];
+    ssize_t k = ::read(fd_, chunk, sizeof chunk);
+    if (k <= 0) { Close(); return false; }
+    rbuf_.append(chunk, (size_t)k);
   }
 }
 
