@@ -137,6 +137,24 @@ def _unpack(data: bytes):
 # coroutine handler instead (slow/conditional branch).
 FAST_FALLBACK = object()
 
+# Process-wide default auth token (reference: authentication_token_loader.cc
+# reads RAY_AUTH_TOKEN/token file once per process). Servers require it and
+# clients send it unless a call site overrides explicitly; DEFAULT_TOKEN as
+# a parameter default means "use the process default", None means "no auth".
+DEFAULT_TOKEN = object()
+_default_token: Optional[str] = None
+
+
+def set_default_token(token: Optional[str]) -> None:
+    """Install the session auth token for every server/connect in this
+    process that doesn't override it. Empty/None disables."""
+    global _default_token
+    _default_token = token or None
+
+
+def _resolve_token(token) -> Optional[str]:
+    return _default_token if token is DEFAULT_TOKEN else token
+
 
 class _WireProtocol(asyncio.Protocol):
     """Thin adapter: the event loop calls here, the Connection does the work."""
@@ -531,11 +549,11 @@ class RpcServer:
     def __init__(self, handlers: Dict[str, Callable], name: str = "server",
                  on_client_close: Callable | None = None,
                  fast_handlers: Dict[str, Callable] | None = None,
-                 auth_token: str | None = None):
+                 auth_token=DEFAULT_TOKEN):
         self.handlers = handlers
         self.fast_handlers = fast_handlers
         self.name = name
-        self.auth_token = auth_token
+        self.auth_token = _resolve_token(auth_token)
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
         # Called with the Connection when a client disconnects — lets the
@@ -591,7 +609,7 @@ class ReconnectingConnection:
                  name: str = "client",
                  on_reconnect: Callable | None = None,
                  dial_retries: int = 75, retry_delay: float = 0.2,
-                 auth_token: str | None = None):
+                 auth_token=DEFAULT_TOKEN):
         self.address = address
         self.handlers = handlers
         self.name = name
@@ -658,13 +676,14 @@ class ReconnectingConnection:
 async def connect(address, handlers: Dict[str, Callable] | None = None,
                   retries: int = 10, retry_delay: float = 0.2,
                   name: str = "client", on_close: Callable | None = None,
-                  auth_token: str | None = None) -> Connection:
+                  auth_token=DEFAULT_TOKEN) -> Connection:
     """address: (host, port) tuple or unix socket path str."""
     loop = asyncio.get_running_loop()
+    send_token = _resolve_token(auth_token)
     last_err: Exception | None = None
     for attempt in range(retries):
         conn = Connection(handlers, name=name, on_close=on_close,
-                          send_token=auth_token)
+                          send_token=send_token)
         try:
             if isinstance(address, str):
                 await loop.create_unix_connection(
