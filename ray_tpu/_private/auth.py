@@ -1,0 +1,126 @@
+"""Cluster auth-token lifecycle.
+
+Every RPC server in the cluster (GCS, agents, workers, client server)
+requires the session token as the first frame on each inbound connection
+(rpc.py handshake), and the dashboard requires it as a bearer header.
+This module owns where the token comes from (reference:
+src/ray/rpc/authentication/authentication_token_loader.cc — a token is
+loaded once per process from RAY_AUTH_TOKEN / a token file; validators
+check it on every server, python/ray/dashboard/http_server_head.py:23-28
+middleware checks HTTP).
+
+Resolution order (first hit wins):
+  1. RAY_TPU_AUTH_TOKEN env var
+  2. RAY_TPU_AUTH_TOKEN_FILE env var (path to a token file)
+  3. <session_dir>/auth_token  (when a session dir is known)
+  4. the well-known current-cluster token file next to the cluster
+     address file (local attach: init(address='auto'), CLI)
+
+`ensure_cluster_token` is the head-start path: it generates a fresh
+token when none is configured, exports it into os.environ (so every
+daemon/worker spawned with child_env() inherits it — including the C++
+client, which reads RAY_TPU_AUTH_TOKEN), and installs it as this
+process's rpc default.  Zero-config clusters therefore come up
+authenticated without the user doing anything.
+
+Set RAY_TPU_AUTH_DISABLED=1 to run a cluster with auth off.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+from typing import Optional
+
+from . import rpc
+
+logger = logging.getLogger("ray_tpu.auth")
+
+TOKEN_ENV = "RAY_TPU_AUTH_TOKEN"
+TOKEN_FILE_ENV = "RAY_TPU_AUTH_TOKEN_FILE"
+DISABLE_ENV = "RAY_TPU_AUTH_DISABLED"
+# Sibling of worker.CLUSTER_ADDRESS_FILE — lets a second local driver
+# attach with address='auto' and no configuration.
+CLUSTER_TOKEN_FILE = "/tmp/ray_tpu/ray_current_cluster_token"
+
+
+def auth_disabled() -> bool:
+    return os.environ.get(DISABLE_ENV, "") in ("1", "true", "yes")
+
+
+def _read_file(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def load_token(session_dir: Optional[str] = None) -> Optional[str]:
+    """Resolve the cluster token for this process without generating."""
+    if auth_disabled():
+        return None
+    tok = os.environ.get(TOKEN_ENV)
+    if tok:
+        return tok.strip()
+    path = os.environ.get(TOKEN_FILE_ENV)
+    if path:
+        tok = _read_file(path)
+        if tok:
+            return tok
+    if session_dir:
+        tok = _read_file(os.path.join(session_dir, "auth_token"))
+        if tok:
+            return tok
+    return _read_file(CLUSTER_TOKEN_FILE)
+
+
+def install_process_token(session_dir: Optional[str] = None) -> Optional[str]:
+    """Load the token and make it this process's rpc default (daemon and
+    attaching-driver mains).  Also exports it to os.environ so any child
+    this process spawns (agents joining via CLI, workers, the C++ client)
+    inherits it.  Returns the token (None = auth off)."""
+    tok = load_token(session_dir)
+    rpc.set_default_token(tok)
+    if tok:
+        os.environ[TOKEN_ENV] = tok
+    return tok
+
+
+def _write_private(path: str, token: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, token.encode())
+    finally:
+        os.close(fd)
+
+
+def ensure_cluster_token(session_dir: str,
+                         write_wellknown: bool = True) -> Optional[str]:
+    """Head-start path: reuse a configured token or generate one, persist
+    it, export it to children via the environment, and install it as this
+    process's rpc default."""
+    if auth_disabled():
+        rpc.set_default_token(None)
+        return None
+    tok = os.environ.get(TOKEN_ENV, "").strip() or None
+    if not tok:
+        path = os.environ.get(TOKEN_FILE_ENV)
+        if path:
+            tok = _read_file(path)
+    generated = tok is None
+    if tok is None:
+        tok = secrets.token_hex(32)
+    try:
+        _write_private(os.path.join(session_dir, "auth_token"), tok)
+        if write_wellknown:
+            _write_private(CLUSTER_TOKEN_FILE, tok)
+    except OSError:
+        logger.warning("could not persist session auth token", exc_info=True)
+    os.environ[TOKEN_ENV] = tok
+    rpc.set_default_token(tok)
+    if generated:
+        logger.info("generated session auth token (session %s)", session_dir)
+    return tok

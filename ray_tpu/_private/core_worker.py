@@ -2110,6 +2110,10 @@ class CoreWorker:
             except Exception:
                 logger.exception("reply handling failed for %s",
                                  task.spec.get("name"))
+                # lease.inflight was already decremented on this path: the
+                # key's queue must still get pumped or it sits idle until
+                # some unrelated event wakes it.
+                self._schedule_pump(key, state)
             finally:
                 n_left -= 1
                 if n_left == 0 and not all_done.done():

@@ -39,6 +39,9 @@ import msgpack
 logger = logging.getLogger(__name__)
 
 MAX_FRAME = 1 << 31
+# An unauthenticated peer's entire stream budget: the auth handshake frame
+# is <100 bytes, so anything past this is hostile or misdirected traffic.
+PREAUTH_MAX_BYTES = 64 << 10
 
 
 class RpcError(Exception):
@@ -208,6 +211,7 @@ class Connection:
         # Server side: require this token before processing any frame.
         self._auth_token = auth_token
         self._authed = auth_token is None
+        self._preauth_bytes = 0
         # Client side: handshake to emit as the very first frame.
         self._send_token = send_token
         self.transport: asyncio.Transport | None = None
@@ -275,6 +279,18 @@ class Connection:
             logger.warning("malformed stream on %s; closing", self.name,
                            exc_info=True)
             self.abort()
+            return
+        if not self._authed:
+            # Still unauthenticated AFTER processing this chunk (the check
+            # runs post-feed so a handshake coalesced with a large first
+            # request in one chunk authenticates before the cap applies):
+            # budget the stream so a hostile peer can't make us buffer up
+            # to MAX_FRAME (2 GiB) of an incomplete frame.
+            self._preauth_bytes += len(data)
+            if self._preauth_bytes > PREAUTH_MAX_BYTES:
+                logger.warning("pre-auth stream exceeded %d bytes on %s; "
+                               "dropping", PREAUTH_MAX_BYTES, self.name)
+                self.abort()
 
     def _on_msg(self, msg):
         if not isinstance(msg, (list, tuple)) or len(msg) != 3:

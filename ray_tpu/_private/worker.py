@@ -107,8 +107,14 @@ def init(address: Optional[str] = None, *,
                 "address='auto' but no running cluster was found "
                 f"({CLUSTER_ADDRESS_FILE} missing); start one with "
                 "`python -m ray_tpu start --head`")
+    from . import auth
     if address is None:
         rt.session_dir = node_mod.new_session_dir()
+        # Session token BEFORE any daemon spawns: children inherit it via
+        # child_env() and their servers require it from birth.
+        # write_wellknown=False: only `ray_tpu start --head` writes the
+        # cluster address file, so only it may write the paired token drop.
+        auth.ensure_cluster_token(rt.session_dir, write_wellknown=False)
         gcs_proc, gcs_addr = node_mod.start_gcs(
             rt.session_dir, system_config=_system_config)
         rt.procs.append(gcs_proc)
@@ -120,6 +126,10 @@ def init(address: Optional[str] = None, *,
         rt.procs.append(agent_proc)
         rt.gcs_address = gcs_addr
     else:
+        # Attaching driver: the cluster's token comes from the env, a
+        # token file, or the well-known local drop — install it before
+        # the first connect below.
+        auth.install_process_token()
         host, port = address.rsplit(":", 1)
         rt.gcs_address = (host, int(port))
         rt.is_external_cluster = True

@@ -1120,6 +1120,8 @@ def main():
     signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
     from .node import install_daemon_profiler
     install_daemon_profiler("worker")
+    from .auth import install_process_token
+    install_process_token()
     try:
         asyncio.run(amain())
     except KeyboardInterrupt:

@@ -43,6 +43,14 @@ class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None):
         self.session_dir = node_mod.new_session_dir()
+        # Same token story as a real head start: generate/export before
+        # any daemon spawns so every agent requires it (the driver that
+        # later init(address=...)s from this process already holds it).
+        # write_wellknown=False: Cluster() never writes the cluster
+        # address file, so it must not clobber the machine-global token
+        # drop either (they'd desync for address='auto' attach).
+        from ._private import auth
+        auth.ensure_cluster_token(self.session_dir, write_wellknown=False)
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_address: Optional[tuple] = None
         self.nodes: List[ClusterNode] = []

@@ -102,9 +102,19 @@ class DashboardHead:
     """One process per cluster, typically beside the GCS (reference:
     dashboard/head.py)."""
 
+    _SESSION_TOKEN = object()   # default: whatever the process loaded
+
     def __init__(self, gcs_address: Tuple[str, int],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_token=_SESSION_TOKEN):
         self.gcs_address = tuple(gcs_address)
+        # Bearer auth (reference: dashboard/http_server_head.py:23-28
+        # token middleware).  Default: this process's session token; pass
+        # auth_token=None explicitly to disable.
+        if auth_token is DashboardHead._SESSION_TOKEN:
+            from .._private import rpc as _rpc
+            auth_token = _rpc._resolve_token(_rpc.DEFAULT_TOKEN)
+        self.auth_token = auth_token
         self.host, self.port = host, port
         self.address: Optional[Tuple[str, int]] = None
         self._conn = None
@@ -147,13 +157,24 @@ class DashboardHead:
             if len(parts) < 2:
                 return
             method, target = parts[0], parts[1]
+            bearer = None
             while True:     # drain headers (all endpoints are GET)
                 h = await asyncio.wait_for(reader.readline(), 30)
                 if h in (b"\r\n", b"\n", b""):
                     break
-            # Full target (incl. query string): _route urlsplits it —
-            # /api/profile's node/kind/duration parameters live there.
-            status, ctype, body = await self._route(method, target)
+                if h.lower().startswith(b"authorization:"):
+                    val = h.split(b":", 1)[1].strip().decode("latin1")
+                    if val.lower().startswith("bearer "):
+                        bearer = val[7:].strip()
+            if not self._authorized(target, bearer):
+                status, ctype, body = (
+                    401, "text/plain",
+                    b"401: missing or invalid auth token (send "
+                    b"'Authorization: Bearer <token>' or '?token=')")
+            else:
+                # Full target (incl. query string): _route urlsplits it —
+                # /api/profile's node/kind/duration parameters live there.
+                status, ctype, body = await self._route(method, target)
         except (asyncio.TimeoutError, ConnectionError):
             return
         except Exception as e:
@@ -163,7 +184,8 @@ class DashboardHead:
             writer.write(
                 b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
                 b"Content-Length: %d\r\nConnection: close\r\n\r\n"
-                % (status, {200: b"OK", 404: b"Not Found",
+                % (status, {200: b"OK", 401: b"Unauthorized",
+                            404: b"Not Found",
                             500: b"Internal Server Error"}.get(status, b"?"),
                    ctype.encode(), len(body)))
             writer.write(body)
@@ -172,6 +194,31 @@ class DashboardHead:
             pass
         finally:
             writer.close()
+
+    # The static index page and liveness probe carry no cluster data: the
+    # UI must be loadable from a bare URL (its JS then attaches the stored
+    # token to every API call), and probes can't send headers.
+    _AUTH_EXEMPT = ("/", "/index.html", "/healthz")
+
+    def _authorized(self, target: str, bearer: Optional[str]) -> bool:
+        """Bearer header or ?token= query (the web UI bootstraps from the
+        URL — a browser can't attach headers to the initial page load)."""
+        if self.auth_token is None:
+            return True
+        from urllib.parse import parse_qs, urlsplit
+        parts = urlsplit(target)
+        if parts.path in self._AUTH_EXEMPT:
+            return True
+        import hmac
+        want = self.auth_token.encode("utf-8", "surrogateescape")
+
+        def _ok(candidate: Optional[str]) -> bool:
+            # bytes-compare: compare_digest raises on non-ASCII str.
+            return candidate is not None and hmac.compare_digest(
+                candidate.encode("utf-8", "surrogateescape"), want)
+
+        return _ok(bearer) or _ok(
+            parse_qs(parts.query).get("token", [None])[0])
 
     async def _node_agent(self, query):
         """Agent connection for the node the `node=<hex prefix>` query
@@ -310,11 +357,14 @@ async def _amain(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8265)
     args = ap.parse_args(argv)
+    from .._private.auth import install_process_token
+    tok = install_process_token()
     host, port = args.gcs_address.rsplit(":", 1)
     head = DashboardHead((host, int(port)), args.host, args.port)
     await head.start()
-    print(f"dashboard listening on http://{head.address[0]}:"
-          f"{head.address[1]}", flush=True)
+    url = f"http://{head.address[0]}:{head.address[1]}"
+    print(f"dashboard listening on {url}"
+          + (f"/?token={tok}" if tok else ""), flush=True)
     await asyncio.Event().wait()
 
 

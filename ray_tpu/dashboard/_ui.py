@@ -67,7 +67,17 @@ function fill(tbl, rows) {
   tb.innerHTML = rows.map(r => "<tr>" +
       r.map(c => `<td>${c}</td>`).join("") + "</tr>").join("");
 }
-async function j(p) { const r = await fetch(p); return r.json(); }
+// Session auth: the token rides in on ?token=... (printed by the CLI),
+// is remembered in localStorage, and goes out as a bearer header on
+// every API call.
+const tok = new URLSearchParams(location.search).get("token")
+  || localStorage.getItem("ray_tpu_token");
+if (tok) localStorage.setItem("ray_tpu_token", tok);
+async function j(p) {
+  const r = await fetch(p, tok
+    ? {headers: {"Authorization": "Bearer " + tok}} : {});
+  return r.json();
+}
 async function tick() {
   try {
     const c = await j("/api/cluster");

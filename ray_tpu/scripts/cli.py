@@ -25,9 +25,12 @@ def cmd_start(args) -> int:
     from ray_tpu._private import node as node_mod
     from ray_tpu._private import worker as worker_mod
 
+    from ray_tpu._private import auth
+
     session_dir = node_mod.new_session_dir()
     pids = []
     if args.head:
+        auth.ensure_cluster_token(session_dir)
         gcs_proc, gcs_addr = node_mod.start_gcs(session_dir, port=args.port)
         pids.append(gcs_proc.pid)
         worker_mod.write_cluster_address_file(gcs_addr)
@@ -37,6 +40,12 @@ def cmd_start(args) -> int:
             print("--address required to join an existing cluster",
                   file=sys.stderr)
             return 2
+        # Joining node: the token must come from the env / a token file /
+        # the local well-known drop (the fresh session_dir can't hold one).
+        if auth.install_process_token() is None and not auth.auth_disabled():
+            print("warning: no auth token found (set RAY_TPU_AUTH_TOKEN "
+                  "from the head's session); joining an authenticated "
+                  "cluster will fail", file=sys.stderr)
         host, port = args.address.rsplit(":", 1)
         gcs_addr = (host, int(port))
     res = node_mod.default_resources(args.num_cpus, args.num_tpus, None)

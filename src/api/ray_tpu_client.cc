@@ -323,6 +323,7 @@ bool GcsClient::Call(const std::string& method, const MsgVal& payload,
                               MsgVal::Str(method), payload});
   std::string body = MsgPackEncode(frame);
   if (!write_all(fd_, (const uint8_t*)body.data(), body.size())) {
+    if (err) *err = "connection lost on send";
     Close();
     return false;
   }
@@ -343,6 +344,7 @@ bool GcsClient::Call(const std::string& method, const MsgVal& payload,
     Reader r{(const uint8_t*)rbuf_.data(), rbuf_.size()};
     bool got = !rbuf_.empty() && decode(&r, &resp);
     if (!got && r.malformed) {
+      if (err) *err = "malformed reply frame from server";
       Close();  // undecodable frame: more bytes can never fix it
       return false;
     }
@@ -363,10 +365,20 @@ bool GcsClient::Call(const std::string& method, const MsgVal& payload,
       if (resp.arr[0].i != (int64_t)want_id) continue;  // stale reply
       return finish(std::move(resp.arr[1]), std::move(resp.arr[2]));
     }
-    if (rbuf_.size() > (64u << 20)) { Close(); return false; }  // malformed
+    // Match the Python side's MAX_FRAME (2 GiB): a legitimate large reply
+    // must not be misread as a malformed stream.
+    if (rbuf_.size() > (2147483648ull)) {
+      if (err) *err = "reply exceeds 2 GiB frame cap";
+      Close();
+      return false;
+    }
     char chunk[16384];
     ssize_t k = ::read(fd_, chunk, sizeof chunk);
-    if (k <= 0) { Close(); return false; }
+    if (k <= 0) {
+      if (err) *err = "connection lost while awaiting reply";
+      Close();
+      return false;
+    }
     rbuf_.append(chunk, (size_t)k);
   }
 }

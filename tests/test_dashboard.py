@@ -37,10 +37,15 @@ def dashboard(ray_start_regular):
     # daemon thread dies with the interpreter; no teardown needed
 
 
-def _get(addr, path):
+def _get(addr, path, token=None):
+    if token is None:
+        from ray_tpu._private import rpc as _rpc
+        token = _rpc._resolve_token(_rpc.DEFAULT_TOKEN)
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}{path}",
+        headers={"Authorization": f"Bearer {token}"} if token else {})
     try:
-        with urllib.request.urlopen(
-                f"http://{addr[0]}:{addr[1]}{path}", timeout=30) as r:
+        with urllib.request.urlopen(req, timeout=30) as r:
             return r.status, r.headers.get("Content-Type", ""), r.read()
     except urllib.error.HTTPError as e:
         return e.code, e.headers.get("Content-Type", ""), e.read()
