@@ -329,6 +329,26 @@ class NodeAgent:
                         logger.exception(
                             "worker death handling failed; lease state may "
                             "need the next reap pass")
+            # Sweep leases whose owner connection is closed: the
+            # disconnect callback covers the common case, but a grant
+            # that registers in the same loop tick the teardown runs (or
+            # any future ordering hole) must not leak CPUs forever —
+            # the raylet likewise returns leases on client disconnect
+            # unconditionally (reference: node_manager.cc disconnect
+            # path).
+            for lease_id, wh in list(self.leases.items()):
+                conn = wh.lease_owner_conn
+                if conn is not None and conn.closed:
+                    logger.warning(
+                        "sweeping lease %s from disconnected client",
+                        lease_id.hex()[:8])
+                    try:
+                        self._reclaim_lease(lease_id, wh)
+                    except Exception:
+                        # The reap loop must survive everything: a dead
+                        # loop means no death detection node-wide.
+                        logger.exception("lease sweep failed for %s",
+                                         lease_id.hex()[:8])
 
     async def _memory_monitor_loop(self):
         """Kill-by-policy when node memory crosses the threshold
@@ -861,13 +881,9 @@ class NodeAgent:
                     fut.set_result(res)
                 elif res.get("granted"):
                     # Nobody is listening for this grant anymore.
-                    wh = self.leases.pop(res["lease_id"], None)
+                    wh = self.leases.get(res["lease_id"])
                     if wh is not None:
-                        self._release_resources(wh.lease_resources,
-                                                wh.lease_bundle)
-                        wh.lease_id = None
-                        wh.lease_owner_conn = None
-                        self._recycle_worker(wh)
+                        self._reclaim_lease(res["lease_id"], wh)
 
     def _find_bundle(self, pg_id: bytes, bundle_index: int,
                      resources: Dict[str, float]
@@ -962,24 +978,27 @@ class NodeAgent:
                                     "reason": "client disconnected"})
         for lease_id, wh in list(self.leases.items()):
             if wh.lease_owner_conn is conn:
-                self.leases.pop(lease_id, None)
-                self._release_resources(wh.lease_resources, wh.lease_bundle)
-                wh.lease_id = None
-                wh.lease_resources = {}
-                wh.lease_bundle = None
-                wh.lease_owner_conn = None
-                self._recycle_worker(wh)
+                self._reclaim_lease(lease_id, wh)
 
-    async def h_return_lease(self, conn, p):
-        wh = self.leases.pop(p["lease_id"], None)
-        if wh is None:
-            return False
+    def _reclaim_lease(self, lease_id: bytes, wh: WorkerHandle):
+        """Forcibly return a lease whose owner is gone.  Settles blocked-get
+        CPU accounting (a blocked worker's CPU was already handed back by
+        h_worker_blocked — returning the full grant would double-credit
+        the pool)."""
+        self.leases.pop(lease_id, None)
         self._release_resources(self._settle_lease_release(wh),
                                 wh.lease_bundle)
         wh.lease_id = None
         wh.lease_resources = {}
         wh.lease_bundle = None
+        wh.lease_owner_conn = None
         self._recycle_worker(wh)
+
+    async def h_return_lease(self, conn, p):
+        wh = self.leases.get(p["lease_id"])
+        if wh is None:
+            return False
+        self._reclaim_lease(p["lease_id"], wh)
         return True
 
     def _settle_lease_release(self, wh: WorkerHandle) -> Dict[str, float]:
