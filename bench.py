@@ -114,7 +114,7 @@ def main():
         proc = subprocess.run(
             [sys.executable, "-m", "ray_tpu.util.perf", "--compact",
              "--min-time-s", "2.0"],
-            capture_output=True, text=True, timeout=300,
+            capture_output=True, text=True, timeout=420,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         line = proc.stdout.strip().splitlines()[-1]
         micro = json.loads(line)

@@ -33,7 +33,9 @@ from typing import Dict, List, Optional, Set, Tuple
 from . import protocol, rpc
 from .config import Config, get_config, set_config
 from .ids import NodeID, WorkerID
-from .shm_store import ObjectExistsError, ShmStore
+from .shm_store import (ObjectExistsError, ShmStore, SpillTruncatedError,
+                        StoreFullError)
+from .. import exceptions as exc
 
 logger = logging.getLogger("ray_tpu.agent")
 
@@ -185,6 +187,8 @@ class NodeAgent:
         self._pull_seq = 0
         self._chunk_bytes = cfg.object_transfer_chunk_bytes
         self._max_pulls = cfg.max_concurrent_pulls
+        self._max_inflight_chunks = cfg.object_transfer_max_inflight_chunks
+        self._chunk_timeout = cfg.object_transfer_chunk_timeout_s
         # Parked lease requests: (params, conn, reply_future, deadline),
         # FIFO-granted by _parked_lease_loop as resources free (reference:
         # ClusterLeaseManager's lease queue).
@@ -1368,17 +1372,35 @@ class NodeAgent:
                 logger.warning("could not register external spill of %s",
                                oid.hex())
 
+    def _reacquire_pins(self, oid: bytes) -> bool:
+        """Re-take this agent's owner pins on a just-restored object.
+        False (with any partial pins dropped) if the object vanished
+        mid-way — callers must then treat the restore as failed rather
+        than deleting the durable copy of an evicted object."""
+        need = self.pinned.get(oid, 0)
+        for i in range(need):
+            if self.store.get(oid, timeout_ms=0) is None:
+                for _ in range(i):
+                    self.store.release(oid)
+                return False
+        return True
+
     def _put_restored(self, oid: bytes, data: bytes) -> bool:
-        """Insert restored bytes into shm + re-acquire this agent's pins."""
+        """Insert restored bytes into shm + re-acquire this agent's pins.
+        The writer pin is held across the re-pin so there is no
+        zero-refcount window in which the fresh copy could be evicted."""
+        held = False
         try:
-            self.store.put(oid, [data])
+            self.store.put(oid, [data], keep_pin=True)
+            held = True
         except ObjectExistsError:
             pass
         except Exception:
             return False
-        for _ in range(self.pinned.get(oid, 0)):
-            self.store.get(oid, timeout_ms=0)
-        return True
+        ok = self._reacquire_pins(oid)
+        if held:
+            self.store.release(oid)
+        return ok
 
     async def _restore_from_external(self, oid: bytes) -> bool:
         """Pull a durable copy registered by ANY node (possibly dead) out
@@ -1447,17 +1469,50 @@ class NodeAgent:
             return await self._restore_from_external(oid)
         path, size = spill
         loop = asyncio.get_running_loop()
-        try:
-            data = await loop.run_in_executor(None, _read_file, path)
-        except FileNotFoundError:
-            self.spilled.pop(oid, None)
-            return await self._restore_from_external(oid)
+        # Zero-copy restore: the spill file is read DIRECTLY into the
+        # object's freshly-allocated arena view (readinto — one pass from
+        # the page cache, no intermediate Python bytes and no second
+        # memcpy), off-loop so a multi-GB restore doesn't stall the agent.
+        # keep_pin=True holds the writer pin across the executor->loop hop
+        # so the fresh copy can't be evicted before the re-pin below.
+        held = False
         for _ in range(3):
-            if self._put_restored(oid, data):
+            try:
+                await loop.run_in_executor(
+                    None, lambda: self.store.read_file_into(
+                        oid, path, size, keep_pin=True))
+                held = True
                 break
-            if await self._free_space(size) == 0:
-                return False
+            except ObjectExistsError:
+                break
+            except FileNotFoundError:
+                self.spilled.pop(oid, None)
+                return await self._restore_from_external(oid)
+            except StoreFullError:
+                if await self._free_space(size) == 0:
+                    return False
+            except SpillTruncatedError:
+                # The on-disk copy itself is damaged: freeing arena space
+                # can't help — the durable external copy is the only way
+                # back, and the broken file must be forgotten.
+                logger.exception("spill file corrupt for %s", oid.hex())
+                self.spilled.pop(oid, None)
+                return await self._restore_from_external(oid)
+            except OSError:
+                # Transient I/O (EMFILE under fd churn, EIO blips): the
+                # spill file is still the durable copy — KEEP the entry
+                # and retry; dropping it would orphan valid bytes and
+                # misreport the object as gone to remote pullers.
+                logger.warning("transient I/O restoring %s; retrying",
+                               oid.hex(), exc_info=True)
         else:
+            return False
+        ok = self._reacquire_pins(oid)
+        if held:
+            self.store.release(oid)
+        if not ok:
+            # Evicted out from under us (pre-existing copy raced an
+            # eviction): keep the spill file — it is the durable copy.
             return False
         self.spilled.pop(oid, None)
         self._disk_cached.pop(oid, None)
@@ -1505,18 +1560,53 @@ class NodeAgent:
             self.store.release(oid)
 
     async def h_fetch_chunk(self, conn, p):
-        """Serve one chunk of an object's bytes, from shm or the spill file."""
+        """Serve one chunk of an object's bytes, from shm or the spill file.
+
+        With p["raw"] the chunk leaves as a raw out-of-band frame: a shm
+        chunk is handed to the transport as a pinned arena subview (zero
+        user-space copies on this side; the pin drops once the transport
+        has taken the bytes), and absence becomes the TYPED {"gone": True}
+        marker so pullers can tell "source no longer holds it" from a
+        dropped/failed fetch.  Legacy (non-raw) callers keep the old
+        bytes-or-None contract."""
         oid, off, length = p["object_id"], p["offset"], p["length"]
+        raw = p.get("raw", False)
         if oid in self.spilled:
             path, _ = self.spilled[oid]
-            fd = os.open(path, os.O_RDONLY)
-            try:
-                return os.pread(fd, length, off)
-            finally:
-                os.close(fd)
+
+            def _read_spill_chunk():
+                try:
+                    fd = os.open(path, os.O_RDONLY)
+                except FileNotFoundError:
+                    # A concurrent restore sealed the object back into
+                    # shm and unlinked the file between our spilled-map
+                    # read and the open: the caller falls through to the
+                    # store lookup — answering "gone" would misroute the
+                    # puller into lineage re-execution for an object
+                    # this node still holds.
+                    return None
+                try:
+                    return os.pread(fd, length, off)
+                finally:
+                    os.close(fd)
+
+            # Off-loop: a cold-cache 8 MiB pread times the whole inflight
+            # window would otherwise stall every RPC this agent serves.
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, _read_spill_chunk)
+            if data is not None:
+                return rpc.RawPayload([data]) if raw else data
         view = self.store.get(oid, timeout_ms=0)
         if view is None:
-            return None
+            return {"gone": True} if raw else None
+        if raw:
+            piece = view[off:off + length]
+
+            def _unpin(v=view, oid=oid):
+                v.release()
+                self.store.release(oid)
+
+            return rpc.RawPayload([piece], release=_unpin)
         try:
             return bytes(view[off:off + length])
         finally:
@@ -1545,58 +1635,175 @@ class NodeAgent:
         self._pull_active -= 1
 
     async def h_pull_object(self, conn, p):
-        """Fetch a remote object into the local store — chunked, deduped
-        against concurrent pulls of the same id, admission-controlled by
-        priority (reference: pull_manager.cc, 806 LoC of priority logic;
-        here: owner-directed single-source chunked pull)."""
+        """Fetch a remote object into the local store — chunked, pipelined,
+        deduped against concurrent pulls of the same id, admission-
+        controlled by priority (reference: pull_manager.cc, 806 LoC of
+        priority logic).  `from_addrs` lists candidate source nodes in
+        preference order (legacy single `from_addr` accepted); a chunk
+        that fails mid-stream on one source fails over to the next
+        instead of aborting the pull.  Returns True on success, False
+        when every source reports the object gone; a TRANSIENT
+        mid-stream failure raises ObjectTransferError (typed — never a
+        truncated buffer, never a false \"lost\")."""
         oid = p["object_id"]
         if self.store.contains(oid) or oid in self.spilled:
             return True
+        addrs = [tuple(a) for a in (p.get("from_addrs") or [])]
+        if not addrs and p.get("from_addr"):
+            addrs = [tuple(p["from_addr"])]
+        addrs = [a for a in addrs if a != tuple(self.address)]
+        if not addrs:
+            return False
         inflight = self._pull_inflight.get(oid)
         if inflight is not None:
             return await asyncio.shield(inflight)
         fut = asyncio.get_running_loop().create_future()
         self._pull_inflight[oid] = fut
         try:
-            ok = await self._do_pull(oid, tuple(p["from_addr"]),
+            ok = await self._do_pull(oid, addrs,
                                      p.get("priority", 0),
                                      p.get("timeout_ms", 10000))
             fut.set_result(ok)
             return ok
         except Exception as e:
             fut.set_exception(e)
+            # Mark retrieved: with no concurrent deduped waiter the future
+            # is dropped, and an unconsumed exception (now routine —
+            # transient failures raise ObjectTransferError by design)
+            # would spam 'Future exception was never retrieved' at GC.
+            fut.exception()
             raise
         finally:
             self._pull_inflight.pop(oid, None)
 
-    async def _stream_chunks(self, peer, oid: bytes, size: int,
-                             write) -> bool:
-        """Shared chunk loop for arena- and disk-destined pulls;
-        write(offset, chunk) lands each piece."""
-        pos = 0
-        while pos < size:
-            n = min(self._chunk_bytes, size - pos)
-            chunk = await peer.call(
-                "fetch_chunk",
-                {"object_id": oid, "offset": pos, "length": n},
-                timeout=60)
-            if chunk is None:
-                return False
-            write(pos, chunk)
-            pos += len(chunk)
-        return True
+    class _ObjectGone(Exception):
+        """Internal: every source reported the object absent."""
 
-    async def _do_pull(self, oid: bytes, from_addr: tuple, priority: int,
+    async def _stream_chunks(self, peers, oid: bytes, size: int,
+                             make_sink, commit=None) -> None:
+        """Shared pipelined chunk engine for arena- and disk-destined
+        pulls (and any future push path).  Keeps up to
+        `object_transfer_max_inflight_chunks` fetch_chunk requests in
+        flight so the source's shm/spill reads overlap the wire; each
+        chunk lands via a raw out-of-band frame scattered straight into
+        make_sink(pos, n) — no msgpack pass, no intermediate bytes.
+        `commit(pos, data)` (optional coroutine) runs after a chunk fully
+        lands — disk-destined pulls stage each chunk in memory and flush
+        it off-loop there, so no blocking write ever runs on the agent
+        loop; without commit the sink itself is the final destination
+        (arena view).
+
+        Failure discipline: a failed chunk retries on each source in turn
+        (two passes).  Raises _ObjectGone when every source consistently
+        answers \"gone\", ObjectTransferError when transient failures
+        (drops, timeouts, short reads) exhaust the retry budget — callers
+        abort the destination, so a partial pull can never be mistaken
+        for complete data."""
+        if size == 0:
+            return
+
+        async def fetch(pos: int) -> None:
+            n = min(self._chunk_bytes, size - pos)
+            last_err = None
+            gone = dead = transient = 0
+            for _round in range(2):
+                gone = dead = transient = 0
+                for peer in peers:
+                    if peer is None or peer.closed:
+                        # Source unreachable == its copy is lost for
+                        # our purposes (matches the pre-raw behavior:
+                        # dead nodes must route to ObjectLost ->
+                        # lineage recovery, not to a retryable
+                        # transient error that never reconstructs).
+                        dead += 1
+                        continue
+                    sink_obj = make_sink(pos, n)
+                    try:
+                        res = await peer.call_raw(
+                            "fetch_chunk",
+                            {"object_id": oid, "offset": pos,
+                             "length": n, "raw": True},
+                            sink=sink_obj,
+                            timeout=self._chunk_timeout)
+                    except rpc.ConnectionLost as e:
+                        dead += 1
+                        last_err = e
+                        continue
+                    except (rpc.RpcError, asyncio.TimeoutError) as e:
+                        transient += 1
+                        last_err = e
+                        continue
+                    if isinstance(res, int) and res == n:
+                        if commit is not None:
+                            await commit(pos, sink_obj)
+                        return
+                    if isinstance(res, (bytes, bytearray)):
+                        # Legacy peer: msgpack bytes body.
+                        if len(res) == n:
+                            if commit is not None:
+                                await commit(pos, res)
+                            else:
+                                sink_obj[0:n] = res
+                            return
+                        transient += 1
+                        last_err = ValueError(
+                            f"short chunk {len(res)}/{n}")
+                        continue
+                    if res is None or (isinstance(res, dict)
+                                       and res.get("gone")):
+                        gone += 1
+                        continue
+                    transient += 1
+                    last_err = ValueError(
+                        f"unexpected fetch_chunk reply {type(res)}")
+                if (gone or dead) and not transient:
+                    # Unanimous and unambiguous: no second pass.
+                    break
+            if transient == 0:
+                # Every source is gone or dead — the object is not
+                # obtainable by retrying this pull.
+                raise NodeAgent._ObjectGone(oid)
+            raise exc.ObjectTransferError(
+                f"chunk {pos}..{pos + n} of {oid.hex()} failed on all "
+                f"{len(peers)} source(s) after retries: {last_err!r}")
+
+        await rpc.gather_windowed(
+            fetch, range(0, size, self._chunk_bytes),
+            self._max_inflight_chunks)
+
+    async def _pull_peers(self, addrs) -> list:
+        """Resolve source addresses to live (cached) connections."""
+        peers = []
+        for addr in addrs:
+            peer = self._peer_conns.get(addr)
+            if peer is None or peer.closed:
+                try:
+                    peer = await rpc.connect(addr, name="agent->agent",
+                                             retries=2)
+                except rpc.ConnectionLost:
+                    continue
+                self._peer_conns[addr] = peer
+            peers.append(peer)
+        return peers
+
+    async def _do_pull(self, oid: bytes, addrs: list, priority: int,
                        timeout_ms: int) -> bool:
-        peer = self._peer_conns.get(from_addr)
-        if peer is None or peer.closed:
-            peer = await rpc.connect(from_addr, name="agent->agent")
-            self._peer_conns[from_addr] = peer
+        peers = await self._pull_peers(addrs)
+        if not peers:
+            return False
         await self._pull_slot(priority)
         try:
-            info = await peer.call("object_info",
-                                   {"object_id": oid, "timeout_ms": timeout_ms},
-                                   timeout=60)
+            info = None
+            for peer in peers:
+                try:
+                    info = await peer.call(
+                        "object_info",
+                        {"object_id": oid, "timeout_ms": timeout_ms},
+                        timeout=60)
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    continue
+                if info is not None:
+                    break
             if info is None:
                 return False
             size = info["size"]
@@ -1612,42 +1819,78 @@ class NodeAgent:
                         break
             if buf is None:
                 # No room even after spilling: land the pull on disk.
-                return await self._pull_to_disk(peer, oid, size)
+                return await self._pull_to_disk(peers, oid, size)
             ok = False
             try:
-                def _into_buf(pos, chunk):
-                    buf[pos:pos + len(chunk)] = chunk
-                ok = await self._stream_chunks(peer, oid, size, _into_buf)
+                await self._stream_chunks(
+                    peers, oid, size,
+                    make_sink=lambda pos, n: buf[pos:pos + n])
+                ok = True
+            except NodeAgent._ObjectGone:
+                return False
             finally:
                 buf.release()
                 if not ok:
-                    # Covers both chunk==None and a raised timeout/RPC
-                    # error: never leave a permanently-unsealed object
-                    # wedging this id.
+                    # Covers gone, transfer errors and cancellation: never
+                    # leave a permanently-unsealed object wedging this id
+                    # — and never seal a partially-filled buffer.
                     self.store.abort(oid)
-            if not ok:
-                return False
             self.store.seal(oid)
             self.store.release(oid)
             return True
         finally:
             self._pull_done()
 
-    async def _pull_to_disk(self, peer, oid: bytes, size: int) -> bool:
+    @staticmethod
+    def _pwrite_chunk(path: str, data, pos: int) -> None:
+        """Positional chunk write with its own fd: runs on an executor
+        thread, and a stray write from a cancelled pull can never hit a
+        recycled fd number (no shared-fd lifetime).  Loops on short
+        writes — a silently partial chunk would later pread back as a
+        zero-filled hole in a 'complete' spilled object."""
+        view = memoryview(data)
+        fd = os.open(path, os.O_WRONLY)
+        try:
+            off = 0
+            while off < view.nbytes:
+                n = os.pwrite(fd, view[off:], pos + off)
+                if n <= 0:
+                    raise IOError(
+                        f"pwrite stalled at {off}/{view.nbytes} "
+                        f"bytes of chunk @{pos} in {path}")
+                off += n
+        finally:
+            os.close(fd)
+
+    async def _pull_to_disk(self, peers, oid: bytes, size: int) -> bool:
         path = self._spill_path(oid)
+        # Create/truncate up front; chunk commits reopen positionally.
+        os.close(os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644))
+        loop = asyncio.get_running_loop()
+
+        async def commit(pos, data):
+            # Chunks stage in memory (window x chunk bytes, bounded) and
+            # flush off-loop — a dirty-page writeback stall must not
+            # freeze the agent's event loop.
+            await loop.run_in_executor(
+                None, self._pwrite_chunk, path, data, pos)
+
         ok = False
-        with open(path, "wb") as f:
-            def _into_file(pos, chunk):
-                f.seek(pos)
-                f.write(chunk)
+        try:
             try:
-                ok = await self._stream_chunks(peer, oid, size, _into_file)
-            finally:
-                if not ok:
-                    try:
-                        os.unlink(path)
-                    except FileNotFoundError:
-                        pass
+                await self._stream_chunks(
+                    peers, oid, size,
+                    make_sink=lambda pos, n: memoryview(bytearray(n)),
+                    commit=commit)
+                ok = True
+            except NodeAgent._ObjectGone:
+                return False
+        finally:
+            if not ok:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
         if not ok:
             return False
         self.spilled[oid] = (path, size)

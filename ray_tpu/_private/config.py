@@ -91,6 +91,13 @@ _define("object_transfer_chunk_bytes", 8 * 1024 * 1024,
 _define("max_concurrent_pulls", 16,
         "per-node cap on simultaneous inbound object pulls "
         "(reference: pull_manager.cc bundle admission)")
+_define("object_transfer_max_inflight_chunks", 8,
+        "chunk requests kept in flight per object pull — pipelines the "
+        "source's shm read / spill-file read under the wire transfer "
+        "(reference: object_manager.cc overlapping chunked push)")
+_define("object_transfer_chunk_timeout_s", 30.0,
+        "per-chunk fetch deadline during a pull; an expired chunk retries "
+        "on the same source then fails over to alternates")
 _define("task_arg_fetch_timeout_s", 600.0,
         "bound on an executing task's by-reference arg fetch; a freed or "
         "unrecoverable arg fails the task instead of wedging the worker")

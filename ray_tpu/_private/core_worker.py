@@ -742,11 +742,15 @@ class CoreWorker:
         """Serialize ONCE on the calling thread (also keeps multi-GB
         pickling off the event loop); small ref-free values then complete
         entirely here — a freshly minted id can have no waiters, plasma
-        isn't touched, and the serialization capture is thread-local —
-        while large/ref-bearing values hand the pre-serialized parts to
-        the loop for plasma + containment bookkeeping (reference: the
-        Cython put path releases the GIL and never waits on the raylet
-        for inline objects)."""
+        isn't touched, and the serialization capture is thread-local.
+
+        Large values ALSO complete on the calling thread: the pickle-5
+        parts are written straight into the shm arena in one native iov
+        memcpy (GIL released), so the event loop never carries the copy
+        and a put costs exactly one memory pass (reference: plasma's
+        create/write-in-place/seal discipline — the Cython put path
+        likewise copies on the caller).  Only the arena-full fallback
+        (spill backpressure) routes through the loop."""
         ctx = get_context()
         ctx.capture = captured = []
         try:
@@ -761,7 +765,47 @@ class CoreWorker:
             self.reference_counter.add_owned(oid)
             self.memory_store.put_inline(oid, protocol.concat_parts(parts))
             return ObjectRef(oid, self.address, worker=self)
+        if size > self._inline_limit and not self._on_loop_thread():
+            # Zero-copy sync plasma path (containment bookkeeping is
+            # thread-safe; _record_contained pins before the store).
+            # Loop-thread callers fall through to _run, which raises the
+            # same "use await / put_async" guard as before — with no
+            # ownership state recorded and no multi-GB memcpy blocking
+            # the event loop.
+            oid = self._next_put_id()
+            self.reference_counter.add_owned(oid)
+            self._record_contained(oid, captured)
+            if self._put_store_sync(oid, parts):
+                self.memory_store.put_plasma_location(
+                    oid, list(self.agent_address))
+                return ObjectRef(oid, self.address, worker=self)
+            # Arena full: loop-side backpressure/spill.  _run blocks this
+            # thread until stored, so the caller may mutate its buffers
+            # (which `parts` still views) only after the copy completes.
+            return self._run(self._put_plasma_prepinned(oid, parts))
         return self._run(self._put_serialized_async(parts, captured, size))
+
+    def _put_store_sync(self, oid: bytes, parts) -> bool:
+        """One native create+iov-copy+seal into shm on the CALLING thread,
+        keeping the writer pin; the pin-transfer notify is posted to the
+        loop (mailbox order guarantees it precedes any later free of the
+        same id).  False when the arena is full — caller takes the
+        backpressure path."""
+        try:
+            self.store.put(oid, parts, keep_pin=True)
+        except StoreFullError:
+            return False
+        if self._on_loop_thread():
+            self._send_pin_transfer(oid)
+        else:
+            self._post_to_loop(lambda: self._send_pin_transfer(oid))
+        return True
+
+    async def _put_plasma_prepinned(self, oid: bytes, parts) -> ObjectRef:
+        """Finish a sync put whose fast path hit a full arena (ownership
+        already recorded)."""
+        await self._put_plasma(oid, parts)
+        return ObjectRef(oid, self.address, worker=self)
 
     async def _put_serialized_async(self, parts, captured, size
                                     ) -> ObjectRef:
@@ -822,6 +866,10 @@ class CoreWorker:
                 else:
                     self._notify_owner(nowner, "escape_pin", noid)
 
+    # Loop-offload threshold for the arena memcpy inside
+    # store_with_backpressure (below it the executor hop costs more).
+    _OFFLOAD_COPY_MIN = 4 * 1024 * 1024
+
     async def _put_plasma(self, oid: bytes, parts):
         await self.store_with_backpressure(oid, parts)
         self.memory_store.put_plasma_location(oid, list(self.agent_address))
@@ -841,21 +889,35 @@ class CoreWorker:
         cfg = get_config()
         deadline = time.monotonic() + cfg.create_backpressure_timeout_s
         stored = False
-        while True:
+
+        def _try_store() -> bool:
             try:
                 self.store.put(oid, parts, keep_pin=True)
+                return True
+            except StoreFullError:
+                return False
+
+        loop = asyncio.get_running_loop()
+        while True:
+            # Multi-MB copies run on an executor thread so this (worker /
+            # driver) loop keeps serving RPC during the memcpy; small ones
+            # stay inline — the thread hop costs more than the copy.
+            if size >= self._OFFLOAD_COPY_MIN and self.executor is not None:
+                ok = await loop.run_in_executor(self.executor, _try_store)
+            else:
+                ok = _try_store()
+            if ok:
                 stored = True
                 self._send_pin_transfer(oid)
                 break
-            except StoreFullError:
-                res = await self.agent.call("ensure_space", {"nbytes": size})
-                if res["freed"] == 0:
-                    if size >= self.store.stats()["capacity"] // 2 or \
-                            time.monotonic() >= deadline:
-                        break  # fall through to the disk path
-                    await asyncio.sleep(0.05)
-                if time.monotonic() >= deadline:
-                    break
+            res = await self.agent.call("ensure_space", {"nbytes": size})
+            if res["freed"] == 0:
+                if size >= self.store.stats()["capacity"] // 2 or \
+                        time.monotonic() >= deadline:
+                    break  # fall through to the disk path
+                await asyncio.sleep(0.05)
+            if time.monotonic() >= deadline:
+                break
         if not stored:
             # Worker and agent share the host: write the spill file here
             # (off-loop) and just register it — no copy crosses the RPC.
@@ -1267,12 +1329,37 @@ class CoreWorker:
             if view is None:
                 raise exc.ObjectLostError(f"{oid.hex()} not in local store")
             return view
-        try:
-            ok = await self.agent.call("pull_object", {
-                "object_id": oid, "from_addr": list(agent_addr),
-                "priority": 0}, timeout=120)
-        except (rpc.RpcError, asyncio.TimeoutError):
-            ok = False  # source agent unreachable == primary copy lost
+        ok = False
+        for pull_attempt in range(2):
+            try:
+                ok = await self.agent.call("pull_object", {
+                    "object_id": oid, "from_addr": list(agent_addr),
+                    "priority": 0}, timeout=120)
+                break
+            except rpc.RemoteError as e:
+                # The agent distinguishes "object gone at every source"
+                # (ok=False -> ObjectLostError, recovery may engage) from
+                # a TRANSIENT mid-stream failure (ObjectTransferError —
+                # drops/timeouts on a live source).  Match the FIRST line
+                # only: rpc dispatch formats remote errors as
+                # "TypeName: message\n<traceback>", so a traceback that
+                # merely mentions the type can't misclassify.  A
+                # transient failure gets ONE in-place retry; failing
+                # twice escalates to the lost path below — recovery
+                # probes the primary first (_recover_object), so a
+                # source that is alive but flaky is never destructively
+                # re-executed, while a source that can never serve the
+                # bytes (e.g. truncated spill file) does reach
+                # reconstruction instead of erroring forever.
+                first = str(e).split("\n", 1)[0]
+                if first.startswith("ObjectTransferError") \
+                        and pull_attempt == 0:
+                    continue
+                ok = False
+                break
+            except (rpc.RpcError, asyncio.TimeoutError):
+                ok = False  # source unreachable == primary copy lost
+                break
         if not ok:
             raise exc.ObjectLostError(f"failed to pull {oid.hex()}")
         if not self.store.contains(oid):
@@ -1291,25 +1378,46 @@ class CoreWorker:
     async def _read_spilled(self, agent_conn, oid: bytes):
         """Chunked read of a spilled object that cannot re-enter the arena
         (reference: spilled_object_reader.h — readers stream straight from
-        the spill file)."""
+        the spill file).  Chunks arrive as raw out-of-band frames scattered
+        directly into the destination buffer (no msgpack pass, no
+        intermediate bytes), with a window of requests in flight to
+        pipeline the agent's file reads under the wire."""
         info = await agent_conn.call("object_info",
                                      {"object_id": oid, "timeout_ms": 0})
         if info is None or not info.get("spilled"):
             return None
         size = info["size"]
-        chunk = get_config().object_transfer_chunk_bytes
+        cfg = get_config()
+        chunk = cfg.object_transfer_chunk_bytes
         out = bytearray(size)
-        pos = 0
-        while pos < size:
+        dest = memoryview(out)
+
+        class _ChunkFailed(Exception):
+            """Raised (not returned) so gather_windowed cancels the rest
+            of the window — a failed first chunk of a multi-GB object
+            must not let the remaining gigabytes transfer anyway."""
+
+        async def fetch(pos: int) -> None:
             n = min(chunk, size - pos)
-            data = await agent_conn.call(
+            res = await agent_conn.call_raw(
                 "fetch_chunk",
-                {"object_id": oid, "offset": pos, "length": n}, timeout=60)
-            if data is None:
-                return None
-            out[pos:pos + len(data)] = data
-            pos += len(data)
-        return memoryview(out)
+                {"object_id": oid, "offset": pos, "length": n,
+                 "raw": True},
+                sink=dest[pos:pos + n], timeout=60)
+            if isinstance(res, int) and res == n:
+                return
+            if isinstance(res, (bytes, bytearray)) and len(res) == n:
+                dest[pos:pos + n] = res        # legacy peer
+                return
+            raise _ChunkFailed(pos)
+
+        try:
+            await rpc.gather_windowed(
+                fetch, range(0, size, chunk),
+                cfg.object_transfer_max_inflight_chunks)
+        except _ChunkFailed:
+            return None           # absent / gone marker / short read
+        return dest
 
     # Owner-side service: borrowers resolve objects through us.
     async def h_get_object(self, conn, p):
@@ -1320,7 +1428,11 @@ class CoreWorker:
         if entry is None:
             return None
         if entry.data is not None:
-            return {"inline": entry.data}
+            data = entry.data
+            # Entries may hold bytes-like views (raw-frame landings);
+            # normalize at the msgpack boundary only.
+            return {"inline": data if isinstance(data, bytes)
+                    else bytes(data)}
         return {"plasma": list(entry.plasma_node)}
 
     async def h_free_notify(self, conn, p):
@@ -2528,8 +2640,23 @@ class CoreWorker:
                     self.reference_counter.add_owned(poid)
                     self.reference_counter.add_submitted(poid)
                     ref_args.append(poid)
-                    big_puts.append((poid, [bytes(p) for p in parts]))
-                    entry = {"ref": [poid, list(self.address), None]}
+                    if not self._on_loop_thread() and \
+                            self._put_store_sync(poid, parts):
+                        # Zero-copy: one sync memcpy into shm right here —
+                        # post-call arg mutation is safe (the copy already
+                        # happened) and no bytes() flatten survives.
+                        self.memory_store.put_plasma_location(
+                            poid, list(self.agent_address))
+                        entry = {"ref": [poid, list(self.address),
+                                         list(self.agent_address)]}
+                    else:
+                        # Arena full (or submitting from the loop thread,
+                        # which must not carry the memcpy): the store
+                        # happens later on the loop — so the parts must
+                        # be detached from the caller's mutable buffers.
+                        big_puts.append(
+                            (poid, [bytes(p) for p in parts]))
+                        entry = {"ref": [poid, list(self.address), None]}
             if kw:
                 entry["kw"] = kw
             entries.append(entry)

@@ -19,9 +19,13 @@ from typing import Dict, List, Optional, Tuple
 class _Entry:
     __slots__ = ("data", "is_exception", "plasma_node")
 
-    def __init__(self, data: Optional[bytes], is_exception: bool = False,
+    def __init__(self, data, is_exception: bool = False,
                  plasma_node=None):
-        self.data = data              # serialized payload, None if in plasma
+        # Serialized payload (None if in plasma).  Any bytes-like object:
+        # raw-frame landings and zero-copy readers keep memoryviews here
+        # end-to-end; producers that must cross a msgpack boundary
+        # normalize there, not on insert (see core_worker.h_get_object).
+        self.data = data
         self.is_exception = is_exception
         self.plasma_node = plasma_node  # node address holding primary copy
 
@@ -44,7 +48,9 @@ class MemoryStore:
         self._sync_waiters: Dict[bytes, list] = {}
         self._sync_lock = threading.Lock()
 
-    def put_inline(self, object_id: bytes, data: bytes, is_exception=False):
+    def put_inline(self, object_id: bytes, data, is_exception=False):
+        """`data` is any bytes-like (bytes / bytearray / memoryview) —
+        stored as given, zero-copy."""
         self._objects[object_id] = _Entry(data, is_exception)
         self._wake(object_id)
 

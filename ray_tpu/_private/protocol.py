@@ -17,8 +17,10 @@ Address = Any
 
 
 def concat_parts(parts) -> bytes:
-    """Join serialized parts (see serialization.py) into one bytes payload."""
-    return b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
+    """Join serialized parts (see serialization.py) into one bytes payload.
+    bytes.join consumes buffer-protocol parts (memoryviews) directly, so
+    this is a single-allocation single-pass copy — no per-part bytes()."""
+    return b"".join(parts)
 
 
 def function_id(pickled: bytes) -> bytes:

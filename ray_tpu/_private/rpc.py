@@ -18,6 +18,20 @@ previous length-prefixed StreamReader loop between single-core processes.
 Request:  [msg_id, method: str, payload]     (msg_id == 0 -> one-way notify)
 Response: [msg_id, status: 0|1, result_or_error]
 
+Raw out-of-band payloads (reference: object_manager's chunked push carries
+object bytes outside the protobuf control messages): bulk bytes skip msgpack
+entirely in both directions.  A small header frame
+
+    [0, "__raw__", [rid, nbytes]]
+
+announces that the next `nbytes` on the stream are raw payload for request
+`rid`.  The sender hands the payload's memoryviews straight to the transport
+(no pack, no join); the receiver scatters the bytes directly into a
+caller-provided destination buffer (e.g. a shm-arena create_buffer view or a
+spill file) registered via `call_raw`, or collects them for a plain `call`
+/ server-side `take_raw`.  This removes every user-space copy except the one
+memcpy into the destination.
+
 Authentication (reference: src/ray/rpc/authentication/
 authentication_token_validator.cc): when a server is constructed with
 auth_token=..., the first frame on every inbound connection must be the
@@ -32,6 +46,7 @@ import asyncio
 import hmac
 import logging
 import random
+import sys
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
@@ -75,6 +90,35 @@ def spawn(coro) -> asyncio.Task:
     return t
 
 
+async def gather_windowed(fetch_one, positions, window: int) -> list:
+    """Run fetch_one(pos) for every position with at most `window` in
+    flight, using a FIXED pool of `window` worker tasks draining a shared
+    iterator (a 100 GiB pull is ~12k chunks — task-per-chunk would park
+    thousands of Task objects to run 8 at a time).  On the first hard
+    failure the stragglers are cancelled and awaited (so no orphan task
+    keeps streaming into an abandoned buffer) before re-raising.  The
+    shared skeleton of every pipelined chunk fetch (agent pulls,
+    spilled-object reads).  Results are returned in position order."""
+    order = list(positions)
+    it = iter(order)
+    results: dict = {}
+
+    async def worker():
+        for pos in it:          # shared iterator; next() has no await
+            results[pos] = await fetch_one(pos)
+
+    tasks = [asyncio.ensure_future(worker())
+             for _ in range(max(1, min(window, len(order) or 1)))]
+    try:
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    return [results[p] for p in order]
+
+
 def enable_eager_tasks(loop: asyncio.AbstractEventLoop | None = None) -> None:
     """Run new tasks synchronously until their first suspension
     (asyncio.eager_task_factory, 3.12+).  Every runtime loop (driver,
@@ -82,9 +126,13 @@ def enable_eager_tasks(loop: asyncio.AbstractEventLoop | None = None) -> None:
     request, and eager execution roughly halves per-call overhead — most
     handlers finish without ever suspending, so they never touch the ready
     queue (measured: 6.4k -> 12.2k pipelined calls/s between two
-    single-core processes)."""
+    single-core processes).  No-op before 3.12 (no eager factory) — the
+    runtime is correct either way, just slower per call."""
+    factory = getattr(asyncio, "eager_task_factory", None)
+    if factory is None:
+        return
     loop = loop or asyncio.get_event_loop()
-    loop.set_task_factory(asyncio.eager_task_factory)
+    loop.set_task_factory(factory)
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +187,42 @@ def _unpack(data: bytes):
 # Sentinel a fast handler returns to route the request through the normal
 # coroutine handler instead (slow/conditional branch).
 FAST_FALLBACK = object()
+
+
+def _nbytes(b) -> int:
+    # Same contract as serialization.part_nbytes — kept separate so this
+    # transport module stays importable without cloudpickle (GCS/agent
+    # daemons) and serialization stays importable without msgpack.
+    return b.nbytes if isinstance(b, memoryview) else len(b)
+
+
+class RawPayload:
+    """Handler return value: reply with a raw out-of-band payload frame.
+
+    `buffers` is a list of bytes-like chunks sent back-to-back after the
+    header frame — handed to the transport as-is (zero user-space copies).
+    `release` (optional) runs once the bytes can no longer be read by the
+    transport — the safe point to drop shm pins.  On Python <=3.11 the
+    selector transport copies any kernel-backpressured tail into its own
+    bytearray, so that point is right after write(); on 3.12+ the
+    transport buffers unsent data BY REFERENCE (zero-copy writes,
+    gh-91166), so release is deferred until the write buffer has fully
+    flushed (see Connection.send_raw)."""
+
+    __slots__ = ("buffers", "nbytes", "release")
+
+    def __init__(self, buffers, release: Callable | None = None):
+        self.buffers = list(buffers)
+        self.nbytes = sum(_nbytes(b) for b in self.buffers)
+        self.release = release
+
+    def close(self):
+        rel, self.release = self.release, None
+        if rel is not None:
+            try:
+                rel()
+            except Exception:
+                logger.exception("RawPayload release failed")
 
 # Process-wide default auth token (reference: authentication_token_loader.cc
 # reads RAY_AUTH_TOKEN/token file once per process). Servers require it and
@@ -224,6 +308,22 @@ class Connection:
         # streaming unpacker; data_received feeds it raw socket bytes.
         self._unpacker = msgpack.Unpacker(
             raw=False, strict_map_key=False, max_buffer_size=MAX_FRAME)
+        # Bytes fed to the CURRENT unpacker (reset with it): `fed - tell()`
+        # is how many buffered-but-unparsed bytes follow the last decoded
+        # frame — the raw-payload prefix when that frame is a raw header.
+        self._fed = 0
+        # Raw out-of-band payload state (see module docstring):
+        #   _raw_sinks: rid -> destination registered by call_raw
+        #   _raw_cur:   [rid, remaining, sink, written] while receiving
+        #   _raw_takers: rid -> [collector, future] (server-side take_raw)
+        #   _raw_orphans: rid -> [collector, done] payloads that arrived
+        #       before (or without) a taker — bounded, oldest evicted
+        self._raw_sinks: Dict[int, Any] = {}
+        self._raw_cur: list | None = None
+        self._raw_takers: Dict[int, list] = {}
+        self._raw_orphans: Dict[int, list] = {}
+        from collections import deque as _deque
+        self._raw_evicted = _deque(maxlen=64)
         # Frame coalescing: frames queued in one loop tick go out as ONE
         # transport.write (one syscall) — under task fan-out the loop was
         # spending ~3/4 of its samples in per-frame socket sends.
@@ -271,11 +371,10 @@ class Connection:
 
     def _data_received(self, data):
         try:
-            self._unpacker.feed(data)
-            for msg in self._unpacker:
-                self._on_msg(msg)
+            self._ingest(memoryview(data))
         except Exception:
-            # Malformed stream (bad msgpack, oversized buffer): drop peer.
+            # Malformed stream (bad msgpack, oversized buffer, raw-frame
+            # desync): drop peer.
             logger.warning("malformed stream on %s; closing", self.name,
                            exc_info=True)
             self.abort()
@@ -291,6 +390,244 @@ class Connection:
                 logger.warning("pre-auth stream exceeded %d bytes on %s; "
                                "dropping", PREAUTH_MAX_BYTES, self.name)
                 self.abort()
+
+    def _ingest(self, mv: memoryview) -> None:
+        """Demultiplex the stream: msgpack frames through the C unpacker,
+        raw payloads (announced by a `__raw__` header frame) scattered
+        straight into their destination without touching the unpacker."""
+        while True:
+            raw = self._raw_cur
+            if raw is not None:
+                take = min(raw[1], mv.nbytes)
+                if take:
+                    self._raw_deliver(mv[:take])
+                    mv = mv[take:]
+                if self._raw_cur is not None and self._raw_cur[1] > 0:
+                    return                      # payload continues next chunk
+                self._finish_raw()
+                if not mv.nbytes:
+                    return
+                continue
+            if not mv.nbytes:
+                return
+            self._unpacker.feed(mv)
+            self._fed += mv.nbytes
+            hit_raw = False
+            for msg in self._unpacker:
+                if (isinstance(msg, (list, tuple)) and len(msg) == 3
+                        and msg[0] == 0 and msg[1] == "__raw__"):
+                    if not self._authed:
+                        raise RpcError("raw frame before auth handshake")
+                    rid, nbytes = msg[2]
+                    if not isinstance(nbytes, int) or nbytes < 0 \
+                            or nbytes > MAX_FRAME:
+                        raise RpcError(f"bad raw frame length {nbytes!r}")
+                    # Bytes the unpacker buffered past the header are the
+                    # payload prefix.  They can only have arrived in the
+                    # chunk that completed the header (every earlier chunk
+                    # was drained by its own iteration pass), so they are
+                    # a suffix of `mv` — reclaim them and discard the
+                    # unpacker's copy by starting a fresh unpacker.
+                    leftover = self._fed - self._unpacker.tell()
+                    if leftover < 0 or leftover > mv.nbytes:
+                        raise RpcError("raw framing desync")
+                    self._unpacker = msgpack.Unpacker(
+                        raw=False, strict_map_key=False,
+                        max_buffer_size=MAX_FRAME)
+                    self._fed = 0
+                    self._begin_raw(rid, nbytes)
+                    mv = mv[mv.nbytes - leftover:]
+                    hit_raw = True
+                    break
+                self._on_msg(msg)
+            if not hit_raw:
+                return
+
+    # Orphaned raw payloads kept for a late take_raw (see _begin_raw):
+    # bounded by count AND total buffered bytes.  Evicted rids are
+    # remembered so a late take_raw errors promptly instead of hanging
+    # out its full timeout.
+    _MAX_RAW_ORPHANS = 32
+    _MAX_RAW_ORPHAN_BYTES = 256 << 20
+
+    def _begin_raw(self, rid: int, nbytes: int) -> None:
+        sink = self._raw_sinks.pop(rid, None)
+        if sink is None:
+            taker = self._raw_takers.get(rid)
+            if taker is not None:
+                sink = taker[0]                 # collector bytearray
+            elif rid > 0:
+                if rid in self._pending:
+                    sink = bytearray()          # plain call(): collect
+                else:
+                    # Response whose call was reaped (timeout): nobody
+                    # can ever claim it — discard, don't buffer.
+                    sink = None
+            else:
+                # Request-side payload arriving before its take_raw (or
+                # a stray): buffer it so the handler can still claim it.
+                orphan = [bytearray(), False]
+                self._raw_orphans[rid] = orphan
+                while (len(self._raw_orphans) > self._MAX_RAW_ORPHANS
+                       or sum(len(o[0]) for o in
+                              self._raw_orphans.values())
+                       > self._MAX_RAW_ORPHAN_BYTES):
+                    old = next(iter(self._raw_orphans))
+                    if old == rid:
+                        break       # never evict the one being received
+                    self._raw_orphans.pop(old)
+                    self._raw_evicted.append(old)
+                sink = orphan[0]
+        # [rid, remaining, sink, written, sink_error]
+        self._raw_cur = [rid, nbytes, sink, 0, None]
+
+    def _raw_deliver(self, piece: memoryview) -> None:
+        raw = self._raw_cur
+        sink = raw[2]
+        n = piece.nbytes
+        try:
+            if sink is None:
+                pass                            # discard mode
+            elif isinstance(sink, bytearray):
+                sink += piece
+            elif callable(sink):
+                sink(piece)
+            else:                               # writable buffer: scatter
+                sink[raw[3]:raw[3] + n] = piece
+        except Exception as e:
+            # A broken sink (closed fd, undersized buffer) must not kill
+            # the whole connection: drop into discard mode, remember the
+            # error so the caller's future fails instead of reporting a
+            # phantom success.
+            logger.warning("raw sink failed on %s: %r", self.name, e)
+            raw[2] = None
+            raw[4] = e
+        raw[3] += n
+        raw[1] -= n
+
+    def _finish_raw(self) -> None:
+        rid, _remaining, sink, written, error = self._raw_cur
+        self._raw_cur = None
+        # Resolve by rid, not by sink identity: a mid-reception sink
+        # error replaces the sink with None (discard mode), and the
+        # waiter must still learn the outcome rather than hang.
+        taker = self._raw_takers.pop(rid, None)
+        if taker is not None:
+            if not taker[1].done():
+                if error is not None:
+                    taker[1].set_exception(
+                        RpcError(f"raw sink failed: {error!r}"))
+                else:
+                    taker[1].set_result(bytes(taker[0]))
+            return
+        orphan = self._raw_orphans.get(rid)
+        if orphan is not None:
+            if error is not None:
+                # A late take_raw must fail fast, not adopt a truncated
+                # collector or wait out its timeout.
+                self._raw_orphans.pop(rid, None)
+                self._raw_evicted.append(rid)
+            else:
+                orphan[1] = True                # complete; awaiting taker
+            return
+        fut = self._pending.pop(rid, None)
+        if fut is not None and not fut.done():
+            if error is not None:
+                fut.set_exception(RpcError(f"raw sink failed: {error!r}"))
+            else:
+                fut.set_result(bytes(sink) if isinstance(sink, bytearray)
+                               else written)
+            return
+        if fut is None and rid < 0 and error is None \
+                and isinstance(sink, bytearray):
+            # Request-side payload whose taker gave up (take_raw timed
+            # out after reception started): keep it as a COMPLETED
+            # orphan so a retrying take_raw returns the real bytes
+            # instead of hanging on a payload that already arrived.
+            self._raw_orphans[rid] = [sink, True]
+
+    async def take_raw(self, rid: int, timeout: float | None = None) -> bytes:
+        """Server-side: await the raw payload a peer announced for request
+        `rid` (callers put their request's msg_id in the payload so the
+        handler knows it).  Returns the collected bytes."""
+        orphan = self._raw_orphans.pop(rid, None)
+        if orphan is not None:
+            if orphan[1]:
+                return bytes(orphan[0])
+            # Mid-reception: adopt the partially-filled collector.
+            fut = asyncio.get_running_loop().create_future()
+            self._raw_takers[rid] = [orphan[0], fut]
+        elif rid in self._raw_evicted:
+            raise RpcError(
+                f"raw payload {rid} was evicted from the orphan buffer "
+                f"before take_raw claimed it")
+        else:
+            fut = asyncio.get_running_loop().create_future()
+            self._raw_takers[rid] = [bytearray(), fut]
+        try:
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            taker = self._raw_takers.pop(rid, None)
+            cur = self._raw_cur
+            if taker is not None and cur is not None and cur[0] == rid \
+                    and cur[2] is taker[0]:
+                # Timed out mid-reception: re-orphan the partially-filled
+                # collector so a retrying take_raw adopts THIS payload
+                # instead of racing it with a fresh empty collector
+                # (which would resolve to truncated/empty bytes).
+                self._raw_orphans[rid] = [taker[0], False]
+
+    # 3.12+ selector transports keep unsent write() data by REFERENCE
+    # (zero-copy writes, gh-91166); earlier versions copy the tail into a
+    # private bytearray.  Decides when a RawPayload's pins may drop.
+    _WRITES_BUFFER_BY_REF = sys.version_info >= (3, 12)
+
+    def send_raw(self, rid: int, payload: RawPayload) -> None:
+        """Emit a raw payload frame for `rid`: header + buffers straight to
+        the transport, ordered after everything already queued."""
+        done = False
+        try:
+            if self._closed:
+                return
+            self._flush_resp()
+            self._flush_wbuf()
+            if self._closed:
+                return
+            try:
+                self.transport.write(
+                    _pack([0, "__raw__", [rid, payload.nbytes]]))
+                for b in payload.buffers:
+                    self.transport.write(b)
+            except (ConnectionError, OSError):
+                self._teardown()
+                return
+            if (self._WRITES_BUFFER_BY_REF and payload.release is not None
+                    and not self._closed and self.transport is not None
+                    and self.transport.get_write_buffer_size() > 0):
+                # The transport may still be holding our views: defer the
+                # pin drop until the write buffer fully flushes, or the
+                # backing arena region could be evicted and rewritten
+                # before the kernel reads it.
+                done = True
+                spawn(self._close_when_flushed(payload))
+        finally:
+            if not done:
+                payload.close()
+
+    async def _close_when_flushed(self, payload: RawPayload) -> None:
+        try:
+            while not self._closed and self.transport is not None \
+                    and self.transport.get_write_buffer_size() > 0:
+                await self.drain()          # below high watermark…
+                if self._closed or self.transport is None or \
+                        self.transport.get_write_buffer_size() == 0:
+                    break
+                await asyncio.sleep(0.002)  # …then poll down to empty
+        finally:
+            # Closed/aborted counts too: the bytes will never be read.
+            payload.close()
 
     def _on_msg(self, msg):
         if not isinstance(msg, (list, tuple)) or len(msg) != 3:
@@ -360,6 +697,14 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
         self._pending.clear()
+        for taker in self._raw_takers.values():
+            if not taker[1].done():
+                taker[1].set_exception(
+                    ConnectionLost(f"connection {self.name} lost"))
+        self._raw_takers.clear()
+        self._raw_sinks.clear()
+        self._raw_orphans.clear()
+        self._raw_cur = None
         self._resume_writing()  # unblock drain waiters
         try:
             if self.transport is not None:
@@ -392,6 +737,9 @@ class Connection:
             spawn(self._dispatch(mid, method, payload,
                                  skip_req_chaos=True))
             return
+        if isinstance(res, RawPayload) and mid == 0:
+            res.close()
+            return
         if isinstance(res, asyncio.Future):
             if mid == 0:
                 return  # one-way: nothing awaits the outcome
@@ -410,6 +758,13 @@ class Connection:
             self._maybe_reply(mid, method, 0, res)
 
     def _maybe_reply(self, mid: int, method: str, status: int, body):
+        if isinstance(body, RawPayload):
+            if (_chaos and _chaos.should_fail(method, "resp")) \
+                    or self._closed or mid == 0:
+                body.close()        # dropped: release pins, send nothing
+                return
+            self.send_raw(mid, body)
+            return
         if _chaos and _chaos.should_fail(method, "resp"):
             return
         if not self._closed:
@@ -450,8 +805,14 @@ class Connection:
             status, body = 0, result
         except Exception as e:
             import traceback
+            # First line is the machine-readable "TypeName: message"
+            # contract — callers classify remote errors by it (e.g.
+            # core_worker's ObjectTransferError handling); the traceback
+            # follows for humans only.
             status, body = 1, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
         if mid == 0:
+            if isinstance(body, RawPayload):
+                body.close()    # one-way caller can't receive it: drop pins
             return  # one-way
         self._maybe_reply(mid, method, status, body)
 
@@ -476,9 +837,88 @@ class Connection:
                 fut.exception()  # consume, avoid never-retrieved warning
             raise ConnectionLost(f"connection {self.name} lost on send")
         await self.drain()
-        if timeout:
-            return await asyncio.wait_for(fut, timeout)
-        return await fut
+        try:
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            if fut.cancelled():
+                self._pending.pop(mid, None)    # reap timed-out entries
+
+    async def call_raw(self, method: str, payload, sink,
+                       timeout: float | None = None):
+        """Call whose successful response arrives as a raw out-of-band
+        payload scattered into `sink` (a writable buffer — filled from
+        offset 0 — or a callable receiving sequential memoryview pieces).
+        Resolves to the byte count scattered.  A peer replying with a
+        normal msgpack frame instead (absence marker, typed error, or a
+        legacy bytes body) resolves to that value — callers handle both."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        mid = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        self._raw_sinks[mid] = sink
+        try:
+            self._send_frame([mid, method, payload])
+            if self._closed:
+                if fut.done():
+                    fut.exception()  # consume
+                raise ConnectionLost(f"connection {self.name} lost on send")
+            await self.drain()
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._raw_sinks.pop(mid, None)
+            # The caller is done with this sink (success, timeout or
+            # cancellation).  If its payload is still streaming in, defuse
+            # the reception: the destination buffer may be released,
+            # aborted, or reallocated the moment we return — late bytes
+            # must be discarded, not scattered into freed memory.
+            cur = self._raw_cur
+            if cur is not None and cur[0] == mid:
+                cur[2] = None
+            if fut.cancelled():
+                # Timed-out/cancelled calls must not accumulate in
+                # _pending on long-lived peer connections (per-chunk
+                # timeouts are a designed recurrent event); a late reply
+                # for the reaped mid is ignored / orphan-buffered.
+                self._pending.pop(mid, None)
+
+    async def call_with_raw(self, method: str, payload: dict,
+                            body: RawPayload,
+                            timeout: float | None = None):
+        """Call whose REQUEST carries a raw payload: a normal request
+        frame (its payload dict gains 'raw_id' and 'nbytes' so the
+        handler can `await conn.take_raw(raw_id)`), immediately followed
+        by the raw frame.  Returns the response; timed-out entries are
+        reaped from _pending like call()/call_raw()."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        mid = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        payload = dict(payload)
+        # Negative rid: request-side payloads live in their own id space so
+        # they can never collide with a pending outbound call of the
+        # RECEIVER (whose mids are positive and independently allocated).
+        payload["raw_id"] = -mid
+        payload["nbytes"] = body.nbytes
+        self._send_frame([mid, method, payload])
+        self.send_raw(-mid, body)
+        # Backpressure like call()/call_raw(): bound userspace buffering
+        # at the transport's high watermark for multi-GB uploads.
+        await self.drain()
+        try:
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            if fut.cancelled():
+                self._pending.pop(mid, None)
 
     def notify(self, method: str, payload=None):
         if self._closed:

@@ -75,8 +75,9 @@ class SerializationContext:
         deserialize-side compare below so the two can't drift."""
         blob = SerializationContext._NONE_BLOB
         if blob is None:
-            parts = self.serialize(None)
-            blob = b"".join(bytes(p) for p in parts)
+            # bytes.join accepts buffer-protocol parts directly: one pass,
+            # no per-part bytes() materialization.
+            blob = b"".join(self.serialize(None))
             SerializationContext._NONE_BLOB = blob
         return blob
 
@@ -108,6 +109,33 @@ class SerializationContext:
     @staticmethod
     def loads_code(data: bytes) -> Any:
         return cloudpickle.loads(data)
+
+
+def part_nbytes(p) -> int:
+    return p.nbytes if isinstance(p, memoryview) else len(p)
+
+
+def write_parts_into(parts, dest: memoryview) -> int:
+    """Scatter serialized parts into a caller-provided buffer (e.g. a shm
+    create_buffer view): the single memcpy of the zero-copy put
+    discipline (see docs/data_plane.md).  Returns bytes written."""
+    off = 0
+    for p in parts:
+        n = part_nbytes(p)
+        dest[off:off + n] = p
+        off += n
+    return off
+
+
+def copied_part_bytes(parts, threshold: int = 1 << 12) -> int:
+    """Copy-audit helper: bytes held in materialized `bytes` parts above
+    `threshold` — i.e. payload bytes that were COPIED out of their source
+    buffer instead of travelling as pickle-5 out-of-band memoryviews.
+    The zero-copy put discipline keeps this at 0 for large values (small
+    parts — struct headers, the pickle header — are exempt); tests assert
+    it to catch regressions reintroducing a flatten on the put path."""
+    return sum(len(p) for p in parts
+               if isinstance(p, (bytes, bytearray)) and len(p) > threshold)
 
 
 _context: SerializationContext | None = None

@@ -114,6 +114,12 @@ class ShmObjectStoreError(Exception):
     pass
 
 
+class SpillTruncatedError(OSError):
+    """A spill file is shorter than the object it records — the on-disk
+    copy itself is damaged (vs a transient I/O error, which must NOT be
+    treated as corruption)."""
+
+
 class ObjectExistsError(ShmObjectStoreError):
     pass
 
@@ -221,6 +227,39 @@ class ShmStore:
         rc = self._lib.rts_seal(self._h, object_id)
         if rc < 0 and rc != -114:  # EALREADY ok
             raise ShmObjectStoreError(f"seal failed: errno {-rc}")
+
+    def read_file_into(self, object_id: bytes, path: str, size: int,
+                       keep_pin: bool = False) -> None:
+        """Spill-restore fast path: allocate the object and read the spill
+        file DIRECTLY into its arena view (readinto — one copy from the
+        page cache, no intermediate Python bytes), then seal.  With
+        keep_pin=False the writer pin drops at seal; keep_pin=True leaves
+        it in place so the caller can transfer pins without an evictable
+        window.  Raises StoreFullError/ObjectExistsError like
+        create_buffer; aborts the allocation on a read failure."""
+        # Open FIRST: a missing spill file must surface as
+        # FileNotFoundError (-> external-tier fallback), not as whatever
+        # the arena allocation would raise under memory pressure.
+        with open(path, "rb", buffering=0) as f:
+            buf = self.create_buffer(object_id, size)
+            try:
+                got = 0
+                while got < size:
+                    n = f.readinto(buf[got:])   # raw read: may be short
+                    if not n:
+                        break
+                    got += n
+                if got != size:
+                    raise SpillTruncatedError(
+                        f"spill file {path} truncated: {got}/{size} bytes")
+                buf.release()
+                self.seal(object_id)
+                if not keep_pin:
+                    self.release(object_id)
+            except BaseException:
+                buf.release()           # idempotent
+                self.abort(object_id)
+                raise
 
     def get(self, object_id: bytes, timeout_ms: int = 0) -> memoryview | None:
         """Returns a zero-copy readonly view, or None if absent/timeout.

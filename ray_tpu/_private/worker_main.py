@@ -582,7 +582,9 @@ class Executor:
             eb = ctx.serialize(exc.RayError(
                 f"{type(e).__name__}: {e} (unpicklable)"))
         from ..dag import _transport
-        return _transport.ERR + b"".join(bytes(p) for p in eb)
+        # Single-pass join: bytes.join consumes the memoryview parts
+        # directly — no per-part bytes() materialization.
+        return b"".join([_transport.ERR, *eb])
 
     def _dag_serve(self, stage):
         """Resident compiled-graph stage loop: block on input channels,
@@ -673,8 +675,7 @@ class Executor:
                 if err_body is not None:
                     body = err_body
                 else:
-                    body = _transport.OK + b"".join(
-                        bytes(p) for p in ctx.serialize(result))
+                    body = b"".join([_transport.OK, *ctx.serialize(result)])
                 _transport.send(store, out, body, nreaders, slot_bytes,
                                 self.core._next_put_id)
         finally:

@@ -367,8 +367,7 @@ class CompiledDAG:
         from . import _transport
         from .._private.serialization import get_context
         ctx = get_context()
-        body = _transport.OK + b"".join(
-            bytes(p) for p in ctx.serialize(inp))
+        body = b"".join([_transport.OK, *ctx.serialize(inp)])
         with self._send_lock:
             idx = self._exec_idx
             sent = 0

@@ -50,6 +50,17 @@ class ObjectLostError(RayError):
     pass
 
 
+class ObjectTransferError(RayError):
+    """A chunked inter-node object transfer failed mid-stream for a
+    TRANSIENT reason (dropped/timed-out chunk fetches on every source)
+    after in-place retries and source failover.  Distinct from
+    ObjectLostError: the object may still exist, so callers may retry the
+    pull — and owners must NOT treat it as a lost primary (which would
+    trigger destructive lineage re-execution).  Never surfaces as a
+    silently truncated buffer: the partially-filled destination is
+    aborted before this raises."""
+
+
 class OwnerDiedError(ObjectLostError):
     pass
 

@@ -81,7 +81,12 @@ class _JaxBackend(Backend):
             f"{flags} --xla_force_host_platform_device_count={n}").strip()
         try:
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", n)
+            try:
+                jax.config.update("jax_num_cpu_devices", n)
+            except AttributeError:
+                # Older jax has no jax_num_cpu_devices; the XLA_FLAGS
+                # device-count override above does the same job.
+                pass
         except RuntimeError as e:
             # Backend already initialized in this process — device count
             # can no longer change.  Only fatal if the count is wrong AND

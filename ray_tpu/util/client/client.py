@@ -132,13 +132,46 @@ class ClientContext:
             return wrap
         return wrap(obj)
 
+    # Blobs at or above this ship as raw out-of-band frames (skipping the
+    # msgpack pack/unpack of the whole payload on both sides); below it
+    # the extra header frame isn't worth it.
+    _RAW_MIN = 64 * 1024
+
     def put(self, value: Any) -> ClientObjectRef:
-        res = self._call("client_put", {"blob": cloudpickle.dumps(value)})
+        blob = cloudpickle.dumps(value)
+        if len(blob) >= self._RAW_MIN:
+            # No legacy fallback here: once the raw payload bytes are on
+            # the wire a pre-raw server's msgpack stream is desynced, so
+            # client and server must speak the same protocol (they ship
+            # together).
+            res = self._run(self._put_raw(blob))
+            return ClientObjectRef(self, res["ref"])
+        res = self._call("client_put", {"blob": blob})
         return ClientObjectRef(self, res["ref"])
+
+    async def _put_raw(self, blob: bytes):
+        from ..._private import rpc
+        return await self._conn.call_with_raw(
+            "client_put_raw", {}, rpc.RawPayload([blob]), timeout=300)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ClientObjectRef)
         reflist = [refs] if single else list(refs)
+        if single:
+            # Raw-framed single get: the value bytes bypass msgpack in
+            # both directions (the connection collects the raw payload
+            # and resolves the plain call with bytes).  No legacy-server
+            # fallback — same protocol story as put() above.
+            res = self._call("client_get_raw",
+                             {"ref": reflist[0]._rid, "timeout": timeout},
+                             timeout=(300 if timeout is None
+                                      else timeout) + 30)
+            if isinstance(res, (bytes, bytearray)):
+                return cloudpickle.loads(res)
+            if isinstance(res, dict) and "error" in res:
+                raise cloudpickle.loads(res["error"])
+            raise RuntimeError(
+                f"unexpected client_get_raw reply type {type(res)}")
         res = self._call("client_get", {
             "refs": [r._rid for r in reflist], "timeout": timeout},
             timeout=(300 if timeout is None else timeout) + 30)

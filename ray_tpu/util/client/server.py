@@ -36,7 +36,9 @@ class ClientServer:
         self._owned: Dict[Any, Dict[str, set]] = {}
         self._server = rpc.RpcServer({
             "client_put": self.h_put,
+            "client_put_raw": self.h_put_raw,
             "client_get": self.h_get,
+            "client_get_raw": self.h_get_raw,
             "client_call": self.h_call,
             "client_create_actor": self.h_create_actor,
             "client_actor_call": self.h_actor_call,
@@ -123,6 +125,30 @@ class ClientServer:
         core = self._ray._core()
         ref = await self._on_core(core.put_async(value))
         return {"ref": self._track(ref, conn)}
+
+    async def h_put_raw(self, conn, p):
+        """Put whose value blob arrives as a raw out-of-band frame — bulk
+        uploads skip the msgpack pack/unpack on both sides (reference:
+        the 0.10 GiB/s ray:// put ceiling is exactly this overhead)."""
+        blob = await conn.take_raw(p["raw_id"], timeout=300)
+        value = cloudpickle.loads(blob)
+        core = self._ray._core()
+        ref = await self._on_core(core.put_async(value))
+        return {"ref": self._track(ref, conn)}
+
+    async def h_get_raw(self, conn, p):
+        """Single-ref get whose value ships back as a raw frame (errors
+        still travel as normal typed msgpack replies)."""
+        ref = self._refs[p["ref"]]
+        core = self._ray._core()
+        timeout = p.get("timeout")
+        try:
+            val = await asyncio.wait_for(
+                self._on_core(core.get_async(ref)),
+                300 if timeout is None else timeout)
+        except Exception as e:
+            return {"error": cloudpickle.dumps(e)}
+        return self._rpc.RawPayload([cloudpickle.dumps(val)])
 
     async def h_get(self, conn, p):
         import time as _time

@@ -308,6 +308,69 @@ def bench_wait_many_refs(min_time_s: float, n_refs: int = 1000) -> float:
     return _timeit(run, min_time_s)
 
 
+def bench_internode_pull_gigabytes(min_time_s: float, mb: int = 64) -> float:
+    """GiB/s of an agent->agent chunked object pull over loopback TCP —
+    the inter-node leg of the data plane (raw out-of-band chunk frames,
+    `object_transfer_max_inflight_chunks` requests pipelined, scattered
+    straight into the destination arena).  Spawns a second node agent in
+    the running session, pulls one `mb` MB object into it, frees the
+    copy, repeats.  Reference anchor: the 1 GiB / 50-node broadcast row
+    of BASELINE.md (14.8 s) ≈ 3.4 GiB/s of per-node pull bandwidth."""
+    import asyncio
+
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private import rpc as rpc_mod
+
+    core = ray_tpu._core()
+    payload = np.frombuffer(
+        np.random.default_rng(0).bytes(mb << 20), dtype=np.uint8)
+    ref = ray_tpu.put(payload)
+    oid = ref.binary()
+    proc = None
+    try:
+        proc, addr, _store_path, _node_id = node_mod.start_agent(
+            core.session_dir, core.gcs_address, {"CPU": 0.0},
+            labels={"bench": "pull_sink"},
+            store_capacity=max(128 << 20, (mb << 20) * 2))
+
+        async def _connect():
+            return await rpc_mod.connect(tuple(addr), name="bench->sink",
+                                         retries=50)
+
+        conn = asyncio.run_coroutine_threadsafe(
+            _connect(), core.loop).result(30)
+        src = list(core.agent_address)
+
+        async def _pull_once():
+            ok = await conn.call("pull_object", {
+                "object_id": oid, "from_addrs": [src], "priority": 0},
+                timeout=120)
+            assert ok, "pull_object returned False"
+            await conn.call("free_objects", {"object_ids": [oid]})
+
+        def run():
+            asyncio.run_coroutine_threadsafe(
+                _pull_once(), core.loop).result(150)
+            return 1
+
+        pulls_per_s = _timeit(run, min_time_s, windows=2)
+        return pulls_per_s * mb / 1024.0
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning(
+            "internode pull bench failed: %s", e)
+        return 0.0
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)   # reap: no zombie for the suite
+            except Exception:
+                proc.kill()
+        # keep `ref` alive through the whole measurement
+        del ref
+
+
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     from ray_tpu.util import placement_group, remove_placement_group
 
@@ -339,6 +402,9 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "single_client_wait_1k_refs": bench_wait_many_refs,
     "single_client_get_object_containing_10k_refs": bench_get_containing_10k_refs,
     "placement_group_create_removal": bench_pg_create_removal,
+    # Last: spawns/kills an extra node agent; its churn must not overlap
+    # another measurement.
+    "internode_pull_gigabytes": bench_internode_pull_gigabytes,
 }
 
 # Reference values from BASELINE.md (64-core node,
@@ -358,11 +424,15 @@ BASELINE = {
     "single_client_wait_1k_refs": 4.4,
     "single_client_get_object_containing_10k_refs": 11.3,
     "placement_group_create_removal": 666.0,
+    # 1 GiB to 50+ nodes in 14.8 s (BASELINE.md scalability row) ≈ 3.4
+    # GiB/s of per-node pull bandwidth on the reference's network.
+    "internode_pull_gigabytes": 3.4,
 }
 
 UNITS = {
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
+    "internode_pull_gigabytes": "GiB/s",
     "single_client_wait_1k_refs": "waits/s (1k refs)",
     "single_client_get_object_containing_10k_refs": "gets/s (10k refs)",
     "placement_group_create_removal": "pg/s",

@@ -23,10 +23,14 @@ if os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu") == "cpu":
     try:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 8)
-    except RuntimeError:
-        # backend already initialized (e.g. a plugin touched jax.devices());
-        # tests that need the 8-device mesh will fail loudly instead of the
-        # whole session aborting at collection.
+    except (RuntimeError, AttributeError):
+        # RuntimeError: backend already initialized (e.g. a plugin touched
+        # jax.devices()) — tests needing the 8-device mesh fail loudly
+        # instead of the whole session aborting at collection.
+        # AttributeError: jax_num_cpu_devices doesn't exist on older jax —
+        # the XLA_FLAGS fallback above already provides the 8-device mesh.
+        # Anything else propagates: one clear failure at collection beats
+        # every mesh test failing with confusing 1-device errors.
         pass
     # Persistent compilation cache: the model/collective tests recompile
     # identical jaxprs every run (the suite's biggest wall-time sink on
@@ -41,6 +45,13 @@ if os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu") == "cpu":
         pass
 
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (multi-GiB data plane etc.); tier-1 runs "
+        "with -m 'not slow'")
 
 
 @pytest.fixture(autouse=True)
