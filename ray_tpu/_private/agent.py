@@ -1991,8 +1991,8 @@ def main():
     logging.basicConfig(level=args.log_level)
     from .node import install_daemon_profiler
     install_daemon_profiler("agent")
-    from .auth import install_process_token
-    install_process_token(args.session_dir)
+    from .auth import require_process_token
+    require_process_token("agent", args.session_dir)
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

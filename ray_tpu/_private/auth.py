@@ -13,8 +13,8 @@ Resolution order (first hit wins):
   1. RAY_TPU_AUTH_TOKEN env var
   2. RAY_TPU_AUTH_TOKEN_FILE env var (path to a token file)
   3. <session_dir>/auth_token  (when a session dir is known)
-  4. the well-known current-cluster token file next to the cluster
-     address file (local attach: init(address='auto'), CLI)
+  4. the well-known current-cluster token file in the user-private
+     ~/.ray_tpu dir (local attach: init(address='auto'), CLI)
 
 `ensure_cluster_token` is the head-start path: it generates a fresh
 token when none is configured, exports it into os.environ (so every
@@ -41,8 +41,13 @@ TOKEN_ENV = "RAY_TPU_AUTH_TOKEN"
 TOKEN_FILE_ENV = "RAY_TPU_AUTH_TOKEN_FILE"
 DISABLE_ENV = "RAY_TPU_AUTH_DISABLED"
 # Sibling of worker.CLUSTER_ADDRESS_FILE — lets a second local driver
-# attach with address='auto' and no configuration.
-CLUSTER_TOKEN_FILE = "/tmp/ray_tpu/ray_current_cluster_token"
+# attach with address='auto' and no configuration.  Lives under the
+# USER-PRIVATE home dir, not world-writable /tmp: a token in a
+# predictable /tmp path can be pre-created or symlinked by another local
+# user (the reference keeps its default token in ~/.ray for the same
+# reason; only the non-secret address file stays in /tmp).
+CLUSTER_TOKEN_FILE = os.path.join(
+    os.path.expanduser("~"), ".ray_tpu", "auth_token")
 
 
 def auth_disabled() -> bool:
@@ -57,8 +62,37 @@ def _read_file(path: str) -> Optional[str]:
         return None
 
 
-def load_token(session_dir: Optional[str] = None) -> Optional[str]:
-    """Resolve the cluster token for this process without generating."""
+def _read_owned_file(path: str) -> Optional[str]:
+    """Read a secret drop only when it is a regular file WE own: a
+    pre-created foreign file or a symlink must never supply (or exfiltrate
+    via) the cluster token."""
+    flags = os.O_RDONLY | getattr(os, "O_NOFOLLOW", 0)
+    try:
+        fd = os.open(path, flags)
+    except OSError:
+        return None
+    try:
+        st = os.fstat(fd)
+        import stat as _stat
+        if st.st_uid != os.getuid() or not _stat.S_ISREG(st.st_mode):
+            logger.warning("ignoring token file %s: not a regular file "
+                           "owned by this user", path)
+            return None
+        return os.read(fd, 4096).decode(errors="replace").strip() or None
+    except OSError:
+        return None
+    finally:
+        os.close(fd)
+
+
+def load_token(session_dir: Optional[str] = None, *,
+               allow_cluster_file: bool = True) -> Optional[str]:
+    """Resolve the cluster token for this process without generating.
+
+    allow_cluster_file=False skips the machine-local well-known drop —
+    a driver attaching to an EXPLICIT remote address must not silently
+    pick up a stale token from some older local cluster (it produces
+    opaque ConnectionLost failures instead of a clear auth error)."""
     if auth_disabled():
         return None
     tok = os.environ.get(TOKEN_ENV)
@@ -73,24 +107,59 @@ def load_token(session_dir: Optional[str] = None) -> Optional[str]:
         tok = _read_file(os.path.join(session_dir, "auth_token"))
         if tok:
             return tok
-    return _read_file(CLUSTER_TOKEN_FILE)
+    if not allow_cluster_file:
+        return None
+    return _read_owned_file(CLUSTER_TOKEN_FILE)
 
 
-def install_process_token(session_dir: Optional[str] = None) -> Optional[str]:
+def install_process_token(session_dir: Optional[str] = None, *,
+                          allow_cluster_file: bool = True
+                          ) -> Optional[str]:
     """Load the token and make it this process's rpc default (daemon and
     attaching-driver mains).  Also exports it to os.environ so any child
     this process spawns (agents joining via CLI, workers, the C++ client)
     inherits it.  Returns the token (None = auth off)."""
-    tok = load_token(session_dir)
+    tok = load_token(session_dir, allow_cluster_file=allow_cluster_file)
     rpc.set_default_token(tok)
     if tok:
         os.environ[TOKEN_ENV] = tok
     return tok
 
 
+def require_process_token(role: str,
+                          session_dir: Optional[str] = None
+                          ) -> Optional[str]:
+    """Daemon mains (agent/gcs/worker/dashboard): resolve the cluster
+    token or refuse to start.  A daemon that silently comes up with no
+    token runs an UNAUTHENTICATED RPC server (the agent surface spawns
+    workers — code execution) while the rest of the cluster is
+    authenticated; the reference hard-fails the same way when auth is
+    enabled but no token resolves.  RAY_TPU_AUTH_DISABLED=1 is the only
+    sanctioned way to run without auth."""
+    tok = install_process_token(session_dir)
+    if tok is None and not auth_disabled():
+        raise SystemExit(
+            f"ray_tpu {role}: no cluster auth token found (checked "
+            f"${TOKEN_ENV}, ${TOKEN_FILE_ENV}, the session dir, and "
+            f"{CLUSTER_TOKEN_FILE}); refusing to start an unauthenticated "
+            f"RPC server. Provide the cluster token via ${TOKEN_ENV}, or "
+            f"set {DISABLE_ENV}=1 to run the whole cluster without auth.")
+    return tok
+
+
 def _write_private(path: str, token: str) -> None:
+    """Create the token file fresh with owner-only permissions: unlink +
+    O_EXCL|O_NOFOLLOW means a pre-existing foreign file or symlink is
+    replaced, never followed or trusted (its lax mode would survive a
+    plain O_CREAT open)."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    fd = os.open(path,
+                 os.O_WRONLY | os.O_CREAT | os.O_EXCL
+                 | getattr(os, "O_NOFOLLOW", 0), 0o600)
     try:
         os.write(fd, token.encode())
     finally:

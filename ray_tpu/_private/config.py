@@ -47,6 +47,16 @@ _define("num_workers_soft_limit", 0, "0 = num_cpus")
 _define("max_leases_per_scheduling_key", 64,
         "client-side cap on concurrent worker leases per scheduling key "
         "(reference: normal_task_submitter lease pool; queue-bounded anyway)")
+_define("max_tasks_in_flight_per_worker", 64,
+        "ceiling of the ADAPTIVE per-lease submit window: the pipeline "
+        "deepens toward this while observed push->complete latency stays "
+        "low and shrinks back on backpressure/loss (reference: "
+        "normal_task_submitter.cc max_tasks_in_flight_per_worker)")
+_define("submit_batch_ack_timeout_s", 15.0,
+        "how long a submitter waits for a submit_batch enqueue-ack before "
+        "resending the still-unfinished tasks (the worker dedups by task "
+        "id, so a dropped ack is harmless); 4 lost acks recycle the "
+        "connection")
 _define("worker_pythonpath_strip_cpu", ".axon_site",
         "PYTHONPATH entries containing this substring are stripped from "
         "CPU-only workers so accelerator site hooks (eager TPU client "

@@ -57,11 +57,14 @@ logger = logging.getLogger("ray_tpu.core_worker")
 # avoidance for tasks that block on results of tasks they submitted).
 task_exec_tls = threading.local()
 
-# In-flight pushes per leased worker.  A granted lease still RUNS one
-# task at a time (the worker's task lock serializes execution, matching
-# reference semantics); a small pipeline hides the push/reply round trip
-# so tiny-task throughput isn't bounded by per-task RTT.  Kept small so
-# long tasks don't pile onto one worker while other nodes idle — the
+# Floor of the ADAPTIVE in-flight window per leased worker.  A granted
+# lease still RUNS one task at a time (the worker's task lock serializes
+# execution, matching reference semantics); pipelined pushes hide the
+# push/complete round trip so tiny-task throughput isn't bounded by
+# per-task RTT.  The window starts here and grows toward
+# max_tasks_in_flight_per_worker while observed latency stays low
+# (_note_task_latency), shrinking back on backpressure or lease loss —
+# so long tasks never pile onto one worker while other nodes idle, the
 # queue drains back through _pump when a lease dies, and queued-at-worker
 # tasks remain cancellable (_cancel_requested check before execution).
 PIPELINE_DEPTH = 3
@@ -96,7 +99,8 @@ class _Lease:
 class _KeyState:
     __slots__ = ("queue", "leases", "pending_lease_requests", "resources",
                  "strategy", "runtime_env", "last_demand_report",
-                 "lease_backoff_until", "pump_scheduled", "avg_task_s")
+                 "lease_backoff_until", "pump_scheduled", "avg_task_s",
+                 "prefix", "prefix_blob", "window")
 
     def __init__(self, resources, strategy, runtime_env=None):
         self.queue: deque[_PendingTask] = deque()
@@ -108,16 +112,31 @@ class _KeyState:
         self.last_demand_report = 0.0
         self.lease_backoff_until = 0.0
         self.pump_scheduled = False
-        # EMA of push->reply latency; gates deep pipelining (see _pump).
+        # EMA of push->complete latency; drives the adaptive window.
         self.avg_task_s: Optional[float] = None
+        # Stable spec prefix shared by every task of this key (and its
+        # one-time msgpack encoding) — see protocol.spec_prefix_of.
+        # Seeded from RemoteFunction._submit_cache when available, else
+        # built from the first pushed spec.
+        self.prefix: Optional[dict] = None
+        self.prefix_blob: Optional[bytes] = None
+        # Adaptive in-flight window per lease (PIPELINE_DEPTH ..
+        # max_tasks_in_flight_per_worker): grows on low RTT, shrinks on
+        # transport backpressure / lease loss.
+        self.window = PIPELINE_DEPTH
 
 
 class _ActorState:
     __slots__ = ("actor_id", "address", "conn", "seq", "dead", "death_cause",
                  "resolving", "submit_queue", "draining", "drain_scheduled",
-                 "out_of_order")
+                 "out_of_order", "prefix", "prefix_blob")
 
     def __init__(self, actor_id: bytes):
+        # Stable spec prefix for this handle's calls (the actor-method
+        # equivalent of RemoteFunction's submit cache): method/seq/args
+        # travel as per-call deltas.
+        self.prefix: Optional[dict] = None
+        self.prefix_blob: Optional[bytes] = None
         self.actor_id = actor_id
         self.address = None
         self.conn: Optional[rpc.Connection] = None
@@ -180,6 +199,14 @@ class CoreWorker:
         self._streams: Dict[bytes, StreamState] = {}
         self._inflight_tasks: Dict[bytes, _Lease] = {}        # normal tasks
         self._inflight_actor_tasks: Dict[bytes, _ActorState] = {}
+        # task_id -> completion record for batched pushes: ("n", key,
+        # state, lease, task, t_push) for normal tasks, ("a", astate,
+        # conn, task, t_push) for actor calls (the conn the batch was
+        # pushed on — astate.conn may already point at a reconnect by
+        # the time the old conn's loss cleanup runs).  Resolved by
+        # complete_batch frames (_f_complete_batch) or by
+        # connection-loss cleanup.
+        self._pending_replies: Dict[bytes, tuple] = {}
         # actor_id -> future of an in-flight background registration this
         # process initiated; _actor_conn awaits it instead of polling GCS.
         self._registering: Dict[bytes, asyncio.Future] = {}
@@ -208,6 +235,9 @@ class CoreWorker:
         self._shutdown = False
         cfg = get_config()
         self._inline_limit = cfg.max_direct_call_object_size
+        self._max_inflight = max(PIPELINE_DEPTH,
+                                 cfg.max_tasks_in_flight_per_worker)
+        self._ack_timeout = cfg.submit_batch_ack_timeout_s
         ctx = get_context()
         ctx.ref_factory = self._ref_factory
         ctx.ref_hook = self._ref_serialized_hook
@@ -1542,13 +1572,19 @@ class CoreWorker:
                     runtime_env=None, name="",
                     fn_blob: Optional[bytes] = None,
                     generator_backpressure: int = 0,
-                    sched_key: Optional[bytes] = None) -> List[ObjectRef]:
+                    sched_key: Optional[bytes] = None,
+                    spec_prefix: Optional[tuple] = None) -> List[ObjectRef]:
         """Submit a normal task. NEVER blocks on dependencies: refs are
         minted and returned immediately; pending ObjectRef args resolve on
         the io loop and the task joins the lease queue when they're ready
         (reference: normal_task_submitter.cc + dependency_resolver.cc —
         submission is asynchronous end to end). Sync-safe from any thread,
-        including the event loop."""
+        including the event loop.
+
+        spec_prefix: optional (prefix_dict, prefix_blob) computed once by
+        the RemoteFunction submit cache — per-call spec construction then
+        copies the template instead of rebuilding all stable fields, and
+        the blob rides every submit_batch frame un-re-encoded."""
         num_returns, streaming = self._parse_streaming(
             num_returns, generator_backpressure)
         if sched_key is None:
@@ -1560,7 +1596,7 @@ class CoreWorker:
             resources=resources, max_retries=max_retries,
             scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env, name=name, streaming=streaming,
-            sched_key=sched_key)
+            sched_key=sched_key, spec_prefix=spec_prefix)
         if refs is not None:
             return refs
         return self._submit_task_deferred(
@@ -1568,12 +1604,14 @@ class CoreWorker:
             num_returns=num_returns, resources=resources,
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env, name=name, fn_blob=fn_blob,
-            streaming=streaming, sched_key=sched_key)
+            streaming=streaming, sched_key=sched_key,
+            spec_prefix=spec_prefix)
 
     def _try_submit_fast(self, *, fn_id, args, kwargs, num_returns,
                          resources, max_retries, scheduling_strategy,
                          runtime_env, name, streaming=None,
-                         sched_key=None) -> Optional[List[ObjectRef]]:
+                         sched_key=None,
+                         spec_prefix=None) -> Optional[List[ObjectRef]]:
         """Submission hot path (reference: the Cython submit_task releases
         the GIL and never blocks on the raylet, _raylet.pyx:3432).  When
         the function is already exported and every arg inlines, the spec
@@ -1616,13 +1654,27 @@ class CoreWorker:
                 entry["kw"] = kw
             entries.append(entry)
         task_id = TaskID.for_normal_task(JobID(self.job_id)).binary()
-        spec = protocol.make_task_spec(
-            task_id=task_id, job_id=self.job_id, fn_id=fn_id,
-            args=entries, nreturns=num_returns,
-            owner_addr=list(self.address), resources=resources,
-            retries_left=max_retries,
-            scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env, name=name, streaming=streaming)
+        if spec_prefix is not None:
+            # Pre-encoded submit cache hit: the stable fields were built
+            # (and msgpack-encoded) once by the RemoteFunction — per call
+            # only the delta fields are written.
+            spec = dict(spec_prefix[0])
+            spec["task_id"] = task_id
+            spec["args"] = entries
+            spec["retries_left"] = max_retries
+            if streaming is not None:
+                spec["streaming"] = streaming
+            tr = protocol._trace_inject()
+            if tr is not None:
+                spec["trace"] = tr
+        else:
+            spec = protocol.make_task_spec(
+                task_id=task_id, job_id=self.job_id, fn_id=fn_id,
+                args=entries, nreturns=num_returns,
+                owner_addr=list(self.address), resources=resources,
+                retries_left=max_retries,
+                scheduling_strategy=scheduling_strategy,
+                runtime_env=runtime_env, name=name, streaming=streaming)
         refs = []
         for i in range(num_returns):
             oid = task_id + (i + 1).to_bytes(4, "little")
@@ -1644,9 +1696,11 @@ class CoreWorker:
                 state = self._keys[key] = _KeyState(resources,
                                                     scheduling_strategy,
                                                     runtime_env)
+                if spec_prefix is not None:
+                    state.prefix, state.prefix_blob = spec_prefix
             state.queue.append(_PendingTask(spec, []))
             # Deferred pump: a burst of submissions landing in this loop
-            # tick pumps ONCE, so tasks group into per-lease multi-call
+            # tick pumps ONCE, so tasks group into per-lease submit_batch
             # frames instead of one frame each.
             self._schedule_pump(key, state)
 
@@ -1660,6 +1714,16 @@ class CoreWorker:
     def _note_task_latency(self, state: _KeyState, dt: float) -> None:
         state.avg_task_s = dt if state.avg_task_s is None \
             else 0.8 * state.avg_task_s + 0.2 * dt
+        # Adaptive window (reference: normal_task_submitter.cc
+        # max_tasks_in_flight_per_worker): deepen while the pipeline keeps
+        # push->complete latency low, back off once tasks are slow enough
+        # that queuing them here (where lease growth / spillback can still
+        # spread them) beats parking them behind one worker.
+        if state.avg_task_s < 0.05:
+            if state.window < self._max_inflight:
+                state.window = min(self._max_inflight, state.window * 2)
+        elif state.avg_task_s > 0.25 and state.window > PIPELINE_DEPTH:
+            state.window = max(PIPELINE_DEPTH, state.window // 2)
 
     def _schedule_pump(self, key: bytes, state):
         """Pump at the END of the current loop tick: a burst of replies
@@ -1672,7 +1736,7 @@ class CoreWorker:
     def _submit_task_deferred(self, *, fn, fn_id, args, kwargs, num_returns,
                               resources, max_retries, scheduling_strategy,
                               runtime_env, name, fn_blob, streaming,
-                              sched_key) -> List[ObjectRef]:
+                              sched_key, spec_prefix=None) -> List[ObjectRef]:
         """Slow-path submission (ref args / oversized args / unexported
         fn) without blocking the caller: args serialize on the CALLING
         thread (post-call mutation is safe, matching the fast path and
@@ -1748,6 +1812,8 @@ class CoreWorker:
             if state is None:
                 state = self._keys[key] = _KeyState(
                     resources, scheduling_strategy, runtime_env)
+                if spec_prefix is not None:
+                    state.prefix, state.prefix_blob = spec_prefix
             state.queue.append(task)
             self._schedule_pump(key, state)
 
@@ -1787,21 +1853,22 @@ class CoreWorker:
         # second push.  While more leases are still in flight, hold at
         # depth 1 — pipelining is only for hiding RTT once the cluster
         # has granted all the concurrency it's going to.  When observed
-        # task latency is SHORT (EMA < 50ms), deepen the pipelines so each
-        # worker receives a chunk worth amortizing (one frame, one
-        # executor hop per chunk) instead of trickling 1-3 tasks per reply
-        # round trip — binding a burst of sub-50ms tasks to the granted
-        # leases costs at most a few hundred ms even if the pool later
-        # grows.  Long/unknown tasks never deep-pipeline: they must stay
-        # queued here so lease growth (and spillback to other nodes) can
-        # still spread them.
+        # task latency is SHORT (EMA < 50ms), deepen to the adaptive
+        # window (grown by _note_task_latency toward
+        # max_tasks_in_flight_per_worker) so each worker receives a chunk
+        # worth amortizing (one submit_batch frame, one executor hop per
+        # chunk) instead of trickling a few tasks per completion round
+        # trip — binding a burst of sub-50ms tasks to the granted leases
+        # costs at most a few hundred ms even if the pool later grows.
+        # Long/unknown tasks never deep-pipeline: they must stay queued
+        # here so lease growth (and spillback to other nodes) can still
+        # spread them.
         if state.avg_task_s is not None and state.avg_task_s < 0.05:
             # Short tasks deepen even while lease requests are parked at a
-            # saturated agent: binding a burst of sub-50ms tasks to the
-            # granted leases costs at most a few hundred ms, and a parked
-            # request may not resolve for seconds.
+            # saturated agent: a parked request may not resolve for
+            # seconds.
             depth_cap = max(PIPELINE_DEPTH,
-                            min(64, len(state.queue)
+                            min(state.window, len(state.queue)
                                 // max(1, len(state.leases))))
         elif state.pending_lease_requests > 0:
             depth_cap = 1
@@ -1820,14 +1887,12 @@ class CoreWorker:
                 lease.inflight += 1
                 assign.setdefault(id(lease), (lease, []))[1].append(task)
         for lease, tasks in assign.values():
-            if len(tasks) == 1:
-                self._spawn(self._push_and_track(key, state, lease, tasks[0]))
-            else:
-                # One multi-call frame per lease per pump wave: identical
-                # per-task semantics to separate pushes (the worker executes
-                # them serially off its task lock either way), amortized
-                # framing.
-                self._spawn(self._push_many(key, state, lease, tasks))
+            # One submit_batch frame per lease per pump wave: identical
+            # per-task semantics to separate pushes (the worker executes
+            # them serially off its task lock either way), amortized
+            # framing, and completions return as coalesced complete_batch
+            # frames.
+            self._spawn(self._push_batch(key, state, lease, tasks))
         if time.monotonic() < state.lease_backoff_until:
             return          # saturated: the denied-retry loop re-pumps
         max_leases = get_config().max_leases_per_scheduling_key
@@ -2126,7 +2191,10 @@ class CoreWorker:
     async def _worker_conn(self, addr: tuple) -> rpc.Connection:
         conn = self._worker_conns.get(addr)
         if conn is None or conn.closed:
-            conn = await rpc.connect(addr, name="cw->worker", retries=3)
+            conn = await rpc.connect(addr, name="cw->worker", retries=3,
+                                     on_close=self._on_peer_conn_close)
+            # Batched completions ride back on this same connection.
+            conn.fast_handlers["complete_batch"] = self._f_complete_batch
             self._worker_conns[addr] = conn
         return conn
 
@@ -2155,12 +2223,20 @@ class CoreWorker:
                         pass
                     return
 
-    async def _push_many(self, key, state, lease: _Lease, tasks):
-        """Push several queued tasks to one leased worker in a single
-        multi-call frame. Per-task semantics (cancel checks, retry/requeue
-        on worker death, OOM triage) match _push_and_track; the worker
-        executes them serially off its task lock exactly as it would
-        pipelined singles."""
+    async def _push_batch(self, key, state, lease: _Lease, tasks):
+        """Push queued tasks to one leased worker as a single submit_batch
+        frame: one pre-encoded spec prefix + per-task deltas (see
+        docs/control_plane.md).  The worker acks enqueue immediately and
+        ships results back as coalesced complete_batch frames, applied by
+        _f_complete_batch.  Per-task semantics (cancel checks,
+        retry/requeue on worker death, OOM triage) match the per-call
+        pushes this replaces; the worker executes the batch serially off
+        its task lock exactly as it would pipelined singles.
+
+        A lost ack (chaos drop / wedged worker) resends the
+        still-unfinished tasks after submit_batch_ack_timeout_s — the
+        worker dedups by task id, so a dropped RESPONSE is harmless and a
+        dropped REQUEST simply re-enqueues."""
         ready = []
         for task in tasks:
             spec = task.spec
@@ -2172,70 +2248,171 @@ class CoreWorker:
                 self._release_task_pins(task)
                 self._cancelled.discard(tid)
                 continue
-            self._inflight_tasks[tid] = lease
             ready.append(task)
         if not ready:
             self._pump(key, state)
             return
-        try:
-            futs = lease.conn.call_many("push_task",
-                                        [t.spec for t in ready])
-        except rpc.ConnectionLost:
+        if lease.conn.closed:
             await self._lease_lost(key, state, lease, ready)
             return
-        # Concurrent reply handling: a long task in the frame must not
-        # delay a short one's result.  Done-callbacks instead of a
-        # coroutine per sub-call (the _push_actor_tasks pattern): a Task
-        # costs ~5us to create+schedule per push, a callback runs inline
-        # when the reply frame resolves the future.
-        lost: list = []
+        # Note: a transport write-buffer pause is NOT treated as a shrink
+        # signal — the pause already throttles emission, and on a
+        # saturated host it fires constantly; halving on it collapses the
+        # pipeline exactly when deep batching pays most.  The window
+        # shrinks on the real backpressure signals instead: rising
+        # push->complete latency (_note_task_latency) and lease loss
+        # (_lease_lost).
+        if state.prefix is None:
+            state.prefix = protocol.spec_prefix_of(ready[0].spec)
+            state.prefix_blob = protocol.encode_prefix(state.prefix)
         t_push = time.monotonic()
-        n_left = len(ready)
-        all_done = self.loop.create_future()
-
-        def _one_cb(fut, task):
-            nonlocal n_left
-            # Unconditional decrement: an exception escaping a
-            # done-callback goes to the loop's handler, and a skipped
-            # decrement would leave all_done unresolved forever.
-            try:
-                spec = task.spec
-                tid = spec["task_id"]
+        for task in ready:
+            tid = task.spec["task_id"]
+            self._inflight_tasks[tid] = lease
+            self._pending_replies[tid] = ("n", key, state, lease, task,
+                                          t_push)
+        outcome, info = await self._submit_batch_with_ack(
+            lease.conn, state.prefix, state.prefix_blob, ready,
+            actor=False, abort_label=str(lease.worker_addr))
+        if outcome == "remote_error":
+            # Dispatch-level failure: fail the tasks, keep the lease
+            # accounted.
+            e, pending = info
+            for task in pending:
+                tid = task.spec["task_id"]
+                if self._pending_replies.pop(tid, None) is None:
+                    continue
                 self._inflight_tasks.pop(tid, None)
-                try:
-                    reply = fut.result()
-                except rpc.ConnectionLost:
-                    lost.append(task)
-                except Exception as e:  # dispatch-level RemoteError: fail
-                    #                     task, keep lease slot accounted
-                    self._store_task_exception(spec, exc.RayError(
-                        f"task push failed: {e}"))
-                    self._release_task_pins(task)
-                    lease.inflight -= 1
-                    self._schedule_pump(key, state)
-                else:
-                    lease.inflight -= 1
-                    lease.idle_since = time.monotonic()
-                    self._note_task_latency(state, lease.idle_since - t_push)
-                    self._handle_reply(spec, task, reply)
-                    self._schedule_pump(key, state)
-            except Exception:
-                logger.exception("reply handling failed for %s",
-                                 task.spec.get("name"))
-                # lease.inflight was already decremented on this path: the
-                # key's queue must still get pumped or it sits idle until
-                # some unrelated event wakes it.
-                self._schedule_pump(key, state)
-            finally:
-                n_left -= 1
-                if n_left == 0 and not all_done.done():
-                    all_done.set_result(None)
+                lease.inflight -= 1
+                self._store_task_exception(task.spec, exc.RayError(
+                    f"task push failed: {e}"))
+                self._release_task_pins(task)
+            self._schedule_pump(key, state)
+        elif outcome == "conn_lost":
+            # The conn's on_close cleanup usually runs first and sweeps
+            # these entries; handle whatever it hasn't claimed.
+            leftovers = [t for t in ready
+                         if self._pending_replies.pop(t.spec["task_id"],
+                                                      None) is not None]
+            if leftovers:
+                await self._lease_lost(key, state, lease, leftovers)
 
-        for t, f in zip(ready, futs):
-            f.add_done_callback(lambda fut, t=t: _one_cb(fut, t))
-        await all_done
-        if lost:
-            await self._lease_lost(key, state, lease, lost)
+    async def _submit_batch_with_ack(self, conn, prefix, prefix_blob,
+                                     pending, *, actor: bool,
+                                     abort_label: str):
+        """Send one submit_batch frame and drive the lost-ack resend loop
+        (shared by the normal-task and actor arms — the protocol must
+        never diverge between them).
+
+        Returns ("ok", _) once acked or nothing is left pending,
+        ("remote_error", (err, still_pending)) on a dispatch-level
+        RemoteError, ("conn_lost", _) when the connection died mid-call
+        (the caller sweeps leftovers).  Tasks whose completions land
+        during the retry loop drop out of the resend via the
+        _pending_replies membership filter; the receiver dedups re-sent
+        ids.  Acks lost 4x in a row mean the worker (or the wire) is
+        wedged: recycle the connection — its on_close cleanup funnels the
+        in-flight tasks through the normal worker-death semantics."""
+        for _attempt in range(4):
+            if self._shutdown:
+                # Don't resend (or resume bookkeeping) against a runtime
+                # that is tearing down.
+                return "ok", None
+            payload = {"pr": prefix_blob,
+                       "t": [protocol.spec_delta(prefix, t.spec)
+                             for t in pending]}
+            if actor:
+                payload["a"] = True
+            try:
+                await conn.call("submit_batch", payload,
+                                timeout=self._ack_timeout)
+                return "ok", None   # completions arrive via complete_batch
+            except asyncio.TimeoutError:
+                pending = [t for t in pending
+                           if t.spec["task_id"] in self._pending_replies]
+                if not pending:
+                    return "ok", None
+            except rpc.RemoteError as e:
+                return "remote_error", (e, pending)
+            except rpc.ConnectionLost:
+                return "conn_lost", None
+        logger.warning("submit_batch acks lost to %s; recycling "
+                       "connection", abort_label)
+        conn.abort()
+        return "ok", None
+
+    def _f_complete_batch(self, conn, p):
+        """Fast handler on direct worker/actor connections: a peer shipped
+        a coalesced batch of push results.  Applies every reply's refcount
+        + memory-store updates in one pass and schedules a single deferred
+        pump per affected scheduling key (the group wakeup) — no per-reply
+        RPC future, callback, or asyncio Task."""
+        now = time.monotonic()
+        for tid, reply in p["t"]:
+            tid = bytes(tid)
+            rec = self._pending_replies.pop(tid, None)
+            if rec is None:
+                continue    # already resolved by connection-loss cleanup
+            if rec[0] == "n":
+                _, key, state, lease, task, t_push = rec
+                self._inflight_tasks.pop(tid, None)
+                lease.inflight -= 1
+                lease.idle_since = now
+                self._note_task_latency(state, now - t_push)
+                try:
+                    self._handle_reply(task.spec, task, reply)
+                except Exception:
+                    logger.exception("completion handling failed for %s",
+                                     task.spec.get("name"))
+                # Pump even when reply handling blew up: inflight was
+                # already decremented, and a skipped pump would leave the
+                # key's queue idle until some unrelated event wakes it.
+                self._schedule_pump(key, state)
+            else:
+                _, astate, _conn, task, _t_push = rec
+                self._inflight_actor_tasks.pop(tid, None)
+                try:
+                    self._handle_reply(task.spec, task, reply)
+                except Exception:
+                    logger.exception("completion handling failed for %s",
+                                     task.spec.get("method"))
+        return True
+
+    def _on_peer_conn_close(self, conn):
+        """A direct worker/actor connection died: every task whose
+        completion was pending on it gets the per-task retry/cancel/fail
+        treatment (same semantics as a lost per-call push reply)."""
+        if self._shutdown or not self._pending_replies:
+            return
+        self._spawn(self._conn_lost_cleanup(conn))
+
+    async def _conn_lost_cleanup(self, conn):
+        by_lease: Dict[int, tuple] = {}
+        by_actor: Dict[int, tuple] = {}
+        for tid, rec in list(self._pending_replies.items()):
+            if rec[0] == "n":
+                _, key, state, lease, task, _t = rec
+                if lease.conn is conn:
+                    self._pending_replies.pop(tid, None)
+                    by_lease.setdefault(
+                        id(lease), (key, state, lease, []))[3].append(task)
+            else:
+                _, astate, pushed_conn, task, _t = rec
+                if pushed_conn is conn:
+                    self._pending_replies.pop(tid, None)
+                    by_actor.setdefault(
+                        id(astate), (astate, []))[1].append(task)
+        for key, state, lease, tasks in by_lease.values():
+            await self._lease_lost(key, state, lease, tasks)
+        for astate, tasks in by_actor.values():
+            # Only clear the actor's conn if it still points at the DEAD
+            # connection — a concurrent retry may already have
+            # reconnected, and clobbering the healthy conn would split
+            # subsequent calls across two connections (breaking the
+            # sequential actor's arrival ordering).
+            if astate.conn is conn:
+                astate.conn = None
+            await self._actor_tasks_lost(astate, tasks)
 
     async def _lease_lost(self, key, state, lease: _Lease, tasks):
         """The leased worker's connection died with these tasks in flight:
@@ -2243,6 +2420,9 @@ class CoreWorker:
         the agent for the whole burst)."""
         if lease in state.leases:
             state.leases.remove(lease)
+        if state.window > PIPELINE_DEPTH:
+            # A died worker is the strongest backpressure signal there is.
+            state.window = max(PIPELINE_DEPTH, state.window // 2)
         fate = None
         need_fate = any(
             t.spec["retries_left"] <= 0
@@ -2279,67 +2459,6 @@ class CoreWorker:
                 self._store_task_failure(spec, err)
                 self._release_task_pins(task)
         self._pump(key, state)
-
-    async def _push_and_track(self, key, state, lease: _Lease, task: _PendingTask):
-        spec = task.spec
-        task_id = spec["task_id"]
-        if task_id in self._cancelled:
-            lease.inflight -= 1
-            self._store_task_exception(
-                spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
-            self._release_task_pins(task)
-            self._cancelled.discard(task_id)
-            self._pump(key, state)
-            return
-        self._inflight_tasks[task_id] = lease
-        t_push = time.monotonic()
-        try:
-            reply = await lease.conn.call("push_task", spec)
-        except rpc.ConnectionLost:
-            lease.inflight -= 1
-            if lease in state.leases:
-                state.leases.remove(lease)
-            if task_id in self._cancelled:
-                # force-cancel killed the worker: resolve as cancelled,
-                # never retry.
-                self._store_task_exception(
-                    spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
-                self._release_task_pins(task)
-                self._cancelled.discard(task_id)
-            elif spec["retries_left"] > 0:
-                spec["retries_left"] -= 1
-                self._stream_reset_for_retry(spec)
-                state.queue.append(task)
-            else:
-                # Triage the crash with the worker's agent: an OOM kill
-                # surfaces as a typed error (reference: raylet annotates
-                # worker death so owners raise OutOfMemoryError).
-                fate = None
-                try:
-                    fate = await lease.agent_conn.call(
-                        "worker_fate", {"worker_id": lease.worker_id},
-                        timeout=5)
-                except (rpc.RpcError, asyncio.TimeoutError):
-                    pass
-                if fate and fate.get("oom_killed"):
-                    err = exc.OutOfMemoryError(fate.get("reason") or (
-                        f"worker at {lease.worker_addr} was OOM-killed "
-                        f"running {spec['name']}"))
-                else:
-                    err = exc.WorkerCrashedError(
-                        f"worker at {lease.worker_addr} died running "
-                        f"{spec['name']}")
-                self._store_task_failure(spec, err)
-                self._release_task_pins(task)
-            self._pump(key, state)
-            return
-        finally:
-            self._inflight_tasks.pop(task_id, None)
-        lease.inflight -= 1
-        lease.idle_since = time.monotonic()
-        self._note_task_latency(state, lease.idle_since - t_push)
-        self._handle_reply(spec, task, reply)
-        self._schedule_pump(key, state)
 
     _REPLY_EVENT = {"ok": "FINISHED", "cancelled": "CANCELLED"}
 
@@ -2489,7 +2608,7 @@ class CoreWorker:
             except (rpc.RpcError, asyncio.TimeoutError):
                 return True
         # Not visible yet (actor resolving, push racing): the _cancelled
-        # mark is honored at dispatch by _push_and_track/_push_actor_task.
+        # mark is honored at dispatch by _push_batch/_push_actor_task.
         return True
 
     # ------------------------------------------------------------- actors ----
@@ -2780,7 +2899,7 @@ class CoreWorker:
             if not batch:
                 return
             items, batch[:] = list(batch), []
-            self._spawn(self._push_actor_tasks(state, items))
+            self._spawn(self._push_actor_batch(state, items))
 
         try:
             while state.submit_queue:
@@ -2899,8 +3018,12 @@ class CoreWorker:
                     raise exc.ActorDiedError(state.death_cause)
                 if info["state"] == protocol.ACTOR_ALIVE and info["address"]:
                     try:
-                        state.conn = await rpc.connect(
-                            tuple(info["address"]), name="cw->actor", retries=3)
+                        conn = await rpc.connect(
+                            tuple(info["address"]), name="cw->actor",
+                            retries=3, on_close=self._on_peer_conn_close)
+                        conn.fast_handlers["complete_batch"] = \
+                            self._f_complete_batch
+                        state.conn = conn
                         state.address = tuple(info["address"])
                         return state.conn
                     except rpc.ConnectionLost:
@@ -2911,120 +3034,114 @@ class CoreWorker:
             fut, state.resolving = state.resolving, None
             fut.set_result(None)
 
-    async def _push_actor_tasks(self, state: _ActorState, items):
-        """Push a burst of ready actor tasks as one multi-call frame.
+    def _sweep_cancelled_actor(self, tasks):
+        """Resolve any cancelled calls in `tasks`; returns the rest."""
+        still = []
+        for task in tasks:
+            tid = task.spec["task_id"]
+            if tid in self._cancelled:
+                self._store_task_exception(task.spec, exc.TaskCancelledError(
+                    f"{task.spec['method']} cancelled"))
+                self._release_task_pins(task)
+                self._cancelled.discard(tid)
+            else:
+                still.append(task)
+        return still
 
-        Same per-task semantics as _push_actor_task (cancel checks, retry
-        across restarts per retries_left, death-cause reporting) — only the
-        wire framing is shared. Each sub-call's reply resolves its own
-        future, so a slow method never delays another's result."""
+    async def _push_actor_batch(self, state: _ActorState, items):
+        """Push a burst of ready actor calls as one submit_batch frame
+        (pre-encoded prefix + per-call deltas); results return as
+        coalesced complete_batch frames on the same connection.
+
+        Same per-call semantics as _push_actor_task (cancel checks, retry
+        across restarts per retries_left, death-cause reporting — see
+        _actor_tasks_lost) — only the framing and completion plumbing are
+        shared.  The worker enqueues the batch in frame order onto the
+        same serial queue per-call pushes use, so a sequential actor
+        executes calls in submission order across batch boundaries."""
         if len(items) == 1:
             await self._push_actor_task(state, items[0][0], items[0][1])
             return
-        remaining = list(items)
-        while remaining:
-            pending = []
-            for spec, task in remaining:
-                tid = spec["task_id"]
-                if tid in self._cancelled:
-                    self._store_task_exception(spec, exc.TaskCancelledError(
-                        f"{spec['method']} cancelled"))
-                    self._release_task_pins(task)
-                    self._cancelled.discard(tid)
-                else:
-                    pending.append((spec, task))
-            if not pending:
+        tasks = [t for _s, t in items]
+        while True:
+            tasks = self._sweep_cancelled_actor(tasks)
+            if not tasks:
                 return
             try:
                 conn = await self._actor_conn(state)
             except exc.ActorDiedError as e:
-                for spec, task in pending:
-                    self._store_task_exception(spec, e)
+                for task in tasks:
+                    self._store_task_exception(task.spec, e)
                     self._release_task_pins(task)
                 return
             # Cancels may have landed while the connection resolved (an
             # actor restart can block _actor_conn for minutes); honor them
             # before the push, as the single-task path does.
-            if any(s["task_id"] in self._cancelled for s, _ in pending):
-                remaining = pending
-                continue
-            for spec, _ in pending:
-                self._inflight_actor_tasks[spec["task_id"]] = state
-            try:
-                futs = conn.call_many("push_actor_task",
-                                      [s for s, _ in pending])
-            except rpc.ConnectionLost:
+            tasks = self._sweep_cancelled_actor(tasks)
+            if not tasks:
+                return
+            if not conn.closed:
+                break
+            state.conn = None
+        if state.prefix is None:
+            state.prefix = protocol.spec_prefix_of(tasks[0].spec)
+            state.prefix_blob = protocol.encode_prefix(state.prefix)
+        t_push = time.monotonic()
+        for task in tasks:
+            tid = task.spec["task_id"]
+            self._inflight_actor_tasks[tid] = state
+            self._pending_replies[tid] = ("a", state, conn, task, t_push)
+        outcome, info = await self._submit_batch_with_ack(
+            conn, state.prefix, state.prefix_blob, tasks,
+            actor=True, abort_label=f"actor {state.actor_id.hex()[:8]}")
+        if outcome == "remote_error":
+            e, pending = info
+            for task in pending:
+                tid = task.spec["task_id"]
+                if self._pending_replies.pop(tid, None) is None:
+                    continue
+                self._inflight_actor_tasks.pop(tid, None)
+                self._store_task_exception(task.spec, exc.RayError(
+                    f"actor push failed: {e}"))
+                self._release_task_pins(task)
+        elif outcome == "conn_lost":
+            if state.conn is conn:
                 state.conn = None
-                for spec, _ in pending:
-                    self._inflight_actor_tasks.pop(spec["task_id"], None)
-                remaining = pending
-                continue
-            # Handle replies CONCURRENTLY: each sub-call's reply is handled
-            # the moment it resolves — awaiting the futures in list order
-            # would delay a fast call's result behind a slow earlier one
-            # in the same frame.  Done-callbacks instead of a coroutine per
-            # sub-call: a Task costs ~5us to create+schedule, a callback
-            # runs inline when the reply frame resolves the future.
-            lost: list = []
-            n_left = len(pending)
-            all_done = self.loop.create_future()
+            leftovers = [t for t in tasks
+                         if self._pending_replies.pop(t.spec["task_id"],
+                                                      None) is not None]
+            if leftovers:
+                await self._actor_tasks_lost(state, leftovers)
 
-            def _one_cb(fut, spec, task):
-                nonlocal n_left
-                # The n_left decrement must be unconditional: an exception
-                # escaping a done-callback goes to the loop's exception
-                # handler, and a skipped decrement would leave all_done
-                # unresolved — wedging this actor's submit pipeline.
-                try:
-                    tid = spec["task_id"]
-                    self._inflight_actor_tasks.pop(tid, None)
-                    try:
-                        reply = fut.result()
-                    except rpc.ConnectionLost:
-                        lost.append((spec, task))
-                    except Exception as e:  # infra RemoteError: fail task
-                        self._store_task_exception(spec, exc.RayError(
-                            f"actor push failed: {e}"))
-                        self._release_task_pins(task)
-                    else:
-                        self._handle_reply(spec, task, reply)
-                except Exception:
-                    logger.exception("reply handling failed for %s",
-                                     spec.get("method"))
-                finally:
-                    n_left -= 1
-                    if n_left == 0 and not all_done.done():
-                        all_done.set_result(None)
-
-            for (s, t), f in zip(pending, futs):
-                f.add_done_callback(
-                    lambda fut, s=s, t=t: _one_cb(fut, s, t))
-            await all_done
-            retry, death_cause = [], None
-            for spec, task in lost:
-                tid = spec["task_id"]
-                if tid in self._cancelled:
-                    self._store_task_exception(
-                        spec, exc.TaskCancelledError(
-                            f"{spec['method']} cancelled"))
-                    self._release_task_pins(task)
-                    self._cancelled.discard(tid)
-                elif spec["retries_left"] > 0:
-                    spec["retries_left"] -= 1
-                    self._stream_reset_for_retry(spec)
-                    retry.append((spec, task))
-                else:
-                    if death_cause is None:
-                        death_cause = await self._actor_death_cause(
-                            state.actor_id)
-                    self._store_task_exception(spec, exc.ActorDiedError(
-                        f"actor {state.actor_id.hex()[:8]} died during "
-                        f"{spec['method']}"
-                        + (f": {death_cause}" if death_cause else "")))
-                    self._release_task_pins(task)
-            if lost:
-                state.conn = None
-            remaining = retry
+    async def _actor_tasks_lost(self, state: _ActorState, tasks):
+        """The actor's connection died with these calls awaiting
+        completion: honor cancels, retry per retries_left across the
+        restart (re-entering through the reconnect-aware single-call
+        path), and fail the rest with the GCS-recorded death cause (one
+        lookup for the whole burst)."""
+        death_cause = None
+        for task in tasks:
+            spec = task.spec
+            tid = spec["task_id"]
+            self._inflight_actor_tasks.pop(tid, None)
+            if tid in self._cancelled:
+                self._store_task_exception(spec, exc.TaskCancelledError(
+                    f"{spec['method']} cancelled"))
+                self._release_task_pins(task)
+                self._cancelled.discard(tid)
+            elif spec["retries_left"] > 0:
+                spec["retries_left"] -= 1
+                self._stream_reset_for_retry(spec)
+                self._spawn(self._push_actor_task(state, spec, task))
+            else:
+                if death_cause is None:
+                    death_cause = await self._actor_death_cause(
+                        state.actor_id)
+                self._store_task_exception(spec, exc.ActorDiedError(
+                    f"actor {state.actor_id.hex()[:8]} died during "
+                    f"{spec['method']}"
+                    + (f": {death_cause}" if death_cause else "")))
+                self._release_task_pins(task)
 
     async def _push_actor_task(self, state: _ActorState, spec, task):
         """Push with reconnect-after-restart: a ConnectionLost mid-call

@@ -1120,8 +1120,8 @@ def main():
     logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     from .node import install_daemon_profiler
     install_daemon_profiler("gcs")
-    from .auth import install_process_token
-    install_process_token()
+    from .auth import require_process_token
+    require_process_token("gcs")
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -78,6 +78,63 @@ def make_task_spec(
     }
 
 
+# --------------------------------------------------------------------------
+# Pre-encoded spec prefixes (submit/complete fast path; see
+# docs/control_plane.md).  A task spec splits into a STABLE prefix — every
+# field that is constant across calls of one RemoteFunction / actor handle
+# (fn_id, resources, owner, scheduling strategy, runtime env, job) — and a
+# small per-call DELTA (task id, args, retries, seq, ...).  The prefix is
+# msgpack-encoded ONCE and shipped as an opaque blob inside each
+# submit_batch frame; the receiver decodes it once per batch (and caches
+# the decode by blob), then reconstructs each spec as {**prefix, **delta}.
+# This removes the per-call serialize/deserialize of the ~16 stable fields
+# that dominated control-plane CPU under task fan-out.
+# --------------------------------------------------------------------------
+
+# Fields that may differ between two tasks sharing a prefix.  Everything
+# else MUST be byte-identical across the batch (guaranteed by grouping:
+# normal tasks batch per scheduling key + owner, actor tasks per handle).
+SPEC_VOLATILE = ("retries_left", "nreturns", "streaming", "trace",
+                 "method", "seq", "name")
+
+
+def spec_prefix_of(spec: dict) -> dict:
+    """Normalize one sample spec into the stable prefix every delta is
+    applied on top of: per-call fields reset to their cheapest defaults so
+    a large inline arg (or a task id) can never be frozen into the blob."""
+    p = dict(spec)
+    p["task_id"] = b""
+    p["args"] = []
+    p["retries_left"] = 0
+    p["seq"] = 0
+    p["trace"] = None
+    p["streaming"] = None
+    return p
+
+
+def spec_delta(prefix: dict, spec: dict) -> dict:
+    """Per-call wire delta: task id + args always, plus any volatile field
+    that differs from the prefix.  {**prefix, **delta} == spec exactly."""
+    d = {"task_id": spec["task_id"], "args": spec["args"]}
+    for k in SPEC_VOLATILE:
+        v = spec.get(k)
+        if v != prefix.get(k):
+            d[k] = v
+    return d
+
+
+def encode_prefix(prefix: dict) -> bytes:
+    """Pack the stable prefix once; the blob is reused verbatim on every
+    submit_batch frame (and is the receiver's decode-cache key)."""
+    import msgpack
+    return msgpack.packb(prefix, use_bin_type=True)
+
+
+def decode_prefix(blob: bytes) -> dict:
+    import msgpack
+    return msgpack.unpackb(blob, raw=False, strict_map_key=False)
+
+
 _tracing = None
 
 

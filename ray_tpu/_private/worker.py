@@ -100,6 +100,7 @@ def init(address: Optional[str] = None, *,
         # Driver spawned under a submitted job (or user exported the
         # address): join that cluster (reference: RAY_ADDRESS).
         address = os.environ["RAY_TPU_ADDRESS"]
+    address_was_auto = address == "auto"
     if address == "auto":
         address = read_cluster_address_file()
         if address is None:
@@ -128,8 +129,22 @@ def init(address: Optional[str] = None, *,
     else:
         # Attaching driver: the cluster's token comes from the env, a
         # token file, or the well-known local drop — install it before
-        # the first connect below.
-        auth.install_process_token()
+        # the first connect.  The drop is only trusted when the target
+        # IS the local cluster it was written for: address='auto', or an
+        # explicit address equal to the one in the cluster address file
+        # (head start writes the pair together).  Any other explicit
+        # address skips it — a stale token from an older local cluster
+        # would produce opaque ConnectionLost failures instead of a
+        # clear auth error.
+        local_attach = address_was_auto or \
+            address == read_cluster_address_file()
+        tok = auth.install_process_token(
+            allow_cluster_file=local_attach)
+        if tok is None and not auth.auth_disabled():
+            logger.warning(
+                "no cluster auth token resolved for %s; connection will "
+                "fail if the cluster requires one (set %s)", address,
+                auth.TOKEN_ENV)
         host, port = address.rsplit(":", 1)
         rt.gcs_address = (host, int(port))
         rt.is_external_cluster = True

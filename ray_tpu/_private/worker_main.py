@@ -74,6 +74,11 @@ class Executor:
         # must still find their not-yet-started entries (see
         # _resolve_queued_cancel).
         self._active_chunks: list = []
+        # Batched submit/complete fast path (f_submit_batch): decoded
+        # spec prefixes keyed by their wire blob, and per-connection
+        # completion buffers flushed once per loop tick.
+        self._prefix_cache: Dict[bytes, dict] = {}
+        self._cmpl_bufs: Dict[Any, list] = {}
 
     # ------------------------------------------------------------ helpers ---
     async def _load_function(self, fn_id: bytes):
@@ -229,6 +234,86 @@ class Executor:
             if not ok:
                 return rpc.FAST_FALLBACK
         return self._enqueue_serial(spec)
+
+    # --------------------------------------- batched submit/complete ----
+    # submit_batch: one frame carrying a msgpack-encoded STABLE spec
+    # prefix plus per-task deltas (see protocol.spec_prefix_of and
+    # docs/control_plane.md).  Tasks enqueue in frame order onto the SAME
+    # queues the per-call push handlers use — ordering, cancel, and
+    # execution semantics are identical — and the ack returns as soon as
+    # everything is enqueued.  Results ship back as coalesced
+    # complete_batch frames: one notify per loop tick per connection,
+    # applied owner-side in a single pass (core_worker._f_complete_batch).
+
+    _PREFIX_CACHE_MAX = 256
+
+    def f_submit_batch(self, conn, p):
+        pr = p["pr"]
+        prefix = self._prefix_cache.get(pr)
+        if prefix is None:
+            if len(self._prefix_cache) >= self._PREFIX_CACHE_MAX:
+                self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            prefix = self._prefix_cache[pr] = protocol.decode_prefix(pr)
+        is_actor = bool(p.get("a"))
+        # Per-connection dedup of task ids: a submitter whose ack was
+        # dropped (chaos / transient stall) resends the still-unfinished
+        # tasks on the same connection; re-enqueueing them would run the
+        # task twice and double-resolve the completion.  Bounded (resends
+        # only ever target recent ids) so a long-lived connection doesn't
+        # accumulate every task id it ever carried.
+        seen = getattr(conn, "_seen_batch_tids", None)
+        if seen is None:
+            seen = conn._seen_batch_tids = (set(), deque())
+        seen_set, seen_order = seen
+        accepted = 0
+        for d in p["t"]:
+            spec = dict(prefix)
+            spec.update(d)
+            tid = spec["task_id"]
+            if tid in seen_set:
+                continue
+            seen_set.add(tid)
+            seen_order.append(tid)
+            if len(seen_order) > 65536:
+                seen_set.discard(seen_order.popleft())
+            accepted += 1
+            if is_actor:
+                fut = self.f_push_actor_task(conn, spec)
+                if fut is rpc.FAST_FALLBACK:
+                    fut = rpc.spawn(self.h_push_actor_task(conn, spec))
+            else:
+                fut = self.f_push_task(conn, spec)
+            fut.add_done_callback(
+                lambda f, conn=conn, tid=tid:
+                self._queue_completion(conn, tid, f))
+        return {"n": accepted}
+
+    async def h_submit_batch(self, conn, p):
+        return self.f_submit_batch(conn, p)
+
+    def _queue_completion(self, conn, tid, fut):
+        try:
+            reply = fut.result()
+        except asyncio.CancelledError:
+            reply = {"status": "cancelled"}
+        except BaseException as e:  # noqa: BLE001 — infra failure must
+            #                         still resolve the owner's return refs
+            reply = self._error_reply(e, f"{type(e).__name__}: {e}")
+        buf = self._cmpl_bufs.get(conn)
+        if buf is None:
+            buf = self._cmpl_bufs[conn] = []
+            asyncio.get_running_loop().call_soon(
+                self._flush_completions, conn)
+        buf.append([tid, reply])
+
+    def _flush_completions(self, conn):
+        buf = self._cmpl_bufs.pop(conn, None)
+        if not buf or conn.closed:
+            return
+        try:
+            conn.notify("complete_batch", {"t": buf})
+        except rpc.RpcError:
+            pass    # owner gone; its conn-loss cleanup resolves the tasks
 
     # ------------------------------------------------------------ handlers --
     async def h_push_task(self, conn, spec):
@@ -1072,6 +1157,7 @@ async def amain():
     exec_handlers = {
         "push_task": executor.h_push_task,
         "push_actor_task": executor.h_push_actor_task,
+        "submit_batch": executor.h_submit_batch,
         "actor_init": executor.h_actor_init,
         "cancel_task": executor.h_cancel_task,
         "kill": executor.h_kill,
@@ -1082,6 +1168,7 @@ async def amain():
     fast_handlers = {
         "push_task": executor.f_push_task,
         "push_actor_task": executor.f_push_actor_task,
+        "submit_batch": executor.f_submit_batch,
     }
     core._server.fast_handlers = fast_handlers
     for c in core._server.connections:
@@ -1121,8 +1208,8 @@ def main():
     signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
     from .node import install_daemon_profiler
     install_daemon_profiler("worker")
-    from .auth import install_process_token
-    install_process_token()
+    from .auth import require_process_token
+    require_process_token("worker")
     try:
         asyncio.run(amain())
     except KeyboardInterrupt:

@@ -69,11 +69,23 @@ class ActorHandle:
         # when the original handle goes out of scope). Copies (serialized
         # handles, get_actor results) never terminate the actor.
         self._owned = owned
+        # Submit-cache (the actor-method arm of RemoteFunction's): one
+        # ActorMethod per name per handle instead of a fresh object per
+        # `handle.method` attribute access — under fan-out, `a.ping.remote()`
+        # was paying an allocation + 4 attribute writes per call.  The
+        # per-call wire prefix lives on the core's _ActorState.  This forms
+        # a handle<->method reference cycle, so an owned handle's __del__
+        # (actor termination) fires at the next gc cycle rather than on
+        # refcount zero — same visible semantics, slightly later.
+        self._method_cache: Dict[str, "ActorMethod"] = {}
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        m = self._method_cache.get(item)
+        if m is None:
+            m = self._method_cache[item] = ActorMethod(self, item)
+        return m
 
     @property
     def actor_id(self) -> bytes:

@@ -357,8 +357,8 @@ async def _amain(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8265)
     args = ap.parse_args(argv)
-    from .._private.auth import install_process_token
-    tok = install_process_token()
+    from .._private.auth import require_process_token
+    tok = require_process_token("dashboard")
     host, port = args.gcs_address.rsplit(":", 1)
     head = DashboardHead((host, int(port)), args.host, args.port)
     await head.start()

@@ -73,6 +73,14 @@ function fill(tbl, rows) {
 const tok = new URLSearchParams(location.search).get("token")
   || localStorage.getItem("ray_tpu_token");
 if (tok) localStorage.setItem("ray_tpu_token", tok);
+// Once stored, scrub the token from the address bar: a ?token= URL
+// persists in browser history, bookmarks, and request logs.  API
+// clients should prefer the Authorization header form.
+if (new URLSearchParams(location.search).has("token")) {
+  const clean = new URL(location.href);
+  clean.searchParams.delete("token");
+  history.replaceState(null, "", clean);
+}
 async function j(p) {
   const r = await fetch(p, tok
     ? {headers: {"Authorization": "Bearer " + tok}} : {});

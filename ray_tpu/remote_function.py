@@ -103,10 +103,24 @@ class RemoteFunction:
             renv = core.package_runtime_env_cached(self._runtime_env)
             key = protocol.scheduling_key(self._fn_id, resources, strat,
                                           renv)
+            # Pre-encoded spec prefix: every stable field of this
+            # function's task specs, built and msgpack-encoded ONCE.
+            # Each .remote() then copies the template and writes only
+            # task_id/args/retries, and each submit_batch frame carries
+            # the blob verbatim instead of re-serializing ~16 fields per
+            # task (see docs/control_plane.md).
+            nret = self._num_returns
+            prefix = protocol.spec_prefix_of(protocol.make_task_spec(
+                task_id=b"", job_id=core.job_id, fn_id=self._fn_id,
+                args=[], nreturns=1 if isinstance(nret, str) else nret,
+                owner_addr=list(core.address), resources=resources,
+                retries_left=0, scheduling_strategy=strat,
+                runtime_env=renv, name=self._name, streaming=None))
+            spec_prefix = (prefix, protocol.encode_prefix(prefix))
             # Single assignment: a racing thread sees all or nothing.
             cache = self._submit_cache = (core, max_retries, resources,
-                                          strat, renv, key)
-        _, max_retries, resources, strat, renv, key = cache
+                                          strat, renv, key, spec_prefix)
+        _, max_retries, resources, strat, renv, key, spec_prefix = cache
         refs = core.submit_task(
             fn=self._fn, fn_id=self._fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns, resources=resources,
@@ -115,7 +129,7 @@ class RemoteFunction:
             runtime_env=renv, name=self._name,
             fn_blob=self._export_blob,
             generator_backpressure=self._generator_backpressure,
-            sched_key=key)
+            sched_key=key, spec_prefix=spec_prefix)
         # num_returns="streaming" yields a single ObjectRefGenerator.
         if self._num_returns == 1 or isinstance(self._num_returns, str):
             return refs[0]

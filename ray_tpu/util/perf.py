@@ -439,6 +439,149 @@ UNITS = {
 }
 
 
+# Metrics whose cost is dominated by the task-submission control plane
+# (spec encode, push/complete framing, refcount + memory-store updates):
+# the regression gate of `--check` watches exactly these.
+CONTROL_PLANE_METRICS = (
+    "single_client_tasks_sync",
+    "single_client_tasks_async",
+    "1_1_actor_calls_sync",
+    "1_1_actor_calls_async",
+    "multi_client_tasks_async",
+    "n_n_actor_calls_async",
+    "single_client_put_calls",
+    "single_client_get_calls",
+    "single_client_wait_1k_refs",
+    "placement_group_create_removal",
+)
+
+
+def _latest_committed_bench(repo_root: str = "."):
+    """Parse the newest committed BENCH_*.json: its `tail` field embeds the
+    compact micro dict as `"micro_value_vs_ref": {...}`.  Returns
+    (filename, {metric: value}) or (None, None)."""
+    import glob
+    import os
+    import re
+    files = sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json")))
+    if not files:
+        return None, None
+    path = files[-1]
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError:
+        return None, None
+    # BENCH_*.json wraps the bench output: the compact micro dict is
+    # embedded in its "tail" string field.  Prefer the decoded field
+    # (handles the JSON string escaping); fall back to a raw scan.
+    try:
+        tail = json.loads(raw).get("tail") or raw
+    except (json.JSONDecodeError, AttributeError):
+        tail = raw
+    m = re.search(r'"micro_value_vs_ref"\s*:\s*', tail)
+    if m is None:
+        return path, None
+    try:
+        table, _ = json.JSONDecoder().raw_decode(tail, m.end())
+    except json.JSONDecodeError:
+        return path, None
+    host = None
+    mh = re.search(r'"micro_host"\s*:\s*', tail)
+    if mh is not None:
+        try:
+            host, _ = json.JSONDecoder().raw_decode(tail, mh.end())
+        except json.JSONDecodeError:
+            pass
+    # Entries are [value, vs_ref, ...] lists (bench.py compact form).
+    return path, ({k: (v[0] if isinstance(v, list) else v)
+                   for k, v in table.items()}, host)
+
+
+def _host_fingerprint():
+    """Cheap host-class probe matching the fields bench.py records in
+    micro_host: core count plus a ~0.15s memcpy-bandwidth sample (two
+    hosts with the same core count can differ 5-10x in speed class —
+    absolute ops/s gates are meaningless across that gap)."""
+    import multiprocessing
+    buf = bytearray(64 << 20)
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 0.15:
+        bytes(buf)
+        n += 1
+    gibs = n * (64 / 1024) / (time.perf_counter() - t0)
+    return {"cpu_cores": multiprocessing.cpu_count(),
+            "memcpy_gibs": round(gibs, 2)}
+
+
+def _host_matches(base_host, this_host, speed_slack: float = 1.5) -> bool:
+    if base_host.get("cpu_cores") not in (None,
+                                          this_host["cpu_cores"]):
+        return False
+    base_gibs = base_host.get("memcpy_gibs")
+    if base_gibs:
+        ratio = this_host["memcpy_gibs"] / base_gibs
+        if not (1.0 / speed_slack <= ratio <= speed_slack):
+            return False
+    return True
+
+
+def check_against_committed(min_time_s: float = 2.0,
+                            threshold: float = 0.20,
+                            repo_root: str = ".",
+                            force: bool = False) -> int:
+    """CI gate: run the control-plane micro suite and compare against the
+    last committed BENCH_*.json.  Returns a non-zero exit code when any
+    control-plane metric regressed more than `threshold` (host variance
+    makes tighter gates flaky; 20% catches real control-plane breaks).
+
+    Absolute ops/s only compare meaningfully on the host class that
+    recorded the baseline, so when the committed file carries a
+    `micro_host` fingerprint that doesn't match this machine the gate
+    reports informationally and exits 0 (pass force=True to gate
+    anyway)."""
+    path, parsed = _latest_committed_bench(repo_root)
+    committed, base_host = parsed if parsed else (None, None)
+    if not committed:
+        print(json.dumps({"check": "skip",
+                          "reason": f"no parseable BENCH_*.json ({path})"}))
+        return 0
+    this_host = _host_fingerprint()
+    host_mismatch = base_host is not None and \
+        not _host_matches(base_host, this_host)
+    results = run_microbenchmarks(min_time_s=min_time_s,
+                                  only=set(CONTROL_PLANE_METRICS))
+    failures = []
+    for name in CONTROL_PLANE_METRICS:
+        if name not in results or name not in committed:
+            continue
+        now, ref = results[name]["value"], committed[name]
+        ratio = now / ref if ref else 1.0
+        row = {"metric": name, "now": now, "committed": ref,
+               "ratio": round(ratio, 3)}
+        if ratio < 1.0 - threshold:
+            row["REGRESSION"] = True
+            failures.append(name)
+        print(json.dumps(row))
+    if failures:
+        if host_mismatch and not force:
+            print(json.dumps({
+                "check": "host-mismatch", "baseline": path,
+                "baseline_host": base_host,
+                "this_host": this_host,
+                "would_have_regressed": failures,
+                "note": "absolute ops/s not comparable across hosts; "
+                        "re-record the baseline here or pass --check-force"}))
+            return 0
+        print(json.dumps({"check": "FAIL", "baseline": path,
+                          "regressed": failures,
+                          "threshold": threshold}))
+        return 1
+    print(json.dumps({"check": "ok", "baseline": path}))
+    return 0
+
+
 def warmup_cluster(n: int = 200) -> None:
     """Spawn/prestart the worker pool and export the bench functions so
     measurements see steady state, not process-spawn latency."""
@@ -488,6 +631,14 @@ def main(argv=None):
     ap.add_argument("--compact", action="store_true",
                     help="print one JSON dict {name: [value, vs_ref]} "
                          "(consumed by bench.py)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: compare the control-plane metrics "
+                         "against the last committed BENCH_*.json and exit "
+                         "non-zero on a >20%% regression in any of them")
+    ap.add_argument("--check-threshold", type=float, default=0.20)
+    ap.add_argument("--check-force", action="store_true",
+                    help="gate even when the committed baseline was "
+                         "recorded on a different host class")
     args = ap.parse_args(argv)
     owns = not ray_tpu.is_initialized()
     if owns:
@@ -496,6 +647,11 @@ def main(argv=None):
         import multiprocessing
         ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
     try:
+        if args.check:
+            raise SystemExit(check_against_committed(
+                min_time_s=args.min_time_s,
+                threshold=args.check_threshold,
+                force=args.check_force))
         results = run_microbenchmarks(min_time_s=args.min_time_s)
         if args.compact:
             # [value, vs_ref, cpu_saturation, cpu_by_role] — saturation

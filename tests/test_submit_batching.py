@@ -1,0 +1,172 @@
+"""Batched, pre-encoded task submission (docs/control_plane.md).
+
+Covers the submit/complete fast path: prefix/delta wire split
+(protocol.spec_prefix_of / spec_delta), batch-boundary ordering for
+sequential actors, per-task cancel and per-task retry inside a coalesced
+batch, and the adaptive in-flight window.  Chaos-drop of submit_batch
+frames lives in test_chaos.py with the rest of the fault injection.
+"""
+
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol
+from ray_tpu._private.core_worker import PIPELINE_DEPTH, CoreWorker, _KeyState
+from ray_tpu.exceptions import TaskCancelledError
+
+
+# ------------------------------------------------------ prefix/delta ----
+
+def _sample_spec(**over):
+    base = dict(
+        task_id=b"T" * 12, job_id=b"\x00\x00\x00\x01", fn_id=b"F" * 16,
+        args=[{"v": b"payload"}], nreturns=1,
+        owner_addr=["127.0.0.1", 1234], resources={"CPU": 1.0},
+        retries_left=3, scheduling_strategy=None, runtime_env=None,
+        name="fn", streaming=None)
+    base.update(over)
+    return protocol.make_task_spec(**base)
+
+
+def test_prefix_delta_roundtrip_normal_task():
+    spec = _sample_spec()
+    prefix = protocol.spec_prefix_of(spec)
+    # The prefix froze nothing per-call.
+    assert prefix["task_id"] == b"" and prefix["args"] == []
+    delta = protocol.spec_delta(prefix, spec)
+    assert {**prefix, **delta} == spec
+    # The delta carries only what varies.
+    assert set(delta) <= {"task_id", "args"} | set(protocol.SPEC_VOLATILE)
+    # Wire roundtrip of the encoded prefix.
+    assert protocol.decode_prefix(protocol.encode_prefix(prefix)) == prefix
+
+
+def test_prefix_delta_roundtrip_actor_and_aliases():
+    prefix = protocol.spec_prefix_of(_sample_spec(
+        fn_id=b"", actor_id=b"A" * 16, method="ping", seq=1, name="ping",
+        resources={}))
+    # Later calls of OTHER methods on the same handle reconstruct exactly.
+    for method, seq, retries in [("ping", 2, 0), ("work", 3, 5)]:
+        spec = _sample_spec(fn_id=b"", actor_id=b"A" * 16, method=method,
+                            seq=seq, name=method, retries_left=retries,
+                            resources={})
+        delta = protocol.spec_delta(prefix, spec)
+        assert {**prefix, **delta} == spec
+    # A name alias sharing the prefix (options(name=...)) still travels.
+    spec = _sample_spec(name="other_name")
+    p2 = protocol.spec_prefix_of(_sample_spec())
+    assert {**p2, **protocol.spec_delta(p2, spec)} == spec
+
+
+def test_delta_reencodes_mutated_state():
+    """Retries mutate retries_left on the spec dict; the delta is built at
+    push time, so the wire form must follow the mutation (pre-encoding
+    discipline rule 1)."""
+    spec = _sample_spec(retries_left=2)
+    prefix = protocol.spec_prefix_of(spec)
+    spec["retries_left"] -= 1
+    assert {**prefix, **protocol.spec_delta(prefix, spec)}[
+        "retries_left"] == 1
+
+
+# ------------------------------------------------- adaptive window ------
+
+def test_adaptive_window_grows_and_shrinks():
+    core = SimpleNamespace(_max_inflight=64)
+    state = _KeyState({"CPU": 1.0}, None)
+    assert state.window == PIPELINE_DEPTH
+    # Fast completions: exponential growth to the cap.
+    for _ in range(10):
+        CoreWorker._note_task_latency(core, state, 0.001)
+    assert state.window == 64
+    # Slow completions: decay back to the floor.
+    for _ in range(10):
+        CoreWorker._note_task_latency(core, state, 2.0)
+    assert state.window == PIPELINE_DEPTH
+    assert state.avg_task_s > 0.25
+
+
+# ---------------------------------------------- cluster semantics -------
+
+def test_sequential_actor_order_across_batch_boundaries(ray_start_regular):
+    """Calls submitted in one burst cross the per-flush batch cap (256)
+    and several drain ticks; a sequential actor must still execute them
+    in submission order."""
+    @ray_tpu.remote(num_cpus=0)
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, i):
+            self.items.append(i)
+            return i
+
+        def items_so_far(self):
+            return list(self.items)
+
+    log = Log.remote()
+    n = 600
+    refs = [log.append.remote(i) for i in range(n)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(n))
+    assert ray_tpu.get(log.items_so_far.remote(),
+                       timeout=60) == list(range(n))
+    ray_tpu.kill(log)
+
+
+def test_cancel_inside_coalesced_batch(ray_start_regular):
+    """One call cancelled out of the middle of a coalesced burst resolves
+    to TaskCancelledError; its batch-mates complete normally."""
+    @ray_tpu.remote(num_cpus=0)
+    class Slow:
+        def first(self):
+            time.sleep(3)
+            return "first"
+
+        def quick(self, i):
+            return i
+
+    a = Slow.remote()
+    ray_tpu.get(a.quick.remote(-1), timeout=60)   # actor is up
+    blocker = a.first.remote()
+    refs = [a.quick.remote(i) for i in range(10)]
+    victim = refs[5]
+    time.sleep(0.3)               # let the batch reach the worker queue
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    rest = [r for i, r in enumerate(refs) if i != 5]
+    assert ray_tpu.get(rest, timeout=60) == [i for i in range(10) if i != 5]
+    assert ray_tpu.get(blocker, timeout=60) == "first"
+    ray_tpu.kill(a)
+
+
+def test_retry_inside_coalesced_batch(ray_start_isolated, tmp_path):
+    """A worker death mid-batch retries every unfinished call of the batch
+    (retries_left permitting) against the actor's next incarnation."""
+    flag = str(tmp_path / "died_once")
+
+    @ray_tpu.remote(num_cpus=0, max_restarts=1, max_task_retries=1)
+    class Flaky:
+        def ping(self, i):
+            return i
+
+        def boom(self, flag_path):
+            if not os.path.exists(flag_path):
+                with open(flag_path, "w") as f:
+                    f.write("x")
+                os._exit(1)
+            return "survived"
+
+    a = Flaky.remote()
+    ray_tpu.get(a.ping.remote(-1), timeout=60)
+    # One coalesced burst: pings, a killer in the middle, more pings.
+    head = [a.ping.remote(i) for i in range(5)]
+    killer = a.boom.remote(flag)
+    tail = [a.ping.remote(i) for i in range(5, 10)]
+    assert ray_tpu.get(killer, timeout=120) == "survived"
+    assert ray_tpu.get(head + tail, timeout=120) == list(range(10))
+    ray_tpu.kill(a)
