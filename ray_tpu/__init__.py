@@ -30,7 +30,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "kill", "cancel", "get_actor", "nodes", "drain_node",
+    "cluster_resources",
     "available_resources", "ObjectRef", "ObjectRefGenerator", "ActorHandle",
     "exceptions", "method", "timeline", "get_runtime_context",
 ]
@@ -150,6 +151,28 @@ def usage_stats() -> dict:
 def nodes() -> List[dict]:
     core = _core()
     return core._run(core.gcs.call("get_nodes", {}))
+
+
+def drain_node(node_id: bytes, *, reason: str = "manual",
+               deadline_s: Optional[float] = None,
+               wait: bool = True) -> bool:
+    """Gracefully drain a node ahead of a planned departure (maintenance
+    event, spot preemption warning, scale-down).  Two-phase: the node is
+    marked DRAINING (no new work lands on it), its restartable actors are
+    restarted elsewhere before teardown, sole primary object copies are
+    migrated to a live peer, and in-flight leases get until ``deadline_s``
+    to finish; only then does the node transition to DEAD (reference:
+    autoscaler.proto DrainNode).  ``reason`` is one of ``preemption`` |
+    ``idle`` | ``manual``.  With ``wait=True`` (default) blocks until the
+    drain completes; returns False on a drain that missed its deadline
+    wait window."""
+    core = _core()
+    payload: Dict[str, Any] = {"node_id": node_id, "reason": reason,
+                               "wait": wait}
+    if deadline_s is not None:
+        payload["deadline_s"] = float(deadline_s)
+    timeout = (30.0 if deadline_s is None else deadline_s) + 30.0
+    return core._run(core.gcs.call("drain_node", payload, timeout=timeout))
 
 
 def cluster_resources() -> Dict[str, float]:

@@ -202,6 +202,23 @@ class NodeAgent:
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._shutdown = False
+        # Graceful drain state (reference: raylet drain / autoscaler
+        # DrainNode): reason string while draining — new leases/actors/
+        # bundles are refused (with spillback), in-flight leases finish,
+        # pinned primaries migrate to a peer before the node exits.
+        # _drain_deadline bounds the state itself: well past it, a drain
+        # whose orchestrator vanished (GCS crash mid-drain) is abandoned
+        # rather than leaving a permanent zombie (see _report_loop).
+        self._draining: Optional[str] = None
+        self._drain_deadline: float = 0.0
+        # Primaries this node adopted from a draining peer (oid set): a
+        # later owner free must also clear the cluster-wide "migrated"
+        # KV record the drain left behind.
+        self._adopted: Set[bytes] = set()
+        # oid -> destination agent address for primaries migrated OFF this
+        # node while it drains: frees arriving here before teardown are
+        # forwarded so the adopted copy (and its pin) can't leak.
+        self._migrated_away: Dict[bytes, tuple] = {}
         # worker_id -> {"reason", "ts"}: deaths caused by the OOM monitor,
         # queried by owners via h_worker_fate for typed errors.
         self._oom_kills: Dict[bytes, dict] = {}
@@ -226,6 +243,8 @@ class NodeAgent:
             "reserve_bundles": self.h_reserve_bundles,
             "commit_bundle": self.h_commit_bundle,
             "return_bundle": self.h_return_bundle,
+            "drain": self.h_drain,
+            "adopt_primary": self.h_adopt_primary,
             "pin_object": self.h_pin_object,
             "pin_transfer": self.h_pin_transfer,
             "unpin_object": self.h_unpin_object,
@@ -256,24 +275,10 @@ class NodeAgent:
         addr = await self._server.start_tcp(self.host, 0)
         self.address = addr
 
-        async def _register(conn):
-            # Runs on every (re)connect: a restarted GCS replays its
-            # journal with nodes marked not-alive; re-registering brings
-            # this node back (reference: raylet re-registration after
-            # RayletNotifyGCSRestart, core_worker.proto:467).
-            await conn.call("register_node", {
-                "node_id": self.node_id,
-                "address": list(addr),
-                "resources": self.resources_total,
-                "labels": self.labels,
-                "store_path": self.store_path,
-                "session_dir": self.session_dir,
-            })
-
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, name="agent->gcs",
             handlers={"pubsub": self._on_pubsub},
-            on_reconnect=_register)
+            on_reconnect=self._register_gcs)
         await self.gcs.ensure()
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._parked_lease_loop()))
@@ -302,6 +307,38 @@ class NodeAgent:
             wh.last_idle = time.monotonic()
             self.idle_workers.append(wh)
 
+    async def _register_gcs(self, conn):
+        """Registration, run on every (re)connect: a restarted GCS replays
+        its journal with nodes marked not-alive; re-registering brings
+        this node back (reference: raylet re-registration after
+        RayletNotifyGCSRestart, core_worker.proto:467).  Reads self.node_id
+        at call time so a fresh-id rejoin reuses it unchanged."""
+        await conn.call("register_node", {
+            "node_id": self.node_id,
+            "address": list(self.address),
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "store_path": self.store_path,
+            "session_dir": self.session_dir,
+        })
+
+    async def _rejoin_with_fresh_id(self):
+        """The GCS rejected our heartbeat: this node was marked dead while
+        the agent was actually alive (health-check false positive — e.g. a
+        GC pause outlived the failure budget).  Death is permanent for
+        consumers (actors restarted elsewhere, primaries written off), so
+        zombieing on the old id helps nobody: rejoin as a FRESH node id —
+        same agent process, same store, new identity (reference: a
+        restarted raylet likewise registers a new node id)."""
+        from .ids import NodeID
+        old = self.node_id
+        self.node_id = NodeID.from_random().binary()
+        logger.warning(
+            "GCS rejected heartbeats for node %s (marked dead); "
+            "re-registering as fresh node %s",
+            old.hex()[:8], self.node_id.hex()[:8])
+        await self._register_gcs(self.gcs)
+
     async def _report_loop(self):
         cfg = get_config()
         period = cfg.resource_report_period_ms / 1000.0
@@ -309,10 +346,39 @@ class NodeAgent:
             await asyncio.sleep(period)
             try:
                 if self.gcs and not self.gcs.closed:
-                    await self.gcs.call("report_resources", {
+                    ok = await self.gcs.call("report_resources", {
                         "node_id": self.node_id,
                         "available": self.resources_available,
                     })
+                    if ok is False and not self._shutdown \
+                            and self._draining is None:
+                        # Rejected = we're listed dead.  (Never during a
+                        # drain: its mark-dead is intentional and the
+                        # shutdown notify is on the way.)
+                        await self._rejoin_with_fresh_id()
+                    elif self._draining is not None and not self._shutdown \
+                            and time.monotonic() > \
+                            self._drain_deadline + 30.0:
+                        # Well past the drain deadline with no teardown:
+                        # the orchestrator is gone (GCS crash mid-drain).
+                        if ok is False:
+                            # The drain DID conclude (we're dead at the
+                            # GCS) but the shutdown notify was lost — exit
+                            # as it would have made us.
+                            logger.warning(
+                                "drain concluded but teardown notify lost; "
+                                "exiting")
+                            await self.h_shutdown(None, {"graceful": True})
+                        else:
+                            # Still alive at the GCS: the drain was
+                            # abandoned — return to service instead of
+                            # zombieing (refusing leases forever).
+                            logger.warning(
+                                "drain (%s) abandoned past deadline; "
+                                "returning node to service", self._draining)
+                            self._draining = None
+                            self._drain_deadline = 0.0
+                            self._kick_parked()
             except Exception:
                 # One slow/failed report (GCS busy, reconnecting, ...) must
                 # never kill the loop: a dead report loop freezes this
@@ -698,13 +764,23 @@ class NodeAgent:
         wh = await self._spawn_worker(env_extra, needs_tpu=needs_tpu,
                                       cwd=cwd)
         cfg = get_config()
-        try:
-            await asyncio.wait_for(wh.registered.wait(),
-                                   cfg.worker_register_timeout_s)
-        except asyncio.TimeoutError:
-            wh.proc.kill()
-            raise rpc.RpcError("worker failed to register in time")
-        return wh
+        deadline = time.monotonic() + cfg.worker_register_timeout_s
+        while True:
+            try:
+                await asyncio.wait_for(wh.registered.wait(), 0.5)
+                return wh
+            except asyncio.TimeoutError:
+                # A worker killed between spawn and registration (crash,
+                # chaos SIGKILL) must fail the grant NOW — waiting out the
+                # full registration timeout stalls the lease request (and
+                # its parked successors) for a minute.
+                if wh.proc.poll() is not None:
+                    raise rpc.RpcError(
+                        f"worker died during startup (exit "
+                        f"{wh.proc.returncode})")
+                if time.monotonic() >= deadline:
+                    wh.proc.kill()
+                    raise rpc.RpcError("worker failed to register in time")
 
     @staticmethod
     def _try_acquire_from(avail: Dict[str, float],
@@ -763,6 +839,16 @@ class NodeAgent:
         """One grant attempt. Returns a reply dict, or None when the
         request should park (feasible here, saturated, no spillback)."""
         resources = p.get("resources", {})
+        if self._draining is not None:
+            # Draining nodes accept no new work; point the submitter at a
+            # live peer so its lease pump re-routes instead of spinning
+            # (reference: raylet lease rejection while draining).
+            spill = None
+            if not p.get("placement_group"):
+                spill = await self._find_spillback(resources)
+            return {"granted": False,
+                    "reason": f"node draining ({self._draining})",
+                    "spillback": spill, "retry_after_ms": 200}
         pg = p.get("placement_group")
         bundle_key = None
         if pg:
@@ -924,7 +1010,8 @@ class NodeAgent:
         cands = [(tuple(n["address"]), n["resources_total"],
                   n["resources_available"])
                  for n in nodes
-                 if n["alive"] and bytes(n["node_id"]) != self.node_id]
+                 if policy.targetable(n)
+                 and bytes(n["node_id"]) != self.node_id]
         best = policy.hybrid_pick(cands, resources)
         return list(best) if best else None
 
@@ -1062,6 +1149,8 @@ class NodeAgent:
         """Lease a dedicated worker and instantiate the actor in it
         (reference: GcsActorScheduler leasing from raylet + PushTask of the
         creation task)."""
+        if self._draining is not None:
+            raise rpc.RpcError(f"node draining ({self._draining})")
         # Idempotence across GCS restarts: if this actor already has a
         # live worker here (the previous create's reply was lost with the
         # GCS), return it instead of leasing a second process.
@@ -1130,6 +1219,117 @@ class NodeAgent:
         await self.gcs.call("actor_failed", p)
         return True
 
+    # ------------------------------------------------------ graceful drain --
+    async def h_drain(self, conn, p):
+        """Agent half of the two-phase node drain (GCS h_drain_node):
+        stop granting leases (parked requests resolve with spillback),
+        migrate pinned primary objects to a live peer, then wait — bounded
+        by the deadline — for in-flight non-actor leases to finish.  Actor
+        workers keep serving until the final teardown: the GCS restarts
+        their actors elsewhere concurrently, and clients fail over on
+        connection loss."""
+        reason = p.get("reason") or "manual"
+        deadline = time.monotonic() + float(p.get("deadline_s", 30.0))
+        if self._draining is None:
+            self._draining = reason
+            logger.warning("node %s draining (%s)",
+                           self.node_id.hex()[:8], reason)
+            self._kick_parked()
+        self._drain_deadline = max(self._drain_deadline, deadline)
+        migrated = await self._migrate_primaries(deadline)
+        while time.monotonic() < deadline:
+            if not any(not wh.is_actor for wh in self.leases.values()):
+                break
+            await asyncio.sleep(0.1)
+        # Second pass: leases that finished during the wait may have
+        # pinned fresh task returns; push those off-node too.
+        migrated += await self._migrate_primaries(deadline)
+        busy = sum(1 for wh in self.leases.values() if not wh.is_actor)
+        return {"migrated": migrated, "busy_leases": busy}
+
+    async def _migrate_primaries(self, deadline: float) -> int:
+        """Republish this node's pinned primary copies to a live peer and
+        record each move in the GCS KV (ns 'migrated') so owners repoint
+        instead of running destructive lineage re-execution.  Spilled
+        primaries migrate the same way — the peer pulls them straight out
+        of the spill file via the chunked transfer path."""
+        oids = [oid for oid in list(self.pinned)
+                if oid not in self._migrated_away]
+        if not oids:
+            return 0
+        try:
+            nodes = await self.gcs.call("get_nodes", {})
+        except (rpc.RpcError, asyncio.TimeoutError):
+            return 0
+        from . import scheduling_policy as policy
+        peers = [n for n in nodes
+                 if policy.targetable(n)
+                 and bytes(n["node_id"]) != self.node_id]
+        if not peers:
+            logger.warning(
+                "drain: no live peer for %d pinned primaries; owners fall "
+                "back to external restore or lineage re-execution",
+                len(oids))
+            return 0
+        migrated = 0
+        for i, oid in enumerate(oids):
+            if time.monotonic() >= deadline:
+                break
+            for attempt in range(len(peers)):
+                n = peers[(i + attempt) % len(peers)]
+                addr = tuple(n["address"])
+                conns = await self._pull_peers([addr])
+                if not conns:
+                    continue
+                timeout = max(1.0, min(60.0, deadline - time.monotonic()))
+                try:
+                    ok = await conns[0].call("adopt_primary", {
+                        "object_id": oid,
+                        "from_addrs": [list(self.address)],
+                        "priority": 0}, timeout=timeout)
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    continue
+                if not ok:
+                    continue
+                # Record the destination BEFORE the KV write: even if the
+                # write fails (owners then fall back to lineage), a later
+                # migration pass must not re-adopt at a different peer and
+                # orphan this pinned copy, and frees must still forward.
+                self._migrated_away[oid] = addr
+                try:
+                    await self.gcs.call("kv_put", {
+                        "ns": "migrated", "key": oid.hex(),
+                        "value": json.dumps(list(addr)).encode(),
+                        "overwrite": True})
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    break    # copy exists but owners can't find it; move on
+                migrated += 1
+                break
+        return migrated
+
+    async def h_adopt_primary(self, conn, p):
+        """Become the primary holder of an object migrating off a draining
+        node: pull the bytes (shm, or disk when the arena is full), take
+        one owner pin so they can't be evicted before the owner repoints,
+        and remember the adoption so a later free also clears the
+        cluster-wide 'migrated' KV record."""
+        oid = p["object_id"]
+        if not await self.h_pull_object(conn, p):
+            return False
+        self._disk_cached.pop(oid, None)   # a primary now, not a cache
+        if not await self.h_pin_object(conn, {"object_id": oid}):
+            return False
+        self._adopted.add(oid)
+        return True
+
+    async def _forward_free(self, addr: tuple, oid: bytes) -> None:
+        try:
+            conns = await self._pull_peers([tuple(addr)])
+            if conns:
+                await conns[0].call("free_objects", {"object_ids": [oid]})
+        except (rpc.RpcError, asyncio.TimeoutError):
+            pass
+
     # ------------------------------------------------------ placement groups --
     def _reserve_one(self, pg_id: bytes, bundle_index: int,
                      resources: Dict[str, float]) -> Optional[bool]:
@@ -1139,6 +1339,8 @@ class NodeAgent:
         key = (pg_id, bundle_index)
         if key in self.bundles:
             return None
+        if self._draining is not None:
+            return False        # no new reservations on a departing node
         if not self._try_acquire(resources):
             return False
         self.bundles[key] = {"total": dict(resources),
@@ -1234,6 +1436,19 @@ class NodeAgent:
                     pass
             self._ext_delete(oid)
             self.store.delete(oid)
+            if oid in self._adopted:
+                # Adopted-from-drain primary freed: clear the cluster-wide
+                # migration record so nothing repoints to freed bytes.
+                self._adopted.discard(oid)
+                if self.gcs is not None:
+                    rpc.spawn(self.gcs.call(
+                        "kv_del", {"ns": "migrated", "key": oid.hex(),
+                                   "prefix": False}))
+            dest = self._migrated_away.pop(oid, None)
+            if dest is not None:
+                # Freed on the draining source after migration: forward so
+                # the adopted copy (and its pin) can't leak at the peer.
+                rpc.spawn(self._forward_free(dest, oid))
         return True
 
     def _ext_delete(self, oid: bytes) -> None:
@@ -1931,6 +2146,23 @@ class NodeAgent:
         return self.store.list_objects((p or {}).get("limit", 10_000))
 
     async def h_shutdown(self, conn, p):
+        if (p or {}).get("graceful"):
+            # Drain teardown: SIGTERM workers (their actor/lease conns
+            # close, so clients fail over to restarted incarnations),
+            # unlink the shm arena, then exit — same bounded discipline
+            # as the SIGTERM path in _amain.
+            async def _bye():
+                try:
+                    await asyncio.wait_for(self.close(), timeout=10)
+                except Exception:
+                    try:
+                        os.unlink(self.store_path)
+                    except OSError:
+                        pass
+                os._exit(0)
+
+            rpc.spawn(_bye())
+            return True
         asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
         return True
 

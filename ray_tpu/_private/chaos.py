@@ -1,0 +1,218 @@
+"""Process-kill fault injection: the chaos harness.
+
+rpc.py's ``_Chaos`` drops *messages*; this supervisor kills *processes*
+— SIGKILL, no warning — because the crash paths (a SIGKILL'd worker,
+agent, or GCS) are what dominate production failures on preemptible TPU
+fleets, and message-level drops never exercise them.  The spec mirrors
+the ``rpc_chaos`` style (config ``process_chaos``):
+
+    'class=N:period_s[:delay_s],...'
+
+      class     worker | agent | gcs
+      N         total kills of that class
+      period_s  seconds between kills (default 5; also the default
+                first delay)
+      delay_s   seconds before the first kill (optional)
+
+e.g. ``'worker=3:2:1,gcs=1:10'`` — SIGKILL one worker at t≈1, 3, 5 and
+the GCS at t≈10.  Schedules are deterministic; victim choice among the
+live candidates uses a fixed-seed RNG.
+
+Victims are discovered by scanning ``/proc`` for processes whose stderr
+(fd 2) points into this session's log directory — worker/agent/GCS
+daemons all log to ``<session_dir>/logs/<class>…err``.  fd-based
+discovery also finds zygote-FORKED workers, whose ``/proc`` cmdline and
+environ still show the zygote's (a fork without exec keeps both).
+
+An ``agent`` kill takes the whole node down the way a preemption does:
+the agent plus its zygote and workers, found via the ppid chain.  A
+killed GCS can be respawned through a ``restart`` callback (same port,
+same journal) so the cluster exercises journal-replay recovery — see
+``cluster_utils.Cluster.restart_gcs``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+logger = logging.getLogger("ray_tpu.chaos")
+
+CLASSES = ("worker", "agent", "gcs")
+
+# log-file basename prefix -> process class
+_LOG_CLASS = (("worker-", "worker"), ("agent_", "agent"),
+              ("gcs.", "gcs"), ("zygote", "zygote"))
+
+
+def parse_spec(spec: str) -> Dict[str, dict]:
+    """'class=N:period_s[:delay_s],...' -> {class: rule dict}."""
+    rules: Dict[str, dict] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, rhs = part.split("=")
+        if name not in CLASSES:
+            raise ValueError(
+                f"unknown process_chaos class {name!r} "
+                f"(expected one of {CLASSES})")
+        fields = rhs.split(":")
+        count = int(fields[0])
+        period = float(fields[1]) if len(fields) > 1 else 5.0
+        delay = float(fields[2]) if len(fields) > 2 else period
+        rules[name] = {"left": count, "period": period, "delay": delay,
+                       "due": None}
+    return rules
+
+
+class ProcessChaos:
+    """SIGKILL supervisor for one cluster session (see module docstring).
+
+        chaos = ProcessChaos("worker=2:2", session_dir).start()
+        ...
+        chaos.stop()
+
+    ``restart`` maps a class to a zero-arg respawn callback invoked after
+    each kill of that class (used for the GCS).  ``protect_pids`` are
+    never killed (the driver and, typically, the head node's agent — the
+    driver's object store lives there).  ``kills`` records
+    (monotonic_ts, class, pid) per kill for assertions.
+    """
+
+    def __init__(self, spec: str, session_dir: str,
+                 restart: Optional[Dict[str, Callable[[], None]]] = None,
+                 protect_pids: Iterable[int] = (),
+                 seed: int = 0xC0FFEE):
+        self.rules = parse_spec(spec)
+        self._log_dir = os.path.join(session_dir, "logs")
+        self.restart = dict(restart or {})
+        self.protect = set(protect_pids) | {os.getpid()}
+        self._rng = random.Random(seed)
+        self.kills: list = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self) -> "ProcessChaos":
+        # A rule's delay counts from the moment its class FIRST has a
+        # killable candidate (rule["due"] is armed lazily in _loop), so
+        # schedules are deterministic relative to the cluster actually
+        # being up rather than to however long fixture setup took.
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu_chaos")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def done(self) -> bool:
+        """Every rule's kill budget is spent."""
+        return all(rule["left"] <= 0 for rule in self.rules.values())
+
+    # ------------------------------------------------------------ discovery --
+    @staticmethod
+    def _ppid(pid: int) -> Optional[int]:
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                # comm may contain ')': split on the LAST one.
+                return int(f.read().rsplit(b")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            return None
+
+    def _scan(self) -> Dict[int, str]:
+        """pid -> class for this session's processes, classified by where
+        fd 2 (stderr) points — robust for exec'd and zygote-forked
+        processes alike."""
+        procs: Dict[int, str] = {}
+        prefix = self._log_dir + os.sep
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            pid = int(pid_s)
+            if pid in self.protect:
+                continue
+            try:
+                target = os.readlink(f"/proc/{pid}/fd/2")
+            except OSError:
+                continue
+            if not target.startswith(prefix):
+                continue
+            base = os.path.basename(target)
+            for log_prefix, cls in _LOG_CLASS:
+                if base.startswith(log_prefix):
+                    procs[pid] = cls
+                    break
+        return procs
+
+    def _node_members(self, agent_pid: int, procs: Dict[int, str]) -> list:
+        """Workers/zygotes whose ppid chain reaches agent_pid — killed
+        together with the agent so an 'agent' kill behaves like losing
+        the whole node (a preemption kills every process on the host)."""
+        out = []
+        for pid, cls in procs.items():
+            if cls == "agent":
+                continue
+            p, hops = pid, 0
+            while p is not None and p > 1 and hops < 8:
+                p = self._ppid(p)
+                hops += 1
+                if p == agent_pid:
+                    out.append(pid)
+                    break
+        return out
+
+    # ----------------------------------------------------------------- loop --
+    @staticmethod
+    def _kill(pid: int) -> bool:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            procs = None          # at most ONE /proc walk per tick
+            for cls, rule in self.rules.items():
+                if rule["left"] <= 0:
+                    continue
+                if rule["due"] is not None and now < rule["due"]:
+                    continue
+                if procs is None:
+                    procs = self._scan()
+                cands = sorted(p for p, c in procs.items() if c == cls)
+                if not cands:
+                    continue          # nothing alive yet; retry next tick
+                if rule["due"] is None:
+                    # First candidate of this class just appeared: arm the
+                    # schedule's initial delay from now.
+                    rule["due"] = now + rule["delay"]
+                    continue
+                pid = cands[self._rng.randrange(len(cands))]
+                extras = (self._node_members(pid, procs)
+                          if cls == "agent" else [])
+                if not self._kill(pid):
+                    continue          # raced its exit; budget untouched
+                for extra in extras:
+                    self._kill(extra)
+                rule["left"] -= 1
+                rule["due"] = now + rule["period"]
+                self.kills.append((now, cls, pid))
+                logger.warning("chaos: SIGKILL %s pid=%d%s", cls, pid,
+                               f" (+{len(extras)} node procs)"
+                               if extras else "")
+                cb = self.restart.get(cls)
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        logger.exception(
+                            "chaos restart callback for %s failed", cls)
+            self._stop.wait(0.1)

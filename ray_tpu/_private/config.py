@@ -119,6 +119,20 @@ _define("rpc_connect_retry_delay_s", 0.2)
 _define("rpc_chaos", "",
         "deterministic RPC fault injection: 'Method=N:req%:resp%' "
         "(reference: src/ray/rpc/rpc_chaos.cc RAY_testing_rpc_failure)")
+_define("process_chaos", "",
+        "deterministic process-kill fault injection for cluster fixtures: "
+        "'class=N:period_s[:delay_s]' with class in worker|agent|gcs — "
+        "SIGKILLs N processes of that class, one every period_s seconds "
+        "(first after delay_s); mirrors the rpc_chaos spec style but "
+        "exercises the CRASH paths message drops never reach "
+        "(_private/chaos.py ProcessChaos)")
+_define("node_drain_deadline_s", 30.0,
+        "default deadline for the two-phase graceful node drain "
+        "(drain_node without an explicit deadline_s): the GCS stops "
+        "scheduling onto the node, restarts its actors elsewhere and "
+        "migrates sole primary object copies off it, then falls back to "
+        "the hard-kill death path when the deadline expires "
+        "(reference: autoscaler.proto DrainNode deadline_timestamp_ms)")
 _define("grant_or_reject_spillback", True)
 _define("scheduler_top_k_fraction", 0.2,
         "hybrid policy: pick among best-k nodes "

@@ -756,6 +756,19 @@ class CoreWorker:
             conn = self.agent if agent_addr == self.agent_address else \
                 await self._peer_owner(agent_addr)
             await conn.call("free_objects", {"object_ids": [object_id]})
+            return
+        except rpc.RpcError:
+            pass
+        # The recorded primary node is unreachable (e.g. it finished a
+        # graceful drain and this owner never re-read the object, so
+        # plasma_node was never repointed): follow the drain's relocation
+        # record so the adopted pinned copy — and the KV record itself,
+        # cleared by the adoptive agent's free — can't leak.
+        try:
+            moved = await self._migrated_location(object_id)
+            if moved is not None and tuple(moved) != tuple(agent_addr):
+                conn = await self._peer_owner(tuple(moved))
+                await conn.call("free_objects", {"object_ids": [object_id]})
         except rpc.RpcError:
             pass
 
@@ -1232,83 +1245,114 @@ class CoreWorker:
                         pass
                 raise
 
+    async def _migrated_location(self, oid: bytes):
+        """Where a graceful drain republished this object's primary copy,
+        per the GCS KV record the draining agent left (ns 'migrated'), or
+        None.  Lets owners repoint instead of re-executing lineage — and
+        covers put objects, which have no lineage at all."""
+        try:
+            v = await self.gcs.call(
+                "kv_get", {"ns": "migrated", "key": oid.hex()}, timeout=10)
+        except (rpc.RpcError, asyncio.TimeoutError):
+            return None
+        if not v:
+            return None
+        import json
+        try:
+            host, port = json.loads(
+                v.decode() if isinstance(v, (bytes, bytearray)) else v)
+            return (host, int(port))
+        except (ValueError, TypeError):
+            return None
+
     async def _recover_object(self, oid: bytes) -> bool:
-        """Re-execute the creating task to restore a lost object (reference:
+        """Restore a lost object: probe the recorded primary, follow a
+        drain-migrated copy, restore from the durable spill tier, and only
+        then re-execute the creating task from lineage (reference:
         task_manager.h:227 ResubmitTask + object_recovery_manager.cc).
         Deduped across concurrent losses of the same id; actor task returns
         carry no lineage and are never replayed (side effects)."""
         existing = self._recovering.get(oid)
         if existing is not None:
             return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._recovering[oid] = fut
+        ok = False
+        try:
+            ok = await self._recover_object_inner(oid)
+            return ok
+        finally:
+            if not fut.done():
+                fut.set_result(ok)
+            self._recovering.pop(oid, None)
+
+    async def _recover_object_inner(self, oid: bytes) -> bool:
+        # Probe first: a transient pull failure must not trigger a
+        # destructive re-execution (tasks may have side effects and a
+        # failed rerun would overwrite healthy sibling returns).
+        entry = self.memory_store.get(oid)
+        if entry is not None and entry.plasma_node is not None and \
+                await self._primary_alive(oid, tuple(entry.plasma_node)):
+            return True
+        # Drain-migration fast path: a gracefully drained node republished
+        # its sole primaries to a peer before exiting — repoint the
+        # owner's location record and read from the new holder; no
+        # reconstruction, no side effects.
+        moved = await self._migrated_location(oid)
+        if moved is not None and await self._primary_alive(oid, moved):
+            if entry is not None:
+                entry.plasma_node = list(moved)
+            return True
+        # Cloud-spill fast path: if a durable external copy was
+        # registered (object_spill_external_uri), the LOCAL agent can
+        # restore it — no destructive lineage re-execution, and it
+        # works even when the spiller node is dead (reference:
+        # spilled-object URLs usable cluster-wide,
+        # external_storage.py).
+        try:
+            if await self.agent.call("restore_object",
+                                     {"object_id": oid}, timeout=60):
+                # The local agent is the new primary: re-pin there
+                # and repoint the owner's location record.
+                await self.agent.call("pin_object",
+                                      {"object_id": oid})
+                if entry is not None:
+                    entry.plasma_node = self.agent_address
+                return True
+        except (rpc.RpcError, asyncio.TimeoutError):
+            pass
         spec = self.reference_counter.get_lineage(oid)
         if spec is None:
             return False
-        fut = asyncio.get_running_loop().create_future()
-        self._recovering[oid] = fut
-        try:
-            # Probe first: a transient pull failure must not trigger a
-            # destructive re-execution (tasks may have side effects and a
-            # failed rerun would overwrite healthy sibling returns).
-            entry = self.memory_store.get(oid)
-            if entry is not None and entry.plasma_node is not None and \
-                    await self._primary_alive(oid, tuple(entry.plasma_node)):
-                fut.set_result(True)
-                return True
-            # Cloud-spill fast path: if a durable external copy was
-            # registered (object_spill_external_uri), the LOCAL agent can
-            # restore it — no destructive lineage re-execution, and it
-            # works even when the spiller node is dead (reference:
-            # spilled-object URLs usable cluster-wide,
-            # external_storage.py).
-            try:
-                if await self.agent.call("restore_object",
-                                         {"object_id": oid}, timeout=60):
-                    # The local agent is the new primary: re-pin there
-                    # and repoint the owner's location record.
-                    await self.agent.call("pin_object",
-                                          {"object_id": oid})
-                    if entry is not None:
-                        entry.plasma_node = self.agent_address
-                    fut.set_result(True)
-                    return True
-            except (rpc.RpcError, asyncio.TimeoutError):
-                pass
-            # Resubmission can only succeed if its by-reference args are
-            # still resolvable (live somewhere, or themselves recoverable).
-            for e in spec["args"]:
-                if "ref" not in e:
-                    continue
-                aid = bytes(e["ref"][0])
-                aowner = tuple(e["ref"][1])
-                if aowner == self.address and \
-                        not self.memory_store.contains(aid) and \
-                        not self.store.contains(aid) and \
-                        self.reference_counter.get_lineage(aid) is None:
-                    fut.set_result(False)
-                    return False
-            self.memory_store.delete(oid)  # only the lost return
-            respec = dict(spec)
-            respec["retries_left"] = max(respec.get("retries_left", 0), 1)
-            key = protocol.scheduling_key(respec["fn_id"], respec["resources"],
-                                          respec.get("scheduling_strategy"),
-                                          respec.get("runtime_env"))
-            state = self._keys.get(key)
-            if state is None:
-                state = self._keys[key] = _KeyState(
-                    respec["resources"], respec.get("scheduling_strategy"),
-                    respec.get("runtime_env"))
-            state.queue.append(_PendingTask(respec, []))
-            self._pump(key, state)
-            entry = await self.memory_store.wait_for(oid, 120)
-            ok = entry is not None
-            fut.set_result(ok)
-            return ok
-        except Exception:
-            if not fut.done():
-                fut.set_result(False)
-            raise
-        finally:
-            self._recovering.pop(oid, None)
+        # Resubmission can only succeed if its by-reference args are
+        # still resolvable (live somewhere, or themselves recoverable).
+        for e in spec["args"]:
+            if "ref" not in e:
+                continue
+            aid = bytes(e["ref"][0])
+            aowner = tuple(e["ref"][1])
+            if aowner == self.address and \
+                    not self.memory_store.contains(aid) and \
+                    not self.store.contains(aid) and \
+                    self.reference_counter.get_lineage(aid) is None:
+                return False
+        self.memory_store.delete(oid)  # only the lost return
+        respec = dict(spec)
+        # Ensure at least one attempt; negative stays negative (infinite).
+        rl = respec.get("retries_left", 0)
+        respec["retries_left"] = rl if rl < 0 else max(rl, 1)
+        key = protocol.scheduling_key(respec["fn_id"], respec["resources"],
+                                      respec.get("scheduling_strategy"),
+                                      respec.get("runtime_env"))
+        state = self._keys.get(key)
+        if state is None:
+            state = self._keys[key] = _KeyState(
+                respec["resources"], respec.get("scheduling_strategy"),
+                respec.get("runtime_env"))
+        state.queue.append(_PendingTask(respec, []))
+        self._pump(key, state)
+        entry = await self.memory_store.wait_for(oid, 120)
+        return entry is not None
 
     async def _primary_alive(self, oid: bytes, agent_addr: tuple) -> bool:
         """Short-timeout probe of the agent recorded as holding the primary."""
@@ -2071,8 +2115,10 @@ class CoreWorker:
                  and not strat.get("soft"))
                 or (strat.get("type") == "node_label"
                     and strat.get("hard")))
+        from . import scheduling_policy as policy
         try:
-            nodes = [n for n in await self._cluster_nodes() if n["alive"]]
+            nodes = [n for n in await self._cluster_nodes()
+                     if policy.targetable(n)]
         except (rpc.RpcError, asyncio.TimeoutError):
             # Never silently violate a hard constraint on a GCS blip.
             return (None, "retry") if hard else (self.agent, "ok")
@@ -2085,7 +2131,7 @@ class CoreWorker:
             # the constraint unsatisfiable.
             try:
                 nodes = [n for n in await self._cluster_nodes(force=True)
-                         if n["alive"]]
+                         if policy.targetable(n)]
             except (rpc.RpcError, asyncio.TimeoutError):
                 return None, "retry"
             conn, verdict = await self._route_on_view(strat, resources,
@@ -2425,7 +2471,7 @@ class CoreWorker:
             state.window = max(PIPELINE_DEPTH, state.window // 2)
         fate = None
         need_fate = any(
-            t.spec["retries_left"] <= 0
+            t.spec["retries_left"] == 0
             and t.spec["task_id"] not in self._cancelled for t in tasks)
         if need_fate:
             try:
@@ -2443,8 +2489,11 @@ class CoreWorker:
                     spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
                 self._release_task_pins(task)
                 self._cancelled.discard(tid)
-            elif spec["retries_left"] > 0:
-                spec["retries_left"] -= 1
+            elif spec["retries_left"] != 0:
+                # Negative = retry forever (max_retries=-1, reference
+                # semantics); only positive budgets are consumed.
+                if spec["retries_left"] > 0:
+                    spec["retries_left"] -= 1
                 self._stream_reset_for_retry(spec)
                 state.queue.append(task)
             else:
@@ -3129,8 +3178,10 @@ class CoreWorker:
                     f"{spec['method']} cancelled"))
                 self._release_task_pins(task)
                 self._cancelled.discard(tid)
-            elif spec["retries_left"] > 0:
-                spec["retries_left"] -= 1
+            elif spec["retries_left"] != 0:
+                # Negative = infinite (max_task_retries=-1).
+                if spec["retries_left"] > 0:
+                    spec["retries_left"] -= 1
                 self._stream_reset_for_retry(spec)
                 self._spawn(self._push_actor_task(state, spec, task))
             else:
@@ -3177,8 +3228,10 @@ class CoreWorker:
                     self._release_task_pins(task)
                     self._cancelled.discard(task_id)
                     return
-                if spec["retries_left"] > 0:
-                    spec["retries_left"] -= 1
+                if spec["retries_left"] != 0:
+                    # Negative = infinite (max_task_retries=-1).
+                    if spec["retries_left"] > 0:
+                        spec["retries_left"] -= 1
                     self._stream_reset_for_retry(spec)
                     continue
                 cause = await self._actor_death_cause(state.actor_id)

@@ -84,6 +84,18 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.conn: Optional[rpc.Connection] = None  # GCS→agent client
+        # {"reason", "deadline"} while the two-phase drain runs (NODE_DRAINING)
+        self.draining: Optional[dict] = None
+        self.drain_task: Optional[asyncio.Task] = None
+        # The agent's inbound connection (the one that called
+        # register_node): its close is an immediate death signal for
+        # cleanly crashed agents (see GcsServer._on_client_close).
+        self.client_conn: Optional[rpc.Connection] = None
+
+    @property
+    def schedulable(self) -> bool:
+        """May receive NEW work: alive and not draining."""
+        return self.alive and self.draining is None
 
     def view(self) -> dict:
         return {
@@ -94,6 +106,11 @@ class NodeInfo:
             "labels": self.labels,
             "store_path": self.store_path,
             "alive": self.alive,
+            "state": (protocol.NODE_DEAD if not self.alive
+                      else protocol.NODE_DRAINING if self.draining
+                      else protocol.NODE_ALIVE),
+            "draining": ({"reason": self.draining["reason"]}
+                         if self.alive and self.draining else None),
         }
 
 
@@ -164,7 +181,9 @@ class GcsServer:
         # Bumped on every node registration; pending-actor scheduling resets
         # its deadline when this moves (new capacity may fit the actor).
         self._node_epoch = 0
-        self._server = rpc.RpcServer(self._handlers(), name="gcs")
+        self._closing = False
+        self._server = rpc.RpcServer(self._handlers(), name="gcs",
+                                     on_client_close=self._on_client_close)
         self._health_task: Optional[asyncio.Task] = None
 
     def _handlers(self):
@@ -377,6 +396,7 @@ class GcsServer:
             self._log_actor(actor)
 
     async def close(self):
+        self._closing = True
         if self._health_task:
             self._health_task.cancel()
         await self._server.close()
@@ -432,6 +452,7 @@ class GcsServer:
             node.resources_available = dict(prev.resources_available)
             if prev.conn is not None and not prev.conn.closed:
                 await prev.conn.close()
+        node.client_conn = conn
         self.nodes[node.node_id] = node
         self._node_epoch += 1
         self._log("node", {
@@ -454,14 +475,104 @@ class GcsServer:
 
     async def h_report_resources(self, conn, p):
         node = self.nodes.get(p["node_id"])
-        if node:
-            node.resources_available = p["available"]
-            node.last_heartbeat = time.monotonic()
+        if node is None or not node.alive:
+            # The reporter was marked dead (health-check false positive —
+            # e.g. a GC pause on the agent outlived the failure budget) or
+            # predates a journal wipe.  Death is permanent for consumers
+            # (its actors were restarted, its primaries written off), so
+            # tell the agent its reports are going nowhere: it re-registers
+            # under a FRESH node id and rejoins instead of zombieing.
+            return False
+        node.resources_available = p["available"]
+        node.last_heartbeat = time.monotonic()
         return True
 
     async def h_drain_node(self, conn, p):
-        await self._mark_node_dead(p["node_id"], "drained")
+        """Two-phase graceful drain (reference: autoscaler.proto DrainNode;
+        Pathways-style preemption handling — planned departure is distinct
+        from abrupt death).  Phase 1 marks the node DRAINING: the scheduler
+        and spillback stop targeting it, its ALIVE actors restart elsewhere
+        through the normal restart path (before teardown, with a
+        NodePreemptedError cause), and the agent migrates sole primary
+        object copies to a peer.  Phase 2 — only at the deadline, or once
+        the agent reports the drain complete — falls back to the hard-kill
+        death path.  Payload: node_id, reason (preemption|idle|manual),
+        deadline_s, wait (block until the node is dead)."""
+        node = self.nodes.get(p["node_id"])
+        if node is None:
+            return False
+        if not node.alive:
+            return True          # already dead: drain is trivially done
+        reason = p.get("reason") or protocol.DRAIN_MANUAL
+        from .config import get_config
+        d = p.get("deadline_s")   # explicit 0 = hard-kill now, not default
+        deadline_s = float(get_config().node_drain_deadline_s
+                           if d is None else d)
+        if node.draining is None:
+            node.draining = {"reason": reason,
+                             "deadline": time.monotonic() + deadline_s}
+            logger.warning("node %s draining (reason=%s, deadline=%.1fs)",
+                           node.node_id.hex()[:8], reason, deadline_s)
+            self._publish(protocol.CH_NODE, {
+                "event": "draining", "node": node.view(),
+                "reason": reason, "deadline_s": deadline_s})
+            node.drain_task = rpc.spawn(
+                self._drain_node(node, reason, deadline_s))
+        if p.get("wait") and node.drain_task is not None:
+            try:
+                await asyncio.wait_for(asyncio.shield(node.drain_task),
+                                       deadline_s + 10.0)
+            except asyncio.TimeoutError:
+                return False
         return True
+
+    async def _drain_node(self, node: NodeInfo, reason: str,
+                          deadline_s: float):
+        deadline = time.monotonic() + deadline_s
+        cause = (f"NodePreemptedError: node {node.node_id.hex()[:8]} is "
+                 f"being drained (reason={reason})")
+        # Restart ALIVE actors elsewhere BEFORE teardown — _pick_node no
+        # longer offers the draining node, so the existing restart path
+        # lands them on a peer while the old incarnations keep serving
+        # in-flight calls until the node exits.  Runs concurrently with
+        # the agent-side object migration below; both are bounded by the
+        # drain deadline (a restart that cannot place in time continues in
+        # the background and is skipped by the final hard-kill pass, which
+        # only death-handles actors still ALIVE on this node).
+        pending = [rpc.spawn(self._handle_actor_death(actor, cause))
+                   for actor in list(self.actors.values())
+                   if actor.node_id == node.node_id
+                   and actor.state == protocol.ACTOR_ALIVE]
+        if node.conn is not None and not node.conn.closed:
+            remaining = max(0.5, deadline - time.monotonic())
+            pending.append(rpc.spawn(node.conn.call(
+                "drain", {"reason": reason, "deadline_s": remaining},
+                timeout=remaining + 5.0)))
+        for t in pending:
+            # Tasks may outlive the deadline wait below; retrieve their
+            # exceptions (e.g. an agent drain RPC racing the node's death)
+            # so they don't log as never-retrieved at GC.
+            t.add_done_callback(lambda t: t.cancelled() or t.exception())
+        if pending:
+            # asyncio.wait, NOT wait_for(gather(...)): the latter CANCELS
+            # the children at the deadline, which would kill an actor
+            # restart mid create_actor_worker and strand the actor in
+            # RESTARTING.  Slow restarts must keep running past the
+            # deadline (the hard-kill pass below skips RESTARTING actors).
+            await asyncio.wait(pending,
+                               timeout=max(0.1, deadline - time.monotonic()))
+        await self._mark_node_dead(node.node_id,
+                                   f"drained (reason={reason})")
+        # Graceful teardown: the agent SIGTERMs its workers (closing actor
+        # connections so clients fail over to the restarted incarnations),
+        # unlinks its shm arena and exits.
+        live = self.nodes.get(node.node_id)
+        if live is not None and live.conn is not None \
+                and not live.conn.closed:
+            try:
+                live.conn.notify("shutdown", {"graceful": True})
+            except rpc.ConnectionLost:
+                pass
 
     async def h_report_demand(self, conn, p):
         """Core workers report unfulfilled lease shapes so the autoscaler
@@ -518,11 +629,28 @@ class GcsServer:
             except Exception:
                 logger.exception("health check pass failed")
 
+    def _on_client_close(self, conn):
+        """A registered agent's inbound connection closed: for a crashed
+        or SIGKILL'd agent the kernel closes the socket immediately, so
+        mark the node dead NOW instead of waiting health_check_period_ms ×
+        health_check_failure_threshold.  Netsplits send no FIN/RST and
+        still take the heartbeat-timeout path; an agent-side reconnect
+        re-registers (fresh NodeInfo), so a stale conn's close can never
+        kill the successor (identity check below)."""
+        if self._closing:
+            return
+        for node in self.nodes.values():
+            if node.client_conn is conn and node.alive:
+                rpc.spawn(self._mark_node_dead(
+                    node.node_id, "agent connection closed"))
+                break
+
     async def _mark_node_dead(self, node_id: bytes, reason: str):
         node = self.nodes.get(node_id)
         if not node or not node.alive:
             return
         node.alive = False
+        node.draining = None
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish(protocol.CH_NODE, {"event": "dead", "node": node.view(),
                                          "reason": reason})
@@ -646,7 +774,7 @@ class GcsServer:
         below the packing threshold)."""
         if strategy and strategy.get("type") == "node_affinity":
             node = self.nodes.get(strategy["node_id"])
-            if node and node.alive:
+            if node and node.schedulable:
                 return node
             if not strategy.get("soft"):
                 return None
@@ -660,7 +788,7 @@ class GcsServer:
                     # per-bundle usage; agents reject exhausted bundles)
                     live = [b for b in pg["bundles"]
                             if (n := self.nodes.get(b["node_id"]))
-                            and n.alive]
+                            and n.schedulable]
                     if not live:
                         return None
                     self._pg_rr[pg["pg_id"]] = (
@@ -669,11 +797,11 @@ class GcsServer:
                     return self.nodes[b["node_id"]]
                 bundle = pg["bundles"][idx]
                 node = self.nodes.get(bundle["node_id"])
-                if node and node.alive:
+                if node and node.schedulable:
                     return node
             return None
         from . import scheduling_policy as policy
-        live = [n for n in self.nodes.values() if n.alive]
+        live = [n for n in self.nodes.values() if n.schedulable]
         if strategy and strategy.get("type") == "node_label":
             keep = set(policy.label_filter(
                 [(n.node_id, n.labels or {}) for n in live],
@@ -976,7 +1104,7 @@ class GcsServer:
             return
 
     def _place_bundles(self, bundles, strategy) -> Optional[List[NodeInfo]]:
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable]
         if not alive:
             return None
         remaining = {n.node_id: dict(n.resources_available) for n in alive}

@@ -173,6 +173,22 @@ ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
 ACTOR_DEAD = "DEAD"
 
+# Node states (reference: gcs.proto GcsNodeInfo.GcsNodeState + the
+# autoscaler's DRAINING drain protocol, autoscaler.proto DrainNode).
+# DRAINING is the two-phase departure state: the node finishes what it
+# has (in-flight leases, actor hand-off, primary-object migration) but
+# receives no new work; at the drain deadline it transitions to DEAD.
+NODE_ALIVE = "ALIVE"
+NODE_DRAINING = "DRAINING"
+NODE_DEAD = "DEAD"
+
+# Drain reasons (reference: autoscaler.proto DrainNodeReason —
+# preemption carries a deadline the cloud enforces; idle drains come
+# from the autoscaler; manual from operators/tests).
+DRAIN_PREEMPTION = "preemption"
+DRAIN_IDLE = "idle"
+DRAIN_MANUAL = "manual"
+
 # Pubsub channels (reference: pubsub channel types in gcs.proto)
 CH_ACTOR = "actor"
 CH_NODE = "node"

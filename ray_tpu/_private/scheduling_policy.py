@@ -24,6 +24,16 @@ SPREAD_THRESHOLD = 0.5
 TOP_K_FRACTION = 0.2
 
 
+def targetable(view: Dict) -> bool:
+    """Whether a node (GCS NodeInfo.view dict) may receive NEW work.
+    DRAINING nodes are alive — they finish in-flight leases and serve
+    object pulls during migration — but the scheduler, spillback and
+    submitter-side lease routing must all stop targeting them
+    (reference: the raylet rejects leases while draining; autoscaler
+    DrainNode semantics)."""
+    return bool(view.get("alive")) and not view.get("draining")
+
+
 def feasible(avail: Dict[str, float], resources: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) >= v for k, v in resources.items()
                if v > 0)

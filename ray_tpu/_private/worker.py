@@ -162,7 +162,8 @@ def init(address: Optional[str] = None, *,
         alive = [n for n in nodes if n["alive"]]
         if not alive:
             raise RuntimeError("no alive nodes in cluster")
-        n0 = alive[0]
+        # Prefer a node that isn't mid-drain as the driver's home agent.
+        n0 = ([n for n in alive if not n.get("draining")] or alive)[0]
         agent_addr = tuple(n0["address"])
         store_path = n0["store_path"]
         node_id = bytes(n0["node_id"])

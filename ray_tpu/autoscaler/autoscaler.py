@@ -25,6 +25,10 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     update_period_s: float = 5.0
     dead_node_reclaim_s: float = 30.0
+    # Graceful-drain budget for scale-down: idle nodes get this long to
+    # migrate primaries / finish stragglers before the instance is
+    # reclaimed (reference: autoscaler DrainNode before termination).
+    drain_deadline_s: float = 30.0
 
 
 class Autoscaler:
@@ -73,7 +77,10 @@ class Autoscaler:
         """One reconcile pass; returns {"launched": {type: n},
         "terminated": [provider ids]} for observability/tests."""
         state = await self._read_state()
-        alive = [n for n in state["nodes"] if n["alive"]]
+        # DRAINING nodes are on their way out: not capacity, not
+        # idle-termination candidates (their drain already runs).
+        alive = [n for n in state["nodes"]
+                 if n["alive"] and not n.get("draining")]
         free = [dict(n["resources_available"]) for n in alive]
         # Launched-but-not-yet-registered nodes count as incoming capacity,
         # else every reconcile during a node's boot window re-launches for
@@ -190,7 +197,14 @@ class Autoscaler:
                     per_type.get(pn.node_type, 0) > cfg.min_workers:
                 gcs = await self._gcs()
                 try:
-                    await gcs.call("drain_node", {"node_id": nid})
+                    # Graceful two-phase drain (reason=idle): migrates any
+                    # primary object copies off the node and lets
+                    # stragglers finish before the instance disappears;
+                    # wait=True so termination never races the drain.
+                    await gcs.call("drain_node", {
+                        "node_id": nid, "reason": "idle", "wait": True,
+                        "deadline_s": self.config.drain_deadline_s},
+                        timeout=self.config.drain_deadline_s + 15.0)
                 except Exception:
                     pass
                 self.provider.terminate_node(pn)

@@ -55,13 +55,30 @@ class Cluster:
         self.gcs_address: Optional[tuple] = None
         self.nodes: List[ClusterNode] = []
         self.head_node: Optional[ClusterNode] = None
+        self.chaos = None
+        self._head_system_config = (head_node_args or {}).get(
+            "_system_config")
         if initialize_head:
             # The head's _system_config also parameterizes the GCS (e.g.
             # rpc_chaos must inject in EVERY process, GCS included).
             self.gcs_proc, self.gcs_address = node_mod.start_gcs(
-                self.session_dir,
-                system_config=(head_node_args or {}).get("_system_config"))
+                self.session_dir, system_config=self._head_system_config)
             self.head_node = self.add_node(**(head_node_args or {}))
+            # Process-kill chaos harness (config `process_chaos` or env
+            # RAY_TPU_process_chaos): SIGKILLs worker/agent/GCS processes
+            # of THIS session on a deterministic schedule.  The driver and
+            # the head node's agent are protected (the driver's object
+            # store lives there); a killed GCS is respawned on the same
+            # port + journal so recovery-by-replay is exercised.
+            spec = ((self._head_system_config or {}).get("process_chaos")
+                    or os.environ.get("RAY_TPU_process_chaos", ""))
+            if spec:
+                from ._private.chaos import ProcessChaos
+                self.chaos = ProcessChaos(
+                    spec, self.session_dir,
+                    restart={"gcs": self.restart_gcs},
+                    protect_pids={os.getpid(),
+                                  self.head_node.proc.pid}).start()
 
     @property
     def address(self) -> str:
@@ -88,6 +105,22 @@ class Cluster:
         node = ClusterNode(proc, addr, store_path, node_id)
         self.nodes.append(node)
         return node
+
+    def restart_gcs(self) -> None:
+        """Respawn the GCS on the SAME port with the same journal
+        (reference: GCS FT restart behind external Redis) — tables replay,
+        agents re-register over their reconnecting connections, drivers'
+        calls retry.  Used by the chaos harness after a GCS kill."""
+        old = self.gcs_proc
+        if old is not None:
+            try:
+                old.wait(timeout=10)    # reap; frees the listen port
+            except subprocess.TimeoutExpired:
+                old.kill()
+                old.wait()
+        self.gcs_proc, self.gcs_address = node_mod.start_gcs(
+            self.session_dir, port=self.gcs_address[1],
+            system_config=self._head_system_config)
 
     def remove_node(self, node: ClusterNode,
                     allow_graceful: bool = False) -> None:
@@ -126,6 +159,10 @@ class Cluster:
 
     def shutdown(self) -> None:
         import ray_tpu
+        if self.chaos is not None:
+            # Stop injecting before teardown starts killing things itself.
+            self.chaos.stop()
+            self.chaos = None
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         # Parallel: signal every agent first, THEN reap — serial

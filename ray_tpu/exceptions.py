@@ -79,3 +79,16 @@ class RuntimeEnvSetupError(RayError):
 
 class NodeDiedError(RayError):
     pass
+
+
+class NodePreemptedError(NodeDiedError):
+    """The node hosting this task/actor/object was preempted or drained
+    (maintenance event, spot reclaim, autoscaler scale-down).  Distinct
+    from an unplanned crash: the runtime had a warning window and ran the
+    two-phase drain protocol — actors were restarted elsewhere (counting
+    against max_restarts) and sole primary object copies migrated off the
+    node — so work that could be preserved was.  Today the drain embeds
+    this class's name in the recorded death-cause STRING (carried inside
+    the ActorDiedError raised to callers, preserving isinstance
+    compatibility); match on the cause text to distinguish preemption
+    from a crash."""

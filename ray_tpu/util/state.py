@@ -20,7 +20,10 @@ def list_nodes() -> List[Dict[str, Any]]:
     for n in _gcs("get_nodes"):
         out.append({
             "node_id": n["node_id"].hex(),
-            "state": "ALIVE" if n["alive"] else "DEAD",
+            # Server-provided state includes DRAINING (graceful drain in
+            # progress); fall back to alive for older GCS payloads.
+            "state": n.get("state")
+            or ("ALIVE" if n["alive"] else "DEAD"),
             "address": tuple(n["address"]),
             "resources_total": n["resources_total"],
             "resources_available": n["resources_available"],

@@ -52,6 +52,11 @@ def pytest_configure(config):
         "markers",
         "slow: long-running (multi-GiB data plane etc.); tier-1 runs "
         "with -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (process kills / RPC drops); the "
+        "long kill-chaos soak is additionally marked slow — run it with "
+        "-m 'chaos and slow'")
 
 
 @pytest.fixture(autouse=True)

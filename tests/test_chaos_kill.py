@@ -1,0 +1,207 @@
+"""Process-kill chaos: SIGKILL'd workers, agents, and GCS.
+
+The rpc-level chaos (test_chaos.py) drops messages; this file exercises
+the CRASH paths that dominate production failures on preemptible
+fleets, via the ProcessChaos supervisor (_private/chaos.py) wired into
+cluster_utils.Cluster through the `process_chaos` config.  The short
+worker-kill smoke and the direct actor-SIGKILL test run in tier-1; the
+full worker+agent+GCS soak is gated behind -m 'chaos and slow'.
+"""
+
+import os
+import signal
+import time
+from collections import Counter
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.chaos
+
+
+def _fresh():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def test_process_chaos_spec_parsing():
+    from ray_tpu._private.chaos import parse_spec
+    rules = parse_spec("worker=3:2:1,agent=1:6,gcs=2:10")
+    assert rules["worker"]["left"] == 3
+    assert rules["worker"]["period"] == 2.0
+    assert rules["worker"]["delay"] == 1.0
+    assert rules["agent"]["delay"] == 6.0       # defaults to the period
+    assert rules["gcs"]["left"] == 2
+    with pytest.raises(ValueError):
+        parse_spec("driver=1:1")
+
+
+def test_worker_kills_tasks_survive():
+    """Smoke (tier-1): SIGKILL'd workers mid-stream — every task still
+    completes exactly once from the submitter's point of view (lease
+    loss -> retry path)."""
+    _fresh()
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2,
+        "_system_config": {"process_chaos": "worker=2:1.5:1.0"}})
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=20)
+        def square(i):
+            time.sleep(0.05)
+            return i * i
+
+        deadline = time.monotonic() + 60
+        while True:
+            out = ray_tpu.get([square.remote(i) for i in range(20)],
+                              timeout=120)
+            assert out == [i * i for i in range(20)]
+            if cluster.chaos.done() or time.monotonic() > deadline:
+                break
+        assert [k for k in cluster.chaos.kills if k[1] == "worker"], \
+            "chaos harness never found a worker to kill"
+    finally:
+        cluster.shutdown()
+
+
+def test_actor_worker_sigkill_restart_exactly_once(tmp_path):
+    """Satellite: end-to-end max_restarts — SIGKILL the actor's worker
+    PROCESS (not an RPC drop) mid-stream.  The actor restarts, every
+    in-flight call replays onto the new incarnation and resolves, and
+    calls that had already completed before the kill are NOT replayed
+    (exactly-once through the completion/dedup bookkeeping of the
+    batched submit path)."""
+    _fresh()
+    ray_tpu.init(num_cpus=2)
+    try:
+        log = tmp_path / "calls.log"
+
+        @ray_tpu.remote(num_cpus=0, max_restarts=1, max_task_retries=-1)
+        class Recorder:
+            def __init__(self, path):
+                self.path = path
+
+            def pid(self):
+                return os.getpid()
+
+            def record(self, i):
+                time.sleep(0.02)      # keep a real in-flight window open
+                with open(self.path, "a") as f:
+                    f.write(f"{i}\n")
+                return i
+
+        rec = Recorder.remote(str(log))
+        pid = ray_tpu.get(rec.pid.remote(), timeout=60)
+        refs = [rec.record.remote(i) for i in range(30)]
+        done, _ = ray_tpu.wait(refs, num_returns=5, timeout=60)
+        resolved_early = set(ray_tpu.get(done, timeout=30))
+        os.kill(pid, signal.SIGKILL)
+
+        assert ray_tpu.get(refs, timeout=120) == list(range(30))
+        pid2 = ray_tpu.get(rec.pid.remote(), timeout=60)
+        assert pid2 != pid                       # really restarted
+        runs = Counter(int(x) for x in log.read_text().split())
+        assert set(runs) == set(range(30))       # every call ran
+        for i in resolved_early:
+            # Completed-and-acknowledged calls must not replay after the
+            # restart — their completion records were resolved.
+            assert runs[i] == 1, f"call {i} replayed after completing"
+        ray_tpu.kill(rec)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_agent_kill_node_loss_tasks_reroute():
+    """An 'agent' kill takes a whole node down (agent + its workers, as a
+    preemption would); tasks re-lease onto the surviving node and lost
+    returns reconstruct from lineage."""
+    _fresh()
+    # First kill 6 s after the victim agent appears: clear of add_node/
+    # wait_for_nodes/init even on a loaded host, inside the task loop.
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2,
+        "_system_config": {"process_chaos": "agent=1:5:6"}})
+    try:
+        cluster.add_node(num_cpus=2)     # the (unprotected) victim
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=20)
+        def work(i):
+            time.sleep(0.05)
+            return i + 1000
+
+        deadline = time.monotonic() + 60
+        while True:
+            out = ray_tpu.get([work.remote(i) for i in range(16)],
+                              timeout=120)
+            assert out == [i + 1000 for i in range(16)]
+            if cluster.chaos.done() or time.monotonic() > deadline:
+                break
+        assert [k for k in cluster.chaos.kills if k[1] == "agent"]
+        # The killed node is detected dead (conn-close fast path).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sum(n["alive"] for n in ray_tpu.nodes()) == 1:
+                break
+            time.sleep(0.2)
+        assert sum(n["alive"] for n in ray_tpu.nodes()) == 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_kill_chaos_soak_worker_agent_gcs():
+    """Soak (acceptance): worker, agent AND GCS kill schedules enabled at
+    once; tasks, actor calls and objects keep making progress through
+    every kill class, the GCS respawns from its journal, and the final
+    state is consistent."""
+    _fresh()
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2,
+        "_system_config": {
+            "process_chaos": "worker=4:4:3,agent=1:11,gcs=1:12:12"}})
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=20)
+        def bump(i):
+            time.sleep(0.02)
+            return i * 3
+
+        @ray_tpu.remote(num_cpus=0, max_restarts=-1, max_task_retries=-1)
+        class Survivor:
+            def __init__(self):
+                self.calls = 0
+
+            def tick(self, i):
+                self.calls += 1
+                return i
+
+        s = Survivor.remote()
+        anchor = ray_tpu.put(list(range(256)))   # lives on the head store
+        rounds = 0
+        deadline = time.monotonic() + 90
+        while not cluster.chaos.done() and time.monotonic() < deadline:
+            out = ray_tpu.get([bump.remote(i) for i in range(12)],
+                              timeout=150)
+            assert out == [i * 3 for i in range(12)]
+            assert ray_tpu.get([s.tick.remote(i) for i in range(4)],
+                               timeout=150) == list(range(4))
+            assert ray_tpu.get(anchor, timeout=60) == list(range(256))
+            rounds += 1
+        killed = {k[1] for k in cluster.chaos.kills}
+        assert killed == {"worker", "agent", "gcs"}, \
+            f"soak ended with kill classes {killed} after {rounds} rounds"
+        # One clean round with the dust settled.
+        assert ray_tpu.get([bump.remote(i) for i in range(12)],
+                           timeout=150) == [i * 3 for i in range(12)]
+        assert ray_tpu.get(s.tick.remote(99), timeout=150) == 99
+    finally:
+        cluster.shutdown()

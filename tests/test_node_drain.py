@@ -1,0 +1,240 @@
+"""Graceful node drain & preemption handling.
+
+The two-phase drain protocol (gcs.py h_drain_node + agent.py h_drain):
+a DRAINING node stops receiving work, its restartable actors restart
+elsewhere BEFORE teardown (NodePreemptedError cause), sole primary
+object copies migrate to a live peer (GCS KV ns 'migrated' + owner
+repoint — no lineage re-execution), and only at the deadline does the
+node fall back to the hard-kill death path.  Also covers the fast
+crash-detection path (agent connection close => immediate node death)
+and the false-positive-death rejoin path (rejected heartbeats =>
+re-register under a fresh node id).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _fresh():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def _node_views():
+    return {bytes(n["node_id"]): n for n in ray_tpu.nodes()}
+
+
+def test_drain_migrates_actor_and_sole_primary(tmp_path):
+    """Acceptance: a 5 s-deadline drain of a node hosting a restartable
+    actor and the sole primary copy of an object completes with zero
+    task failures — the actor is re-alive elsewhere before the node
+    exits, and ray.get on the object succeeds WITHOUT lineage
+    re-execution."""
+    _fresh()
+    # Head has no CPUs: all work lands on the victim node.
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    try:
+        victim = cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        exec_count = tmp_path / "blob_runs"
+
+        @ray_tpu.remote(max_retries=0)
+        def blob(path):
+            import numpy as np
+            with open(path, "a") as f:
+                f.write("x")
+            return np.full(1 << 20, 7, dtype=np.uint8)
+
+        ref = blob.remote(str(exec_count))
+        # Wait for completion WITHOUT fetching: the sole copy stays in the
+        # victim's store (a get would leave a cached replica here).
+        ready, _ = ray_tpu.wait([ref], timeout=60)
+        assert ready and exec_count.read_text() == "x"
+
+        @ray_tpu.remote(num_cpus=1, max_restarts=1, max_task_retries=-1)
+        class Preemptee:
+            def where(self):
+                return bytes(ray_tpu.get_runtime_context().node_id)
+
+            def ping(self, i):
+                return i
+
+        a = Preemptee.remote()
+        assert ray_tpu.get(a.where.remote(), timeout=60) == victim.node_id
+
+        # Replacement capacity arrives (the preemption warning window).
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+
+        # Calls in flight across the whole drain window: none may fail.
+        refs = [a.ping.remote(i) for i in range(10)]
+        assert ray_tpu.drain_node(victim.node_id, reason="preemption",
+                                  deadline_s=5.0, wait=True)
+        refs += [a.ping.remote(i) for i in range(10, 20)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(20))
+
+        # The actor restarted on a surviving node.
+        assert ray_tpu.get(a.where.remote(), timeout=60) != victim.node_id
+        views = _node_views()
+        assert not views[victim.node_id]["alive"]
+        assert views[victim.node_id]["state"] == "DEAD"
+
+        # Sole primary migrated: the drain left a cluster-wide relocation
+        # record, and the read resolves through it — NOT by re-executing
+        # blob() (the owner's location record still points at the dead
+        # victim, so without migration this would be lineage recovery).
+        moved = ray_tpu._core().gcs_call(
+            "kv_get", {"ns": "migrated", "key": ref.binary().hex()})
+        assert moved is not None
+        again = ray_tpu.get(ref, timeout=60)
+        assert again.nbytes == 1 << 20 and again[0] == 7
+        assert exec_count.read_text() == "x"      # executed exactly once
+    finally:
+        cluster.shutdown()
+
+
+def test_drain_reason_surfaces_preemption_for_unrestartable_actor():
+    """An actor with no restart budget on a drained node is buried with a
+    NodePreemptedError cause, and callers see it."""
+    _fresh()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    try:
+        victim = cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=1)      # max_restarts=0
+        class Doomed:
+            def ping(self):
+                return "pong"
+
+        d = Doomed.remote()
+        assert ray_tpu.get(d.ping.remote(), timeout=60) == "pong"
+        assert ray_tpu.drain_node(victim.node_id, reason="preemption",
+                                  deadline_s=5.0, wait=True)
+        info = ray_tpu._core().get_actor_info(actor_id=d._actor_id)
+        assert info["state"] == "DEAD"
+        assert "NodePreemptedError" in (info["death_cause"] or "")
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                           match="NodePreemptedError"):
+            ray_tpu.get(d.ping.remote(), timeout=60)
+    finally:
+        cluster.shutdown()
+
+
+def test_draining_node_receives_no_new_work():
+    """While DRAINING, the node is excluded from the scheduler and the
+    lease path spills submitters back to live peers."""
+    _fresh()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    try:
+        victim = cluster.add_node(num_cpus=2)
+        other = cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+        # Mark DRAINING without waiting for the node to die, then check
+        # fresh tasks land on the other node while both are still up.
+        assert ray_tpu.drain_node(victim.node_id, reason="manual",
+                                  deadline_s=8.0, wait=False)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            v = _node_views()[victim.node_id]
+            if not v["alive"] or v.get("draining"):
+                break
+            time.sleep(0.05)
+
+        @ray_tpu.remote
+        def where():
+            return bytes(ray_tpu.get_runtime_context().node_id)
+
+        spots = set(ray_tpu.get([where.options(num_cpus=1).remote()
+                                 for _ in range(6)], timeout=60))
+        assert victim.node_id not in spots
+        assert other.node_id in spots
+    finally:
+        cluster.shutdown()
+
+
+def test_agent_crash_detected_via_conn_close():
+    """Satellite: a SIGKILL'd agent's socket closes immediately, so the
+    GCS marks the node dead right away instead of waiting out
+    health_check_period_ms x health_check_failure_threshold (set to a
+    60 s budget here so the timeout path can't be what passes this)."""
+    _fresh()
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1,
+        "_system_config": {"health_check_period_ms": 2000,
+                           "health_check_failure_threshold": 30}})
+    try:
+        node = cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+        node.proc.kill()                    # SIGKILL: kernel sends FIN/RST
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if not _node_views()[node.node_id]["alive"]:
+                return
+            time.sleep(0.1)
+        raise AssertionError(
+            "node not marked dead within 5s of its agent's SIGKILL "
+            "(the heartbeat-timeout path alone would need 60s)")
+    finally:
+        cluster.shutdown()
+
+
+def test_false_dead_node_rejoins_with_fresh_id():
+    """Satellite: a node wrongly marked dead (agent paused past the
+    health budget — a GC-pause stand-in) detects its rejected heartbeats
+    once resumed and re-registers under a FRESH node id instead of
+    zombieing with silently ignored reports."""
+    _fresh()
+    # 3 s heartbeat budget: long enough that normal startup jitter (agent
+    # prestart, loaded CI host) can't trip it, short enough to test fast.
+    chk = {"health_check_period_ms": 300,
+           "health_check_failure_threshold": 10}
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1, "_system_config": chk})
+    try:
+        node = cluster.add_node(num_cpus=2, resources={"mark": 2.0})
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address, _system_config=chk)
+
+        os.kill(node.proc.pid, signal.SIGSTOP)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not _node_views()[node.node_id]["alive"]:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("paused node never marked dead")
+        finally:
+            os.kill(node.proc.pid, signal.SIGCONT)
+
+        deadline = time.monotonic() + 20
+        fresh = None
+        while time.monotonic() < deadline:
+            fresh = [n for n in ray_tpu.nodes()
+                     if n["alive"] and n["resources_total"].get("mark")
+                     and bytes(n["node_id"]) != node.node_id]
+            if fresh:
+                break
+            time.sleep(0.2)
+        assert fresh, "node did not rejoin under a fresh id"
+        assert not _node_views()[node.node_id]["alive"]  # old id stays dead
+
+        @ray_tpu.remote(resources={"mark": 1})
+        def on_mark():
+            return "ok"
+
+        assert ray_tpu.get(on_mark.remote(), timeout=60) == "ok"
+    finally:
+        cluster.shutdown()
