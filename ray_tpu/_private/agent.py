@@ -189,6 +189,21 @@ class NodeAgent:
         self._max_pulls = cfg.max_concurrent_pulls
         self._max_inflight_chunks = cfg.object_transfer_max_inflight_chunks
         self._chunk_timeout = cfg.object_transfer_chunk_timeout_s
+        # Per-peer link health: addr -> {"lat": deque[s], "rtt": EMA s,
+        # "rate": EMA B/s, "fail": count, "ts": monotonic of last sample}.
+        # Fed by every fetch_chunk/object_info round trip; drives the
+        # hedge delay (p95 of recent latencies) and rides heartbeats to
+        # the GCS as gray-failure evidence about OTHER nodes.
+        self._peer_stats: Dict[tuple, dict] = {}
+        # Hedge budget (The Tail at Scale): hedged fetches are capped at
+        # a fraction of total fetches plus a small burst, so hedging
+        # can't amplify load on a cluster that is slow because it is
+        # OVERLOADED rather than gray.
+        self._hedge_enabled = cfg.pull_hedge_enabled
+        self._hedge_delay_ms = cfg.pull_hedge_delay_ms
+        self._hedge_budget_frac = cfg.pull_hedge_budget_fraction
+        self._hedge_total = 0
+        self._hedge_used = 0
         # Parked lease requests: (params, conn, reply_future, deadline),
         # FIFO-granted by _parked_lease_loop as resources free (reference:
         # ClusterLeaseManager's lease queue).
@@ -349,6 +364,10 @@ class NodeAgent:
                     ok = await self.gcs.call("report_resources", {
                         "node_id": self.node_id,
                         "available": self.resources_available,
+                        # Gray-failure evidence about peers: per-peer
+                        # RTT/rate EMAs from this node's transfer paths
+                        # (the GCS folds them into suspicion scores).
+                        "peer_stats": self._peer_stats_snapshot(),
                     })
                     if ok is False and not self._shutdown \
                             and self._draining is None:
@@ -608,6 +627,12 @@ class NodeAgent:
             # Chaos must reach worker processes too (their config builds
             # from env; _system_config stops at the daemons' argv).
             env.setdefault("RAY_TPU_rpc_chaos", chaos_spec)
+        link_spec = get_config().link_chaos
+        if link_spec:
+            # Slow-NODE mode: a node whose agent is link-degraded
+            # degrades its workers the same way (the whole host shares
+            # the gray NIC).
+            env.setdefault("RAY_TPU_link_chaos", link_spec)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
@@ -1007,13 +1032,23 @@ class NodeAgent:
             except rpc.RpcError:
                 return None
         nodes = self._nodes_cache
-        cands = [(tuple(n["address"]), n["resources_total"],
-                  n["resources_available"])
-                 for n in nodes
-                 if policy.targetable(n)
-                 and bytes(n["node_id"]) != self.node_id]
-        best = policy.hybrid_pick(cands, resources)
-        return list(best) if best else None
+        # Gray-suspect nodes are spilled to only when nothing healthy
+        # FITS — try the trusted subset first, then fall back to every
+        # live node (mirroring the GCS scheduler: a suspect node is a
+        # last resort, never a hard exclusion — if only it has room the
+        # lease must spill there, not park forever).
+        live = [n for n in nodes
+                if policy.targetable(n)
+                and bytes(n["node_id"]) != self.node_id]
+        trusted = policy.prefer_trusted(live)
+        for group in ([trusted, live] if len(trusted) < len(live)
+                      else [live]):
+            cands = [(tuple(n["address"]), n["resources_total"],
+                      n["resources_available"]) for n in group]
+            best = policy.hybrid_pick(cands, resources)
+            if best:
+                return list(best)
+        return None
 
     async def h_profile_worker(self, conn, p):
         """Forward a live-profiling request to workers on this node
@@ -1869,15 +1904,54 @@ class NodeAgent:
         addrs = [a for a in addrs if a != tuple(self.address)]
         if not addrs:
             return False
-        inflight = self._pull_inflight.get(oid)
-        if inflight is not None:
-            return await asyncio.shield(inflight)
+        # End-to-end budget: explicit payload field, or the deadline the
+        # RPC frame itself carried (rpc dispatch exposes it) — pulls
+        # triggered inside a deadline-carrying call inherit the
+        # REMAINING budget with zero caller changes.
+        deadline = p.get("deadline") or rpc.current_handler_deadline()
+        # Join a concurrent pull of the same object, but keep each
+        # caller's OWN budget: a deadline-less joiner must not inherit
+        # DeadlineExceededError when the running pull's (shorter) budget
+        # expires — the object is healthy, so re-pull with our budget —
+        # and a deadline-carrying joiner is bounded by wait_for even
+        # when the running pull has no deadline at all.
+        while True:
+            entry = self._pull_inflight.get(oid)
+            if entry is None:
+                break
+            fut, running_deadline = entry
+            if deadline is not None:
+                # Our own budget is checked OUTSIDE the try: the except
+                # below routes the running pull's expiry to a re-pull,
+                # and our own expiry must never take that branch (it
+                # would loop without awaiting — a synchronous spin).
+                left = deadline - time.time()
+                if left <= -rpc.DEADLINE_SKEW_SLACK_S:
+                    raise exc.DeadlineExceededError(
+                        f"pull of {oid.hex()} exceeded its deadline "
+                        f"while joining an in-flight pull")
+            try:
+                if deadline is None:
+                    return await asyncio.shield(fut)
+                return await asyncio.wait_for(asyncio.shield(fut),
+                                              max(0.05, left))
+            except asyncio.TimeoutError:
+                raise exc.DeadlineExceededError(
+                    f"pull of {oid.hex()} exceeded its deadline "
+                    f"while joining an in-flight pull") from None
+            except exc.DeadlineExceededError:
+                if deadline is None or (
+                        running_deadline is not None
+                        and deadline > running_deadline + 1e-6):
+                    continue  # its budget, not ours — pull ourselves
+                raise
         fut = asyncio.get_running_loop().create_future()
-        self._pull_inflight[oid] = fut
+        self._pull_inflight[oid] = (fut, deadline)
         try:
             ok = await self._do_pull(oid, addrs,
                                      p.get("priority", 0),
-                                     p.get("timeout_ms", 10000))
+                                     p.get("timeout_ms", 10000),
+                                     deadline=deadline)
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -1895,7 +1969,8 @@ class NodeAgent:
         """Internal: every source reported the object absent."""
 
     async def _stream_chunks(self, peers, oid: bytes, size: int,
-                             make_sink, commit=None) -> None:
+                             make_sink, commit=None,
+                             deadline: float | None = None) -> None:
         """Shared pipelined chunk engine for arena- and disk-destined
         pulls (and any future push path).  Keeps up to
         `object_transfer_max_inflight_chunks` fetch_chunk requests in
@@ -1908,6 +1983,16 @@ class NodeAgent:
         loop; without commit the sink itself is the final destination
         (arena view).
 
+        Tail defense: with >=2 sources and budget left in the hedge
+        bucket, the first attempt of each chunk RACES a backup source
+        started after the primary's observed p95 latency — first
+        responder wins, the straggler is cancelled (its late bytes are
+        discarded by call_raw's sink defusal, so the two writers can
+        never interleave into the destination).  An end-to-end
+        `deadline` (absolute wall clock) caps every attempt's timeout by
+        the remaining budget and raises DeadlineExceededError when it
+        runs out.
+
         Failure discipline: a failed chunk retries on each source in turn
         (two passes).  Raises _ObjectGone when every source consistently
         answers \"gone\", ObjectTransferError when transient failures
@@ -1917,60 +2002,189 @@ class NodeAgent:
         if size == 0:
             return
 
+        def budget_timeout() -> float:
+            if deadline is None:
+                return self._chunk_timeout
+            rem = deadline - time.time()
+            # Slack: the deadline may have been stamped by a remote
+            # owner's clock.  Within the skew window attempts continue
+            # on a short floor; retry exhaustion past the deadline still
+            # classifies as a deadline failure below.
+            if rem <= -rpc.DEADLINE_SKEW_SLACK_S:
+                raise exc.DeadlineExceededError(
+                    f"pull of {oid.hex()} exceeded its deadline")
+            return min(self._chunk_timeout, max(rem, 0.25))
+
+        async def try_peer(peer, pos: int, n: int, sink_obj,
+                           eff_timeout: float):
+            """One fetch attempt -> ('ok'|'gone'|'dead'|'transient', err).
+            'dead' == source unreachable: its copy is lost for our
+            purposes (must route to ObjectLost -> lineage recovery, not
+            to a retryable transient that never reconstructs)."""
+            if peer is None or peer.closed:
+                return "dead", None
+            t0 = time.monotonic()
+            try:
+                res = await peer.call_raw(
+                    "fetch_chunk",
+                    {"object_id": oid, "offset": pos,
+                     "length": n, "raw": True},
+                    sink=sink_obj, timeout=eff_timeout)
+            except rpc.ConnectionLost as e:
+                self._note_peer_failure(peer)
+                return "dead", e
+            except (rpc.RpcError, asyncio.TimeoutError) as e:
+                self._note_peer_failure(peer)
+                return "transient", e
+            if isinstance(res, int) and res == n:
+                self._note_peer_latency(peer, time.monotonic() - t0, n,
+                                        chunk=True)
+                return "ok", None
+            if isinstance(res, (bytes, bytearray)):
+                # Legacy peer: msgpack bytes body.
+                if len(res) == n:
+                    sink_obj[0:n] = res
+                    self._note_peer_latency(peer, time.monotonic() - t0,
+                                            n, chunk=True)
+                    return "ok", None
+                return "transient", ValueError(f"short chunk {len(res)}/{n}")
+            if res is None or (isinstance(res, dict) and res.get("gone")):
+                return "gone", None
+            return "transient", ValueError(
+                f"unexpected fetch_chunk reply {type(res)}")
+
+        async def settle(task):
+            """Cancel-and-await a straggler attempt: call_raw's finally
+            defuses its reception, so once this returns its late bytes
+            can only be discarded — never scattered into a buffer the
+            winner already filled.  Our OWN cancellation (this whole
+            fetch aborted by a sibling chunk's failure) is re-raised —
+            but only after the straggler is done — rather than
+            swallowed: a worker that survives cancel would keep
+            scattering remote bytes into arena regions the aborted
+            pull has already released for reuse."""
+            if not task.done():
+                task.cancel()
+            external = None
+            while True:
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    if not task.done():
+                        # Injected into US mid-await (the straggler is
+                        # still running, so it can't be the source).
+                        # Remember it and keep waiting the straggler
+                        # out — its cancel is already requested.
+                        external = asyncio.CancelledError()
+                        continue
+                except Exception:  # noqa: BLE001
+                    pass
+                break
+            if external is not None:
+                raise external
+
+        async def hedged(pos: int, n: int) -> bool:
+            """Primary-vs-delayed-backup race; True = chunk landed."""
+            primary = peers[0]
+            backup = next((p for p in peers[1:]
+                           if p is not None and not p.closed), None)
+            if backup is None or primary is None or primary.closed:
+                return False
+            eff = budget_timeout()
+            sink1 = make_sink(pos, n)
+            t1 = rpc.spawn(try_peer(primary, pos, n, sink1, eff))
+            t2 = None
+            # try/finally: budget expiry mid-race, or this whole fetch
+            # being cancelled by a sibling chunk's failure, must never
+            # leave an un-settled attempt scattering into the real sink
+            # after the pull aborts and the arena region is reused.
+            try:
+                delay = min(self._hedge_delay_s(primary), eff)
+                done, _ = await asyncio.wait({t1}, timeout=delay)
+                if t1 in done:
+                    st, _err = t1.result()
+                    if st == "ok":
+                        if commit is not None:
+                            await commit(pos, sink1)
+                        return True
+                    return False   # sequential pass classifies/retries
+                if not self._hedge_allow():
+                    st, _err = await t1
+                    if st == "ok":
+                        if commit is not None:
+                            await commit(pos, sink1)
+                        return True
+                    return False
+                staging = memoryview(bytearray(n))
+                t2 = rpc.spawn(try_peer(backup, pos, n, staging,
+                                        budget_timeout()))
+                winner = None
+                pending = {t1, t2}
+                while pending and winner is None:
+                    done, pending = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED)
+                    for t in done:
+                        if t.result()[0] == "ok":
+                            winner = t
+                            break
+                # Settled BEFORE touching sink1 below (not only in the
+                # finally): the loser must be done writing first.
+                await settle(t1)
+                await settle(t2)
+                if winner is None:
+                    return False
+                if winner is t2:
+                    # Backup won into its private staging buffer; the
+                    # primary is fully settled, so the real sink is ours.
+                    if commit is None:
+                        sink1[0:n] = staging
+                    else:
+                        await commit(pos, staging)
+                    return True
+                if commit is not None:
+                    await commit(pos, sink1)
+                return True
+            finally:
+                # Settle BOTH stragglers even if our own cancellation
+                # lands mid-settle; propagate it only once neither can
+                # write another byte.
+                external = None
+                for t in (t1, t2):
+                    if t is None:
+                        continue
+                    try:
+                        await settle(t)
+                    except asyncio.CancelledError as e:
+                        external = e
+                if external is not None:
+                    raise external
+
         async def fetch(pos: int) -> None:
             n = min(self._chunk_bytes, size - pos)
+            self._hedge_total += 1
+            if self._hedge_enabled and len(peers) >= 2:
+                if await hedged(pos, n):
+                    return
             last_err = None
             gone = dead = transient = 0
             for _round in range(2):
                 gone = dead = transient = 0
                 for peer in peers:
-                    if peer is None or peer.closed:
-                        # Source unreachable == its copy is lost for
-                        # our purposes (matches the pre-raw behavior:
-                        # dead nodes must route to ObjectLost ->
-                        # lineage recovery, not to a retryable
-                        # transient error that never reconstructs).
-                        dead += 1
-                        continue
                     sink_obj = make_sink(pos, n)
-                    try:
-                        res = await peer.call_raw(
-                            "fetch_chunk",
-                            {"object_id": oid, "offset": pos,
-                             "length": n, "raw": True},
-                            sink=sink_obj,
-                            timeout=self._chunk_timeout)
-                    except rpc.ConnectionLost as e:
-                        dead += 1
-                        last_err = e
-                        continue
-                    except (rpc.RpcError, asyncio.TimeoutError) as e:
-                        transient += 1
-                        last_err = e
-                        continue
-                    if isinstance(res, int) and res == n:
+                    st, err = await try_peer(peer, pos, n, sink_obj,
+                                             budget_timeout())
+                    if st == "ok":
                         if commit is not None:
                             await commit(pos, sink_obj)
                         return
-                    if isinstance(res, (bytes, bytearray)):
-                        # Legacy peer: msgpack bytes body.
-                        if len(res) == n:
-                            if commit is not None:
-                                await commit(pos, res)
-                            else:
-                                sink_obj[0:n] = res
-                            return
-                        transient += 1
-                        last_err = ValueError(
-                            f"short chunk {len(res)}/{n}")
-                        continue
-                    if res is None or (isinstance(res, dict)
-                                       and res.get("gone")):
+                    if st == "gone":
                         gone += 1
-                        continue
-                    transient += 1
-                    last_err = ValueError(
-                        f"unexpected fetch_chunk reply {type(res)}")
+                    elif st == "dead":
+                        dead += 1
+                        last_err = err or last_err
+                    else:
+                        transient += 1
+                        last_err = err or last_err
                 if (gone or dead) and not transient:
                     # Unanimous and unambiguous: no second pass.
                     break
@@ -1978,6 +2192,14 @@ class NodeAgent:
                 # Every source is gone or dead — the object is not
                 # obtainable by retrying this pull.
                 raise NodeAgent._ObjectGone(oid)
+            if deadline is not None and time.time() > deadline:
+                # Retries exhausted AND the budget ran out: the typed
+                # deadline outcome, not a retryable transient — the
+                # owner already wrote this pull off.
+                raise exc.DeadlineExceededError(
+                    f"pull of {oid.hex()} exceeded its deadline "
+                    f"(chunk {pos}..{pos + n} unfetched after retries: "
+                    f"{last_err!r})")
             raise exc.ObjectTransferError(
                 f"chunk {pos}..{pos + n} of {oid.hex()} failed on all "
                 f"{len(peers)} source(s) after retries: {last_err!r}")
@@ -1987,7 +2209,9 @@ class NodeAgent:
             self._max_inflight_chunks)
 
     async def _pull_peers(self, addrs) -> list:
-        """Resolve source addresses to live (cached) connections."""
+        """Resolve source addresses to live (cached) connections.  Each
+        connection is tagged with its address (_peer_addr) so transfer
+        paths can record per-peer latency/rate stats."""
         peers = []
         for addr in addrs:
             peer = self._peer_conns.get(addr)
@@ -1996,27 +2220,161 @@ class NodeAgent:
                     peer = await rpc.connect(addr, name="agent->agent",
                                              retries=2)
                 except rpc.ConnectionLost:
+                    self._note_peer_failure(addr)
                     continue
+                peer._peer_addr = addr
                 self._peer_conns[addr] = peer
             peers.append(peer)
         return peers
 
+    # ---------------------------------------------- peer link health ------
+    def _peer_stat(self, addr: tuple) -> dict:
+        st = self._peer_stats.get(addr)
+        if st is None:
+            from collections import deque as _dq
+            st = self._peer_stats[addr] = {
+                "lat": _dq(maxlen=64), "rtt": None, "rate": None,
+                "fail": 0, "ts": time.monotonic()}
+        return st
+
+    def _note_peer_latency(self, peer, dt: float, nbytes: int = 0, *,
+                           chunk: bool = False) -> None:
+        """Record a per-peer link observation.  chunk=True samples are
+        bulk-transfer wall times: they feed the hedge-delay p95 deque
+        and the transfer-rate EMA but NOT the rtt EMA — a chunk's
+        duration is dominated by bandwidth and pipeline queuing, and
+        folding it into 'rtt' would let the GCS's gray scorer (which
+        compares against ~ms ping baselines) defame any node that
+        merely serves bulk traffic.  Only round-trip-shaped samples
+        (timed pings, _sample_peer_rtt) update 'rtt'."""
+        addr = getattr(peer, "_peer_addr", None) if not isinstance(
+            peer, tuple) else peer
+        if addr is None:
+            return
+        st = self._peer_stat(addr)
+        if chunk:
+            st["lat"].append(dt)
+            if nbytes and dt > 0:
+                rate = nbytes / dt
+                st["rate"] = rate if st["rate"] is None \
+                    else 0.8 * st["rate"] + 0.2 * rate
+        else:
+            st["rtt"] = dt if st["rtt"] is None \
+                else 0.8 * st["rtt"] + 0.2 * dt
+        st["ts"] = time.monotonic()
+
+    async def _sample_peer_rtt(self, peer) -> None:
+        """One timed ping — the only evidence allowed into the 'rtt'
+        EMA.  Sampled once per probed peer per pull: cheap relative to
+        any pull, and a delayed/congested link inflates it exactly when
+        the gray scorer should hear about it."""
+        if peer is None or peer.closed:
+            return
+        t0 = time.monotonic()
+        try:
+            await peer.call("ping", {}, timeout=5)
+        except Exception:
+            self._note_peer_failure(peer)
+            # A lost ping is worst-case RTT evidence, not silence —
+            # without this the lossiest link suppresses the very
+            # samples that would indict it.
+            self._note_peer_latency(peer, 5.0)
+            return
+        self._note_peer_latency(peer, time.monotonic() - t0)
+
+    def _note_peer_failure(self, peer) -> None:
+        addr = getattr(peer, "_peer_addr", None) if not isinstance(
+            peer, tuple) else peer
+        if addr is None:
+            return
+        st = self._peer_stat(addr)
+        st["fail"] += 1
+        st["ts"] = time.monotonic()
+
+    def _peer_stats_snapshot(self) -> Dict[str, dict]:
+        """Heartbeat payload: fresh (<60s) per-peer link observations,
+        keyed 'host:port' (msgpack-safe), for the GCS's gray-failure
+        scorer."""
+        now = time.monotonic()
+        # Evict long-dead entries (restarted peers bind fresh ports, so
+        # addresses churn forever) — the 15 min horizon still preserves
+        # hedge-delay p95 history across ordinary idle gaps.
+        for addr in [a for a, st in self._peer_stats.items()
+                     if now - st["ts"] > 900.0]:
+            del self._peer_stats[addr]
+        out = {}
+        for addr, st in self._peer_stats.items():
+            if now - st["ts"] > 60.0:
+                continue
+            # "fail" stays local (debugging): failed pings already fold
+            # into the rtt EMA as worst-case samples, so shipping the
+            # raw lifetime counter would be dead heartbeat payload.
+            out[f"{addr[0]}:{addr[1]}"] = {
+                "rtt": st["rtt"], "rate": st["rate"],
+                "age_s": round(now - st["ts"], 3)}
+        return out
+
+    def _hedge_delay_s(self, peer) -> float:
+        """How long to let the primary source run before racing a
+        backup: its observed p95 chunk latency (x1.5 slack), the
+        config override, or a 200ms cold-start default."""
+        if self._hedge_delay_ms > 0:
+            return self._hedge_delay_ms / 1000.0
+        addr = getattr(peer, "_peer_addr", None)
+        st = self._peer_stats.get(addr) if addr is not None else None
+        if st and len(st["lat"]) >= 8:
+            lat = sorted(st["lat"])
+            p95 = lat[int(0.95 * (len(lat) - 1))]
+            return min(p95 * 1.5 + 0.01, self._chunk_timeout / 2)
+        return 0.2
+
+    def _hedge_allow(self) -> bool:
+        # Windowed budget (tail-at-scale hedge budgets are windowed for
+        # this reason): halving both counters keeps the spend fraction
+        # but caps how much credit a long healthy period can bank —
+        # without the decay, a million quiet fetches would bankroll a
+        # ~100k-hedge burst exactly when the cluster is already slow,
+        # doubling load on it.
+        if self._hedge_total >= 2048:
+            self._hedge_total //= 2
+            self._hedge_used //= 2
+        if self._hedge_used <= (self._hedge_budget_frac
+                                * self._hedge_total + 4):
+            self._hedge_used += 1
+            return True
+        return False
+
     async def _do_pull(self, oid: bytes, addrs: list, priority: int,
-                       timeout_ms: int) -> bool:
+                       timeout_ms: int,
+                       deadline: float | None = None) -> bool:
         peers = await self._pull_peers(addrs)
         if not peers:
             return False
         await self._pull_slot(priority)
         try:
+            if deadline is not None and \
+                    deadline - time.time() <= -rpc.DEADLINE_SKEW_SLACK_S:
+                raise exc.DeadlineExceededError(
+                    f"pull of {oid.hex()} exceeded its deadline before "
+                    f"the first probe")
             info = None
             for peer in peers:
+                probe_timeout = 60 if deadline is None else \
+                    max(0.1, min(60, deadline - time.time()))
+                t0 = time.monotonic()
                 try:
                     info = await peer.call(
                         "object_info",
                         {"object_id": oid, "timeout_ms": timeout_ms},
-                        timeout=60)
+                        timeout=probe_timeout)
                 except (rpc.RpcError, asyncio.TimeoutError):
+                    self._note_peer_failure(peer)
                     continue
+                # NOT a latency sample: object_info is a long-poll that
+                # legitimately parks server-side up to timeout_ms while
+                # the object is being created — time a dedicated ping
+                # instead (the only evidence the rtt EMA accepts).
+                rpc.spawn(self._sample_peer_rtt(peer))
                 if info is not None:
                     break
             if info is None:
@@ -2034,12 +2392,14 @@ class NodeAgent:
                         break
             if buf is None:
                 # No room even after spilling: land the pull on disk.
-                return await self._pull_to_disk(peers, oid, size)
+                return await self._pull_to_disk(peers, oid, size,
+                                                deadline=deadline)
             ok = False
             try:
                 await self._stream_chunks(
                     peers, oid, size,
-                    make_sink=lambda pos, n: buf[pos:pos + n])
+                    make_sink=lambda pos, n: buf[pos:pos + n],
+                    deadline=deadline)
                 ok = True
             except NodeAgent._ObjectGone:
                 return False
@@ -2077,7 +2437,8 @@ class NodeAgent:
         finally:
             os.close(fd)
 
-    async def _pull_to_disk(self, peers, oid: bytes, size: int) -> bool:
+    async def _pull_to_disk(self, peers, oid: bytes, size: int,
+                            deadline: float | None = None) -> bool:
         path = self._spill_path(oid)
         # Create/truncate up front; chunk commits reopen positionally.
         os.close(os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644))
@@ -2096,7 +2457,7 @@ class NodeAgent:
                 await self._stream_chunks(
                     peers, oid, size,
                     make_sink=lambda pos, n: memoryview(bytearray(n)),
-                    commit=commit)
+                    commit=commit, deadline=deadline)
                 ok = True
             except NodeAgent._ObjectGone:
                 return False
@@ -2173,6 +2534,8 @@ async def _amain(args):
     chaos_spec = get_config().rpc_chaos
     if chaos_spec:
         rpc.enable_chaos(chaos_spec)
+    rpc.enable_link_chaos(get_config().link_chaos)
+    rpc.set_default_call_timeout(get_config().control_call_timeout_s)
     agent = NodeAgent(
         gcs_address=json.loads(args.gcs_address),
         session_dir=args.session_dir,

@@ -1,10 +1,14 @@
-"""Process-kill fault injection: the chaos harness.
+"""Fault-injection harnesses: process kills and link-level degradation.
 
-rpc.py's ``_Chaos`` drops *messages*; this supervisor kills *processes*
+rpc.py's ``_Chaos`` drops *messages*; ``ProcessChaos`` kills *processes*
 — SIGKILL, no warning — because the crash paths (a SIGKILL'd worker,
 agent, or GCS) are what dominate production failures on preemptible TPU
-fleets, and message-level drops never exercise them.  The spec mirrors
-the ``rpc_chaos`` style (config ``process_chaos``):
+fleets, and message-level drops never exercise them.  ``LinkChaos``
+degrades the *byte stream* itself (delay, jitter, bandwidth throttle,
+asymmetric blackhole) — the GRAY failures (Huang et al., HotOS'17) that
+neither drops nor kills reproduce: the peer is alive, the TCP session is
+up, and everything is merely late or one-directional.  The ProcessChaos
+spec mirrors the ``rpc_chaos`` style (config ``process_chaos``):
 
     'class=N:period_s[:delay_s],...'
 
@@ -44,6 +48,124 @@ from typing import Callable, Dict, Iterable, Optional
 logger = logging.getLogger("ray_tpu.chaos")
 
 CLASSES = ("worker", "agent", "gcs")
+
+# ---------------------------------------------------------------------------
+# Link chaos (config `link_chaos`): gray-failure injection on the RPC
+# byte stream.  Applied inside each enabled PROCESS by rpc.Connection —
+# enabling it on one node's daemons (per-node _system_config) is
+# slow-node mode; a `match` filter narrows a rule to specific links.
+#
+#   spec:  'rule[,rule...]'
+#   rule:  '[match/]kind=f1[:f2[:f3[:f4]]]'
+#
+#   kind        fields                          meaning
+#   out_delay   delay_s[:jitter_s[:after_s[:dur_s]]]   delay outbound bytes
+#   in_delay    delay_s[:jitter_s[:after_s[:dur_s]]]   delay inbound bytes
+#   out_bw      bytes_per_s[:after_s[:dur_s]]          throttle outbound
+#   in_bw       bytes_per_s[:after_s[:dur_s]]          throttle inbound
+#   out_drop    [after_s[:dur_s]]                      blackhole outbound
+#   in_drop     [after_s[:dur_s]]                      blackhole inbound
+#
+# `match` is a substring filter against the link descriptor
+# "<conn name>|<peer host:port>" (e.g. 'agent->agent/' or
+# ':45123/out_delay=...'); no match = every link of the process.
+# out_drop alone is an ASYMMETRIC partition: A's requests (and replies)
+# never reach B while B->A bytes still flow — the TCP session stays up,
+# which is exactly what makes gray partitions invisible to crash
+# detectors.  after_s/dur_s give deterministic schedules (netsplit that
+# heals, delay that starts late) measured from injector creation.
+# Jitter uses a seeded RNG like the other injectors.
+# ---------------------------------------------------------------------------
+
+LINK_KINDS = ("out_delay", "in_delay", "out_bw", "in_bw",
+              "out_drop", "in_drop")
+
+
+def parse_link_spec(spec: str):
+    """'[match/]kind=f1[:f2...]' rules -> list of rule dicts."""
+    rules = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        # '=' and fields are optional: a bare 'out_drop' is a valid
+        # full asymmetric partition from t=0 (all fields default).
+        lhs, _, rhs = part.partition("=")
+        match = ""
+        if "/" in lhs:
+            match, lhs = lhs.rsplit("/", 1)
+        if lhs not in LINK_KINDS:
+            raise ValueError(
+                f"unknown link_chaos kind {lhs!r} (expected one of "
+                f"{LINK_KINDS})")
+        f = [float(x) for x in rhs.split(":")] if rhs else []
+        rule = {"kind": lhs, "match": match, "after": 0.0, "dur": None}
+        if lhs.endswith("_delay"):
+            rule["delay"] = f[0] if f else 0.0
+            rule["jitter"] = f[1] if len(f) > 1 else 0.0
+            rule["after"] = f[2] if len(f) > 2 else 0.0
+            rule["dur"] = f[3] if len(f) > 3 else None
+        elif lhs.endswith("_bw"):
+            if not f or f[0] <= 0:
+                raise ValueError("link_chaos bw needs bytes_per_s > 0")
+            rule["bw"] = f[0]
+            rule["after"] = f[1] if len(f) > 1 else 0.0
+            rule["dur"] = f[2] if len(f) > 2 else None
+            rule["next_free"] = 0.0      # token-bucket state (monotonic)
+        else:                            # *_drop
+            rule["after"] = f[0] if f else 0.0
+            rule["dur"] = f[1] if len(f) > 1 else None
+        rules.append(rule)
+    return rules
+
+
+class LinkChaos:
+    """Deterministic link-degradation planner, consulted by
+    rpc.Connection for every chunk of bytes it moves.
+
+        lc = LinkChaos("out_delay=0.5,agent->agent/in_drop=")
+        drop, delay_s = lc.plan("out", "agent|127.0.0.1:4567", nbytes)
+
+    The planner itself is sync and transport-agnostic; the async side
+    (ordered delayed delivery) lives in rpc.py.  Schedules (`after`/
+    `dur`) are measured from construction; jitter is drawn from a
+    seeded RNG so runs replay identically."""
+
+    def __init__(self, spec: str, seed: int = 0xC0FFEE):
+        self.rules = parse_link_spec(spec)
+        self._rng = random.Random(seed)
+        self._t0 = time.monotonic()
+
+    def _active(self, rule, now: float) -> bool:
+        t = now - self._t0
+        if t < rule["after"]:
+            return False
+        return rule["dur"] is None or t < rule["after"] + rule["dur"]
+
+    def plan(self, direction: str, desc: str, nbytes: int):
+        """(drop, delay_s) for `nbytes` moving `direction` ('out'|'in')
+        on the link described by `desc`."""
+        drop = False
+        delay = 0.0
+        now = time.monotonic()
+        prefix = direction + "_"
+        for rule in self.rules:
+            if not rule["kind"].startswith(prefix):
+                continue
+            if rule["match"] and rule["match"] not in desc:
+                continue
+            if not self._active(rule, now):
+                continue
+            kind = rule["kind"]
+            if kind.endswith("_drop"):
+                drop = True
+            elif kind.endswith("_delay"):
+                d = rule["delay"]
+                if rule["jitter"]:
+                    d += self._rng.uniform(-rule["jitter"], rule["jitter"])
+                delay += max(0.0, d)
+            else:                        # bandwidth token bucket
+                start = max(now, rule["next_free"])
+                rule["next_free"] = start + nbytes / rule["bw"]
+                delay += start - now
+        return drop, delay
 
 # log-file basename prefix -> process class
 _LOG_CLASS = (("worker-", "worker"), ("agent_", "agent"),

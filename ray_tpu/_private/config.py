@@ -116,9 +116,54 @@ _define("create_backpressure_timeout_s", 30.0,
         "failing (reference: plasma create_request_queue semantics)")
 _define("rpc_connect_retries", 10)
 _define("rpc_connect_retry_delay_s", 0.2)
+_define("control_call_timeout_s", 60.0,
+        "default deadline for unary control-plane RPCs whose call site "
+        "passes no timeout: a half-open connection (gray peer, asymmetric "
+        "partition) can then never hang a caller forever.  Streaming-ish "
+        "calls that legitimately block (actor pushes, stream "
+        "backpressure, object long-polls) opt out with explicit "
+        "timeout=0; 0 here disables the default entirely")
+_define("pull_hedge_enabled", True,
+        "race a backup source for a pull chunk once the primary exceeds "
+        "its observed p95 latency (Dean & Barroso hedged requests); "
+        "needs >=2 sources (from_addrs) to engage")
+_define("pull_hedge_delay_ms", 0,
+        "hedge delay override; 0 = adaptive (per-peer p95 of recent "
+        "chunk fetches, 200ms until enough samples)")
+_define("pull_hedge_budget_fraction", 0.1,
+        "cap on hedged fetches as a fraction of total chunk fetches "
+        "(plus a small burst) so hedging cannot amplify load on an "
+        "already-throttled cluster")
+_define("gray_suspicion_threshold", 0.6,
+        "per-node suspicion score (0..1, EMA of RTT-vs-cluster-baseline "
+        "and heartbeat-staleness evidence) above which a node is "
+        "treated as gray-suspect: placement deprioritizes it and, "
+        "sustained, it is auto-drained")
+_define("gray_sustained_s", 5.0,
+        "how long suspicion must stay above the threshold before the "
+        "GCS auto-drains the node with reason='gray' (0 disables the "
+        "sustain requirement, not the drain)")
+_define("gray_auto_drain", True,
+        "auto-trigger drain_node(reason='gray') for a sustained-suspect "
+        "node (detect -> avoid -> evacuate); never drains the last "
+        "healthy node")
+_define("gray_min_rtt_ms", 100.0,
+        "absolute RTT floor below which a node is never gray-suspect "
+        "(pure ratio-to-baseline would flag healthy microsecond-RTT "
+        "nodes on an idle cluster)")
+_define("gray_rtt_ratio", 3.0,
+        "probe/peer RTT must also exceed this multiple of the cluster "
+        "median RTT to count as gray evidence")
 _define("rpc_chaos", "",
         "deterministic RPC fault injection: 'Method=N:req%:resp%' "
         "(reference: src/ray/rpc/rpc_chaos.cc RAY_testing_rpc_failure)")
+_define("link_chaos", "",
+        "deterministic link-level fault injection on the RPC byte "
+        "stream: '[match/]kind=fields,...' with kind in out_delay|"
+        "in_delay|out_bw|in_bw|out_drop|in_drop — per-peer delay+jitter, "
+        "bandwidth throttling, and ASYMMETRIC partitions (out_drop "
+        "blackholes A->B while B->A flows); enabling it process-wide on "
+        "one node is slow-node mode (_private/chaos.py LinkChaos)")
 _define("process_chaos", "",
         "deterministic process-kill fault injection for cluster fixtures: "
         "'class=N:period_s[:delay_s]' with class in worker|agent|gcs — "

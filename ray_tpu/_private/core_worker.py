@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import exceptions as exc
 from ..object_ref import ObjectRef
-from . import protocol, rpc
+from . import deadlines, protocol, rpc
 from .config import get_config
 from .ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
                   fast_actor_task_id)
@@ -192,6 +192,15 @@ class CoreWorker:
         self._inflight_replies: Dict[bytes, asyncio.Future] = {}
         self._recovering: Dict[bytes, asyncio.Future] = {}
         self._cancelled: set = set()               # task ids cancelled
+        # Task ids whose end-to-end deadline fired owner-side: their
+        # return refs already resolved to DeadlineExceededError, so a
+        # late reply (or the cancel path's TaskCancelledError) must not
+        # overwrite that typed outcome — only bookkeeping runs.
+        self._deadline_expired: set = set()
+        # task_id -> armed call_later handle; cancelled when the task
+        # resolves so a deadline can never fire on a task that already
+        # completed (and whose freed return entries it would resurrect).
+        self._deadline_timers: Dict[bytes, Any] = {}
         # task_id -> asyncio.Task finishing a deferred submission (fn
         # export / dep resolution); _cancel interrupts these directly.
         self._resolving: Dict[bytes, asyncio.Task] = {}
@@ -281,7 +290,13 @@ class CoreWorker:
         # Unconditional: an empty spec CLEARS injection, so a chaos-free
         # init() after a chaos session in the same process doesn't
         # inherit the old rules through the module global.
-        rpc.enable_chaos(get_config().rpc_chaos)
+        cfg = get_config()
+        rpc.enable_chaos(cfg.rpc_chaos)
+        rpc.enable_link_chaos(cfg.link_chaos)
+        # Gray-failure defense: unary control calls get a default bound
+        # so a half-open connection can never hang this process forever
+        # (explicit timeout=0 at a call site opts out).
+        rpc.set_default_call_timeout(cfg.control_call_timeout_s)
         self._server = rpc.RpcServer(self._handlers(), name=f"cw-{self.mode}")
         self.address = await self._server.start_tcp("127.0.0.1", 0)
         # Reconnecting: calls issued across a GCS restart re-dial and
@@ -500,12 +515,20 @@ class CoreWorker:
         self._shutdown = True
         if self.loop and self._loop_thread:
             def _drain_and_stop():
-                # Cancel background tasks before stopping so teardown is
-                # quiet (no 'Task was destroyed but it is pending').
-                for t in asyncio.all_tasks():
-                    if t is not asyncio.current_task():
+                # Cancel background tasks and WAIT (bounded) for them to
+                # unwind before stopping, so teardown is quiet (no 'Task
+                # was destroyed but it is pending') — a task awaiting a
+                # nested future needs several loop iterations to finish
+                # cancelling, not one.
+                async def _finish():
+                    cur = asyncio.current_task()
+                    tasks = [t for t in asyncio.all_tasks() if t is not cur]
+                    for t in tasks:
                         t.cancel()
-                self.loop.call_soon(self.loop.stop)
+                    if tasks:
+                        await asyncio.wait(tasks, timeout=1.0)
+                    self.loop.stop()
+                rpc.spawn(_finish())
             self.loop.call_soon_threadsafe(_drain_and_stop)
             self._loop_thread.join(timeout=5)
         self.executor.shutdown(wait=False)
@@ -1092,6 +1115,22 @@ class CoreWorker:
 
     async def _get_many(self, refs: List[ObjectRef], timeout):
         deadline = None if timeout is None else time.monotonic() + timeout
+        # A get() inside a deadline-carrying task is bounded by the
+        # task's REMAINING budget even with no explicit timeout — the
+        # fetch must not outlive the promise its caller made.
+        amb = deadlines.remaining()
+        if amb is not None:
+            amb_deadline = time.monotonic() + amb
+            if deadline is None or amb_deadline < deadline:
+                try:
+                    return await self._get_many_at(refs, amb_deadline)
+                except exc.GetTimeoutError as e:
+                    raise exc.DeadlineExceededError(
+                        f"get() exceeded the task's end-to-end deadline: "
+                        f"{e}") from None
+        return await self._get_many_at(refs, deadline)
+
+    async def _get_many_at(self, refs: List[ObjectRef], deadline):
         if len(refs) < 4:
             return await asyncio.gather(
                 *[self._get_one(r, deadline) for r in refs])
@@ -1211,9 +1250,12 @@ class CoreWorker:
             timeout_ms = -1 if deadline is None else int(
                 max(0.0, deadline - time.monotonic()) * 1000)
             try:
+                # timeout=0 opts out of the control-call default: an
+                # unbounded ray.get() long-polls the owner by design
+                # (the 30s-slice wait lives server-side).
                 res = await conn.call(
                     "get_object", {"object_id": oid, "timeout_ms": timeout_ms},
-                    timeout=None if deadline is None else
+                    timeout=0 if deadline is None else
                     max(0.1, deadline - time.monotonic()))
             except asyncio.TimeoutError:
                 raise exc.GetTimeoutError(f"timed out getting {oid.hex()}")
@@ -1403,13 +1445,42 @@ class CoreWorker:
             if view is None:
                 raise exc.ObjectLostError(f"{oid.hex()} not in local store")
             return view
+        # Wall-clock deadline for the pull: the tighter of the caller's
+        # get() bound (monotonic) and the ambient task deadline — carried
+        # in the RPC frame and inside the payload so the agent bounds its
+        # chunk fetches by the REMAINING budget.
+        ambient_dl = deadlines.get()
+        wall_dl = ambient_dl
+        caller_dl = None
+        if deadline is not None:
+            caller_dl = time.time() + max(0.0, deadline - time.monotonic())
+            wall_dl = caller_dl if wall_dl is None \
+                else min(wall_dl, caller_dl)
+
+        def _pull_deadline_exc(msg: str) -> Exception:
+            # When the caller's get(timeout=) bound is the binding
+            # constraint (no tighter ambient task deadline), an expiry
+            # is the documented caller-local outcome — GetTimeoutError,
+            # on which poll loops legitimately continue — never the
+            # end-to-end DeadlineExceededError contract.
+            if caller_dl is not None and (ambient_dl is None
+                                          or caller_dl <= ambient_dl):
+                return exc.GetTimeoutError(
+                    f"timed out pulling {oid.hex()}")
+            return exc.DeadlineExceededError(msg)
+
         ok = False
         for pull_attempt in range(2):
             try:
                 ok = await self.agent.call("pull_object", {
                     "object_id": oid, "from_addr": list(agent_addr),
-                    "priority": 0}, timeout=120)
+                    "priority": 0, "deadline": wall_dl}, timeout=120,
+                    deadline=wall_dl)
                 break
+            except exc.DeadlineExceededError as e:
+                # Local deadline= bound on the call expired (blackholed
+                # agent link) before any remote reply.
+                raise _pull_deadline_exc(str(e)) from None
             except rpc.RemoteError as e:
                 # The agent distinguishes "object gone at every source"
                 # (ok=False -> ObjectLostError, recovery may engage) from
@@ -1426,6 +1497,12 @@ class CoreWorker:
                 # bytes (e.g. truncated spill file) does reach
                 # reconstruction instead of erroring forever.
                 first = str(e).split("\n", 1)[0]
+                if first.startswith("DeadlineExceededError"):
+                    # The pull's budget ran out at the agent: surface the
+                    # typed deadline outcome — NOT ObjectLostError, which
+                    # would trigger destructive lineage re-execution for
+                    # an object that may be perfectly healthy.
+                    raise _pull_deadline_exc(first) from None
                 if first.startswith("ObjectTransferError") \
                         and pull_attempt == 0:
                     continue
@@ -1617,7 +1694,8 @@ class CoreWorker:
                     fn_blob: Optional[bytes] = None,
                     generator_backpressure: int = 0,
                     sched_key: Optional[bytes] = None,
-                    spec_prefix: Optional[tuple] = None) -> List[ObjectRef]:
+                    spec_prefix: Optional[tuple] = None,
+                    timeout_s: Optional[float] = None) -> List[ObjectRef]:
         """Submit a normal task. NEVER blocks on dependencies: refs are
         minted and returned immediately; pending ObjectRef args resolve on
         the io loop and the task joins the lease queue when they're ready
@@ -1631,6 +1709,15 @@ class CoreWorker:
         the blob rides every submit_batch frame un-re-encoded."""
         num_returns, streaming = self._parse_streaming(
             num_returns, generator_backpressure)
+        # End-to-end deadline: an explicit timeout_s starts the clock
+        # here; otherwise a submit made INSIDE a deadline-carrying task
+        # inherits that task's remaining budget (the composition rule —
+        # nested work never outlives its parent's promise).
+        # `is not None`, not truthiness: timeout_s=0 is an already-
+        # exhausted budget (e.g. max(0, remaining)) and must expire
+        # typed immediately, not silently run unbounded.
+        deadline = (time.time() + timeout_s) if timeout_s is not None \
+            else deadlines.get()
         if sched_key is None:
             # Caller didn't pre-package: do it here (memoized; raises on
             # the loop thread only for not-yet-cached working_dir uploads).
@@ -1640,7 +1727,8 @@ class CoreWorker:
             resources=resources, max_retries=max_retries,
             scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env, name=name, streaming=streaming,
-            sched_key=sched_key, spec_prefix=spec_prefix)
+            sched_key=sched_key, spec_prefix=spec_prefix,
+            deadline=deadline)
         if refs is not None:
             return refs
         return self._submit_task_deferred(
@@ -1649,13 +1737,13 @@ class CoreWorker:
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env, name=name, fn_blob=fn_blob,
             streaming=streaming, sched_key=sched_key,
-            spec_prefix=spec_prefix)
+            spec_prefix=spec_prefix, deadline=deadline)
 
     def _try_submit_fast(self, *, fn_id, args, kwargs, num_returns,
                          resources, max_retries, scheduling_strategy,
                          runtime_env, name, streaming=None,
-                         sched_key=None,
-                         spec_prefix=None) -> Optional[List[ObjectRef]]:
+                         sched_key=None, spec_prefix=None,
+                         deadline=None) -> Optional[List[ObjectRef]]:
         """Submission hot path (reference: the Cython submit_task releases
         the GIL and never blocks on the raylet, _raylet.pyx:3432).  When
         the function is already exported and every arg inlines, the spec
@@ -1708,6 +1796,8 @@ class CoreWorker:
             spec["retries_left"] = max_retries
             if streaming is not None:
                 spec["streaming"] = streaming
+            if deadline is not None:
+                spec["deadline"] = deadline
             tr = protocol._trace_inject()
             if tr is not None:
                 spec["trace"] = tr
@@ -1718,7 +1808,8 @@ class CoreWorker:
                 owner_addr=list(self.address), resources=resources,
                 retries_left=max_retries,
                 scheduling_strategy=scheduling_strategy,
-                runtime_env=runtime_env, name=name, streaming=streaming)
+                runtime_env=runtime_env, name=name, streaming=streaming,
+                deadline=deadline)
         refs = []
         for i in range(num_returns):
             oid = task_id + (i + 1).to_bytes(4, "little")
@@ -1743,6 +1834,7 @@ class CoreWorker:
                 if spec_prefix is not None:
                     state.prefix, state.prefix_blob = spec_prefix
             state.queue.append(_PendingTask(spec, []))
+            self._arm_task_deadline(spec)
             # Deferred pump: a burst of submissions landing in this loop
             # tick pumps ONCE, so tasks group into per-lease submit_batch
             # frames instead of one frame each.
@@ -1780,7 +1872,8 @@ class CoreWorker:
     def _submit_task_deferred(self, *, fn, fn_id, args, kwargs, num_returns,
                               resources, max_retries, scheduling_strategy,
                               runtime_env, name, fn_blob, streaming,
-                              sched_key, spec_prefix=None) -> List[ObjectRef]:
+                              sched_key, spec_prefix=None,
+                              deadline=None) -> List[ObjectRef]:
         """Slow-path submission (ref args / oversized args / unexported
         fn) without blocking the caller: args serialize on the CALLING
         thread (post-call mutation is safe, matching the fast path and
@@ -1804,7 +1897,8 @@ class CoreWorker:
             owner_addr=list(self.address),
             resources=resources, retries_left=max_retries,
             scheduling_strategy=scheduling_strategy, runtime_env=runtime_env,
-            name=name or getattr(fn, "__name__", ""), streaming=streaming)
+            name=name or getattr(fn, "__name__", ""), streaming=streaming,
+            deadline=deadline)
         refs = []
         for i in range(num_returns):
             oid = task_id + (i + 1).to_bytes(4, "little")
@@ -1862,6 +1956,7 @@ class CoreWorker:
             self._schedule_pump(key, state)
 
         def _start():
+            self._arm_task_deadline(spec)
             # Eager task execution can run _finish to completion INSIDE
             # this _spawn call (everything already resolved, no suspension
             # point) — its finally-pop would then precede this assignment
@@ -2117,28 +2212,35 @@ class CoreWorker:
                     and strat.get("hard")))
         from . import scheduling_policy as policy
         try:
-            nodes = [n for n in await self._cluster_nodes()
-                     if policy.targetable(n)]
+            all_nodes = [n for n in await self._cluster_nodes()
+                         if policy.targetable(n)]
+            # Gray-failure deprioritization (ranking only: node_affinity
+            # resolves against the UNFILTERED view below — an explicitly
+            # targeted suspect node is deprioritized elsewhere, never
+            # hidden from its own affinity match).
+            nodes = all_nodes if hard else policy.prefer_trusted(all_nodes)
         except (rpc.RpcError, asyncio.TimeoutError):
             # Never silently violate a hard constraint on a GCS blip.
             return (None, "retry") if hard else (self.agent, "ok")
         conn, verdict = await self._route_on_view(strat, resources, nodes,
-                                                  hard)
+                                                  hard, all_nodes)
         if verdict == "infeasible":
             # The cached view can be up to 2s stale — a node that just
             # registered must not get its hard-pinned tasks wrongly
             # failed.  Re-evaluate against a FRESH view before declaring
             # the constraint unsatisfiable.
             try:
-                nodes = [n for n in await self._cluster_nodes(force=True)
-                         if policy.targetable(n)]
+                all_nodes = [n for n in
+                             await self._cluster_nodes(force=True)
+                             if policy.targetable(n)]
             except (rpc.RpcError, asyncio.TimeoutError):
                 return None, "retry"
-            conn, verdict = await self._route_on_view(strat, resources,
-                                                      nodes, hard)
+            conn, verdict = await self._route_on_view(
+                strat, resources, all_nodes, hard, all_nodes)
         return conn, verdict
 
-    async def _route_on_view(self, strat: dict, resources, nodes, hard):
+    async def _route_on_view(self, strat: dict, resources, nodes, hard,
+                             all_nodes=None):
         from . import scheduling_policy as policy
         typ = strat.get("type")
 
@@ -2154,7 +2256,11 @@ class CoreWorker:
 
         if typ == "node_affinity":
             target = bytes(strat["node_id"])
-            node = next((n for n in nodes
+            # Resolve against the unfiltered targetable view: a soft
+            # affinity to a gray-suspect node is an explicit locality
+            # preference, not a placement the scheduler chose — hiding
+            # it behind prefer_trusted would hard-exclude the target.
+            node = next((n for n in (all_nodes or nodes)
                          if bytes(n["node_id"]) == target), None)
             if node is None:
                 # Authoritative: the target is dead/absent in the view.
@@ -2166,17 +2272,27 @@ class CoreWorker:
             return (self.agent, "ok") if strat.get("soft") \
                 else (None, "retry")
         if typ == "node_label":
+            # Like node_affinity above: label MATCHING sees the
+            # unfiltered view — suspicion deprioritizes (ranks suspects
+            # last, below), never hard-excludes, so a label whose only
+            # match is gray-suspect still resolves instead of silently
+            # dropping the preference.
+            pool = all_nodes if all_nodes is not None else nodes
             ordered = policy.label_filter(
                 [(tuple(n["address"]), n.get("labels") or {})
-                 for n in nodes],
+                 for n in pool],
                 strat.get("hard") or None, strat.get("soft") or None)
             if not ordered:
                 return (None, "infeasible") if hard else (self.agent, "ok")
-            by_addr = {tuple(n["address"]): n for n in nodes}
-            # Feasible matches first, then any match (its agent
-            # backpressures; spillback is suppressed for hard).
-            for addr in sorted(ordered, key=lambda a: not policy.feasible(
-                    by_addr[a]["resources_available"], resources)):
+            by_addr = {tuple(n["address"]): n for n in pool}
+            # Feasible matches first, trusted before suspect within,
+            # then any match (its agent backpressures; spillback is
+            # suppressed for hard).
+            for addr in sorted(ordered, key=lambda a: (
+                    not policy.feasible(
+                        by_addr[a]["resources_available"], resources),
+                    policy.suspicion_of(by_addr[a])
+                    >= policy.SUSPECT_THRESHOLD)):
                 conn = await _connect(addr)
                 if conn is not None:
                     return conn, "ok"
@@ -2511,46 +2627,80 @@ class CoreWorker:
 
     _REPLY_EVENT = {"ok": "FINISHED", "cancelled": "CANCELLED"}
 
+    def _absorb_reply_refs(self, task_id: bytes, reply, *, discard: bool):
+        """Absorb a successful reply's reference bookkeeping — shared by
+        the normal ok path and the deadline-expired straggler path.
+        Neither may skip it: the worker registered borrows and
+        escape-pinned nested refs during serialization, so dropping the
+        records would free objects the worker still holds, or leak pins
+        forever.  Borrow registration must precede the caller's
+        _release_task_pins so a stored arg ref keeps its object pinned
+        across the handoff.  With discard=True the value can never be
+        read (its returns were already resolved to an error), so every
+        nested set is released after the escape-pin grace instead of
+        being recorded as contained."""
+        # In-band borrow registration (see worker_main: reply["borrows"]).
+        for oid, epoch in reply.get("borrows", []):
+            self.reference_counter.add_borrower_from_reply(
+                bytes(oid), bytes(reply["borrower_id"]), epoch=epoch)
+        for i, entry in enumerate(reply["returns"]):
+            # ObjectID.for_task_return without the class round-trips:
+            # ids are plain concatenation (ids.py:166).
+            oid = task_id + (i + 1).to_bytes(4, "little")
+            # Refs nested inside this return value: the worker already
+            # escape-pinned each at its owner during serialization; we
+            # record containment so freeing the return releases them
+            # (reference: task replies carry borrowed-ref metadata).
+            nested = [(bytes(noid),
+                       None if tuple(nowner) == self.address
+                       else tuple(nowner))
+                      for noid, nowner in entry.get("nested", [])]
+            # Nested refs WE own arrive unpinned by protocol (the worker
+            # defers to us to avoid the notify-vs-reply socket race);
+            # take their escape pins now, strictly before the submitted
+            # arg pins are released by the caller.
+            for noid, nowner in nested:
+                if nowner is None:
+                    self.reference_counter.add_escape_pin(noid)
+            if nested and (discard
+                           or not self.reference_counter.is_tracked(oid)):
+                # Value discarded, or container already freed (caller
+                # dropped the return ref mid-flight): release the
+                # worker-taken pins instead of recording them forever.
+                # Delayed so in-flight escape_pin notifies land first.
+                self.loop.call_later(
+                    1.0, lambda n=nested: self._release_nested(n))
+            elif nested:
+                self._record_contained(oid, nested, take_pins=False)
+
     def _handle_reply(self, spec, task: Optional[_PendingTask], reply):
         task_id = spec["task_id"]
+        self._disarm_task_deadline(task_id)
+        if task_id in self._deadline_expired:
+            # The owner-side deadline already resolved the returns with
+            # DeadlineExceededError; this straggler reply (or the chased
+            # cancel's ack) only settles bookkeeping — storing its value
+            # now would un-error refs the user may have already observed.
+            # The bookkeeping is NOT skippable though: a successful
+            # straggler registered borrows and escape-pinned nested refs
+            # during serialization; dropping those records would free
+            # objects the worker still holds, or leak pins forever.
+            if reply.get("status") == "ok":
+                self._absorb_reply_refs(task_id, reply, discard=True)
+            self._deadline_expired.discard(task_id)
+            self._release_task_pins(task)
+            self._cancelled.discard(task_id)
+            self.record_task_event(
+                task_id, spec.get("name") or spec.get("method", ""),
+                "FAILED")
+            return
         self.record_task_event(
             task_id, spec.get("name") or spec.get("method", ""),
             self._REPLY_EVENT.get(reply.get("status"), "FAILED"))
         if reply.get("status") == "ok":
-            # In-band borrow registration (see worker_main: reply["borrows"])
-            # — must precede _release_task_pins below so a stored arg ref
-            # keeps its object pinned across the handoff.
-            for oid, epoch in reply.get("borrows", []):
-                self.reference_counter.add_borrower_from_reply(
-                    bytes(oid), bytes(reply["borrower_id"]), epoch=epoch)
+            self._absorb_reply_refs(task_id, reply, discard=False)
             for i, entry in enumerate(reply["returns"]):
-                # ObjectID.for_task_return without the class round-trips:
-                # ids are plain concatenation (ids.py:166).
                 oid = task_id + (i + 1).to_bytes(4, "little")
-                # Refs nested inside this return value: the worker already
-                # escape-pinned each at its owner during serialization; we
-                # record containment so freeing the return releases them
-                # (reference: task replies carry borrowed-ref metadata).
-                nested = [(bytes(noid),
-                           None if tuple(nowner) == self.address
-                           else tuple(nowner))
-                          for noid, nowner in entry.get("nested", [])]
-                # Nested refs WE own arrive unpinned by protocol (the worker
-                # defers to us to avoid the notify-vs-reply socket race);
-                # take their escape pins now, strictly before the submitted
-                # arg pins are released below.
-                for noid, nowner in nested:
-                    if nowner is None:
-                        self.reference_counter.add_escape_pin(noid)
-                if nested and not self.reference_counter.is_tracked(oid):
-                    # Container already freed (caller dropped the return ref
-                    # mid-flight): release the worker-taken pins instead of
-                    # recording them forever. Delayed so in-flight
-                    # escape_pin notifies land first.
-                    self.loop.call_later(
-                        1.0, lambda n=nested: self._release_nested(n))
-                else:
-                    self._record_contained(oid, nested, take_pins=False)
                 if "inline" in entry:
                     self.memory_store.put_inline(oid, entry["inline"])
                 else:
@@ -2560,10 +2710,18 @@ class CoreWorker:
                 spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
         else:
             err = get_context().loads_code(reply["error"])
-            wrapped = exc.RayTaskError(
-                f"task {spec['name']} failed", cause=err,
-                remote_traceback=reply.get("traceback", ""))
-            self._store_task_exception(spec, wrapped)
+            if isinstance(err, exc.DeadlineExceededError):
+                # Worker-side expiry (refused-before-execution, or a
+                # nested hop's budget ran out inside user code): surface
+                # the TYPED error — wrapped in RayTaskError it would slip
+                # past the `except DeadlineExceededError` contract the
+                # docs promise.
+                self._store_task_exception(spec, err)
+            else:
+                wrapped = exc.RayTaskError(
+                    f"task {spec['name']} failed", cause=err,
+                    remote_traceback=reply.get("traceback", ""))
+                self._store_task_exception(spec, wrapped)
         self._release_task_pins(task)
         self._cancelled.discard(task_id)
 
@@ -2588,6 +2746,16 @@ class CoreWorker:
         task.borrowed_args = []
 
     def _store_task_exception(self, spec, error):
+        # Terminal for every failure path (retry exhaustion, cancel,
+        # recovery): the armed deadline must not fire afterwards.
+        self._disarm_task_deadline(spec["task_id"])
+        if spec["task_id"] in self._deadline_expired \
+                and not isinstance(error, exc.DeadlineExceededError):
+            # The deadline watchdog already resolved the returns with the
+            # typed DeadlineExceededError; the cancel it kicked off (or a
+            # racing failure path) must not downgrade that to a generic
+            # TaskCancelledError/WorkerCrashedError.
+            return
         data = protocol.concat_parts(get_context().serialize(error))
         for i in range(spec["nreturns"]):
             oid = ObjectID.for_task_return(
@@ -2595,6 +2763,133 @@ class CoreWorker:
             self.memory_store.put_inline(oid, data, is_exception=True)
         if spec.get("streaming"):
             self._stream_on_task_failed(spec)
+
+    # ------------------------------------------------- deadline watchdog ----
+    def _arm_task_deadline(self, spec) -> None:
+        """Loop-thread only: schedule the owner-side deadline for a spec
+        submitted with .options(timeout_s=...).  The watchdog — not any
+        per-hop RPC timeout — is what guarantees the user-visible bound:
+        even a fully blackholed worker/agent cannot hold the returns
+        hostage past the budget (they resolve to DeadlineExceededError
+        and a best-effort cancel chases the in-flight attempt)."""
+        dl = spec.get("deadline")
+        if not dl:
+            return
+        self._deadline_timers[spec["task_id"]] = self.loop.call_later(
+            max(0.0, dl - time.time()), self._on_task_deadline, spec)
+
+    def _disarm_task_deadline(self, task_id: bytes) -> None:
+        """The task resolved (value, error, or cancellation): its armed
+        deadline must never fire — a late firing would write error
+        entries for return ids whose real entries may already be freed,
+        resurrecting them forever."""
+        h = self._deadline_timers.pop(task_id, None)
+        if h is not None:
+            h.cancel()
+
+    def _on_task_deadline(self, spec) -> None:
+        tid = spec["task_id"]
+        self._deadline_timers.pop(tid, None)
+        oid0 = tid + (1).to_bytes(4, "little")
+        if self._shutdown or self.memory_store.contains(oid0):
+            return                      # resolved (or errored) in time
+        name = spec.get("name") or spec.get("method", "")
+        err = exc.DeadlineExceededError(
+            f"task {name} exceeded its end-to-end deadline "
+            f"(submitted with timeout_s; deadline passed "
+            f"{time.time() - spec['deadline']:.2f}s ago)")
+        self._deadline_expired.add(tid)
+        # EVERY return id is consulted, not just the first: a caller
+        # that dropped r0 of a multi-return task but still holds r1
+        # must see r1 resolve to the typed error — else its get() hangs
+        # forever, the exact outcome timeout_s exists to prevent.
+        if any(self.reference_counter.is_tracked(
+                    tid + (i + 1).to_bytes(4, "little"))
+               for i in range(spec.get("nreturns", 1))):
+            self._store_task_exception(spec, err)
+        # else: the caller already dropped every return ref — storing
+        # error entries nobody can observe (or free) would leak them;
+        # the chase below still stops the wasted attempt.
+        # Bounded: entries clear when the straggler reply/cancel lands
+        # (_handle_reply) or via the sweep below once no reply can
+        # arrive any more.  The chase's _cancelled entry gets the same
+        # sweep — under a permanent blackhole no reply ever arrives to
+        # discard it.
+        self.loop.call_later(300.0, self._sweep_expired_marker, tid)
+        self._spawn(self._chase_expired_task(tid))
+
+    def _sweep_expired_marker(self, tid: bytes) -> None:
+        """Cleanup for a deadline-expired task's markers.  While the
+        attempt is still in flight on a live conn the marker must
+        SURVIVE: discarding it early would let a >300s-late straggler
+        reply (gray link, not a dead worker) take the normal ok path
+        and store its value — un-erroring returns the user already
+        observed as DeadlineExceededError.  Conn loss clears the
+        in-flight records, so the next sweep collects; memory stays
+        bounded by the in-flight set itself."""
+        live = (tid in self._inflight_tasks
+                or tid in self._inflight_actor_tasks
+                or tid in self._resolving
+                # Still queued (lease-starved task / actor call behind a
+                # long predecessor): the dispatch-time _cancelled reap
+                # needs the marker when the attempt finally surfaces.
+                or any(t.spec["task_id"] == tid
+                       for state in self._keys.values()
+                       for t in state.queue)
+                or any(spec["task_id"] == tid
+                       for astate in self._actors.values()
+                       for spec, _t, _b in astate.submit_queue))
+        if live:
+            self.loop.call_later(300.0, self._sweep_expired_marker, tid)
+            return
+        self._deadline_expired.discard(tid)
+        self._cancelled.discard(tid)
+
+    async def _chase_expired_task(self, tid: bytes) -> None:
+        """Best-effort cancel of the expired attempt so a merely-slow
+        (not dead) worker stops burning time on a result nobody will
+        read.  (Not routed through _cancel(): the returns already
+        resolved, which _cancel treats as nothing-to-do.)  Queued-but-
+        undispatched attempts are reaped by the _cancelled check at
+        dispatch; failures here are irrelevant."""
+        self._cancelled.add(tid)
+        fin = self._resolving.pop(tid, None)
+        if fin is not None and not fin.done():
+            fin.cancel()                # still resolving deps: never runs
+            return
+        # Queued at the owner but not yet dispatched (lease starvation):
+        # reap NOW — the returns already resolved, so the attempt must
+        # neither burn a worker later nor outlive the marker sweep and
+        # store a straggler value over the typed error.
+        for state in self._keys.values():
+            for t in list(state.queue):
+                if t.spec["task_id"] == tid:
+                    state.queue.remove(t)
+                    self._release_task_pins(t)
+                    self._cancelled.discard(tid)
+                    return
+        try:
+            lease = self._inflight_tasks.get(tid)
+            if lease is not None and not lease.conn.closed:
+                await lease.conn.call(
+                    "cancel_task", {"task_id": tid, "force": False},
+                    timeout=10)
+                return
+            astate = self._inflight_actor_tasks.get(tid)
+            if astate is not None and astate.conn \
+                    and not astate.conn.closed:
+                # interrupt_running=False: an actor method (sync OR
+                # async) already executing finishes its work and the
+                # straggler result is discarded (documented contract) —
+                # interrupting mid-method could leave actor state
+                # half-mutated.  Queued/unstarted attempts are still
+                # reaped.
+                await astate.conn.call(
+                    "cancel_task", {"task_id": tid, "force": False,
+                                    "interrupt_running": False},
+                    timeout=10)
+        except (rpc.RpcError, asyncio.TimeoutError):
+            pass
 
     # -------------------------------------------------------------- cancel ---
     def cancel(self, ref: ObjectRef, force: bool = False):
@@ -2842,7 +3137,8 @@ class CoreWorker:
     def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
                           num_returns, max_task_retries: int = 0,
                           generator_backpressure: int = 0,
-                          out_of_order: bool = False
+                          out_of_order: bool = False,
+                          timeout_s: Optional[float] = None
                           ) -> List[ObjectRef]:
         """Sync-safe from ANY thread, including the event loop (async actor
         methods submitting to other actors — e.g. a Serve controller
@@ -2870,12 +3166,17 @@ class CoreWorker:
         with self._seq_lock:
             state.seq += 1
             seq = state.seq
+        # `is not None`, not truthiness: timeout_s=0 is an already-
+        # exhausted budget (e.g. max(0, remaining)) and must expire
+        # typed immediately, not silently run unbounded.
+        deadline = (time.time() + timeout_s) if timeout_s is not None \
+            else deadlines.get()
         spec = protocol.make_task_spec(
             task_id=task_id, job_id=self.job_id, fn_id=b"", args=entries,
             nreturns=num_returns, owner_addr=list(self.address), resources={},
             retries_left=max_task_retries,
             actor_id=actor_id, method=method, seq=seq, name=method,
-            streaming=streaming)
+            streaming=streaming, deadline=deadline)
         refs = []
         for i in range(num_returns):
             oid = task_id + (i + 1).to_bytes(4, "little")
@@ -2890,6 +3191,7 @@ class CoreWorker:
 
         def _go():
             state.submit_queue.append((spec, task, big_puts))
+            self._arm_task_deadline(spec)
             self._schedule_actor_drain(state)
 
         if self._on_loop_thread():
@@ -3218,7 +3520,10 @@ class CoreWorker:
                 continue  # loop top resolves it as cancelled
             self._inflight_actor_tasks[task_id] = state
             try:
-                reply = await conn.call("push_actor_task", spec)
+                # timeout=0: this per-call push's reply IS the method's
+                # completion — a long-running actor method must not be
+                # guillotined by the unary-call default.
+                reply = await conn.call("push_actor_task", spec, timeout=0)
             except rpc.ConnectionLost:
                 state.conn = None
                 if task_id in self._cancelled:

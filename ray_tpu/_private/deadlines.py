@@ -1,0 +1,51 @@
+"""End-to-end deadline context for task execution.
+
+The deadline a task was submitted with (``.options(timeout_s=...)``)
+travels in its spec (protocol.make_task_spec "deadline", an absolute
+``time.time()`` instant) and is installed here by the executing worker
+for the duration of user code.  Nested ``.remote()`` calls and object
+fetches made by that code then inherit the REMAINING budget instead of
+starting a fresh clock — the composition rule that makes a chain of
+hops fail together within the original budget (gRPC deadline
+propagation; Dean & Barroso, "The Tail at Scale").
+
+A contextvar (not a bare thread-local) so it follows async actor
+methods across awaits; sync tasks set it inside the executor thread
+(worker_main._run_sync), the same placement as tracing's execution
+span, so contextvars never need to cross a run_in_executor boundary.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+_task_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "task_deadline", default=None)
+
+
+def get() -> Optional[float]:
+    """Absolute wall-clock deadline of the currently-executing task, or
+    None when no deadline is in force."""
+    return _task_deadline.get()
+
+
+def remaining() -> Optional[float]:
+    """Seconds of budget left, clamped at 0.0; None when no deadline."""
+    d = _task_deadline.get()
+    return None if d is None else max(0.0, d - time.time())
+
+
+def expired() -> bool:
+    d = _task_deadline.get()
+    return d is not None and time.time() > d
+
+
+def set_current(deadline: Optional[float]):
+    """Install (returns a reset token for contextvars.reset)."""
+    return _task_deadline.set(deadline)
+
+
+def reset(token) -> None:
+    _task_deadline.reset(token)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import bisect
 import json
 import logging
 import os
@@ -24,6 +25,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import protocol, rpc
+from . import scheduling_policy as policy
 
 logger = logging.getLogger("ray_tpu.gcs")
 
@@ -87,6 +89,24 @@ class NodeInfo:
         # {"reason", "deadline"} while the two-phase drain runs (NODE_DRAINING)
         self.draining: Optional[dict] = None
         self.drain_task: Optional[asyncio.Task] = None
+        # Why this node was (last) drained — survives into DEAD so
+        # observers can distinguish a gray-failure evacuation from a
+        # planned preemption after the fact.
+        self.drain_reason: Optional[str] = None
+        # Gray-failure scoring state: RTT EMA from the GCS's own probe
+        # pings, per-reporter peer observations about THIS node
+        # (reporter node_id -> (rtt_s, monotonic ts)), and the resulting
+        # suspicion score in [0, 1] (EMA'd; see _update_suspicion).
+        self.rtt_ema: Optional[float] = None
+        self.rtt_ts: float = 0.0        # monotonic of last probe sample
+        self.peer_rtts: Dict[bytes, tuple] = {}
+        # reporter node_id -> (bytes_per_s, ts): peers' observed chunk
+        # transfer rates FROM this node — the only signal that catches a
+        # bandwidth-degraded (throttled/half-duplex-sick) link whose
+        # small-frame ping RTT still looks healthy.
+        self.peer_rates: Dict[bytes, tuple] = {}
+        self.suspicion = 0.0
+        self.suspect_since: Optional[float] = None
         # The agent's inbound connection (the one that called
         # register_node): its close is an immediate death signal for
         # cleanly crashed agents (see GcsServer._on_client_close).
@@ -111,6 +131,18 @@ class NodeInfo:
                       else protocol.NODE_ALIVE),
             "draining": ({"reason": self.draining["reason"]}
                          if self.alive and self.draining else None),
+            "drain_reason": self.drain_reason,
+            # Gray-failure observability: suspicion in [0,1] and the
+            # last probe RTT EMA — surfaced in `ray_tpu list nodes`,
+            # the dashboard node table, and consumed by every
+            # placement path's prefer_trusted filter.
+            "suspicion": round(self.suspicion, 3),
+            # Authoritative deprioritization threshold, carried with the
+            # score so consumers (dashboard) never hardcode a drifting
+            # copy of scheduling_policy.SUSPECT_THRESHOLD.
+            "suspect_threshold": policy.SUSPECT_THRESHOLD,
+            "rtt_ms": (None if self.rtt_ema is None
+                       else round(self.rtt_ema * 1000.0, 2)),
         }
 
 
@@ -485,6 +517,26 @@ class GcsServer:
             return False
         node.resources_available = p["available"]
         node.last_heartbeat = time.monotonic()
+        peer_stats = p.get("peer_stats")
+        if peer_stats:
+            # Fold the reporter's per-peer link observations into each
+            # TARGET node's evidence: multiple independent reporters
+            # seeing high RTT to one node is the strongest gray signal
+            # there is (differential observability).
+            now = time.monotonic()
+            by_addr = {f"{n.address[0]}:{n.address[1]}": n
+                       for n in self.nodes.values() if n.alive}
+            for addr_s, st in peer_stats.items():
+                target = by_addr.get(addr_s)
+                if target is None or target.node_id == p["node_id"]:
+                    continue
+                ts = now - float(st.get("age_s") or 0.0)
+                rtt = st.get("rtt")
+                if rtt is not None:
+                    target.peer_rtts[p["node_id"]] = (float(rtt), ts)
+                rate = st.get("rate")
+                if rate is not None:
+                    target.peer_rates[p["node_id"]] = (float(rate), ts)
         return True
 
     async def h_drain_node(self, conn, p):
@@ -511,6 +563,7 @@ class GcsServer:
         if node.draining is None:
             node.draining = {"reason": reason,
                              "deadline": time.monotonic() + deadline_s}
+            node.drain_reason = reason
             logger.warning("node %s draining (reason=%s, deadline=%.1fs)",
                            node.node_id.hex()[:8], reason, deadline_s)
             self._publish(protocol.CH_NODE, {
@@ -612,7 +665,12 @@ class GcsServer:
 
     async def _health_loop(self):
         """Active health checking (reference: gcs_health_check_manager.h —
-        FailNode after `health_check_failure_threshold` missed periods)."""
+        FailNode after `health_check_failure_threshold` missed periods),
+        plus the gray-failure scorer: every period the GCS RTT-probes each
+        agent, folds in peers' heartbeat-carried observations, and updates
+        a per-node suspicion score.  Crash detection (silence) and gray
+        detection (lateness) deliberately share this loop — a node can be
+        sliding from one to the other."""
         from .config import get_config
         cfg = get_config()
         period = cfg.health_check_period_ms / 1000.0
@@ -626,8 +684,219 @@ class GcsServer:
                             now - node.last_heartbeat > period * threshold:
                         await self._mark_node_dead(node.node_id,
                                                    "health check failed")
+                for node in self.nodes.values():
+                    if not node.alive:
+                        continue
+                    if node.conn is None or node.conn.closed:
+                        # The probe dial is made once at registration
+                        # and is not self-healing: re-dial here so a
+                        # transient reset can't permanently blind
+                        # probe-based gray detection (and rtt_ms
+                        # observability) for a node that stays ALIVE
+                        # on its own agent->gcs heartbeat dial.
+                        rpc.spawn(self._redial_and_probe(
+                            node, period * threshold))
+                        continue
+                    # Concurrent probes: a slow node must not delay
+                    # the scoring (or probing) of its siblings.
+                    rpc.spawn(self._probe_node(node,
+                                               period * threshold))
+                self._update_suspicion(cfg, period, threshold)
             except Exception:
                 logger.exception("health check pass failed")
+
+    async def _redial_and_probe(self, node: NodeInfo, bound: float) -> None:
+        """Re-establish a dropped gcs→agent probe dial, then probe.  A
+        refused dial while the node's heartbeats keep flowing is the
+        asymmetric-partition signature — fold it in as the same
+        worst-case sample a timed-out probe produces."""
+        await self._connect_agent(node)
+        if node.conn is None or node.conn.closed:
+            rtt = max(bound, 1.0)
+            node.rtt_ema = rtt if node.rtt_ema is None \
+                else 0.7 * node.rtt_ema + 0.3 * rtt
+            node.rtt_ts = time.monotonic()
+            return
+        await self._probe_node(node, bound)
+
+    async def _probe_node(self, node: NodeInfo, bound: float) -> None:
+        """One timed ping of an agent; folds the RTT into its EMA.  A
+        failed/timed-out probe folds the full bound in as a worst-case
+        sample rather than recording nothing: the documented
+        asymmetric-partition case is exactly a GCS→node direction gone
+        dark while node→GCS heartbeats keep flowing — silence THERE
+        must raise suspicion (the EMA and the sustained window still
+        require it to persist before anything drains).  Death from
+        total silence stays the heartbeat detector's job."""
+        t0 = time.monotonic()
+        try:
+            await node.conn.call("ping", {}, timeout=max(bound, 1.0))
+            rtt = time.monotonic() - t0
+        except Exception:
+            rtt = max(bound, 1.0)
+        node.rtt_ema = rtt if node.rtt_ema is None \
+            else 0.7 * node.rtt_ema + 0.3 * rtt
+        node.rtt_ts = time.monotonic()
+
+    def _update_suspicion(self, cfg, period: float, threshold: int) -> None:
+        """Score each alive node against the cluster: suspicion rises
+        when its probe/peer RTT exceeds both an absolute floor
+        (gray_min_rtt_ms) and a multiple of its PEERS' median RTT
+        (gray_rtt_ratio — shared load on the host running the GCS moves
+        the median, not the ratio), or when its heartbeats arrive with
+        gray-zone staleness.  Sustained suspicion past
+        gray_suspicion_threshold auto-triggers the PR-3 two-phase drain
+        with reason='gray' — closing detect -> avoid -> evacuate."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return
+        now = time.monotonic()
+        # Same 30s freshness bar as each node's own obs below: a frozen
+        # EMA (probe conn died) must not keep skewing the cluster
+        # baseline its PEERS are measured against.
+        rtt_pairs = [(n, n.rtt_ema) for n in alive
+                     if n.rtt_ema is not None and now - n.rtt_ts < 30.0]
+        susp_threshold = float(cfg.gray_suspicion_threshold)
+        min_rtt = float(cfg.gray_min_rtt_ms) / 1000.0
+        ratio = float(cfg.gray_rtt_ratio)
+        report_s = cfg.resource_report_period_ms / 1000.0
+        death_bound = period * threshold
+
+        # Evict long-stale peer evidence: reporters die and re-register
+        # under fresh node ids forever (PR-3 rejoin path), so without a
+        # sweep these dicts grow monotonically for the cluster's
+        # lifetime (the agent-side twin, _peer_stats, evicts at the
+        # same horizon for the same reason).
+        for node in alive:
+            for d in (node.peer_rtts, node.peer_rates):
+                for rid in [r for r, (_v, ts) in d.items()
+                            if now - ts > 900.0]:
+                    del d[rid]
+
+        def _fresh_vals(d):
+            """Fresh (<30s) values from healthy reporters only: a gray
+            reporter measures every peer through its own sick link."""
+            return sorted(
+                v for rid, (v, ts) in d.items()
+                if now - ts < 30.0 and v is not None
+                and getattr(self.nodes.get(rid), "suspicion",
+                            1.0) < susp_threshold)
+
+        # Per-node peer-observed transfer rate (upper median — the
+        # healthier read; rate has no own-probe to exonerate a node, so
+        # a lone reporter never counts).
+        rate_med = {}
+        for node in alive:
+            rates = _fresh_vals(node.peer_rates)
+            if len(rates) >= 2:
+                rate_med[node.node_id] = rates[len(rates) // 2]
+
+        def _loo_median(sorted_vals, own, lower=False):
+            """Median of sorted_vals with ONE occurrence of `own`
+            removed (leave-one-out; own=None removes nothing).  Sorting
+            once and bisecting here keeps the tick O(N log N) — a
+            per-node re-sort is O(N^2 log N) of event-loop stall at
+            fleet size, and a slow scorer tick would feed back into its
+            own heartbeat-staleness evidence."""
+            n = len(sorted_vals)
+            if own is None:
+                if not n:
+                    return None
+                return sorted_vals[(n - 1) // 2 if lower else n // 2]
+            if n <= 1:
+                return None
+            m = (n - 2) // 2 if lower else (n - 1) // 2
+            if m >= bisect.bisect_left(sorted_vals, own):
+                m += 1
+            return sorted_vals[m]
+
+        own_rtt = {m.node_id: r for m, r in rtt_pairs}
+        rtts_sorted = sorted(own_rtt.values())
+        rates_sorted = sorted(rate_med.values())
+
+        for node in alive:
+            # Baseline = median RTT of the OTHER nodes: including a
+            # node's own RTT in its baseline lets the slow node of a
+            # 2-node cluster (or the slow half of any even one) set its
+            # own floor and never look suspect.
+            baseline = _loo_median(rtts_sorted,
+                                   own_rtt.get(node.node_id))
+            # Stale probe evidence is no evidence: if the gcs->agent
+            # probe conn died, rtt_ema freezes at its last value — a
+            # node that was briefly slow must not stay suspect forever
+            # on a frozen reading (peer_rtts below age out the same way).
+            obs = node.rtt_ema if now - node.rtt_ts < 30.0 else None
+            fresh = _fresh_vals(node.peer_rtts)
+            # Lower median across reporters, and never a LONE reporter
+            # overriding a fresh healthy probe: a genuinely slow node
+            # looks slow to every reporter, so the lower median stays
+            # high — but one accuser (flaky, or itself sub-threshold
+            # gray) can't defame a node the GCS's own probe exonerates.
+            if fresh and (obs is None or len(fresh) >= 2):
+                med = fresh[(len(fresh) - 1) // 2]
+                obs = med if obs is None else max(obs, med)
+            raw = 0.0
+            if obs is not None and baseline is not None:
+                floor = max(min_rtt, ratio * baseline)
+                if obs > floor:
+                    raw = min(1.0, (obs - floor) / floor)
+            # Bandwidth deficit: peers pull from this node at least
+            # gray_rtt_ratio slower than from the rest of the cluster —
+            # the one signal a throttled/half-duplex-sick link shows
+            # while its small-frame ping RTT still looks clean.
+            rm = rate_med.get(node.node_id)
+            base_r = _loo_median(rates_sorted, rm, lower=True) \
+                if rm is not None else None
+            if rm is not None and base_r is not None:
+                if rm * ratio < base_r:
+                    raw = max(raw, min(1.0, (base_r / max(rm, 1.0)
+                                             - ratio) / ratio))
+            hb_age = now - node.last_heartbeat
+            if hb_age > max(3.0 * report_s, 1.0):
+                # Heartbeats late but not yet fatal: the gray zone
+                # between healthy and the crash detector's verdict.
+                raw = max(raw, min(1.0, hb_age / death_bound))
+            node.suspicion = 0.7 * node.suspicion + 0.3 * raw
+            if node.suspicion >= susp_threshold:
+                if node.suspect_since is None:
+                    node.suspect_since = now
+                    logger.warning(
+                        "node %s gray-suspect: suspicion=%.2f "
+                        "(rtt=%s, baseline=%s, hb_age=%.2fs)",
+                        node.node_id.hex()[:8], node.suspicion,
+                        f"{obs * 1000:.0f}ms" if obs else "n/a",
+                        f"{baseline * 1000:.1f}ms" if baseline else "n/a",
+                        hb_age)
+                self._maybe_gray_drain(node, alive, now,
+                                       float(cfg.gray_sustained_s),
+                                       bool(cfg.gray_auto_drain),
+                                       susp_threshold)
+            elif node.suspicion < 0.8 * susp_threshold:
+                node.suspect_since = None       # hysteresis
+
+    def _maybe_gray_drain(self, node: NodeInfo, alive, now: float,
+                          sustained_s: float, auto: bool,
+                          susp_threshold: float) -> None:
+        if not auto or node.draining is not None or not node.alive:
+            return
+        if node.suspect_since is None \
+                or now - node.suspect_since < sustained_s:
+            return
+        # Never evacuate INTO nothing: require at least one other
+        # schedulable, non-suspect node to receive the work — if the
+        # whole cluster looks gray, the problem is the observer (or the
+        # fabric), not this node.
+        others = [m for m in alive
+                  if m is not node and m.schedulable
+                  and m.suspicion < susp_threshold]
+        if not others:
+            return
+        logger.warning(
+            "auto-draining gray node %s (suspicion %.2f sustained %.1fs)",
+            node.node_id.hex()[:8], node.suspicion,
+            now - node.suspect_since)
+        rpc.spawn(self.h_drain_node(None, {
+            "node_id": node.node_id, "reason": protocol.DRAIN_GRAY}))
 
     def _on_client_close(self, conn):
         """A registered agent's inbound connection closed: for a crashed
@@ -800,7 +1069,6 @@ class GcsServer:
                 if node and node.schedulable:
                     return node
             return None
-        from . import scheduling_policy as policy
         live = [n for n in self.nodes.values() if n.schedulable]
         if strategy and strategy.get("type") == "node_label":
             keep = set(policy.label_filter(
@@ -823,18 +1091,32 @@ class GcsServer:
                          for n in preferred], resources)
                     if pick is not None:
                         return pick
-        cands = [(n, n.resources_total, n.resources_available)
-                 for n in live]
-        if strategy and strategy.get("type") == "spread":
-            # Least-utilized feasible node (reference:
-            # spread_scheduling_policy.h round-robins; least-utilized is
-            # the stateless equivalent under a live resource view).
-            feas = [(n, policy.critical_utilization(t, a, resources))
-                    for n, t, a in cands if policy.feasible(a, resources)]
-            return min(feas, key=lambda nu: nu[1])[0] if feas else None
-        # Default: hybrid top-k pack-then-spread
-        # (reference: hybrid_scheduling_policy.h:50).
-        return policy.hybrid_pick(cands, resources)
+        def _pick(cand_nodes):
+            cands = [(n, n.resources_total, n.resources_available)
+                     for n in cand_nodes]
+            if strategy and strategy.get("type") == "spread":
+                # Least-utilized feasible node (reference:
+                # spread_scheduling_policy.h round-robins; least-utilized
+                # is the stateless equivalent under a live resource view).
+                feas = [(n, policy.critical_utilization(t, a, resources))
+                        for n, t, a in cands
+                        if policy.feasible(a, resources)]
+                return min(feas, key=lambda nu: nu[1])[0] if feas else None
+            # Default: hybrid top-k pack-then-spread
+            # (reference: hybrid_scheduling_policy.h:50).
+            return policy.hybrid_pick(cands, resources)
+
+        # Gray-failure deprioritization AFTER constraint filtering (so a
+        # hard label match on a suspect node stays feasible): place on
+        # the non-suspect subset when it fits, else fall back to every
+        # live node — a suspect node is a last resort, never excluded.
+        trusted = [n for n in live
+                   if n.suspicion < policy.SUSPECT_THRESHOLD]
+        if trusted and len(trusted) < len(live):
+            pick = _pick(trusted)
+            if pick is not None:
+                return pick
+        return _pick(live)
 
     async def _schedule_actor(self, actor: ActorInfo,
                               timeout_s: float | None = None) -> bool:
@@ -1103,10 +1385,22 @@ class GcsServer:
             await _finalize(chosen)
             return
 
-    def _place_bundles(self, bundles, strategy) -> Optional[List[NodeInfo]]:
-        alive = [n for n in self.nodes.values() if n.schedulable]
+    def _place_bundles(self, bundles, strategy,
+                       nodes=None) -> Optional[List[NodeInfo]]:
+        alive = (nodes if nodes is not None else
+                 [n for n in self.nodes.values() if n.schedulable])
         if not alive:
             return None
+        if nodes is None:
+            # Gray-failure deprioritization: try the gang on the
+            # non-suspect subset first; suspect nodes host new bundles
+            # only when the placement cannot succeed without them.
+            trusted = [n for n in alive
+                       if n.suspicion < policy.SUSPECT_THRESHOLD]
+            if trusted and len(trusted) < len(alive):
+                got = self._place_bundles(bundles, strategy, nodes=trusted)
+                if got is not None:
+                    return got
         remaining = {n.node_id: dict(n.resources_available) for n in alive}
 
         def fits(node, bundle):
@@ -1225,6 +1519,8 @@ async def _amain(args):
     chaos_spec = _gcfg().rpc_chaos
     if chaos_spec:
         rpc.enable_chaos(chaos_spec)
+    rpc.enable_link_chaos(_gcfg().link_chaos)
+    rpc.set_default_call_timeout(_gcfg().control_call_timeout_s)
     server = GcsServer(port=args.port,
                        journal_path=args.journal or None)
     addr = await server.start()

@@ -44,6 +44,7 @@ def make_task_spec(
     runtime_env: Optional[dict] = None,
     name: str = "",
     streaming: Optional[dict] = None,
+    deadline: Optional[float] = None,
 ) -> dict:
     """Equivalent of the reference's TaskSpecification (common/task/).
 
@@ -69,6 +70,13 @@ def make_task_spec(
         # {"bp": N} for streaming-generator tasks (num_returns="streaming");
         # absent/None for regular tasks.
         "streaming": streaming,
+        # Absolute wall-clock (time.time()) end-to-end deadline from
+        # .options(timeout_s=...), or None.  Travels with the spec across
+        # every hop (driver -> agent -> worker -> nested submits) so the
+        # remaining budget composes instead of stacking per-hop constants;
+        # enforced owner-side (DeadlineExceededError on the return refs)
+        # and checked worker-side before execution.
+        "deadline": deadline,
         # {"trace_id", "span_id"} of the submitting span when tracing is
         # enabled (reference: remote_function.py:344 — tracing context
         # injected into every submit; workers chain execution spans to
@@ -95,7 +103,7 @@ def make_task_spec(
 # else MUST be byte-identical across the batch (guaranteed by grouping:
 # normal tasks batch per scheduling key + owner, actor tasks per handle).
 SPEC_VOLATILE = ("retries_left", "nreturns", "streaming", "trace",
-                 "method", "seq", "name")
+                 "method", "seq", "name", "deadline")
 
 
 def spec_prefix_of(spec: dict) -> dict:
@@ -109,6 +117,7 @@ def spec_prefix_of(spec: dict) -> dict:
     p["seq"] = 0
     p["trace"] = None
     p["streaming"] = None
+    p["deadline"] = None
     return p
 
 
@@ -188,6 +197,10 @@ NODE_DEAD = "DEAD"
 DRAIN_PREEMPTION = "preemption"
 DRAIN_IDLE = "idle"
 DRAIN_MANUAL = "manual"
+# Gray-failure evacuation: the health scorer found the node alive but
+# sustained-suspect (slow links, lossy NIC, asymmetric partition) and
+# auto-triggered the drain — detect -> avoid -> evacuate.
+DRAIN_GRAY = "gray"
 
 # Pubsub channels (reference: pubsub channel types in gcs.proto)
 CH_ACTOR = "actor"

@@ -16,7 +16,22 @@ per-frame await, no Python slicing: measured 1.4-1.7x the calls/s of the
 previous length-prefixed StreamReader loop between single-core processes.
 
 Request:  [msg_id, method: str, payload]     (msg_id == 0 -> one-way notify)
+          [msg_id, method: str, payload, deadline]   (deadline-carrying)
 Response: [msg_id, status: 0|1, result_or_error]
+
+End-to-end deadlines (reference: gRPC deadline propagation; Dean &
+Barroso, "The Tail at Scale"): a call issued with deadline=<abs wall
+clock> ships it as a 4th frame element.  The receiver refuses to
+dispatch an already-expired request (typed "DeadlineExceededError: ..."
+error reply) and exposes the deadline to the handler via
+current_handler_deadline(), so nested hops inherit the REMAINING budget
+instead of stacking fresh per-hop constants.  Caller-side expiry raises
+ray_tpu.exceptions.DeadlineExceededError.  Unary calls with no explicit
+timeout pick up the process default installed by
+set_default_call_timeout() (config control_call_timeout_s) so a
+half-open gray connection can never hang a caller forever; call sites
+that legitimately block (actor pushes, stream backpressure) opt out
+with timeout=0.
 
 Raw out-of-band payloads (reference: object_manager's chunked push carries
 object bytes outside the protobuf control messages): bulk bytes skip msgpack
@@ -43,10 +58,14 @@ as their first frame after connect. Comparison is constant-time.
 from __future__ import annotations
 
 import asyncio
+import collections
+import contextvars
 import hmac
 import logging
+import os
 import random
 import sys
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
@@ -73,6 +92,72 @@ class ConnectionLost(RpcError):
 
 class AuthError(RpcError):
     """Peer rejected (or never sent) the auth handshake."""
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+# Handler-scope deadline: set for the duration of a deadline-carrying
+# request's dispatch so the handler (and anything it calls synchronously
+# on the same context) can bound its own nested work by the REMAINING
+# budget rather than a fresh constant.
+_handler_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "rpc_handler_deadline", default=None)
+
+# Process default for unary calls issued with timeout=None (installed
+# from config control_call_timeout_s by every daemon/driver main).
+# None = no default (bare library use keeps today's wait-forever).
+_default_call_timeout: Optional[float] = None
+
+
+def set_default_call_timeout(seconds: Optional[float]) -> None:
+    """Install the default timeout applied to call()/call_raw() when the
+    call site passes timeout=None.  timeout=0 at a call site always
+    opts out (streaming-ish calls that legitimately block)."""
+    global _default_call_timeout
+    _default_call_timeout = seconds if seconds else None
+
+
+def current_handler_deadline() -> Optional[float]:
+    """Absolute wall-clock deadline of the request currently being
+    handled, or None."""
+    return _handler_deadline.get()
+
+
+# Tolerance when a RECEIVER judges a remote absolute deadline against
+# its own wall clock: refuse/abort only when expired beyond this slack,
+# because the two clocks are different hosts' (NTP keeps them close, but
+# a skewed-forward receiver would otherwise spuriously refuse EVERY
+# deadline-carrying request whose budget is shorter than the skew).
+# The owner-side watchdog — one clock, skew-free — stays the
+# authoritative enforcement of the user-visible bound.
+DEADLINE_SKEW_SLACK_S = 2.0
+
+
+def deadline_exceeded(msg: str) -> Exception:
+    # Lazy import: this transport module must stay importable on its own
+    # (daemon processes import it before the package surface).
+    from ..exceptions import DeadlineExceededError
+    return DeadlineExceededError(msg)
+
+
+# Deterministic-per-process jitter for reconnect/retry backoff: seeded by
+# pid so one process replays identically, but a fleet reconnecting after
+# a GCS restart or netsplit heal de-synchronizes instead of thundering
+# in lockstep (each process computes different delays).
+_jitter_rng = random.Random(os.getpid() ^ 0x5EED)
+os.register_at_fork(  # zygote-forked workers inherit the module-import
+    after_in_child=lambda: _jitter_rng.seed(os.getpid() ^ 0x5EED))
+#   RNG state — without a reseed every worker on a node would compute
+#   IDENTICAL "jitter" and redial in lockstep after a heal.
+
+
+def _backoff_delay(attempt: int, retry_delay: float,
+                   cap: float = 2.0) -> float:
+    """Exponential backoff with +/-50% jitter: base * 1.5^attempt capped,
+    scaled by uniform [0.5, 1.5)."""
+    base = min(retry_delay * (1.5 ** attempt), cap)
+    return base * (0.5 + _jitter_rng.random())
 
 
 _BG_TASKS: set = set()
@@ -171,6 +256,22 @@ _chaos: Optional[_Chaos] = None
 def enable_chaos(spec: str):
     global _chaos
     _chaos = _Chaos(spec) if spec else None
+
+
+# Link-level chaos (config `link_chaos`, parsed/planned by
+# chaos.LinkChaos): per-peer delay/jitter/bandwidth/asymmetric blackhole
+# applied to this process's RPC byte stream.  None (the default) costs
+# one attribute load on the hot paths.
+_link_chaos = None
+
+
+def enable_link_chaos(spec: str, seed: int = 0xC0FFEE):
+    global _link_chaos
+    if spec:
+        from .chaos import LinkChaos
+        _link_chaos = LinkChaos(spec, seed=seed)
+    else:
+        _link_chaos = None
 
 
 # ---------------------------------------------------------------------------
@@ -322,8 +423,7 @@ class Connection:
         self._raw_cur: list | None = None
         self._raw_takers: Dict[int, list] = {}
         self._raw_orphans: Dict[int, list] = {}
-        from collections import deque as _deque
-        self._raw_evicted = _deque(maxlen=64)
+        self._raw_evicted = collections.deque(maxlen=64)
         # Frame coalescing: frames queued in one loop tick go out as ONE
         # transport.write (one syscall) — under task fan-out the loop was
         # spending ~3/4 of its samples in per-frame socket sends.
@@ -335,6 +435,17 @@ class Connection:
         # actor calls resolves K replies in the same tick).
         self._resp_buf: list = []
         self._resp_scheduled = False
+        # Link-chaos state: ordered delayed-delivery queues
+        # [(bytes, due_monotonic), ...] per direction, each drained by
+        # one task — delays pipeline (every unit waits its own latency)
+        # but never reorder the stream.  Allocated lazily on the first
+        # chaos-planned byte so production connections stay
+        # allocation-free here.
+        self._tx_q: Any = None
+        self._tx_task: Optional[asyncio.Task] = None
+        self._rx_q: Any = None
+        self._rx_task: Optional[asyncio.Task] = None
+        self._link_descr: Optional[str] = None
 
     @property
     def closed(self):
@@ -369,7 +480,116 @@ class Connection:
             if not w.done():
                 w.set_result(None)
 
+    # ------------------------------------------------------- link chaos --
+    def _link_desc(self) -> str:
+        """'<conn name>|<peer host:port>' — what link_chaos rule `match`
+        filters run against."""
+        d = self._link_descr
+        if d is None:
+            peer = ""
+            if self.transport is not None:
+                try:
+                    info = self.transport.get_extra_info("peername")
+                    if isinstance(info, tuple) and len(info) >= 2:
+                        peer = f"{info[0]}:{info[1]}"
+                    elif info:
+                        peer = str(info)
+                except Exception:
+                    pass
+            d = self._link_descr = f"{self.name}|{peer}"
+        return d
+
+    def _tx(self, data) -> None:
+        """All outbound bytes funnel here: direct transport write when no
+        link chaos is enabled, else drop/delay/throttle per the plan.
+        Each unit handed in is a complete frame (or raw-payload segment
+        covered by a group plan), so dropping a unit never desyncs the
+        peer's frame parser mid-message."""
+        lc = _link_chaos
+        if lc is None:
+            try:
+                self.transport.write(data)
+            except (ConnectionError, OSError):
+                self._teardown()
+            return
+        drop, delay = lc.plan("out", self._link_desc(), _nbytes(data))
+        self._tx_enqueue(data, drop, delay)
+
+    def _tx_enqueue(self, data, drop: bool, delay: float) -> None:
+        if drop:
+            return
+        if delay <= 0 and not self._tx_q:
+            try:
+                self.transport.write(data)
+            except (ConnectionError, OSError):
+                self._teardown()
+            return
+        # Due times are clamped monotonic so jitter can't reorder bytes.
+        if self._tx_q is None:
+            self._tx_q = collections.deque()
+        due = time.monotonic() + delay
+        if self._tx_q and due < self._tx_q[-1][1]:
+            due = self._tx_q[-1][1]
+        self._tx_q.append((bytes(data) if isinstance(data, memoryview)
+                           else data, due))
+        if self._tx_task is None:
+            self._tx_task = spawn(self._chaos_drain("_tx_q", "_tx_task",
+                                                    self._tx_emit))
+
+    def _tx_emit(self, data) -> None:
+        try:
+            self.transport.write(data)
+        except (ConnectionError, OSError):
+            self._teardown()
+
+    async def _chaos_drain(self, qattr: str, taskattr: str, emit) -> None:
+        q = getattr(self, qattr)
+        try:
+            while q:
+                data, due = q[0]
+                dt = due - time.monotonic()
+                if dt > 0:
+                    await asyncio.sleep(dt)
+                if self._closed or self.transport is None:
+                    q.clear()
+                    return
+                q.popleft()
+                emit(data)
+        finally:
+            setattr(self, taskattr, None)
+            if q and not self._closed:
+                setattr(self, taskattr,
+                        spawn(self._chaos_drain(qattr, taskattr, emit)))
+
+    def _rx_emit(self, data) -> None:
+        # _rx_process handles its own malformed-stream aborts.
+        self._rx_process(data)
+
     def _data_received(self, data):
+        lc = _link_chaos
+        if lc is not None:
+            drop, delay = lc.plan("in", self._link_desc(), len(data))
+            if drop:
+                # Blackholed inbound direction: the bytes vanish but the
+                # TCP session stays up — the asymmetric-partition shape.
+                # (A drop window that ENDS mid-message resumes the stream
+                # mid-frame; the parser then aborts the connection, which
+                # is the same reset a healing middlebox produces.)
+                return
+            if delay > 0 or self._rx_q:
+                if self._rx_q is None:
+                    self._rx_q = collections.deque()
+                due = time.monotonic() + delay
+                if self._rx_q and due < self._rx_q[-1][1]:
+                    due = self._rx_q[-1][1]
+                self._rx_q.append((bytes(data), due))
+                if self._rx_task is None:
+                    self._rx_task = spawn(self._chaos_drain(
+                        "_rx_q", "_rx_task", self._rx_emit))
+                return
+        self._rx_process(data)
+
+    def _rx_process(self, data):
         try:
             self._ingest(memoryview(data))
         except Exception:
@@ -595,6 +815,19 @@ class Connection:
             self._flush_wbuf()
             if self._closed:
                 return
+            lc = _link_chaos
+            if lc is not None:
+                # ONE plan for the whole header+payload group: a drop
+                # decision that split them would desync the peer's raw
+                # framing.  Queued units copy the views (the arena pins
+                # can then drop immediately) — chaos-mode-only cost.
+                header = _pack([0, "__raw__", [rid, payload.nbytes]])
+                drop, delay = lc.plan("out", self._link_desc(),
+                                      len(header) + payload.nbytes)
+                self._tx_enqueue(header, drop, delay)
+                for b in payload.buffers:
+                    self._tx_enqueue(bytes(b), drop, delay)
+                return
             try:
                 self.transport.write(
                     _pack([0, "__raw__", [rid, payload.nbytes]]))
@@ -630,10 +863,12 @@ class Connection:
             payload.close()
 
     def _on_msg(self, msg):
-        if not isinstance(msg, (list, tuple)) or len(msg) != 3:
+        if not isinstance(msg, (list, tuple)) or len(msg) not in (3, 4):
             logger.warning("malformed frame on %s", self.name)
             return
-        mid, a, b = msg
+        # 4th element: absolute wall-clock deadline on a request frame.
+        mid, a, b = msg[0], msg[1], msg[2]
+        dl = msg[3] if len(msg) == 4 else None
         if not self._authed:
             # EVERY frame shape is gated until the handshake lands —
             # response-shaped frames from an unauthenticated peer could
@@ -676,11 +911,21 @@ class Connection:
                     else:
                         spawn(self._dispatch(sub[0], sub[1], sub[2]))
                 return
+            if dl is not None and time.time() > dl + DEADLINE_SKEW_SLACK_S:
+                # Expired before dispatch (gray link delivered it late):
+                # refuse with the typed first-line error contract instead
+                # of burning handler work whose reply nobody waits for.
+                if mid != 0:
+                    self._maybe_reply(
+                        mid, a, 1,
+                        f"DeadlineExceededError: deadline exceeded "
+                        f"before dispatch of {a}")
+                return
             fh = self.fast_handlers.get(a)
             if fh is not None:
-                self._dispatch_fast(mid, a, fh, b)
+                self._dispatch_fast(mid, a, fh, b, deadline=dl)
             else:
-                spawn(self._dispatch(mid, a, b))
+                spawn(self._dispatch(mid, a, b, deadline=dl))
         else:  # response [mid, status, payload]
             fut = self._pending.pop(mid, None)
             if fut is not None and not fut.done():
@@ -717,11 +962,14 @@ class Connection:
             except Exception:
                 logger.exception("on_close callback failed")
 
-    def _dispatch_fast(self, mid: int, method: str, fh, payload):
+    def _dispatch_fast(self, mid: int, method: str, fh, payload,
+                       deadline: Optional[float] = None):
         """Inline dispatch for fast handlers (see __init__): no Task per
         request.  Chaos injection and error replies match _dispatch."""
         if _chaos and _chaos.should_fail(method, "req"):
             return  # drop silently; caller times out / retries
+        tok = _handler_deadline.set(deadline) if deadline is not None \
+            else None
         try:
             res = fh(self, payload)
         except Exception as e:
@@ -731,11 +979,16 @@ class Connection:
                                   f"{type(e).__name__}: {e}\n"
                                   f"{traceback.format_exc()}")
             return
+        finally:
+            if tok is not None:
+                # The recv path shares one context across callbacks: an
+                # unreset deadline would leak into unrelated dispatches.
+                _handler_deadline.reset(tok)
         if res is FAST_FALLBACK:
             # The request-side chaos check already ran above — skip it in
             # _dispatch or fallback requests would see a doubled drop rate.
             spawn(self._dispatch(mid, method, payload,
-                                 skip_req_chaos=True))
+                                 skip_req_chaos=True, deadline=deadline))
             return
         if isinstance(res, RawPayload) and mid == 0:
             res.close()
@@ -791,11 +1044,18 @@ class Connection:
             self._send_frame([0, "__batch_resp__", buf])
 
     async def _dispatch(self, mid: int, method: str, payload,
-                        skip_req_chaos: bool = False):
+                        skip_req_chaos: bool = False,
+                        deadline: Optional[float] = None):
         handler = self.handlers.get(method)
         if (not skip_req_chaos and _chaos
                 and _chaos.should_fail(method, "req")):
             return  # drop silently; caller times out / retries
+        if deadline is not None:
+            # This dispatch runs in its own Task (own context copy): the
+            # deadline is visible to the whole handler coroutine and
+            # everything it awaits, and dies with the Task — no reset
+            # bookkeeping needed.
+            _handler_deadline.set(deadline)
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
@@ -824,29 +1084,61 @@ class Connection:
             self._drain_waiters.append(w)
             await w
 
-    async def call(self, method: str, payload=None, timeout: float | None = None):
+    @staticmethod
+    def _effective_timeout(timeout: float | None,
+                           deadline: float | None) -> float | None:
+        """Resolve a call's wait bound: explicit timeout wins; None picks
+        up the process default (set_default_call_timeout); 0 opts out
+        entirely.  A deadline further caps whatever that produced, and an
+        already-expired deadline raises immediately."""
+        eff = _default_call_timeout if timeout is None else (timeout or None)
+        if deadline is not None:
+            remaining = deadline - time.time()
+            # Slack: the deadline may carry a remote clock's stamp (an
+            # inherited budget).  Within the skew window the call still
+            # goes out on a short floor and resolves typed via the
+            # TimeoutError -> DeadlineExceededError conversion.
+            if remaining <= -DEADLINE_SKEW_SLACK_S:
+                raise deadline_exceeded(
+                    "deadline already exceeded before call was issued")
+            remaining = max(remaining, 0.1)
+            eff = remaining if eff is None else min(eff, remaining)
+        return eff
+
+    async def call(self, method: str, payload=None,
+                   timeout: float | None = None,
+                   deadline: float | None = None):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
+        eff_timeout = self._effective_timeout(timeout, deadline)
         mid = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
-        self._send_frame([mid, method, payload])
+        self._send_frame([mid, method, payload] if deadline is None
+                         else [mid, method, payload, deadline])
         if self._closed:
             if fut.done():
                 fut.exception()  # consume, avoid never-retrieved warning
             raise ConnectionLost(f"connection {self.name} lost on send")
         await self.drain()
         try:
-            if timeout:
-                return await asyncio.wait_for(fut, timeout)
+            if eff_timeout:
+                return await asyncio.wait_for(fut, eff_timeout)
             return await fut
+        except asyncio.TimeoutError:
+            if deadline is not None and time.time() >= deadline:
+                raise deadline_exceeded(
+                    f"{method} deadline exceeded after "
+                    f"{eff_timeout:.3f}s") from None
+            raise
         finally:
             if fut.cancelled():
                 self._pending.pop(mid, None)    # reap timed-out entries
 
     async def call_raw(self, method: str, payload, sink,
-                       timeout: float | None = None):
+                       timeout: float | None = None,
+                       deadline: float | None = None):
         """Call whose successful response arrives as a raw out-of-band
         payload scattered into `sink` (a writable buffer — filled from
         offset 0 — or a callable receiving sequential memoryview pieces).
@@ -855,21 +1147,29 @@ class Connection:
         legacy bytes body) resolves to that value — callers handle both."""
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
+        eff_timeout = self._effective_timeout(timeout, deadline)
         mid = self._next_id
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
         self._raw_sinks[mid] = sink
         try:
-            self._send_frame([mid, method, payload])
+            self._send_frame([mid, method, payload] if deadline is None
+                             else [mid, method, payload, deadline])
             if self._closed:
                 if fut.done():
                     fut.exception()  # consume
                 raise ConnectionLost(f"connection {self.name} lost on send")
             await self.drain()
-            if timeout:
-                return await asyncio.wait_for(fut, timeout)
-            return await fut
+            try:
+                if eff_timeout:
+                    return await asyncio.wait_for(fut, eff_timeout)
+                return await fut
+            except asyncio.TimeoutError:
+                if deadline is not None and time.time() >= deadline:
+                    raise deadline_exceeded(
+                        f"{method} deadline exceeded") from None
+                raise
         finally:
             self._raw_sinks.pop(mid, None)
             # The caller is done with this sink (success, timeout or
@@ -907,14 +1207,19 @@ class Connection:
         # RECEIVER (whose mids are positive and independently allocated).
         payload["raw_id"] = -mid
         payload["nbytes"] = body.nbytes
+        # Bulk-data call: the control_call_timeout_s default deliberately
+        # does NOT apply — a multi-GB upload legitimately outlives any
+        # unary-call bound.  Callers pass an explicit timeout if they
+        # want one.
+        eff_timeout = timeout or None
         self._send_frame([mid, method, payload])
         self.send_raw(-mid, body)
         # Backpressure like call()/call_raw(): bound userspace buffering
         # at the transport's high watermark for multi-GB uploads.
         await self.drain()
         try:
-            if timeout:
-                return await asyncio.wait_for(fut, timeout)
+            if eff_timeout:
+                return await asyncio.wait_for(fut, eff_timeout)
             return await fut
         finally:
             if fut.cancelled():
@@ -960,10 +1265,7 @@ class Connection:
             self._flush_wbuf()
             if self._closed:
                 return
-            try:
-                self.transport.write(data)
-            except (ConnectionError, OSError):
-                self._teardown()
+            self._tx(data)
             return
         self._wbuf.append(data)
         if not self._flush_scheduled:
@@ -976,13 +1278,10 @@ class Connection:
             self._wbuf.clear()
             return
         buf, self._wbuf = self._wbuf, []
-        try:
-            # Always one transport.write: on a drained transport each
-            # write() is an immediate socket send, so per-frame writes
-            # cost a syscall each.
-            self.transport.write(buf[0] if len(buf) == 1 else b"".join(buf))
-        except (ConnectionError, OSError):
-            self._teardown()
+        # Always one write: on a drained transport each write() is an
+        # immediate socket send, so per-frame writes cost a syscall each.
+        # _tx is a direct transport.write unless link chaos is enabled.
+        self._tx(buf[0] if len(buf) == 1 else b"".join(buf))
 
     async def close(self):
         # Push out coalesced frames before tearing down — a notify()
@@ -1105,11 +1404,13 @@ class ReconnectingConnection:
             return self._conn
 
     async def call(self, method: str, payload=None,
-                   timeout: float | None = None):
+                   timeout: float | None = None,
+                   deadline: float | None = None):
         for attempt in range(2):
             conn = await self._ensure()
             try:
-                return await conn.call(method, payload, timeout)
+                return await conn.call(method, payload, timeout,
+                                       deadline=deadline)
             except ConnectionLost:
                 if attempt:
                     raise
@@ -1150,5 +1451,9 @@ async def connect(address, handlers: Dict[str, Callable] | None = None,
             return conn
         except (ConnectionError, OSError, FileNotFoundError) as e:
             last_err = e
-            await asyncio.sleep(min(retry_delay * (1.5 ** attempt), 2.0))
+            # Jittered backoff (see _backoff_delay): after a GCS restart
+            # or netsplit heal, every client of a node redials at once —
+            # identical deterministic delays would synchronize the whole
+            # fleet into a thundering herd on each retry round.
+            await asyncio.sleep(_backoff_delay(attempt, retry_delay))
     raise ConnectionLost(f"cannot connect to {address}: {last_err}")

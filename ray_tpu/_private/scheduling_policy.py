@@ -23,6 +23,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 SPREAD_THRESHOLD = 0.5
 TOP_K_FRACTION = 0.2
 
+# Suspicion score above which a node is deprioritized for NEW placement
+# (gray-failure defense: alive-but-slow nodes cost more step time than
+# dead ones).  Kept below the auto-drain threshold so placement steers
+# away BEFORE evacuation kicks in.
+SUSPECT_THRESHOLD = 0.5
+
 
 def targetable(view: Dict) -> bool:
     """Whether a node (GCS NodeInfo.view dict) may receive NEW work.
@@ -32,6 +38,23 @@ def targetable(view: Dict) -> bool:
     (reference: the raylet rejects leases while draining; autoscaler
     DrainNode semantics)."""
     return bool(view.get("alive")) and not view.get("draining")
+
+
+def suspicion_of(view: Dict) -> float:
+    """Gray-failure suspicion score of a node view (0 when absent)."""
+    return float(view.get("suspicion") or 0.0)
+
+
+def prefer_trusted(views, threshold: float = SUSPECT_THRESHOLD):
+    """Deprioritize gray-suspect nodes: returns only the views below the
+    suspicion threshold when any exist, else all views — a suspect node
+    is a LAST resort, never a hard exclusion (a wrongly-suspected node
+    must still be usable when it's the only feasible one).  Shared by
+    the GCS scheduler, agent spillback, and submitter-side lease
+    routing so every placement path steers the same way."""
+    views = list(views)
+    trusted = [v for v in views if suspicion_of(v) < threshold]
+    return trusted or views
 
 
 def feasible(avail: Dict[str, float], resources: Dict[str, float]) -> bool:

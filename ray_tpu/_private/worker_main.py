@@ -523,6 +523,14 @@ class Executor:
                 self._cancel_requested.discard(tid)
                 replies[i] = {"status": "cancelled"}
                 continue
+            dl = spec.get("deadline")
+            if dl and time.time() > dl + rpc.DEADLINE_SKEW_SLACK_S:
+                replies[i] = self._error_reply(
+                    exc.DeadlineExceededError(
+                        f"deadline exceeded before execution of "
+                        f"{spec.get('name') or spec.get('method', '')}"),
+                    "deadline exceeded before execution")
+                continue
             self.core.record_task_event(
                 tid, spec.get("name") or spec.get("method", ""), "RUNNING")
             try:
@@ -613,6 +621,12 @@ class Executor:
             self._running_threads[task_id] = threading.get_ident()
         from .core_worker import task_exec_tls
         task_exec_tls.active = True     # blocking get/wait here releases CPU
+        # Ambient deadline installed ON the executing thread (contextvars
+        # don't cross run_in_executor): nested .remote()/get() calls made
+        # by user code inherit the task's remaining end-to-end budget.
+        from . import deadlines
+        _dl_tok = deadlines.set_current(spec["deadline"]) \
+            if spec and spec.get("deadline") else None
         try:
             if spec is not None and spec.get("trace"):
                 # Span set HERE (the executing thread), not around the
@@ -635,6 +649,8 @@ class Executor:
                 "(thread-reuse race)") from None
         finally:
             task_exec_tls.active = False
+            if _dl_tok is not None:
+                deadlines.reset(_dl_tok)
             with self._thread_guard:
                 self._running_threads.pop(task_id, None)
 
@@ -880,9 +896,11 @@ class Executor:
         oid = item_object_id(spec["task_id"], index)
         entry = await self._serialize_value(oid, value,
                                             caller_addr=spec.get("owner_addr"))
+        # timeout=0: the reply is the consumer's backpressure credit —
+        # it legitimately parks until the consumer drains.
         reply = await conn.call("stream_item", {
             "task_id": spec["task_id"], "index": index, "entry": entry,
-            "attempt": spec.get("retries_left", 0)})
+            "attempt": spec.get("retries_left", 0)}, timeout=0)
         if isinstance(reply, dict) and reply.get("dropped"):
             raise self._StreamDropped()
 
@@ -898,12 +916,29 @@ class Executor:
             self._cancel_requested.discard(spec["task_id"])
             self.core.current_task_id = prev_task_id
             return {"status": "cancelled"}
+        dl = spec.get("deadline")
+        if dl and time.time() > dl + rpc.DEADLINE_SKEW_SLACK_S:
+            # Budget spent before execution even started (queued behind a
+            # long predecessor, or delivered late by a gray link): fail
+            # typed instead of burning worker time on a result the owner
+            # already wrote off.  Slack: this clock is not the owner's.
+            self.core.current_task_id = prev_task_id
+            return self._error_reply(
+                exc.DeadlineExceededError(
+                    f"deadline exceeded before execution of "
+                    f"{spec.get('name') or spec.get('method', '')}"),
+                "deadline exceeded before execution")
         # Registered from the very start: a cancel arriving during arg
         # resolution cancels this coroutine (user code hasn't run yet).
         self._running[spec["task_id"]] = (asyncio.current_task(), True)
         self.core.record_task_event(
             spec["task_id"], spec.get("name") or spec.get("method", ""),
             "RUNNING")
+        # Ambient deadline for the async paths (arg resolution, coroutine
+        # actor methods) — the sync path re-installs it on its executor
+        # thread in _run_sync.
+        from . import deadlines
+        _dl_tok = deadlines.set_current(dl) if dl else None
         strat = spec.get("scheduling_strategy") or {}
         prev_pg = self.core.current_placement_group
         if strat.get("type") == "placement_group":
@@ -971,6 +1006,8 @@ class Executor:
         except Exception as e:  # noqa: BLE001 — every user error is reported
             return self._error_reply(e)
         finally:
+            if _dl_tok is not None:
+                deadlines.reset(_dl_tok)
             self._running.pop(spec["task_id"], None)
             self.core.current_task_id = prev_task_id
             self.core.current_placement_group = prev_pg
@@ -1111,11 +1148,24 @@ class Executor:
         if is_async:
             # Covers async actor methods AND any task still resolving args
             # (user code hasn't started; cancelling the coroutine is safe).
+            if not p.get("interrupt_running", True):
+                # Deadline chase: an executing ASYNC method is as
+                # vulnerable to half-mutated actor state as a sync one
+                # (the cancel lands at any await between mutations) —
+                # let it finish; the reply is discarded owner-side.
+                return True
             task.cancel()
             return True
         with self._thread_guard:
             tid = self._running_threads.get(task_id)
             if tid is not None:
+                if not p.get("interrupt_running", True):
+                    # Deadline chase on a sync actor method: the owner
+                    # already resolved the returns, and interrupting the
+                    # thread mid-method could leave actor state half-
+                    # mutated — let it finish; the reply is discarded
+                    # owner-side (_deadline_expired).
+                    return True
                 import ctypes
                 self._cancel_intent.add(task_id)
                 ctypes.pythonapi.PyThreadState_SetAsyncExc(

@@ -13,16 +13,20 @@ from ._private.ids import ActorID, JobID
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1, generator_backpressure: int = 0):
+                 num_returns: int = 1, generator_backpressure: int = 0,
+                 timeout_s=None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
         self._generator_backpressure = generator_backpressure
+        self._timeout_s = timeout_s
 
     def options(self, num_returns: int = 1,
-                _generator_backpressure_num_objects: int = 0, **_):
+                _generator_backpressure_num_objects: int = 0,
+                timeout_s=None, **_):
         return ActorMethod(self._handle, self._method_name, num_returns,
-                           _generator_backpressure_num_objects)
+                           _generator_backpressure_num_objects,
+                           timeout_s=timeout_s)
 
     def remote(self, *args, **kwargs):
         from ._private.worker import global_runtime
@@ -32,7 +36,8 @@ class ActorMethod:
             args=args, kwargs=kwargs, num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
             generator_backpressure=self._generator_backpressure,
-            out_of_order=self._handle._out_of_order)
+            out_of_order=self._handle._out_of_order,
+            timeout_s=self._timeout_s)
         # num_returns="streaming" yields a single ObjectRefGenerator.
         if self._num_returns == 1 or isinstance(self._num_returns, str):
             return refs[0]

@@ -43,7 +43,7 @@ INDEX_HTML = """<!doctype html>
 </nav>
 <h2>Nodes</h2><table id="nodes"><thead><tr>
   <th>node</th><th>state</th><th>address</th><th>CPU</th><th>TPU</th>
-  <th>labels</th></tr></thead><tbody></tbody></table>
+  <th>health</th><th>labels</th></tr></thead><tbody></tbody></table>
 <h2>Actors</h2><table id="actors"><thead><tr>
   <th>actor</th><th>class</th><th>state</th><th>name</th><th>node</th>
   <th>restarts</th></tr></thead><tbody></tbody></table>
@@ -97,11 +97,20 @@ async function tick() {
     const nodes = await j("/api/nodes");
     fill("nodes", nodes.map(n => [
         `<code>${esc((n.node_id || "").slice(0, 12))}</code>`,
-        n.alive ? '<span class="ok">ALIVE</span>'
-                : '<span class="bad">DEAD</span>',
+        !n.alive ? '<span class="bad">DEAD</span>'
+            : n.state === "DRAINING"
+                ? `<span class="bad">DRAINING${n.drain_reason
+                      ? " (" + esc(n.drain_reason) + ")" : ""}</span>`
+                : '<span class="ok">ALIVE</span>',
         esc((n.address || []).join(":")),
         fmt(n.resources_available?.CPU, n.resources_total?.CPU),
         fmt(n.resources_available?.TPU, n.resources_total?.TPU),
+        // Gray-failure health: suspicion score (red past the placement
+        // deprioritization threshold, carried in the view) and RTT EMA.
+        `<span class="${(n.suspicion || 0) >= (n.suspect_threshold ?? 0.5)
+                ? "bad" : "ok"}">` +
+            `${(n.suspicion || 0).toFixed(2)}</span>` +
+            (n.rtt_ms != null ? ` ${esc(n.rtt_ms.toFixed(1))}ms` : ""),
         esc(Object.entries(n.labels || {})
             .map(kv => kv.join("=")).join(" ")),
     ]));

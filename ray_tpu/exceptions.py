@@ -69,6 +69,28 @@ class GetTimeoutError(RayError, TimeoutError):
     pass
 
 
+class DeadlineExceededError(RayError):
+    """An end-to-end deadline expired before the operation completed.
+
+    Deliberately NOT a TimeoutError subclass: on Python >= 3.11
+    asyncio.TimeoutError IS the builtin TimeoutError, and every
+    `except (RpcError, asyncio.TimeoutError)` retry handler in the
+    runtime would silently swallow a deadline expiry as a transient
+    fault — the opposite of its fail-fast contract.
+
+    Raised for task calls submitted with ``.options(timeout_s=...)``, for
+    object pulls carrying a deadline, and for control-plane RPCs issued
+    with an explicit absolute deadline.  The deadline is a wall-clock
+    instant carried in the RPC frame header and propagated across hops
+    (driver -> agent -> worker, and into nested submits), so the whole
+    chain fails fast together instead of each hop waiting out its own
+    constant timeout against a gray peer (Dean & Barroso, "The Tail at
+    Scale").  Distinct from GetTimeoutError (a caller-local get(timeout=)
+    bound) and from ObjectTransferError (a transient transfer failure):
+    the work itself was abandoned because its budget ran out — callers
+    should treat the result as unavailable, not retry blindly."""
+
+
 class TaskCancelledError(RayError):
     pass
 

@@ -13,7 +13,7 @@ from ._private.serialization import get_context
 class RemoteFunction:
     def __init__(self, fn, *, num_returns=1, num_cpus=1, num_tpus=0,
                  resources=None, max_retries=None, scheduling_strategy=None,
-                 runtime_env=None, name=None,
+                 runtime_env=None, name=None, timeout_s=None,
                  _generator_backpressure_num_objects=0):
         self._fn = fn
         import inspect
@@ -31,6 +31,11 @@ class RemoteFunction:
         self._max_retries = max_retries
         self._scheduling_strategy = scheduling_strategy
         self._runtime_env = runtime_env
+        # End-to-end budget per CALL: each .remote() starts its own clock
+        # (deadline = now + timeout_s, carried in the task spec across
+        # every hop); expiry resolves the returns to
+        # DeadlineExceededError instead of hanging.
+        self._timeout_s = timeout_s
         self._name = name or getattr(fn, "__name__", "fn")
         self._export_blob: Optional[bytes] = None
         self._fn_id: Optional[bytes] = None  # cached after first export
@@ -64,6 +69,7 @@ class RemoteFunction:
             max_retries=self._max_retries,
             scheduling_strategy=self._scheduling_strategy,
             runtime_env=self._runtime_env, name=self._name,
+            timeout_s=self._timeout_s,
             _generator_backpressure_num_objects=self._generator_backpressure)
         merged.update(overrides)
         return RemoteFunction(self._fn, **merged)
@@ -129,7 +135,8 @@ class RemoteFunction:
             runtime_env=renv, name=self._name,
             fn_blob=self._export_blob,
             generator_backpressure=self._generator_backpressure,
-            sched_key=key, spec_prefix=spec_prefix)
+            sched_key=key, spec_prefix=spec_prefix,
+            timeout_s=self._timeout_s)
         # num_returns="streaming" yields a single ObjectRefGenerator.
         if self._num_returns == 1 or isinstance(self._num_returns, str):
             return refs[0]

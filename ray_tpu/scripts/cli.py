@@ -125,6 +125,15 @@ def cmd_status(args) -> int:
             avail[k] = avail.get(k, 0.0) + v
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    for n in nodes:
+        state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
+        rtt = n.get("rtt_ms")
+        health = (f"suspicion={n.get('suspicion', 0.0):.2f}"
+                  f" rtt={rtt:.1f}ms" if rtt is not None
+                  else f"suspicion={n.get('suspicion', 0.0):.2f}")
+        reason = n.get("drain_reason")
+        print(f"  node {n['node_id'].hex()[:12]}  {state:8s} {health}"
+              + (f" drain_reason={reason}" if reason else ""))
     if isinstance(info, dict):
         for k, v in info.items():
             if isinstance(v, (int, float, str)):

@@ -28,6 +28,13 @@ def list_nodes() -> List[Dict[str, Any]]:
             "resources_total": n["resources_total"],
             "resources_available": n["resources_available"],
             "labels": n["labels"],
+            # Gray-failure observability: suspicion score in [0, 1] (EMA
+            # of RTT-vs-cluster-baseline and heartbeat-staleness
+            # evidence), last GCS probe RTT EMA in ms, and — once a
+            # drain has run — why (e.g. "gray" for an auto-evacuation).
+            "suspicion": n.get("suspicion", 0.0),
+            "rtt_ms": n.get("rtt_ms"),
+            "drain_reason": n.get("drain_reason"),
         })
     return out
 

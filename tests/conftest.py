@@ -54,9 +54,93 @@ def pytest_configure(config):
         "with -m 'not slow'")
     config.addinivalue_line(
         "markers",
-        "chaos: fault-injection tests (process kills / RPC drops); the "
-        "long kill-chaos soak is additionally marked slow — run it with "
+        "chaos: fault-injection tests (process kills / RPC drops / link "
+        "latency+partitions); guarded by a per-test wall-clock watchdog "
+        "(RAY_TPU_CHAOS_WATCHDOG_S, default 180) that dumps every "
+        "thread/task stack and fails the test instead of hanging; the "
+        "long soaks are additionally marked slow — run them with "
         "-m 'chaos and slow'")
+
+
+class ChaosWatchdogTimeout(BaseException):
+    """Raised INTO the test's main thread when the chaos watchdog fires.
+
+    A BaseException so an `except Exception` inside the runtime or the
+    test body can't swallow it before pytest reports the failure."""
+
+
+def _dump_all_stacks(reason: str):
+    """Every thread's frame (faulthandler) plus every asyncio task of the
+    runtime's loop — the hang's exact shape, in the test log."""
+    import faulthandler
+    import sys
+    sys.stderr.write(f"\n=== chaos watchdog: {reason} ===\n")
+    sys.stderr.flush()
+    faulthandler.dump_traceback(all_threads=True)
+    try:
+        import asyncio
+        from ray_tpu._private import worker as worker_mod
+        rt = worker_mod.global_runtime()
+        loop = rt.core.loop if rt is not None else None
+        if loop is not None and loop.is_running():
+            for task in asyncio.all_tasks(loop):
+                task.print_stack(file=sys.stderr)
+    except Exception:
+        pass  # best-effort: thread stacks above are the load-bearing part
+    sys.stderr.flush()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_watchdog(request):
+    """Wall-clock watchdog for chaos-marked tests: a regression that
+    reintroduces a hang (the failure mode this suite exists to prevent)
+    shows up as a stack trace within minutes instead of eating the whole
+    tier-1 budget.  On expiry: dump all stacks, raise
+    ChaosWatchdogTimeout in the test's thread, and — if the test is so
+    wedged it can't even take an async exception (blocked in C) —
+    hard-exit after a grace period, pytest-timeout style."""
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    budget = float(os.environ.get("RAY_TPU_CHAOS_WATCHDOG_S", "180"))
+    if budget <= 0:
+        yield
+        return
+    import ctypes
+    import threading
+    main_tid = threading.get_ident()
+    done = threading.Event()
+
+    def _expire():
+        if done.wait(budget):
+            return
+        _dump_all_stacks(
+            f"{request.node.nodeid} still running after {budget:.0f}s")
+        if done.is_set():
+            # The test finished while we were dumping stacks: an async
+            # exception now would land in teardown or the NEXT test.
+            return
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(main_tid),
+            ctypes.py_object(ChaosWatchdogTimeout))
+        if not done.wait(15.0):
+            # Blocked in a C call that never returns: the async exception
+            # can't land.  Ending the run with a clear verdict beats
+            # silently burning the remaining suite budget.
+            import sys
+            sys.stderr.write("=== chaos watchdog: test unkillable, "
+                             "aborting run ===\n")
+            sys.stderr.flush()
+            os._exit(70)
+
+    guard = threading.Thread(target=_expire, name="chaos-watchdog",
+                             daemon=True)
+    guard.start()
+    try:
+        yield
+    finally:
+        done.set()
+        guard.join(timeout=5.0)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,642 @@
+"""Gray-failure defense: link chaos, end-to-end deadlines, hedged pulls.
+
+Crashes are the EASY failure mode — rpc drops and SIGKILLs (test_chaos.py,
+test_chaos_kill.py) exercise those.  This file injects the failures that
+crash detectors cannot see (Huang et al., HotOS'17 "gray failure"): added
+latency, bandwidth throttling, and ASYMMETRIC partitions where the TCP
+session stays up while one direction is blackholed.  The assertions are
+always typed outcomes — DeadlineExceededError / ObjectTransferError /
+correct bytes — never hangs (the conftest chaos watchdog turns any
+regression back into a stack trace) and never truncated buffers.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private.chaos import LinkChaos, parse_link_spec
+
+CHUNK = 256 * 1024
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def clean_rpc():
+    """Never leak process-global injection/defaults into later tests."""
+    slack = rpc.DEADLINE_SKEW_SLACK_S
+    yield
+    rpc.enable_link_chaos("")
+    rpc.enable_chaos("")
+    rpc.set_default_call_timeout(None)
+    rpc.DEADLINE_SKEW_SLACK_S = slack
+
+
+def _mini_agent(chunk_bytes=CHUNK, window=4, timeout_s=2.0, hedge=False):
+    from ray_tpu._private.agent import NodeAgent
+    a = NodeAgent.__new__(NodeAgent)
+    a._chunk_bytes = chunk_bytes
+    a._max_inflight_chunks = window
+    a._chunk_timeout = timeout_s
+    a._peer_stats = {}
+    a._hedge_enabled = hedge
+    a._hedge_delay_ms = 0
+    a._hedge_budget_frac = 0.5
+    a._hedge_total = 0
+    a._hedge_used = 0
+    return a
+
+
+# ------------------------------------------------------------ spec parsing --
+
+
+def test_link_spec_parsing():
+    rules = parse_link_spec(
+        "out_delay=0.5:0.1,agent->agent/in_drop=1:4,out_bw=1000000:2")
+    assert [r["kind"] for r in rules] == ["out_delay", "in_drop", "out_bw"]
+    assert rules[0] == {"kind": "out_delay", "match": "", "after": 0.0,
+                        "dur": None, "delay": 0.5, "jitter": 0.1}
+    assert rules[1]["match"] == "agent->agent"
+    assert rules[1]["after"] == 1.0 and rules[1]["dur"] == 4.0
+    assert rules[2]["bw"] == 1_000_000.0 and rules[2]["after"] == 2.0
+
+    with pytest.raises(ValueError):
+        parse_link_spec("sideways_delay=0.5")
+    with pytest.raises(ValueError):
+        parse_link_spec("out_bw=0")
+
+
+def test_link_chaos_plan_is_directional_and_scheduled():
+    lc = LinkChaos("out_delay=0.25,cli/in_drop=,out_bw=1000:0:100")
+    # Direction and match filters.
+    drop, delay = lc.plan("out", "cli|127.0.0.1:1", 10)
+    assert not drop and delay >= 0.25          # delay + bw share the link
+    drop, _ = lc.plan("in", "cli|127.0.0.1:1", 10)
+    assert drop                                 # asymmetric: inbound only
+    drop, delay = lc.plan("in", "srv|127.0.0.1:2", 10)
+    assert not drop and delay == 0.0            # match filter excludes
+    # Token-bucket throttling accumulates across units.
+    lc2 = LinkChaos("out_bw=1000")
+    _, d1 = lc2.plan("out", "x|", 1000)
+    _, d2 = lc2.plan("out", "x|", 1000)
+    assert d2 >= d1 + 0.9                       # second unit queues ~1s
+    # after/dur window: inactive before `after`.
+    lc3 = LinkChaos("out_drop=5:1")
+    drop, _ = lc3.plan("out", "x|", 10)
+    assert not drop
+
+
+# ------------------------------------------------------------- rpc effects --
+
+
+def test_out_delay_slows_but_preserves_calls(clean_rpc):
+    """A delayed link is SLOW, not broken: calls complete correctly and
+    observed latency includes the injected delay."""
+    async def main():
+        server = rpc.RpcServer({"echo": lambda c, p: p}, name="lat-srv",
+                               auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        rpc.enable_link_chaos("lat-cli/out_delay=0.2")
+        conn = await rpc.connect(tuple(addr), name="lat-cli",
+                                 auth_token=None)
+        try:
+            t0 = time.monotonic()
+            assert await conn.call("echo", {"x": 1}, timeout=10) == {"x": 1}
+            assert time.monotonic() - t0 >= 0.2
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_asymmetric_partition_request_direction(clean_rpc):
+    """out_drop on the requester: the handler NEVER runs, yet the same
+    process still receives traffic fine — the one-way blackhole shape
+    that looks healthy to a crash detector."""
+    async def main():
+        ran = []
+        server = rpc.RpcServer(
+            {"m": lambda c, p: ran.append(p) or "ok"},
+            name="asym-srv", auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        conn = await rpc.connect(tuple(addr), name="asym-cli",
+                                 auth_token=None)
+        rpc.enable_link_chaos("asym-cli/out_drop=")
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("m", 1, timeout=0.4)
+            assert ran == []
+            # Heal the partition: the SAME connection works again (the
+            # TCP session never died).
+            rpc.enable_link_chaos("")
+            assert await conn.call("m", 2, timeout=10) == "ok"
+            assert ran == [2]
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_asymmetric_partition_response_direction(clean_rpc):
+    """in_drop on the requester: the handler DID run, only the reply
+    vanishes — the at-least-once hazard, now bounded by a timeout."""
+    async def main():
+        ran = []
+        server = rpc.RpcServer(
+            {"m": lambda c, p: ran.append(p) or "ok"},
+            name="asym2-srv", auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        conn = await rpc.connect(tuple(addr), name="asym2-cli",
+                                 auth_token=None)
+        rpc.enable_link_chaos("asym2-cli/in_drop=")
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("m", 1, timeout=0.4)
+            await asyncio.sleep(0.1)
+            assert ran == [1]                    # side effect happened
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_blackholed_call_raises_deadline_exceeded(clean_rpc):
+    """A call carrying an absolute deadline over a blackholed link fails
+    with the TYPED DeadlineExceededError (not a generic timeout), within
+    its budget."""
+    async def main():
+        server = rpc.RpcServer({"m": lambda c, p: "ok"}, name="bh-srv",
+                               auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        conn = await rpc.connect(tuple(addr), name="bh-cli",
+                                 auth_token=None)
+        rpc.enable_link_chaos("bh-cli/out_drop=")
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(exc.DeadlineExceededError):
+                await conn.call("m", None, deadline=time.time() + 0.5)
+            assert time.monotonic() - t0 < 5.0
+            # Already-expired deadline fails immediately, no wire trip.
+            with pytest.raises(exc.DeadlineExceededError):
+                await conn.call("m", None, deadline=time.time() - 1)
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_expired_request_refused_at_receiver(clean_rpc):
+    """A deadline-carrying request DELIVERED LATE (gray link) is refused
+    before dispatch with the typed first-line error contract.  Skew
+    slack is zeroed so the refusal can be tested at sub-second scale
+    (production keeps a tolerance for cross-host clock skew)."""
+    rpc.DEADLINE_SKEW_SLACK_S = 0.0
+
+    async def main():
+        ran = []
+        server = rpc.RpcServer(
+            {"m": lambda c, p: ran.append(p) or "ok"},
+            name="late-srv", auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        # 0.4s inbound delay at the receiver: the request lands after
+        # its 0.15s deadline already passed.
+        rpc.enable_link_chaos("late-cli/out_delay=0.4")
+        conn = await rpc.connect(tuple(addr), name="late-cli",
+                                 auth_token=None)
+        try:
+            with pytest.raises(exc.DeadlineExceededError):
+                await conn.call("m", 1, deadline=time.time() + 0.15,
+                                timeout=10)
+            await asyncio.sleep(0.6)
+            assert ran == []                     # refused pre-handler
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_default_call_timeout_bounds_unary_calls(clean_rpc):
+    """The control_call_timeout_s default turns a would-be-forever hang
+    into a bounded TimeoutError; explicit timeout=0 opts out."""
+    async def main():
+        async def h_hang(conn, p):
+            await asyncio.sleep(p)
+            return "done"
+
+        server = rpc.RpcServer({"hang": h_hang}, name="dflt-srv",
+                               auth_token=None)
+        addr = await server.start_tcp("127.0.0.1", 0)
+        conn = await rpc.connect(tuple(addr), name="dflt-cli",
+                                 auth_token=None)
+        rpc.set_default_call_timeout(0.3)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("hang", 30)      # timeout=None -> default
+            assert time.monotonic() - t0 < 5.0
+            # timeout=0 opts out (streaming-ish calls that legitimately
+            # block longer than any unary bound).
+            assert await conn.call("hang", 0.5, timeout=0) == "done"
+        finally:
+            await conn.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_reconnect_backoff_is_jittered():
+    """Backoff delays are spread (thundering-herd defense) yet bounded."""
+    delays = [rpc._backoff_delay(a, 0.2) for a in range(8)]
+    assert all(0.0 < d < 3.1 for d in delays)
+    # Jitter actually varies the samples (seeded RNG, but not constant).
+    assert len({round(d, 6) for d in delays}) > 4
+
+
+# ------------------------------------------------------------- data plane --
+
+
+def test_pull_fails_over_under_asymmetric_partition(clean_rpc):
+    """One source's replies are blackholed mid-protocol; the pull fails
+    over to the healthy source and delivers intact bytes — never
+    truncated, never hung."""
+    async def main():
+        import numpy as np
+        data = np.random.default_rng(7).bytes(4 * CHUNK + 17)
+
+        def handler(tag, served):
+            async def h(conn, p):
+                served[tag] += 1
+                off, ln = p["offset"], p["length"]
+                return rpc.RawPayload([memoryview(data)[off:off + ln]])
+            return h
+
+        served = {"a": 0, "b": 0}
+        srv_a = rpc.RpcServer({"fetch_chunk": handler("a", served)},
+                              name="srcA", auth_token=None)
+        srv_b = rpc.RpcServer({"fetch_chunk": handler("b", served)},
+                              name="srcB", auth_token=None)
+        addr_a = await srv_a.start_tcp("127.0.0.1", 0)
+        addr_b = await srv_b.start_tcp("127.0.0.1", 0)
+        peer_a = await rpc.connect(tuple(addr_a), name="pull-a",
+                                   auth_token=None)
+        peer_b = await rpc.connect(tuple(addr_b), name="pull-b",
+                                   auth_token=None)
+        # Asymmetric: source A's replies never arrive (requests DO reach
+        # it — differential observability), source B is healthy.
+        rpc.enable_link_chaos("pull-a/in_drop=")
+        agent = _mini_agent(window=2, timeout_s=0.5)
+        dest = bytearray(len(data))
+        view = memoryview(dest)
+        try:
+            await agent._stream_chunks(
+                [peer_a, peer_b], b"o" * 20, len(data),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        finally:
+            view.release()
+            rpc.enable_link_chaos("")
+            await peer_a.close()
+            await peer_b.close()
+            await srv_a.close()
+            await srv_b.close()
+        assert bytes(dest) == data
+        assert served["b"] >= 5                  # healthy source carried it
+
+    asyncio.run(main())
+
+
+def test_hedged_pull_races_backup_past_p95(clean_rpc):
+    """Tail defense: a slow-but-alive primary is raced by the backup
+    after the hedge delay; first responder wins and the transfer's wall
+    clock tracks the FAST source, not the straggler."""
+    async def main():
+        import numpy as np
+        data = np.random.default_rng(8).bytes(4 * CHUNK)
+        served = {"slow": 0, "fast": 0}
+
+        def handler(tag, latency):
+            async def h(conn, p):
+                served[tag] += 1
+                await asyncio.sleep(latency)
+                off, ln = p["offset"], p["length"]
+                return rpc.RawPayload([memoryview(data)[off:off + ln]])
+            return h
+
+        srv_slow = rpc.RpcServer({"fetch_chunk": handler("slow", 5.0)},
+                                 name="slow", auth_token=None)
+        srv_fast = rpc.RpcServer({"fetch_chunk": handler("fast", 0.0)},
+                                 name="fast", auth_token=None)
+        addr_s = await srv_slow.start_tcp("127.0.0.1", 0)
+        addr_f = await srv_fast.start_tcp("127.0.0.1", 0)
+        peer_s = await rpc.connect(tuple(addr_s), auth_token=None)
+        peer_f = await rpc.connect(tuple(addr_f), auth_token=None)
+        agent = _mini_agent(window=4, timeout_s=10.0, hedge=True)
+        dest = bytearray(len(data))
+        view = memoryview(dest)
+        t0 = time.monotonic()
+        try:
+            await agent._stream_chunks(
+                [peer_s, peer_f], b"o" * 20, len(data),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        finally:
+            view.release()
+            await peer_s.close()
+            await peer_f.close()
+            await srv_slow.close()
+            await srv_fast.close()
+        elapsed = time.monotonic() - t0
+        assert bytes(dest) == data
+        assert served["fast"] >= 1               # the hedge engaged
+        # Sequential failover would cost >= chunks * primary latency;
+        # the hedged race must track hedge_delay (0.2s) + fast source.
+        assert elapsed < 4.0, f"hedge did not engage ({elapsed:.1f}s)"
+        assert agent._hedge_used >= 1
+
+    asyncio.run(main())
+
+
+def test_hedge_budget_caps_amplification():
+    """The hedge budget admits only a bounded fraction of fetches: an
+    overloaded (not gray) cluster must not see doubled load."""
+    agent = _mini_agent(hedge=True)
+    agent._hedge_budget_frac = 0.1
+    agent._hedge_total = 1000
+    agent._hedge_used = 0
+    granted = sum(1 for _ in range(1000) if agent._hedge_allow())
+    assert granted <= 0.1 * 1000 + 5
+
+
+def test_pull_deadline_exceeded_is_typed_not_a_hang(clean_rpc):
+    """A pull whose budget runs out against a stalled source raises
+    DeadlineExceededError promptly — the caller's end-to-end promise
+    holds even when every source is wedged."""
+    async def main():
+        async def h_stall(conn, p):
+            await asyncio.sleep(60)
+
+        srv = rpc.RpcServer({"fetch_chunk": h_stall}, name="stall",
+                            auth_token=None)
+        addr = await srv.start_tcp("127.0.0.1", 0)
+        peer = await rpc.connect(tuple(addr), auth_token=None)
+        agent = _mini_agent(window=2, timeout_s=30.0)
+        dest = bytearray(2 * CHUNK)
+        view = memoryview(dest)
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(exc.DeadlineExceededError):
+                await agent._stream_chunks(
+                    [peer], b"o" * 20, len(dest),
+                    make_sink=lambda pos, n: view[pos:pos + n],
+                    deadline=time.time() + 0.8)
+        finally:
+            view.release()
+            await peer.close()
+            await srv.close()
+        assert time.monotonic() - t0 < 10.0
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- end-to-end tasks --
+
+
+def test_task_timeout_s_surfaces_deadline_exceeded(clean_rpc):
+    """`.options(timeout_s=...)`: a task that cannot finish in budget
+    resolves to DeadlineExceededError — never a hang — while an in-budget
+    task is untouched."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def sleepy(t):
+            time.sleep(t)
+            return "done"
+
+        assert ray_tpu.get(
+            sleepy.options(timeout_s=30).remote(0.01), timeout=60) == "done"
+
+        t0 = time.monotonic()
+        ref = sleepy.options(timeout_s=1.0).remote(60)
+        with pytest.raises(exc.DeadlineExceededError):
+            ray_tpu.get(ref, timeout=90)
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_multi_return_deadline_with_dropped_first_ref(clean_rpc):
+    """Watchdog regression: a multi-return task whose FIRST return ref
+    was dropped must still resolve the remaining refs to the typed
+    error at the deadline — checking only return #1's tracking would
+    turn `get(r1)` into a forever-hang."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=0, num_returns=2)
+        def two(t):
+            time.sleep(t)
+            return 1, 2
+
+        r0, r1 = two.options(timeout_s=1.0).remote(60)
+        del r0
+        t0 = time.monotonic()
+        with pytest.raises(exc.DeadlineExceededError):
+            ray_tpu.get(r1, timeout=90)
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_timeout_preserves_sync_method_state(clean_rpc):
+    """The deadline chase must NOT interrupt a sync actor method that is
+    already executing (interrupt_running=False): an async-exc between
+    two mutations would leave actor state half-mutated.  The method
+    runs a pure-Python loop so an async-exc WOULD land if sent."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Ledger:
+            def __init__(self):
+                self.a = 0
+                self.b = 0
+
+            def transfer(self, spin):
+                self.a -= 1
+                t0 = time.time()
+                while time.time() - t0 < spin:
+                    pass
+                self.b += 1
+
+            def balanced(self):
+                return self.a + self.b == 0
+
+        led = Ledger.remote()
+        with pytest.raises(exc.DeadlineExceededError):
+            ray_tpu.get(led.transfer.options(timeout_s=1.0).remote(4.0),
+                        timeout=60)
+        # The expired call finished its work (result discarded) instead
+        # of aborting between the two mutations.
+        assert ray_tpu.get(led.balanced.options(timeout_s=60).remote(),
+                           timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_call_timeout_s(clean_rpc):
+    """Actor method deadline: an over-budget call fails typed; the actor
+    itself survives and keeps serving."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Slowpoke:
+            def work(self, t):
+                time.sleep(t)
+                return "ok"
+
+        a = Slowpoke.remote()
+        assert ray_tpu.get(a.work.remote(0.01), timeout=60) == "ok"
+        t0 = time.monotonic()
+        with pytest.raises(exc.DeadlineExceededError):
+            ray_tpu.get(a.work.options(timeout_s=1.0).remote(8),
+                        timeout=90)
+        assert time.monotonic() - t0 < 8.0       # typed BEFORE completion
+        # The actor was not killed by the expiry — it finishes the
+        # un-interruptible sleep (cancel is best-effort for sync
+        # methods) and keeps serving.
+        assert ray_tpu.get(a.work.options(timeout_s=60).remote(0.01),
+                           timeout=150) == "ok"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_submit_batch_under_link_latency(clean_rpc):
+    """Coalesced submit_batch under process-wide injected latency: every
+    task runs exactly once, in order, with correct results — slow, never
+    wrong."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "link_chaos": "out_delay=0.05"})
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        out = ray_tpu.get([c.inc.remote() for _ in range(20)], timeout=120)
+        assert out == list(range(1, 21))
+        ray_tpu.kill(c)
+    finally:
+        ray_tpu.shutdown()
+        rpc.enable_link_chaos("")
+
+
+# --------------------------------------------------------------- gray e2e --
+
+
+def test_gray_slow_node_scored_avoided_and_drained(clean_rpc):
+    """Acceptance: one node gets a 500ms one-way link delay.  A 100-task
+    + 1-actor workload completes with ZERO user-visible failures, the
+    slow node's suspicion score rises past threshold, new placement
+    avoids it, and the GCS auto-drains it with reason='gray' — the full
+    detect -> avoid -> evacuate loop."""
+    from ray_tpu.cluster_utils import Cluster
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 0,
+        "_system_config": {
+            # Fast scoring cadence so detect->drain fits a test budget.
+            "health_check_period_ms": 500,
+            "gray_sustained_s": 2.0,
+            "gray_min_rtt_ms": 50.0,
+            "node_drain_deadline_s": 15.0,
+        }})
+    try:
+        fast = cluster.add_node(num_cpus=2)
+        slow = cluster.add_node(num_cpus=2, _system_config={
+            "link_chaos": "out_delay=0.5"})
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=1, max_retries=-1)
+        def where():
+            return bytes(ray_tpu.get_runtime_context().node_id)
+
+        @ray_tpu.remote(num_cpus=1, max_restarts=2, max_task_retries=-1)
+        class Svc:
+            def ping(self, i):
+                return i
+
+        a = Svc.remote()
+        # 100 tasks + actor calls across the whole detection window:
+        # none may surface a failure to the user.
+        refs = [where.remote() for _ in range(100)]
+        pings = [a.ping.remote(i) for i in range(10)]
+        assert ray_tpu.get(pings, timeout=300) == list(range(10))
+        homes = ray_tpu.get(refs, timeout=300)
+        assert len(homes) == 100                  # all completed
+
+        def views():
+            return {bytes(n["node_id"]): n for n in ray_tpu.nodes()}
+
+        # Detection: the slow node's suspicion crosses the placement
+        # threshold (its probe RTT is ~500ms against a ~ms baseline).
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            v = views().get(slow.node_id)
+            if v is not None and v.get("suspicion", 0.0) >= 0.5:
+                break
+            time.sleep(0.5)
+        v = views()[slow.node_id]
+        assert v.get("suspicion", 0.0) >= 0.5, \
+            f"suspicion never rose: {v.get('suspicion')}"
+        assert views()[fast.node_id].get("suspicion", 1.0) < 0.5
+
+        # Avoidance: new placement steers away from the suspect node
+        # while it is still schedulable.
+        if v["state"] == "ALIVE":
+            late = ray_tpu.get([where.remote() for _ in range(10)],
+                               timeout=300)
+            assert slow.node_id not in late
+
+        # Evacuation: sustained suspicion auto-drains with reason='gray'
+        # and the node eventually leaves the cluster.  The actor keeps
+        # serving throughout (restarted elsewhere if it lived there).
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            v = views().get(slow.node_id)
+            if v is not None and v.get("drain_reason") == "gray" \
+                    and v["state"] == "DEAD":
+                break
+            time.sleep(1.0)
+        v = views()[slow.node_id]
+        assert v.get("drain_reason") == "gray", \
+            f"no gray drain: {v.get('state')} {v.get('drain_reason')}"
+        assert v["state"] == "DEAD"
+        assert ray_tpu.get([a.ping.remote(i) for i in range(10, 20)],
+                           timeout=300) == list(range(10, 20))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()

@@ -202,14 +202,23 @@ def test_raw_request_upload_roundtrip():
 
 
 # ------------------------------------------------- pipelined chunked pulls --
-def _mini_agent(chunk_bytes=CHUNK, window=4, timeout_s=2.0):
+def _mini_agent(chunk_bytes=CHUNK, window=4, timeout_s=2.0,
+                hedge=False):
     """A NodeAgent shell exposing only the fields _stream_chunks uses —
-    the chunk engine is testable without a cluster."""
+    the chunk engine is testable without a cluster.  Hedging is off by
+    default so these tests pin down the sequential failover semantics;
+    tests/test_chaos_latency.py exercises the hedged race."""
     from ray_tpu._private.agent import NodeAgent
     a = NodeAgent.__new__(NodeAgent)
     a._chunk_bytes = chunk_bytes
     a._max_inflight_chunks = window
     a._chunk_timeout = timeout_s
+    a._peer_stats = {}
+    a._hedge_enabled = hedge
+    a._hedge_delay_ms = 0
+    a._hedge_budget_frac = 0.1
+    a._hedge_total = 0
+    a._hedge_used = 0
     return a
 
 
