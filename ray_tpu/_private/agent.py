@@ -53,6 +53,31 @@ def _write_file(path: str, data) -> None:
         f.write(data)
 
 
+def _intervals_add(ivs: list, start: int, end: int) -> None:
+    """Merge [start, end) into a sorted list of disjoint committed-byte
+    intervals (in place).  Pulls commit chunks out of order, so the list
+    stays short (≤ inflight window) in steady state."""
+    import bisect
+    i = bisect.bisect_left(ivs, (start, start))
+    if i > 0 and ivs[i - 1][1] >= start:
+        i -= 1
+    j = i
+    while j < len(ivs) and ivs[j][0] <= end:
+        start = min(start, ivs[j][0])
+        end = max(end, ivs[j][1])
+        j += 1
+    ivs[i:j] = [(start, end)]
+
+
+def _intervals_cover(ivs: list, start: int, end: int) -> bool:
+    """Whether [start, end) is fully inside one committed interval."""
+    if start >= end:
+        return True
+    import bisect
+    i = bisect.bisect_right(ivs, (start, float("inf"))) - 1
+    return i >= 0 and ivs[i][0] <= start and ivs[i][1] >= end
+
+
 def _read_file(path: str) -> bytes:
     with open(path, "rb") as f:
         return f.read()
@@ -185,6 +210,26 @@ class NodeAgent:
         self._pull_waiters: List[Tuple[int, int, asyncio.Future]] = []  # heap
         self._pull_active = 0
         self._pull_seq = 0
+        # Replica-plane state (see docs/data_plane.md "replica directory"):
+        # oid -> owner addr for SECONDARY copies this node registered with
+        # an owner (pulled replicas; deregistered on eviction/free/drain so
+        # directory entries can't outlive the bytes) ...
+        self._replica_owner: Dict[bytes, tuple] = {}
+        # ... oid -> owner addr for pinned PRIMARIES (pin_transfer/
+        # pin_object stamp it; drain migration forwards it so the adoptive
+        # node can repoint the owner's directory) ...
+        self._pinned_owner: Dict[bytes, tuple] = {}
+        # ... and in-progress arena pulls serving their already-committed
+        # chunks to peers (receiver-becomes-source, Cornet-style):
+        # oid -> {"size", "buf" (create_buffer view or None for
+        # disk-destined pulls), "done" (committed [start, end) intervals)}.
+        self._partial: Dict[bytes, dict] = {}
+        # Transfer counters (heartbeat -> GCS node view -> `ray_tpu list
+        # nodes` / dashboard transfer column).
+        self._bytes_served = 0
+        self._bytes_pulled = 0
+        self._last_pull_sources = 0   # observability: swarm width of the
+        #                               most recent pull on this node
         self._chunk_bytes = cfg.object_transfer_chunk_bytes
         self._max_pulls = cfg.max_concurrent_pulls
         self._max_inflight_chunks = cfg.object_transfer_max_inflight_chunks
@@ -360,6 +405,7 @@ class NodeAgent:
         while not self._shutdown:
             await asyncio.sleep(period)
             try:
+                self._sweep_replica_registrations()
                 if self.gcs and not self.gcs.closed:
                     ok = await self.gcs.call("report_resources", {
                         "node_id": self.node_id,
@@ -368,6 +414,11 @@ class NodeAgent:
                         # RTT/rate EMAs from this node's transfer paths
                         # (the GCS folds them into suspicion scores).
                         "peer_stats": self._peer_stats_snapshot(),
+                        # Data-plane counters for the node views
+                        # (`ray_tpu list nodes` / dashboard transfer
+                        # column).
+                        "transfer": {"bytes_served": self._bytes_served,
+                                     "bytes_pulled": self._bytes_pulled},
                     })
                     if ok is False and not self._shutdown \
                             and self._draining is None:
@@ -870,7 +921,8 @@ class NodeAgent:
             # (reference: raylet lease rejection while draining).
             spill = None
             if not p.get("placement_group"):
-                spill = await self._find_spillback(resources)
+                spill = await self._find_spillback(resources,
+                                                   p.get("prefetch"))
             return {"granted": False,
                     "reason": f"node draining ({self._draining})",
                     "spillback": spill, "retry_after_ms": 200}
@@ -897,7 +949,8 @@ class NodeAgent:
                 # the client retries (rotating bundles for index -1).
                 return {"granted": False, "reason": "bundle exhausted",
                         "retry_after_ms": 100}
-            spill = await self._find_spillback(resources)
+            spill = await self._find_spillback(resources,
+                                               p.get("prefetch"))
             if spill is not None:
                 return {"granted": False, "spillback": spill}
             if all(self.resources_total.get(k, 0.0) >= v - 1e-9
@@ -948,9 +1001,60 @@ class NodeAgent:
         wh.lease_bundle = bundle_key
         wh.lease_owner_conn = conn
         self.leases[lease_id] = wh
+        if p.get("prefetch"):
+            # Arg prefetch: start pulling the lease's missing large
+            # by-ref args NOW, so the fetch overlaps the submitter's
+            # push round-trip and the worker's dispatch/queueing
+            # (reference: the raylet pulls task-arg bundles during
+            # lease setup).  Fire-and-forget — the executing task's own
+            # resolve joins the in-flight pull (or finds the object
+            # landed) via the pull dedup table.
+            rpc.spawn(self._prefetch_lease_args(p["prefetch"]))
         return {"granted": True, "lease_id": lease_id,
                 "worker_addr": list(wh.address),
                 "worker_id": wh.worker_id}
+
+    async def _prefetch_lease_args(self, entries) -> None:
+        cfg = get_config()
+        if not cfg.arg_prefetch_enabled:
+            return
+        for ent in entries:
+            try:
+                oid, locs, owner, size, task_id = ent
+                oid = bytes(oid)
+            except (TypeError, ValueError):
+                continue
+            if self.store.contains(oid) or oid in self.spilled or \
+                    oid in self._pull_inflight:
+                continue
+            # Visible in the task timeline BEFORE the worker picks the
+            # task up: the acceptance signal that fetch overlapped
+            # dispatch.
+            self._note_task_event(bytes(task_id), "PREFETCH")
+            rpc.spawn(self._prefetch_one(oid, locs, owner))
+
+    async def _prefetch_one(self, oid: bytes, locs, owner) -> None:
+        try:
+            await self.h_pull_object(None, {
+                "object_id": oid,
+                "from_addrs": [list(a) for a in locs or ()],
+                "owner_addr": list(owner) if owner else None,
+                "priority": 2})
+        except Exception:
+            # Best-effort: the task's own arg resolution retries and,
+            # failing that, the owner-mediated fetch path decides.
+            pass
+
+    def _note_task_event(self, task_id: bytes, event: str) -> None:
+        if self.gcs is None or self.gcs.closed:
+            return
+        try:
+            self.gcs.notify("task_events", {"events": [{
+                "task_id": task_id, "name": "", "event": event,
+                "ts": time.time(), "worker_id": b"",
+                "node_id": self.node_id, "job_id": b""}]})
+        except rpc.RpcError:
+            pass
 
     def _kick_parked(self):
         """Resources were released somewhere: let the drain loop retry."""
@@ -1016,13 +1120,19 @@ class NodeAgent:
                 return key
         return None
 
-    async def _find_spillback(self, resources) -> Optional[list]:
+    async def _find_spillback(self, resources,
+                              prefetch=None) -> Optional[list]:
         """Pick a better node from the GCS resource view (stands in for
         the reference's in-raylet cluster view synced by ray_syncer),
         scored by the hybrid top-k policy
         (reference: hybrid_scheduling_policy.h:50). The view is cached
         ~500ms — under saturation every lease request lands here, and the
-        reference's syncer view is likewise eventually consistent."""
+        reference's syncer view is likewise eventually consistent.
+
+        `prefetch` (the lease request's arg work list) doubles as a
+        locality hint: within the same trusted+feasible tier, spill
+        toward the node already holding the task's bytes — locality is
+        a tiebreak below feasibility and trust, never above."""
         from . import scheduling_policy as policy
         now = time.monotonic()
         if now - getattr(self, "_nodes_cache_ts", 0.0) > 0.5:
@@ -1032,6 +1142,16 @@ class NodeAgent:
             except rpc.RpcError:
                 return None
         nodes = self._nodes_cache
+        loc_map = {}
+        if prefetch and get_config().object_locality_scheduling_enabled:
+            for ent in prefetch:
+                try:
+                    _oid, locs, _owner, size, _tid = ent
+                except (TypeError, ValueError):
+                    continue
+                for a in locs or ():
+                    key = tuple(a)
+                    loc_map[key] = loc_map.get(key, 0) + int(size or 0)
         # Gray-suspect nodes are spilled to only when nothing healthy
         # FITS — try the trusted subset first, then fall back to every
         # live node (mirroring the GCS scheduler: a suspect node is a
@@ -1043,6 +1163,15 @@ class NodeAgent:
         trusted = policy.prefer_trusted(live)
         for group in ([trusted, live] if len(trusted) < len(live)
                       else [live]):
+            if loc_map:
+                best = policy.pick_by_locality(
+                    [(tuple(n["address"]), tuple(n["address"]),
+                      n["resources_total"], n["resources_available"])
+                     for n in group],
+                    resources, loc_map,
+                    min_bytes=get_config().object_locality_min_bytes)
+                if best:
+                    return list(best)
             cands = [(tuple(n["address"]), n["resources_total"],
                       n["resources_available"]) for n in group]
             best = policy.hybrid_pick(cands, resources)
@@ -1270,6 +1399,13 @@ class NodeAgent:
             logger.warning("node %s draining (%s)",
                            self.node_id.hex()[:8], reason)
             self._kick_parked()
+            # Swarm-source handoff: withdraw every secondary-replica
+            # registration NOW, so new pulls stop routing here.  The
+            # copies keep serving in-flight chunk requests until
+            # teardown; mid-stream pulls fail over to the remaining
+            # holders when this node finally goes away.
+            for oid in list(self._replica_owner):
+                self._drop_replica_registration(oid)
         self._drain_deadline = max(self._drain_deadline, deadline)
         migrated = await self._migrate_primaries(deadline)
         while time.monotonic() < deadline:
@@ -1317,10 +1453,16 @@ class NodeAgent:
                 if not conns:
                     continue
                 timeout = max(1.0, min(60.0, deadline - time.monotonic()))
+                owner = self._pinned_owner.get(oid)
                 try:
                     ok = await conns[0].call("adopt_primary", {
                         "object_id": oid,
                         "from_addrs": [list(self.address)],
+                        # The adoptive node repoints the owner's replica
+                        # directory directly (primary=True add) — owners
+                        # learn the new home without waiting for a
+                        # recovery probe or the migrated-KV fallback.
+                        "owner_addr": list(owner) if owner else None,
                         "priority": 0}, timeout=timeout)
                 except (rpc.RpcError, asyncio.TimeoutError):
                     continue
@@ -1355,6 +1497,15 @@ class NodeAgent:
         if not await self.h_pin_object(conn, {"object_id": oid}):
             return False
         self._adopted.add(oid)
+        owner = p.get("owner_addr")
+        if owner:
+            # Promote in the owner's replica directory: this node is the
+            # primary now (any stale secondary record of us collapses
+            # into it), so subsequent pulls/frees route straight here.
+            self._pinned_owner[oid] = tuple(owner)
+            self._replica_owner.pop(oid, None)
+            rpc.spawn(self._notify_owner_location(oid, tuple(owner),
+                                                  add=True, primary=True))
         return True
 
     async def _forward_free(self, addr: tuple, oid: bytes) -> None:
@@ -1424,6 +1575,9 @@ class NodeAgent:
         """Owner-requested pin of a primary copy (reference: raylet
         PinObjectIDs keeping plasma objects alive for their owner)."""
         oid = p["object_id"]
+        if p.get("owner_addr"):
+            # Who to tell when a drain migrates this primary elsewhere.
+            self._pinned_owner[oid] = tuple(p["owner_addr"])
         if oid in self.spilled:
             self.pinned[oid] = self.pinned.get(oid, 0) + 1
             return True
@@ -1442,6 +1596,8 @@ class NodeAgent:
         same pinned accounting (h_unpin_object/h_free_objects check
         self.spilled before touching the store)."""
         oid = p["object_id"]
+        if p.get("owner_addr"):
+            self._pinned_owner[oid] = tuple(p["owner_addr"])
         self.pinned[oid] = self.pinned.get(oid, 0) + 1
         await self._maybe_spill_to_threshold()
         return True
@@ -1451,6 +1607,7 @@ class NodeAgent:
         n = self.pinned.get(oid, 0)
         if n <= 1:
             self.pinned.pop(oid, None)
+            self._pinned_owner.pop(oid, None)
         else:
             self.pinned[oid] = n - 1
         if n >= 1 and oid not in self.spilled:
@@ -1464,6 +1621,12 @@ class NodeAgent:
                     self.store.release(oid)
             spill = self.spilled.pop(oid, None)
             self._disk_cached.pop(oid, None)
+            self._pinned_owner.pop(oid, None)
+            # Deregister with the owner: for owner-initiated frees the
+            # directory entry is already gone (the remove is a no-op),
+            # but a direct free (tools/bench) must not leave the owner
+            # pointing at bytes we just dropped.
+            self._drop_replica_registration(oid)
             if spill is not None:
                 try:
                     os.unlink(spill[0])
@@ -1800,6 +1963,14 @@ class NodeAgent:
         oid = p["object_id"]
         if oid in self.spilled:
             return {"size": self.spilled[oid][1], "spilled": True}
+        part = self._partial.get(oid)
+        if part is not None and part["size"] is not None:
+            # Mid-pull here: peers may stripe committed chunks off us
+            # (uncommitted ones answer "later" and fail over).  A
+            # size-less marker (pull still probing) stays silent — we
+            # know nothing a prober doesn't.
+            return {"size": part["size"], "spilled": False,
+                    "partial": True}
         view = self.store.get(oid, timeout_ms=p.get("timeout_ms", 0))
         if view is None:
             return None
@@ -1845,10 +2016,31 @@ class NodeAgent:
             data = await asyncio.get_running_loop().run_in_executor(
                 None, _read_spill_chunk)
             if data is not None:
+                self._bytes_served += len(data)
                 return rpc.RawPayload([data]) if raw else data
         view = self.store.get(oid, timeout_ms=0)
         if view is None:
+            # Receiver-becomes-source: an arena pull of this object is in
+            # flight here — serve the chunk if its bytes are already
+            # committed (a COPY, never a subview: the unsealed buffer's
+            # lifetime belongs to the pull, which may abort), else tell
+            # the peer to come back ("later" — it retries its remaining
+            # sources; the primary always has the bytes).
+            part = self._partial.get(oid)
+            if part is not None:
+                if part["buf"] is not None and part["size"] is not None:
+                    end = min(off + length, part["size"])
+                    if _intervals_cover(part["done"], off, end):
+                        piece = bytes(part["buf"][off:end])
+                        self._bytes_served += len(piece)
+                        return rpc.RawPayload([piece]) if raw else piece
+                return {"later": True} if raw else None
+            # No copy at all: if the directory still lists us, retract
+            # the registration (the copy was LRU-evicted) so pullers
+            # stop being routed here.
+            self._drop_replica_registration(oid)
             return {"gone": True} if raw else None
+        self._bytes_served += min(length, max(0, len(view) - off))
         if raw:
             piece = view[off:off + length]
 
@@ -1902,7 +2094,8 @@ class NodeAgent:
         if not addrs and p.get("from_addr"):
             addrs = [tuple(p["from_addr"])]
         addrs = [a for a in addrs if a != tuple(self.address)]
-        if not addrs:
+        owner = tuple(p["owner_addr"]) if p.get("owner_addr") else None
+        if not addrs and owner is None:
             return False
         # End-to-end budget: explicit payload field, or the deadline the
         # RPC frame itself carried (rpc dispatch exposes it) — pulls
@@ -1951,7 +2144,7 @@ class NodeAgent:
             ok = await self._do_pull(oid, addrs,
                                      p.get("priority", 0),
                                      p.get("timeout_ms", 10000),
-                                     deadline=deadline)
+                                     deadline=deadline, owner=owner)
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -1970,7 +2163,8 @@ class NodeAgent:
 
     async def _stream_chunks(self, peers, oid: bytes, size: int,
                              make_sink, commit=None,
-                             deadline: float | None = None) -> None:
+                             deadline: float | None = None,
+                             on_chunk=None) -> None:
         """Shared pipelined chunk engine for arena- and disk-destined
         pulls (and any future push path).  Keeps up to
         `object_transfer_max_inflight_chunks` fetch_chunk requests in
@@ -1981,7 +2175,19 @@ class NodeAgent:
         lands — disk-destined pulls stage each chunk in memory and flush
         it off-loop there, so no blocking write ever runs on the agent
         loop; without commit the sink itself is the final destination
-        (arena view).
+        (arena view).  `on_chunk(pos, n)` (optional, sync) fires once a
+        chunk is final — the pull path publishes committed ranges there
+        so this agent can serve them to swarm peers mid-pull.
+
+        Swarm striping: with >=2 sources, chunk i's source order is the
+        peer list ROTATED by i (round-robin) — N concurrent pullers of
+        one object spread their chunk load across every holder instead
+        of serializing on the first (Cornet-style broadcast; the owner
+        already ordered the list primary-first, suspects last, and
+        `_order_peers` folded in local link evidence).  A source that
+        answers "later" (mid-pull peer that hasn't committed that chunk
+        yet) is skipped for this attempt — the rotation always ends at
+        a complete copy.
 
         Tail defense: with >=2 sources and budget left in the hedge
         bucket, the first attempt of each chunk RACES a backup source
@@ -2048,6 +2254,11 @@ class NodeAgent:
                                             n, chunk=True)
                     return "ok", None
                 return "transient", ValueError(f"short chunk {len(res)}/{n}")
+            if isinstance(res, dict) and res.get("later"):
+                # Mid-pull peer hasn't committed this chunk yet: not a
+                # failure (no health penalty), just not a source for
+                # THIS chunk right now.
+                return "later", None
             if res is None or (isinstance(res, dict) and res.get("gone")):
                 return "gone", None
             return "transient", ValueError(
@@ -2083,10 +2294,13 @@ class NodeAgent:
             if external is not None:
                 raise external
 
-        async def hedged(pos: int, n: int) -> bool:
-            """Primary-vs-delayed-backup race; True = chunk landed."""
-            primary = peers[0]
-            backup = next((p for p in peers[1:]
+        async def hedged(pos: int, n: int, ordered) -> bool:
+            """Primary-vs-delayed-backup race; True = chunk landed.
+            `ordered` is this chunk's striped source order — its head is
+            the chunk's assigned source, the backup comes from the
+            rest."""
+            primary = ordered[0]
+            backup = next((p for p in ordered[1:]
                            if p is not None and not p.closed), None)
             if backup is None or primary is None or primary.closed:
                 return False
@@ -2159,23 +2373,41 @@ class NodeAgent:
                 if external is not None:
                     raise external
 
+        # Per-node stripe phase: with N pullers and N holders, chunk i's
+        # PRIMARY-assigned owner is unique per puller (k = (i + phase)
+        # mod n), so in the cold concurrent phase the origin serves each
+        # chunk ~once instead of N times — the swarm then exchanges the
+        # rest peer-to-peer (Cornet partitions the chunk space the same
+        # way before receivers gossip).
+        phase = int.from_bytes(getattr(self, "node_id", b"")[:2] or b"\0",
+                               "little") if len(peers) > 1 else 0
+
         async def fetch(pos: int) -> None:
             n = min(self._chunk_bytes, size - pos)
+            # Round-robin stripe: chunk i's preferred source rotates
+            # through the holder set, so concurrent pulls of one object
+            # form a swarm instead of a convoy on the first source.
+            k = (pos // self._chunk_bytes + phase) % len(peers)
+            ordered = peers[k:] + peers[:k]
             self._hedge_total += 1
             if self._hedge_enabled and len(peers) >= 2:
-                if await hedged(pos, n):
+                if await hedged(pos, n, ordered):
+                    if on_chunk is not None:
+                        on_chunk(pos, n)
                     return
             last_err = None
             gone = dead = transient = 0
             for _round in range(2):
                 gone = dead = transient = 0
-                for peer in peers:
+                for peer in ordered:
                     sink_obj = make_sink(pos, n)
                     st, err = await try_peer(peer, pos, n, sink_obj,
                                              budget_timeout())
                     if st == "ok":
                         if commit is not None:
                             await commit(pos, sink_obj)
+                        if on_chunk is not None:
+                            on_chunk(pos, n)
                         return
                     if st == "gone":
                         gone += 1
@@ -2183,8 +2415,19 @@ class NodeAgent:
                         dead += 1
                         last_err = err or last_err
                     else:
+                        # "later" (mid-pull peer) counts with transient:
+                        # that source still EXISTS, so an all-gone
+                        # verdict (-> ObjectLost -> lineage) stays off
+                        # the table while any swarm member remains.
                         transient += 1
-                        last_err = err or last_err
+                        if st == "later" and _round == 0:
+                            # Give the mid-pull source a beat to commit
+                            # before falling back — without it the cold
+                            # phase of a broadcast degenerates to
+                            # everyone re-converging on the origin.
+                            await asyncio.sleep(0.02)
+                        elif st != "later":
+                            last_err = err or last_err
                 if (gone or dead) and not transient:
                     # Unanimous and unambiguous: no second pass.
                     break
@@ -2227,6 +2470,97 @@ class NodeAgent:
             peers.append(peer)
         return peers
 
+    # ---------------------------------------------- replica directory -----
+    async def _owner_conn(self, owner: tuple) -> rpc.Connection:
+        """Connection to an object OWNER (a worker/driver process, not an
+        agent) — shares the peer connection cache; owners and agents
+        speak the same RPC layer."""
+        conn = self._peer_conns.get(owner)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(owner, name="agent->owner", retries=2)
+            conn._peer_addr = owner
+            self._peer_conns[owner] = conn
+        return conn
+
+    async def _merge_owner_locations(self, oid: bytes, addrs: list,
+                                     owner: tuple,
+                                     register: bool = False) -> list:
+        """Union of the caller's from_addrs and the owner directory's
+        CURRENT holder set (self excluded, caller's order preserved —
+        the owner already ranks suspects last).  With register=True the
+        same round trip records THIS node as a mid-pull secondary,
+        atomically on the owner's loop — concurrent broadcast pullers
+        discover each other through exactly this.  Best-effort: an
+        unreachable owner just means no extra sources."""
+        try:
+            conn = await self._owner_conn(owner)
+            res = await conn.call(
+                "object_locations",
+                {"object_id": oid,
+                 "add_addr": list(self.address) if register else None},
+                timeout=5)
+        except (rpc.RpcError, asyncio.TimeoutError, OSError):
+            return addrs
+        if not res:
+            return addrs
+        merged = [tuple(a) for a in addrs]
+        me = tuple(self.address)
+        for a in res.get("locations") or ():
+            t = tuple(a)
+            if t != me and t not in merged:
+                merged.append(t)
+        return merged
+
+    def _order_peers(self, peers: list) -> list:
+        """Stable reorder of pull sources by LOCAL link evidence: peers
+        with fresh failures sink to the back (the owner's ordering
+        already put GCS-scored gray suspects last; this folds in what
+        this node saw first-hand, e.g. a half-open link the GCS can't
+        see from its vantage).  Freshness is judged on the FAILURE
+        timestamp — successes clear the counter — so a long-healed peer
+        is never punished for ancient blips."""
+        def suspect(conn) -> int:
+            st = self._peer_stats.get(getattr(conn, "_peer_addr", None))
+            if not st:
+                return 0
+            return 1 if (st["fail"] >= 2
+                         and time.monotonic()
+                         - st.get("fail_ts", 0.0) < 60.0) else 0
+        return sorted(peers, key=suspect)
+
+    def _drop_replica_registration(self, oid: bytes) -> None:
+        """Withdraw a secondary registration (eviction/abort/drain):
+        directory entries must never outlive the bytes they point at."""
+        owner = self._replica_owner.pop(oid, None)
+        if owner is not None:
+            rpc.spawn(self._notify_owner_location(oid, owner, add=False))
+
+    async def _notify_owner_location(self, oid: bytes, owner: tuple,
+                                     add: bool,
+                                     primary: bool = False) -> None:
+        try:
+            conn = await self._owner_conn(tuple(owner))
+            await conn.call(
+                "object_location_add" if add else "object_location_remove",
+                {"object_id": oid, "addr": list(self.address),
+                 "primary": primary}, timeout=10)
+        except Exception:
+            # Best-effort: a stale directory entry only costs a puller
+            # one failed probe (it fails over); a dead owner means the
+            # object is unreachable anyway.
+            pass
+
+    def _sweep_replica_registrations(self) -> None:
+        """Deregister secondaries whose local copy silently vanished
+        (shm LRU eviction happens inside the store, below this agent's
+        sight) — rides the heartbeat tick; the fetch-chunk "gone" path
+        catches the in-between window lazily."""
+        for oid in list(self._replica_owner):
+            if oid in self._partial or oid in self.spilled or \
+                    self.store.contains(oid):
+                continue
+            self._drop_replica_registration(oid)
+
     # ---------------------------------------------- peer link health ------
     def _peer_stat(self, addr: tuple) -> dict:
         st = self._peer_stats.get(addr)
@@ -2234,7 +2568,7 @@ class NodeAgent:
             from collections import deque as _dq
             st = self._peer_stats[addr] = {
                 "lat": _dq(maxlen=64), "rtt": None, "rate": None,
-                "fail": 0, "ts": time.monotonic()}
+                "fail": 0, "fail_ts": 0.0, "ts": time.monotonic()}
         return st
 
     def _note_peer_latency(self, peer, dt: float, nbytes: int = 0, *,
@@ -2254,6 +2588,10 @@ class NodeAgent:
         st = self._peer_stat(addr)
         if chunk:
             st["lat"].append(dt)
+            # A served chunk proves the link works NOW: clear the local
+            # failure evidence so _order_peers judges the present, not
+            # a healed blip.
+            st["fail"] = 0
             if nbytes and dt > 0:
                 rate = nbytes / dt
                 st["rate"] = rate if st["rate"] is None \
@@ -2289,7 +2627,7 @@ class NodeAgent:
             return
         st = self._peer_stat(addr)
         st["fail"] += 1
-        st["ts"] = time.monotonic()
+        st["fail_ts"] = st["ts"] = time.monotonic()
 
     def _peer_stats_snapshot(self) -> Dict[str, dict]:
         """Heartbeat payload: fresh (<60s) per-peer link observations,
@@ -2346,10 +2684,44 @@ class NodeAgent:
 
     async def _do_pull(self, oid: bytes, addrs: list, priority: int,
                        timeout_ms: int,
-                       deadline: float | None = None) -> bool:
+                       deadline: float | None = None,
+                       owner=None) -> bool:
+        use_dir = owner is not None and \
+            get_config().replica_directory_enabled
+        ok = False
+        if use_dir:
+            # Announce this pull FIRST: the size-less partial marker
+            # makes peers probing us answer "later" (not "gone"), and
+            # the register-and-query round trip below both records us in
+            # the owner's directory and returns the freshest holder set
+            # — secondaries that registered since the caller stamped its
+            # from_addrs, including peers MID-PULL right now.  That is
+            # what turns N concurrent pulls of one object into a chunk
+            # swarm instead of N convoys on the primary.
+            self._partial.setdefault(
+                oid, {"size": None, "buf": None, "done": []})
+            self._replica_owner[oid] = tuple(owner)
+            addrs = await self._merge_owner_locations(oid, addrs, owner,
+                                                      register=True)
+        try:
+            ok = await self._pull_into_node(oid, addrs, priority,
+                                            timeout_ms, deadline, owner)
+            return ok
+        finally:
+            if not ok and use_dir:
+                # Withdraw the registration before the marker: directory
+                # entries must not outlive what they point at.
+                self._drop_replica_registration(oid)
+            if not ok:
+                self._partial.pop(oid, None)
+
+    async def _pull_into_node(self, oid: bytes, addrs: list, priority: int,
+                              timeout_ms: int, deadline, owner) -> bool:
         peers = await self._pull_peers(addrs)
+        self._last_pull_sources = len(peers)
         if not peers:
             return False
+        peers = self._order_peers(peers)
         await self._pull_slot(priority)
         try:
             if deadline is not None and \
@@ -2393,25 +2765,45 @@ class NodeAgent:
             if buf is None:
                 # No room even after spilling: land the pull on disk.
                 return await self._pull_to_disk(peers, oid, size,
-                                                deadline=deadline)
+                                                deadline=deadline,
+                                                owner=owner)
+            # Receiver-becomes-source: publish committed ranges so peers
+            # pulling the same object can stripe them off us mid-pull
+            # (the directory registration happened at pull start).
+            part = {"size": size, "buf": buf, "done": []}
+            self._partial[oid] = part
+
+            def on_chunk(pos, n, _done=part["done"], _sz=size):
+                _intervals_add(_done, pos, min(pos + n, _sz))
+                self._bytes_pulled += n
+
             ok = False
             try:
                 await self._stream_chunks(
                     peers, oid, size,
                     make_sink=lambda pos, n: buf[pos:pos + n],
-                    deadline=deadline)
+                    deadline=deadline, on_chunk=on_chunk)
                 ok = True
             except NodeAgent._ObjectGone:
                 return False
             finally:
+                if not ok:
+                    # The partial marker drops BEFORE the buffer's
+                    # memory can be reused: a peer's fetch_chunk must
+                    # never copy out of an aborted arena region.
+                    self._partial.pop(oid, None)
                 buf.release()
                 if not ok:
                     # Covers gone, transfer errors and cancellation: never
                     # leave a permanently-unsealed object wedging this id
-                    # — and never seal a partially-filled buffer.
+                    # — and never seal a partially-filled buffer (the
+                    # caller withdraws the directory registration too).
                     self.store.abort(oid)
             self.store.seal(oid)
             self.store.release(oid)
+            # Sealed into the store before the partial record drops:
+            # a peer's fetch_chunk always finds one of the two.
+            self._partial.pop(oid, None)
             return True
         finally:
             self._pull_done()
@@ -2438,7 +2830,8 @@ class NodeAgent:
             os.close(fd)
 
     async def _pull_to_disk(self, peers, oid: bytes, size: int,
-                            deadline: float | None = None) -> bool:
+                            deadline: float | None = None,
+                            owner=None) -> bool:
         path = self._spill_path(oid)
         # Create/truncate up front; chunk commits reopen positionally.
         os.close(os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644))
@@ -2451,18 +2844,28 @@ class NodeAgent:
             await loop.run_in_executor(
                 None, self._pwrite_chunk, path, data, pos)
 
+        # Disk-destined pulls don't serve partial chunks (the staged
+        # buffers are transient), but the marker still answers peers
+        # "later" instead of "gone" — a swarm member under memory
+        # pressure must not push siblings toward lineage recovery.
+        self._partial[oid] = {"size": size, "buf": None, "done": []}
+
+        def on_chunk(pos, n):
+            self._bytes_pulled += n
+
         ok = False
         try:
             try:
                 await self._stream_chunks(
                     peers, oid, size,
                     make_sink=lambda pos, n: memoryview(bytearray(n)),
-                    commit=commit, deadline=deadline)
+                    commit=commit, deadline=deadline, on_chunk=on_chunk)
                 ok = True
             except NodeAgent._ObjectGone:
                 return False
         finally:
             if not ok:
+                self._partial.pop(oid, None)
                 try:
                     os.unlink(path)
                 except FileNotFoundError:
@@ -2470,6 +2873,7 @@ class NodeAgent:
         if not ok:
             return False
         self.spilled[oid] = (path, size)
+        self._partial.pop(oid, None)
         # Non-primary disk copies are a bounded cache, LRU-evicted — the
         # owner's free only reaches the primary node (reference analogue:
         # remote copies are evictable, only primaries are pinned).
@@ -2480,6 +2884,9 @@ class NodeAgent:
             if old == oid:
                 break
             self._disk_cached.pop(old)
+            # Directory invalidation precedes the unlink: a puller
+            # routed here between the two sees "gone" and fails over.
+            self._drop_replica_registration(old)
             sp = self.spilled.pop(old, None)
             if sp is not None:
                 try:
@@ -2496,10 +2903,20 @@ class NodeAgent:
             "resources_available": self.resources_available,
             "store_path": self.store_path,
             "num_workers": len(self.workers),
+            "transfer": {"bytes_served": self._bytes_served,
+                         "bytes_pulled": self._bytes_pulled},
         }
 
     async def h_store_stats(self, conn, p):
-        return self.store.stats()
+        st = self.store.stats()
+        # Replica-plane observability: how wide the last pull's source
+        # set was (tests assert a production pull sees >=2 once a
+        # secondary exists) and cumulative transfer volume.
+        st["last_pull_sources"] = self._last_pull_sources
+        st["bytes_served"] = self._bytes_served
+        st["bytes_pulled"] = self._bytes_pulled
+        st["replica_registrations"] = len(self._replica_owner)
+        return st
 
     async def h_list_objects(self, conn, p):
         """Full store index for the state API (reference: raylet

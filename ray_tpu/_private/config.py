@@ -123,6 +123,32 @@ _define("control_call_timeout_s", 60.0,
         "calls that legitimately block (actor pushes, stream "
         "backpressure, object long-polls) opt out with explicit "
         "timeout=0; 0 here disables the default entirely")
+_define("replica_directory_enabled", True,
+        "owners track EVERY holder of a plasma object (primary + pulled "
+        "secondaries, reference: the ownership table tracks all object "
+        "locations, Ownership NSDI'21): pulls stamp the full from_addrs "
+        "set (hedging/failover get real alternates), concurrent pulls "
+        "stripe chunks across holders (Cornet-style swarm broadcast), "
+        "and the scheduler scores bytes-already-local placement")
+_define("replica_directory_max_secondaries", 8,
+        "per-object cap on tracked secondary holders (oldest registration "
+        "dropped first; secondaries are evictable caches, so a dropped "
+        "entry only costs the swarm a source)")
+_define("object_locality_scheduling_enabled", True,
+        "score default-strategy placement by bytes already local to each "
+        "candidate node (task-spec ref-arg location hints); never "
+        "overrides feasibility, labels, or trusted-first ordering")
+_define("object_locality_min_bytes", 1024 * 1024,
+        "ignore locality below this many hinted arg bytes — tiny args "
+        "re-fetch faster than a misplaced lease costs")
+_define("arg_prefetch_enabled", True,
+        "on lease grant the agent immediately starts pulling the lease's "
+        "missing large by-reference args, overlapping the fetch with "
+        "worker dispatch/queueing (reference: the raylet pulls task args "
+        "during lease setup, pull_manager task-arg bundles)")
+_define("arg_prefetch_min_bytes", 1024 * 1024,
+        "only prefetch args at least this large: small args resolve "
+        "through the owner faster than a pull round-trip")
 _define("pull_hedge_enabled", True,
         "race a backup source for a pull chunk once the primary exceeds "
         "its observed p95 latency (Dean & Barroso hedged requests); "
@@ -134,6 +160,13 @@ _define("pull_hedge_budget_fraction", 0.1,
         "cap on hedged fetches as a fraction of total chunk fetches "
         "(plus a small burst) so hedging cannot amplify load on an "
         "already-throttled cluster")
+_define("gray_bulk_drain_exempt_bytes_per_s", 8 * 1024 * 1024,
+        "hold the gray AUTO-DRAIN (placement deprioritization still "
+        "applies) while a suspect node is moving at least this much "
+        "object-plane data per second between heartbeats — a node "
+        "serving a weight broadcast is busy, not gray, and evacuating "
+        "it would kill the transfer that inflated its probe RTT; 0 "
+        "disables the exemption")
 _define("gray_suspicion_threshold", 0.6,
         "per-node suspicion score (0..1, EMA of RTT-vs-cluster-baseline "
         "and heartbeat-staleness evidence) above which a node is "

@@ -319,6 +319,9 @@ class CoreWorker:
             "escape_pin": self.h_escape_pin,
             "escape_release": self.h_escape_release,
             "recover_object": self.h_recover_object,
+            "object_locations": self.h_object_locations,
+            "object_location_add": self.h_object_location_add,
+            "object_location_remove": self.h_object_location_remove,
             "device_fetch": self.h_device_fetch,
             "device_free": self.h_device_free,
             "stream_item": self.h_stream_item,
@@ -371,7 +374,8 @@ class CoreWorker:
         if "inline" in entry:
             self.memory_store.put_inline(oid, entry["inline"])
         else:
-            self.memory_store.put_plasma_location(oid, entry["plasma"])
+            self.memory_store.put_plasma_location(
+                oid, entry["plasma"], size=entry.get("size"))
         while (st.bp and st.unconsumed() >= st.bp and st.total is None
                and not st.released):
             ev = st.consume_event
@@ -770,11 +774,21 @@ class CoreWorker:
         self.memory_store.delete(object_id)
         if entry is not None and entry.plasma_node is not None:
             node = tuple(entry.plasma_node)
+            secondaries = tuple(entry.secondaries or ())
             if self.loop and not self._shutdown:
                 asyncio.run_coroutine_threadsafe(
-                    self._free_plasma(node, object_id), self.loop)
+                    self._free_plasma(node, object_id, secondaries),
+                    self.loop)
 
-    async def _free_plasma(self, agent_addr, object_id: bytes):
+    async def _free_plasma(self, agent_addr, object_id: bytes,
+                           secondaries=()):
+        # Replica copies free in parallel with the primary: secondaries
+        # are unpinned caches (the holder may have evicted already —
+        # free is idempotent), but an explicit free bounds how long
+        # freed bytes linger cluster-wide.
+        for sec in secondaries:
+            if tuple(sec) != tuple(agent_addr):
+                rpc.spawn(self._free_secondary(tuple(sec), object_id))
         try:
             conn = self.agent if agent_addr == self.agent_address else \
                 await self._peer_owner(agent_addr)
@@ -794,6 +808,14 @@ class CoreWorker:
                 await conn.call("free_objects", {"object_ids": [object_id]})
         except rpc.RpcError:
             pass
+
+    async def _free_secondary(self, addr: tuple, object_id: bytes) -> None:
+        try:
+            conn = self.agent if addr == self.agent_address else \
+                await self._peer_owner(addr)
+            await conn.call("free_objects", {"object_ids": [object_id]})
+        except (rpc.RpcError, asyncio.TimeoutError):
+            pass    # evictable cache: the holder's sweep also cleans up
 
     async def _peer_owner(self, addr) -> rpc.Connection:
         addr = tuple(addr)
@@ -843,7 +865,7 @@ class CoreWorker:
             self._record_contained(oid, captured)
             if self._put_store_sync(oid, parts):
                 self.memory_store.put_plasma_location(
-                    oid, list(self.agent_address))
+                    oid, list(self.agent_address), size=size)
                 return ObjectRef(oid, self.address, worker=self)
             # Arena full: loop-side backpressure/spill.  _run blocks this
             # thread until stored, so the caller may mutate its buffers
@@ -937,14 +959,23 @@ class CoreWorker:
     _OFFLOAD_COPY_MIN = 4 * 1024 * 1024
 
     async def _put_plasma(self, oid: bytes, parts):
+        size = get_context().total_size(parts)
         await self.store_with_backpressure(oid, parts)
-        self.memory_store.put_plasma_location(oid, list(self.agent_address))
+        self.memory_store.put_plasma_location(
+            oid, list(self.agent_address), size=size)
 
-    async def store_with_backpressure(self, oid: bytes, parts):
+    async def store_with_backpressure(self, oid: bytes, parts,
+                                      owner_addr=None):
         """Create-queue backpressure (reference: plasma create_request_queue):
         on ENOMEM, ask the agent to spill pinned primaries and retry; an
         object that can never fit the arena spills straight to disk. Shared
         by puts and large task returns.
+
+        `owner_addr` names the object's OWNER for the agent's pin records
+        (drain migration tells the owner's replica directory where the
+        primary moved): task returns are owned by the CALLER, so the
+        executing worker passes the caller's address; puts default to
+        this process.
 
         Pin transfer: the shm put keeps the writer's refcount and hands it
         to the agent with a one-way pin_transfer notify — the object is
@@ -974,7 +1005,7 @@ class CoreWorker:
                 ok = _try_store()
             if ok:
                 stored = True
-                self._send_pin_transfer(oid)
+                self._send_pin_transfer(oid, owner_addr)
                 break
             res = await self.agent.call("ensure_space", {"nbytes": size})
             if res["freed"] == 0:
@@ -1002,9 +1033,9 @@ class CoreWorker:
                     f"object of size {size} does not fit and could not spill")
             # Disk-spilled primaries carry no shm refcount; the agent still
             # records the owner pin so free_objects accounting matches.
-            self._send_pin_transfer(oid)
+            self._send_pin_transfer(oid, owner_addr)
 
-    def _send_pin_transfer(self, oid: bytes) -> None:
+    def _send_pin_transfer(self, oid: bytes, owner_addr=None) -> None:
         """Hand the writer-held pin to the agent. Normally a one-way notify
         on the agent connection (ordered ahead of any later free). If the
         connection is down the notify raises synchronously — release our
@@ -1013,14 +1044,19 @@ class CoreWorker:
         is node death: the arena dies with the agent, so a leaked refcount
         in it is moot (workers watching the agent connection exit too)."""
         try:
-            self.agent.notify("pin_transfer", {"object_id": oid})
+            self.agent.notify("pin_transfer", {
+                "object_id": oid,
+                "owner_addr": list(owner_addr or self.address)})
         except rpc.RpcError:
             self.store.release(oid)
-            rpc.spawn(self._pin_after_reconnect(oid))
+            rpc.spawn(self._pin_after_reconnect(oid, owner_addr))
 
-    async def _pin_after_reconnect(self, oid: bytes) -> None:
+    async def _pin_after_reconnect(self, oid: bytes,
+                                   owner_addr=None) -> None:
         try:
-            await self.agent.call("pin_object", {"object_id": oid})
+            await self.agent.call("pin_object", {
+                "object_id": oid,
+                "owner_addr": list(owner_addr or self.address)})
         except rpc.RpcError:
             pass
 
@@ -1211,8 +1247,10 @@ class CoreWorker:
                 if entry.data is not None:
                     return memoryview(entry.data)
                 try:
-                    return await self._read_plasma(oid, entry.plasma_node,
-                                                   deadline)
+                    return await self._read_plasma(
+                        oid, entry.plasma_node, deadline,
+                        locations=self._ordered_locations(entry),
+                        owner_addr=list(self.address))
                 except exc.ObjectLostError:
                     # Primary copy gone (node death / eviction): owners
                     # re-execute the creating task from lineage (reference:
@@ -1267,7 +1305,10 @@ class CoreWorker:
             if "inline" in res:
                 return memoryview(res["inline"])
             try:
-                return await self._read_plasma(oid, res["plasma"], deadline)
+                return await self._read_plasma(
+                    oid, res["plasma"], deadline,
+                    locations=res.get("locations"),
+                    owner_addr=list(owner))
             except exc.ObjectLostError:
                 # Borrowers can't reconstruct; ask the owner to. Bounded by
                 # the caller's get() deadline.
@@ -1331,11 +1372,35 @@ class CoreWorker:
     async def _recover_object_inner(self, oid: bytes) -> bool:
         # Probe first: a transient pull failure must not trigger a
         # destructive re-execution (tasks may have side effects and a
-        # failed rerun would overwrite healthy sibling returns).
+        # failed rerun would overwrite healthy sibling returns).  The
+        # probe walks the LOCATION SET — primary first, then every
+        # registered secondary: a dead primary with a live replica is a
+        # repoint (promote the survivor), never a reconstruction.
         entry = self.memory_store.get(oid)
-        if entry is not None and entry.plasma_node is not None and \
-                await self._primary_alive(oid, tuple(entry.plasma_node)):
-            return True
+        if entry is not None and entry.plasma_node is not None:
+            if await self._primary_alive(oid, tuple(entry.plasma_node)):
+                return True
+            for sec in list(entry.secondaries or ()):
+                if not await self._primary_alive(oid, tuple(sec)):
+                    self.memory_store.remove_location(oid, sec)
+                    continue
+                # A secondary survives the primary's loss: promote it.
+                # adopt_primary pins the (already-present) copy so the
+                # new primary can't be LRU-evicted from under us; if the
+                # pin fails (copy evicted mid-probe) keep probing.
+                try:
+                    conn = await self._peer_owner(tuple(sec))
+                    if await conn.call("adopt_primary", {
+                            "object_id": oid,
+                            "from_addrs": [list(sec)],
+                            "owner_addr": list(self.address),
+                            "priority": 0}, timeout=30):
+                        entry.plasma_node = list(sec)
+                        self.memory_store.remove_location(oid, sec)
+                        return True
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    pass
+                self.memory_store.remove_location(oid, sec)
         # Drain-migration fast path: a gracefully drained node republished
         # its sole primaries to a peer before exiting — repoint the
         # owner's location record and read from the new holder; no
@@ -1356,8 +1421,9 @@ class CoreWorker:
                                      {"object_id": oid}, timeout=60):
                 # The local agent is the new primary: re-pin there
                 # and repoint the owner's location record.
-                await self.agent.call("pin_object",
-                                      {"object_id": oid})
+                await self.agent.call("pin_object", {
+                    "object_id": oid,
+                    "owner_addr": list(self.address)})
                 if entry is not None:
                     entry.plasma_node = self.agent_address
                 return True
@@ -1413,7 +1479,13 @@ class CoreWorker:
         except (rpc.RpcError, asyncio.TimeoutError):
             return False
 
-    async def _read_plasma(self, oid: bytes, agent_addr, deadline) -> memoryview:
+    async def _read_plasma(self, oid: bytes, agent_addr, deadline,
+                           locations=None, owner_addr=None) -> memoryview:
+        """`locations` is the owner's full replica set (primary first;
+        falls back to just `agent_addr`): the local agent stripes/hedges
+        the pull across every holder and — given `owner_addr` — registers
+        itself as a fresh secondary so the NEXT puller has one more
+        source (receiver-becomes-source broadcast)."""
         view = self.store.get(oid, timeout_ms=0)
         if view is not None:
             return view
@@ -1469,11 +1541,14 @@ class CoreWorker:
                     f"timed out pulling {oid.hex()}")
             return exc.DeadlineExceededError(msg)
 
+        from_addrs = [list(a) for a in (locations or [])] \
+            or [list(agent_addr)]
         ok = False
         for pull_attempt in range(2):
             try:
                 ok = await self.agent.call("pull_object", {
-                    "object_id": oid, "from_addr": list(agent_addr),
+                    "object_id": oid, "from_addrs": from_addrs,
+                    "owner_addr": owner_addr,
                     "priority": 0, "deadline": wall_dl}, timeout=120,
                     deadline=wall_dl)
                 break
@@ -1584,12 +1659,102 @@ class CoreWorker:
             # normalize at the msgpack boundary only.
             return {"inline": data if isinstance(data, bytes)
                     else bytes(data)}
-        return {"plasma": list(entry.plasma_node)}
+        # Full replica set rides along (primary first, suspects last) so
+        # the borrower's pull stripes/hedges across every holder.
+        return {"plasma": list(entry.plasma_node),
+                "locations": self._ordered_locations(entry),
+                "size": entry.size}
 
     async def h_free_notify(self, conn, p):
         for oid in p["object_ids"]:
             self.memory_store.delete(oid)
         return True
+
+    # ------------------------------------------------ replica directory --
+    # Owner-side location directory (reference: the ownership table tracks
+    # every location of an object, Ownership NSDI'21 §4; here the owner IS
+    # the directory).  Agents that complete a pull (or adopt a primary off
+    # a draining node) register here; agents that evict/drop their copy
+    # deregister; pullers query for the freshest holder set so a 1→N
+    # broadcast stripes across every replica instead of serializing on the
+    # primary's NIC.
+
+    async def h_object_locations(self, conn, p):
+        """Current holder set of an owned plasma object — primary first —
+        plus its size.  None when the object is unknown/inline (inline
+        objects travel through get_object, not the pull path).
+
+        `add_addr` registers the CALLER as a (mid-pull) secondary in the
+        same round trip, atomically on this owner's loop: N agents
+        starting a broadcast pull concurrently each register-and-query,
+        so all but the very first see their siblings and the stripe set
+        forms immediately — the race that would otherwise leave every
+        puller convoying on the primary."""
+        oid = p["object_id"]
+        entry = self.memory_store.get(oid)
+        if entry is None or entry.plasma_node is None:
+            return None
+        if p.get("add_addr"):
+            from .config import get_config
+            self.memory_store.add_location(
+                oid, tuple(p["add_addr"]),
+                max_secondaries=get_config()
+                .replica_directory_max_secondaries)
+        # Exclude the caller from its own view (it can't pull from
+        # itself) but keep everyone else, including other mid-pull
+        # registrants.
+        me = tuple(p["add_addr"]) if p.get("add_addr") else None
+        return {"locations": [list(a) for a in self._ordered_locations(
+                    entry) if tuple(a) != me],
+                "size": entry.size}
+
+    async def h_object_location_add(self, conn, p):
+        """An agent holds (or is mid-pull of) a copy: record it.  With
+        primary=True the primary record repoints — the drain path's
+        adopt_primary uses this so owners learn the new pinned home
+        without waiting for a recovery probe."""
+        from .config import get_config
+        return self.memory_store.add_location(
+            p["object_id"], tuple(p["addr"]),
+            primary=bool(p.get("primary")),
+            max_secondaries=get_config().replica_directory_max_secondaries)
+
+    async def h_object_location_remove(self, conn, p):
+        """A holder evicted/aborted its copy (or is draining): the
+        directory entry must not outlive the bytes."""
+        self.memory_store.remove_location(p["object_id"], tuple(p["addr"]))
+        return True
+
+    def _ordered_locations(self, entry_or_oid) -> list:
+        """Holder set of an owned object as wire addresses, primary first,
+        gray-suspect/draining holders LAST (PR 4's health scores: a
+        suspect node still serves, but the swarm prefers healthy
+        sources).  Uses the cached node view only — never a GCS round
+        trip on the read path."""
+        entry = entry_or_oid if not isinstance(entry_or_oid, bytes) \
+            else self.memory_store.get(entry_or_oid)
+        if entry is None or entry.plasma_node is None:
+            return []
+        locs = entry.locations()
+        if len(locs) > 1:
+            locs = locs[:1] + self._suspects_last(locs[1:])
+        return [list(a) for a in locs]
+
+    def _suspects_last(self, addrs: list) -> list:
+        """Stable-sort addresses: healthy targetable nodes first, then
+        gray-suspect, then draining/unknown — per the (possibly stale)
+        cached GCS view; on no view, order unchanged."""
+        cached = getattr(self, "_nodes_cache", None)
+        if not cached:
+            return list(addrs)
+        from . import scheduling_policy as policy
+        rank = {}
+        for n in cached[1]:
+            rank[tuple(n["address"])] = (
+                2 if not policy.targetable(n)
+                else 1 if policy.suspicion_of(n) >= policy.SUSPECT_THRESHOLD
+                else 0)
+        return sorted(addrs, key=lambda a: rank.get(tuple(a), 0))
 
     # ----------------------------------------------------------------- wait --
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
@@ -2084,6 +2249,18 @@ class CoreWorker:
                     "satisfiable node (hard constraint)"))
                 return
             agent_conn = routed
+        loc_map = self._lease_locality_map(state) \
+            if agent_conn is None and not strat.get("type") else None
+        if loc_map:
+            # Default-strategy tasks with large by-ref args: route the
+            # lease to the node already holding the bytes (reference:
+            # lease_policy.cc locality-aware raylet choice driven by the
+            # owner's location table).  Locality only ever picks among
+            # feasible, targetable, trusted nodes — a miss falls through
+            # to the local agent exactly as before.
+            routed = await self._locality_lease_agent(state, loc_map)
+            if routed is not None:
+                agent_conn = routed
         if agent_conn is None and is_pg:
             # Route the lease to the agent hosting the target bundle — the
             # local agent may not hold it at all (reference: lease_policy.cc
@@ -2111,6 +2288,11 @@ class CoreWorker:
                                      "bundle_index":
                                      strat.get("bundle_index", 0)}
                                     if is_pg else None),
+                # Large by-ref args of the queued tasks this lease will
+                # serve: the granting agent starts pulling missing ones
+                # IMMEDIATELY (fetch overlaps worker dispatch/queueing)
+                # and its spillback choice scores bytes-already-local.
+                "prefetch": self._lease_prefetch_entries(state),
             }, timeout=130)
         except (rpc.RpcError, asyncio.TimeoutError):
             state.pending_lease_requests -= 1
@@ -2195,6 +2377,70 @@ class CoreWorker:
         nodes = await self.gcs.call("get_nodes", {})
         self._nodes_cache = (now, nodes)
         return nodes
+
+    def _lease_locality_map(self, state) -> Optional[dict]:
+        """Bytes-already-local map for the task at the head of this
+        scheduling key's queue (the one the requested lease will run
+        first), or None when locality scheduling is disabled / has
+        nothing to say."""
+        cfg = get_config()
+        if not (cfg.object_locality_scheduling_enabled
+                and cfg.replica_directory_enabled and state.queue):
+            return None
+        from . import scheduling_policy as policy
+        loc = policy.arg_locality(state.queue[0].spec.get("args"))
+        if not loc or max(loc.values()) < cfg.object_locality_min_bytes:
+            return None
+        return loc
+
+    async def _locality_lease_agent(self, state, loc_map):
+        """Agent connection for the targetable+trusted+feasible node
+        holding the most hinted arg bytes; None keeps the local agent.
+        Feasibility, draining state and gray-suspicion all rank ABOVE
+        locality — a byte-holding node that fails any of them is simply
+        not a candidate."""
+        from . import scheduling_policy as policy
+        try:
+            nodes = [n for n in await self._cluster_nodes()
+                     if policy.targetable(n)]
+        except (rpc.RpcError, asyncio.TimeoutError):
+            return None
+        cands = [(tuple(n["address"]), tuple(n["address"]),
+                  n["resources_total"], n["resources_available"])
+                 for n in policy.prefer_trusted(nodes)]
+        best = policy.pick_by_locality(
+            cands, state.resources, loc_map,
+            min_bytes=get_config().object_locality_min_bytes)
+        if best is None or best == self.agent_address:
+            return None
+        try:
+            return await self._peer_owner(best)
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            return None     # stale view: the local agent still works
+
+    def _lease_prefetch_entries(self, state, limit: int = 8):
+        """[oid, locations, owner_addr, size, task_id] for the large
+        by-ref args of the first few queued tasks — the granting agent's
+        prefetch work list (missing ones start pulling on grant)."""
+        cfg = get_config()
+        if not (cfg.arg_prefetch_enabled and cfg.replica_directory_enabled):
+            return None
+        out, seen = [], set()
+        for task in list(state.queue)[:4]:
+            for e in task.spec.get("args") or ():
+                if "ref" not in e or \
+                        int(e.get("sz") or 0) < cfg.arg_prefetch_min_bytes:
+                    continue
+                oid = bytes(e["ref"][0])
+                locs = e["ref"][2]
+                if oid in seen or not locs:
+                    continue
+                seen.add(oid)
+                out.append([oid, locs, list(e["ref"][1]),
+                            int(e["sz"]), task.spec["task_id"]])
+                if len(out) >= limit:
+                    return out
+        return out or None
 
     async def _route_lease_agent(self, strat: dict, resources):
         """Pick the agent to lease from for spread / node_affinity /
@@ -2704,7 +2950,8 @@ class CoreWorker:
                 if "inline" in entry:
                     self.memory_store.put_inline(oid, entry["inline"])
                 else:
-                    self.memory_store.put_plasma_location(oid, entry["plasma"])
+                    self.memory_store.put_plasma_location(
+                        oid, entry["plasma"], size=entry.get("size"))
         elif reply.get("status") == "cancelled":
             self._store_task_exception(
                 spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
@@ -3071,17 +3318,24 @@ class CoreWorker:
             if isinstance(a, ObjectRef):
                 oid = a.binary()
                 owner = list(a.owner_address or self.address)
-                hint = None
+                hint, sz = None, None
                 if tuple(owner) == self.address:
                     entry_ms = self.memory_store.get(oid)
                     if entry_ms is not None and entry_ms.plasma_node:
-                        hint = list(entry_ms.plasma_node)
+                        # Full replica set (primary first, suspects
+                        # last): the scheduler scores bytes-already-
+                        # local against EVERY holder and the executing
+                        # node's prefetch stripes across them.
+                        hint = self._ordered_locations(entry_ms)
+                        sz = entry_ms.size
                 # Pin EVERY by-ref arg while in flight — for borrowed refs
                 # the submitted pin keeps the local borrow registered (and
                 # thus the owner's borrower entry) until the reply.
                 ref_args.append(oid)
                 self.reference_counter.add_submitted(oid)
                 entry = {"ref": [oid, owner, hint]}
+                if sz:
+                    entry["sz"] = sz
             else:
                 ctx.capture = captured = []
                 try:
@@ -3109,9 +3363,10 @@ class CoreWorker:
                         # post-call arg mutation is safe (the copy already
                         # happened) and no bytes() flatten survives.
                         self.memory_store.put_plasma_location(
-                            poid, list(self.agent_address))
+                            poid, list(self.agent_address), size=size)
                         entry = {"ref": [poid, list(self.address),
-                                         list(self.agent_address)]}
+                                         [list(self.agent_address)]],
+                                 "sz": size}
                     else:
                         # Arena full (or submitting from the loop thread,
                         # which must not carry the memcpy): the store
@@ -3130,9 +3385,12 @@ class CoreWorker:
         location hints into the spec entries."""
         for poid, parts in big_puts:
             await self._put_plasma(poid, parts)
+            entry_ms = self.memory_store.get(poid)
             for e in spec_args:
                 if "ref" in e and bytes(e["ref"][0]) == poid:
-                    e["ref"][2] = list(self.agent_address)
+                    e["ref"][2] = [list(self.agent_address)]
+                    if entry_ms is not None and entry_ms.size:
+                        e["sz"] = entry_ms.size
 
     def submit_actor_task(self, *, actor_id: bytes, method: str, args, kwargs,
                           num_returns, max_task_retries: int = 0,
@@ -3316,7 +3574,9 @@ class CoreWorker:
                     e.clear()
                     e.update(val)
                 elif entry.plasma_node is not None:
-                    e["ref"][2] = list(entry.plasma_node)
+                    e["ref"][2] = self._ordered_locations(entry)
+                    if entry.size:
+                        e["sz"] = entry.size
         except Exception as e:  # put/resolve failed: fail this task
             self._store_task_exception(spec, exc.RayError(
                 f"failed to resolve task arg: {e}"))

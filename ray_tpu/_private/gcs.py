@@ -107,6 +107,17 @@ class NodeInfo:
         self.peer_rates: Dict[bytes, tuple] = {}
         self.suspicion = 0.0
         self.suspect_since: Optional[float] = None
+        # Data-plane transfer counters from the agent's heartbeat
+        # (bytes_served / bytes_pulled): `ray_tpu list nodes` and the
+        # dashboard's transfer column read them off the node view.
+        # bulk_rate (B/s since the previous heartbeat) additionally
+        # guards the gray auto-drain: a node mid-broadcast is BUSY, not
+        # gray — its probe RTT inflates for exactly the duration of the
+        # transfer (see _maybe_gray_drain).
+        self.transfer: Dict[str, int] = {}
+        self.bulk_rate: float = 0.0
+        self._transfer_prev: int = 0
+        self._transfer_prev_ts: float = 0.0
         # The agent's inbound connection (the one that called
         # register_node): its close is an immediate death signal for
         # cleanly crashed agents (see GcsServer._on_client_close).
@@ -143,6 +154,7 @@ class NodeInfo:
             "suspect_threshold": policy.SUSPECT_THRESHOLD,
             "rtt_ms": (None if self.rtt_ema is None
                        else round(self.rtt_ema * 1000.0, 2)),
+            "transfer": self.transfer,
         }
 
 
@@ -517,6 +529,16 @@ class GcsServer:
             return False
         node.resources_available = p["available"]
         node.last_heartbeat = time.monotonic()
+        if p.get("transfer"):
+            node.transfer = p["transfer"]
+            total = int(node.transfer.get("bytes_served") or 0) + \
+                int(node.transfer.get("bytes_pulled") or 0)
+            now_ts = time.monotonic()
+            dt = now_ts - node._transfer_prev_ts
+            if node._transfer_prev_ts and 0.0 < dt < 60.0:
+                node.bulk_rate = max(0, total - node._transfer_prev) / dt
+            node._transfer_prev = total
+            node._transfer_prev_ts = now_ts
         peer_stats = p.get("peer_stats")
         if peer_stats:
             # Fold the reporter's per-peer link observations into each
@@ -882,6 +904,19 @@ class GcsServer:
         if node.suspect_since is None \
                 or now - node.suspect_since < sustained_s:
             return
+        from .config import get_config as _gc
+        exempt = float(_gc().gray_bulk_drain_exempt_bytes_per_s)
+        if exempt > 0 and node.bulk_rate >= exempt:
+            # Mid-broadcast/bulk-serving node: its probe RTT inflates for
+            # exactly as long as the transfer runs (PR 4's "bulk transfer
+            # != RTT" principle, applied to the GCS's own probes, which
+            # queue behind the agent's chunk serving).  Placement already
+            # deprioritizes it via suspicion; EVACUATING it would kill
+            # the very transfer that made it look slow.  The auto-drain
+            # resumes the first sustained-suspect window after the bulk
+            # flow stops.
+            node.suspect_since = now
+            return
         # Never evacuate INTO nothing: require at least one other
         # schedulable, non-suspect node to receive the work — if the
         # whole cluster looks gray, the problem is the observer (or the
@@ -1036,11 +1071,17 @@ class GcsServer:
                           {"event": "dead", "actor": actor.view()})
 
     def _pick_node(self, resources: Dict[str, float],
-                   strategy: Optional[dict]) -> Optional[NodeInfo]:
+                   strategy: Optional[dict],
+                   locality: Optional[Dict] = None) -> Optional[NodeInfo]:
         """Feasibility + best-fit over the live resource view. Honors
         node-affinity and placement-group strategies; falls back to
         most-available (spread-ish, mirroring hybrid policy's behavior
-        below the packing threshold)."""
+        below the packing threshold).
+
+        `locality` (addr -> hinted arg bytes, from the spec's replica-
+        directory hints) biases the DEFAULT policy toward nodes already
+        holding the bytes — strictly below feasibility, explicit
+        strategies, labels, and trusted-first ordering."""
         if strategy and strategy.get("type") == "node_affinity":
             node = self.nodes.get(strategy["node_id"])
             if node and node.schedulable:
@@ -1102,6 +1143,21 @@ class GcsServer:
                         for n, t, a in cands
                         if policy.feasible(a, resources)]
                 return min(feas, key=lambda nu: nu[1])[0] if feas else None
+            if locality:
+                # Bytes-already-local tiebreak (within this trust tier;
+                # feasibility checked inside): a node holding the spec's
+                # large args saves their whole transfer.  Same min_bytes
+                # floor as the submitter/spillback paths — a feasible
+                # node holding only a tiny arg must not override
+                # pack/spread.
+                from .config import get_config as _gc2
+                best = policy.pick_by_locality(
+                    [(n, n.address, n.resources_total,
+                      n.resources_available) for n in cand_nodes],
+                    resources, locality,
+                    min_bytes=_gc2().object_locality_min_bytes)
+                if best is not None:
+                    return best
             # Default: hybrid top-k pack-then-spread
             # (reference: hybrid_scheduling_policy.h:50).
             return policy.hybrid_pick(cands, resources)
@@ -1134,6 +1190,12 @@ class GcsServer:
         deadline = time.monotonic() + timeout_s
         epoch = self._node_epoch
         node = None
+        from .config import get_config as _get_config
+        locality = policy.arg_locality(spec.get("args")) \
+            if _get_config().object_locality_scheduling_enabled else None
+        if locality and max(locality.values()) < \
+                _get_config().object_locality_min_bytes:
+            locality = None
         while time.monotonic() < deadline:
             if self._node_epoch != epoch:
                 epoch = self._node_epoch
@@ -1142,7 +1204,8 @@ class GcsServer:
                                    protocol.ACTOR_RESTARTING):
                 return False        # killed while pending/restarting
             node = self._pick_node(spec.get("resources", {}),
-                                   spec.get("scheduling_strategy"))
+                                   spec.get("scheduling_strategy"),
+                                   locality=locality)
             if node is not None and node.conn is not None and not node.conn.closed:
                 try:
                     result = await node.conn.call("create_actor_worker", spec,

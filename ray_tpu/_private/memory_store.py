@@ -17,10 +17,11 @@ from typing import Dict, List, Optional, Tuple
 
 
 class _Entry:
-    __slots__ = ("data", "is_exception", "plasma_node")
+    __slots__ = ("data", "is_exception", "plasma_node", "size",
+                 "secondaries")
 
     def __init__(self, data, is_exception: bool = False,
-                 plasma_node=None):
+                 plasma_node=None, size=None):
         # Serialized payload (None if in plasma).  Any bytes-like object:
         # raw-frame landings and zero-copy readers keep memoryviews here
         # end-to-end; producers that must cross a msgpack boundary
@@ -28,6 +29,29 @@ class _Entry:
         self.data = data
         self.is_exception = is_exception
         self.plasma_node = plasma_node  # node address holding primary copy
+        # Serialized size in bytes when known (plasma entries stamp it at
+        # put/return time): feeds the locality-aware scheduler's
+        # bytes-already-local score via task-spec hints.
+        self.size = size
+        # Replica directory (owner-side; reference: the ownership table
+        # tracks ALL locations of each object, ownership NSDI'21 §4):
+        # agent addresses holding a pulled/partial SECONDARY copy, in
+        # registration order.  Secondaries are evictable caches — the
+        # holders deregister on eviction/drain, and frees broadcast to
+        # them — so an entry here is a hint, never a liveness promise;
+        # readers probe (or fail over) exactly as they do for the
+        # primary.  None until the first registration (cheap common
+        # case: most objects are never pulled anywhere).
+        self.secondaries = None
+
+    def locations(self):
+        """All known holders, primary first.  List of address tuples."""
+        out = []
+        if self.plasma_node is not None:
+            out.append(tuple(self.plasma_node))
+        if self.secondaries:
+            out.extend(a for a in self.secondaries if a not in out)
+        return out
 
 
 class MemoryStore:
@@ -54,9 +78,55 @@ class MemoryStore:
         self._objects[object_id] = _Entry(data, is_exception)
         self._wake(object_id)
 
-    def put_plasma_location(self, object_id: bytes, node_addr):
-        self._objects[object_id] = _Entry(None, plasma_node=node_addr)
+    def put_plasma_location(self, object_id: bytes, node_addr,
+                            size: int | None = None):
+        self._objects[object_id] = _Entry(None, plasma_node=node_addr,
+                                          size=size)
         self._wake(object_id)
+
+    # ----------------------------------------------- replica directory ---
+    def add_location(self, object_id: bytes, addr, *,
+                     primary: bool = False,
+                     max_secondaries: int = 8) -> bool:
+        """Register `addr` as a holder of a plasma object.  primary=True
+        repoints the primary record (drain adoption); otherwise the addr
+        joins the secondary set (bounded, oldest registration dropped —
+        secondaries are evictable caches, so dropping a directory entry
+        only costs a source, never correctness)."""
+        entry = self._objects.get(object_id)
+        if entry is None or (entry.data is not None and not primary):
+            return False
+        addr = tuple(addr)
+        if primary:
+            if entry.secondaries and addr in entry.secondaries:
+                entry.secondaries.remove(addr)
+            entry.plasma_node = list(addr)
+            return True
+        if entry.plasma_node is not None and \
+                tuple(entry.plasma_node) == addr:
+            return True            # already the primary
+        if entry.secondaries is None:
+            entry.secondaries = []
+        if addr in entry.secondaries:
+            return True
+        entry.secondaries.append(addr)
+        while len(entry.secondaries) > max_secondaries:
+            entry.secondaries.pop(0)
+        return True
+
+    def remove_location(self, object_id: bytes, addr) -> None:
+        entry = self._objects.get(object_id)
+        if entry is None or not entry.secondaries:
+            return
+        addr = tuple(addr)
+        if addr in entry.secondaries:
+            entry.secondaries.remove(addr)
+
+    def locations(self, object_id: bytes):
+        """All known holders of a plasma object, primary first ([] for
+        absent/inline entries)."""
+        entry = self._objects.get(object_id)
+        return entry.locations() if entry is not None else []
 
     def _wake(self, object_id: bytes):
         for ev in self._waiters.pop(object_id, []):

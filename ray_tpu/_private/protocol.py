@@ -27,6 +27,21 @@ def function_id(pickled: bytes) -> bytes:
     return hashlib.sha1(pickled).digest()[:16]
 
 
+def ref_locations(raw) -> List[Tuple]:
+    """Normalize a ref entry's location hint (`e["ref"][2]`) to a list of
+    address tuples, primary first.
+
+    Accepts every shape that has ever been on the wire: None (no hint),
+    a single `[host, port]` address (pre-directory peers published one
+    primary), or the replica-directory list `[[host, port], ...]`."""
+    if not raw:
+        return []
+    first = raw[0]
+    if isinstance(first, (list, tuple)):
+        return [tuple(a) for a in raw]
+    return [tuple(raw)]
+
+
 def make_task_spec(
     *,
     task_id: bytes,
@@ -49,8 +64,15 @@ def make_task_spec(
     """Equivalent of the reference's TaskSpecification (common/task/).
 
     args entries:
-      {"v": bytes}                      — inline serialized value
-      {"ref": [id_bytes, owner_addr, in_plasma, node_addr]} — by-reference
+      {"v": bytes}                          — inline serialized value
+      {"ref": [id_bytes, owner_addr, locations], "sz": nbytes}
+                                            — by-reference
+    `locations` is the owner's replica-directory snapshot at submit time
+    (list of node addresses holding a copy, PRIMARY FIRST) or None when
+    unknown; legacy peers sent a single address — use `ref_locations` to
+    consume either shape.  `sz` (optional) is the serialized size of the
+    referenced object: together they feed the locality-aware scheduler's
+    bytes-already-local score and the agent's arg prefetch.
     """
     return {
         "task_id": task_id,

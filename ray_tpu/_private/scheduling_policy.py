@@ -107,6 +107,56 @@ def hybrid_pick(candidates: Sequence[Tuple[object, Dict[str, float],
     return min(scored, key=lambda ku: ku[1])[0]
 
 
+def arg_locality(args) -> Dict[Tuple, int]:
+    """Bytes-already-local map of a task spec's by-reference args:
+    holder address -> total hinted bytes resident there.  Fed by the
+    owner's replica directory (every holder counts, not just the
+    primary) via the spec's location hints; inline args and refs
+    without a size hint contribute nothing."""
+    out: Dict[Tuple, int] = {}
+    for e in args or ():
+        sz = int(e.get("sz") or 0) if isinstance(e, dict) else 0
+        if sz <= 0 or "ref" not in e:
+            continue
+        locs = e["ref"][2] if len(e["ref"]) > 2 else None
+        if not locs:
+            continue
+        first = locs[0]
+        if not isinstance(first, (list, tuple)):   # legacy single addr
+            locs = [locs]
+        for a in locs:
+            key = tuple(a)
+            out[key] = out.get(key, 0) + sz
+    return out
+
+
+def locality_bytes(loc_map: Dict[Tuple, int], addr) -> int:
+    return loc_map.get(tuple(addr), 0) if loc_map else 0
+
+
+def pick_by_locality(candidates, resources: Dict[str, float],
+                     loc_map: Dict[Tuple, int],
+                     min_bytes: int = 0):
+    """Locality tiebreak WITHIN an already feasibility/label/trust-
+    filtered candidate set: the feasible node holding the most hinted
+    arg bytes (>= min_bytes), or None when locality has nothing to say
+    — the caller then falls through to its normal policy, so locality
+    can bias placement but never veto it.  candidates:
+    (key, addr, resources_total, resources_available) per node."""
+    if not loc_map:
+        return None
+    best, best_bytes = None, 0
+    for key, addr, total, avail in candidates:
+        if not feasible(avail, resources):
+            continue
+        b = locality_bytes(loc_map, addr)
+        if b > best_bytes:
+            best, best_bytes = key, b
+    if best is None or best_bytes < max(min_bytes, 1):
+        return None
+    return best
+
+
 def label_filter(candidates, selector: Optional[Dict[str, str]],
                  soft: Optional[Dict[str, str]] = None):
     """NodeLabelSchedulingPolicy: hard selector filters, soft selector

@@ -106,13 +106,19 @@ class Executor:
                 # borrower with the owner (kept alive if user code stores
                 # the ref beyond the task).
                 ref = self.core._ref_factory(bytes(oid), tuple(owner_addr))
-                if plasma_hint is not None and not self.core.store.contains(
-                        bytes(oid)) and tuple(plasma_hint) != \
-                        self.core.agent_address:
+                # Replica-set hint (list of holders, primary first;
+                # legacy peers sent one address): the local agent
+                # stripes the pull across every holder and registers the
+                # landed copy back with the owner — usually a no-op
+                # join of the prefetch pull the lease grant started.
+                locs = protocol.ref_locations(plasma_hint)
+                locs = [a for a in locs if a != self.core.agent_address]
+                if locs and not self.core.store.contains(bytes(oid)):
                     try:
                         await self.core.agent.call("pull_object", {
                             "object_id": bytes(oid),
-                            "from_addr": list(plasma_hint),
+                            "from_addrs": [list(a) for a in locs],
+                            "owner_addr": list(owner_addr),
                             "priority": 2}, timeout=120)
                     except (rpc.RpcError, asyncio.TimeoutError):
                         pass  # owner-mediated fetch below will sort it out
@@ -166,10 +172,14 @@ class Executor:
         if size <= self.core._inline_limit:
             entry = {"inline": protocol.concat_parts(parts)}
         else:
-            # store_with_backpressure pins the plasma copy via pin-transfer;
-            # nothing further for the reply to carry.
-            await self.core.store_with_backpressure(oid, parts)
-            entry = {"plasma": list(self.core.agent_address)}
+            # store_with_backpressure pins the plasma copy via pin-transfer
+            # (owner = the CALLER for returns — its directory is the one a
+            # drain migration must repoint); the size rides the reply so
+            # the owner's directory entry can feed locality scoring
+            # without a store round trip.
+            await self.core.store_with_backpressure(
+                oid, parts, owner_addr=caller_addr)
+            entry = {"plasma": list(self.core.agent_address), "size": size}
         if nested:
             entry["nested"] = nested
         return entry

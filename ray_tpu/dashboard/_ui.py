@@ -43,7 +43,8 @@ INDEX_HTML = """<!doctype html>
 </nav>
 <h2>Nodes</h2><table id="nodes"><thead><tr>
   <th>node</th><th>state</th><th>address</th><th>CPU</th><th>TPU</th>
-  <th>health</th><th>labels</th></tr></thead><tbody></tbody></table>
+  <th>health</th><th>transfer</th><th>labels</th></tr></thead>
+  <tbody></tbody></table>
 <h2>Actors</h2><table id="actors"><thead><tr>
   <th>actor</th><th>class</th><th>state</th><th>name</th><th>node</th>
   <th>restarts</th></tr></thead><tbody></tbody></table>
@@ -62,6 +63,10 @@ const esc = v => String(v).replace(/[&<>"']/g,
     c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const fmt = (a, t) => (t === undefined || t === 0) ? "–"
     : `${(t - (a ?? t)).toFixed(0)}/${t.toFixed(0)} used`;
+// Data-plane volume: bytes → short human form for the transfer column.
+const gib = b => !b ? "0" : b >= 2 ** 30 ? (b / 2 ** 30).toFixed(1) + "G"
+    : b >= 2 ** 20 ? (b / 2 ** 20).toFixed(1) + "M"
+    : (b / 1024).toFixed(0) + "K";
 function fill(tbl, rows) {
   const tb = $(tbl).tBodies[0];
   tb.innerHTML = rows.map(r => "<tr>" +
@@ -111,6 +116,8 @@ async function tick() {
                 ? "bad" : "ok"}">` +
             `${(n.suspicion || 0).toFixed(2)}</span>` +
             (n.rtt_ms != null ? ` ${esc(n.rtt_ms.toFixed(1))}ms` : ""),
+        // Replica-plane transfer counters: served↑ / pulled↓ volume.
+        `↑${gib(n.transfer?.bytes_served)} ↓${gib(n.transfer?.bytes_pulled)}`,
         esc(Object.entries(n.labels || {})
             .map(kv => kv.join("=")).join(" ")),
     ]));

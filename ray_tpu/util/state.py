@@ -35,6 +35,9 @@ def list_nodes() -> List[Dict[str, Any]]:
             "suspicion": n.get("suspicion", 0.0),
             "rtt_ms": n.get("rtt_ms"),
             "drain_reason": n.get("drain_reason"),
+            # Data-plane transfer counters (replica plane): bytes this
+            # node has served to peers / pulled from peers since start.
+            "transfer": n.get("transfer") or {},
         })
     return out
 

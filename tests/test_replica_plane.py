@@ -1,0 +1,628 @@
+"""Replica-aware object plane: location directory, swarm broadcast,
+locality + prefetch (see docs/data_plane.md "Replica directory").
+
+Covers the tentpole and its satellites:
+
+- owner-side location set (memory_store): add/remove/primary-repoint,
+  bounded secondaries, locations() ordering
+- chunk STRIPING across sources, "later" (mid-pull peer) semantics, and
+  correctness under link-chaos asymmetric partition of one source — no
+  truncated bytes, typed error only when every source is gone
+- directory registration by pulling agents; production pulls seeing
+  >=2 from_addrs once a secondary exists (hedged pulls get a real
+  backup); invalidation on free and on drain
+- recovery promoting a surviving SECONDARY when the primary is lost
+- drain during broadcast: mid-stream failover + adopt_primary
+  repointing the owner's directory
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private.agent import _intervals_add, _intervals_cover
+from ray_tpu._private.memory_store import MemoryStore
+
+CHUNK = 128 * 1024
+
+
+# ---------------------------------------------------------------- unit ----
+def test_location_set_add_remove_promote():
+    ms = MemoryStore()
+    oid = b"o" * 20
+    ms.put_plasma_location(oid, ["h0", 1], size=123)
+    assert ms.locations(oid) == [("h0", 1)]
+    assert ms.get(oid).size == 123
+    assert ms.add_location(oid, ("h1", 2))
+    assert ms.add_location(oid, ("h2", 3))
+    assert ms.add_location(oid, ("h1", 2))          # idempotent
+    assert ms.locations(oid) == [("h0", 1), ("h1", 2), ("h2", 3)]
+    # Registering the primary as a secondary is a no-op.
+    assert ms.add_location(oid, ("h0", 1))
+    assert ms.locations(oid) == [("h0", 1), ("h1", 2), ("h2", 3)]
+    ms.remove_location(oid, ("h1", 2))
+    assert ms.locations(oid) == [("h0", 1), ("h2", 3)]
+    # primary=True repoints (drain adoption) and absorbs the secondary.
+    assert ms.add_location(oid, ("h2", 3), primary=True)
+    assert ms.locations(oid) == [("h2", 3)]
+    # Bounded: oldest secondary falls off first.
+    for i in range(12):
+        ms.add_location(oid, ("s", i), max_secondaries=4)
+    assert len(ms.locations(oid)) == 5              # primary + 4
+    # Unknown/inline entries never grow a directory.
+    assert not ms.add_location(b"x" * 20, ("h", 1))
+    ms.put_inline(b"i" * 20, b"v")
+    assert not ms.add_location(b"i" * 20, ("h", 1))
+    assert ms.locations(b"i" * 20) == []
+
+
+def test_committed_interval_bookkeeping():
+    ivs = []
+    _intervals_add(ivs, 0, 10)
+    _intervals_add(ivs, 20, 30)
+    assert _intervals_cover(ivs, 0, 10) and not _intervals_cover(ivs, 5, 15)
+    _intervals_add(ivs, 10, 20)                     # merge all three
+    assert ivs == [(0, 30)]
+    assert _intervals_cover(ivs, 0, 30) and not _intervals_cover(ivs, 29, 31)
+    assert _intervals_cover(ivs, 7, 7)              # empty range
+
+
+def _mini_agent(window=4, timeout_s=2.0, hedge=False, node_id=b"\0\0"):
+    from ray_tpu._private.agent import NodeAgent
+    a = NodeAgent.__new__(NodeAgent)
+    a._chunk_bytes = CHUNK
+    a._max_inflight_chunks = window
+    a._chunk_timeout = timeout_s
+    a._peer_stats = {}
+    a._hedge_enabled = hedge
+    a._hedge_delay_ms = 0
+    a._hedge_budget_frac = 0.1
+    a._hedge_total = 0
+    a._hedge_used = 0
+    a.node_id = node_id
+    return a
+
+
+def _chunk_server(name, data, served, transform=None):
+    async def h(conn, p):
+        served[name] += 1
+        off, ln = p["offset"], p["length"]
+        if transform is not None:
+            res = transform(off, ln)
+            if res is not None:
+                return res
+        return rpc.RawPayload([memoryview(data)[off:off + ln]])
+    return rpc.RpcServer({"fetch_chunk": h}, name=name, auth_token=None)
+
+
+def test_striping_spreads_chunks_across_sources():
+    """With two healthy sources the engine round-robins chunks across
+    BOTH (swarm broadcast building block) — not a convoy on the first."""
+    async def main():
+        data = np.random.default_rng(3).bytes(8 * CHUNK)
+        served = {"sA": 0, "sB": 0}
+        srv_a = _chunk_server("sA", data, served)
+        srv_b = _chunk_server("sB", data, served)
+        addr_a = await srv_a.start_tcp("127.0.0.1", 0)
+        addr_b = await srv_b.start_tcp("127.0.0.1", 0)
+        peer_a = await rpc.connect(tuple(addr_a), auth_token=None)
+        peer_b = await rpc.connect(tuple(addr_b), auth_token=None)
+        agent = _mini_agent()
+        dest = bytearray(len(data))
+        view = memoryview(dest)
+        try:
+            await agent._stream_chunks(
+                [peer_a, peer_b], b"o" * 20, len(data),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        finally:
+            view.release()
+            await peer_a.close()
+            await peer_b.close()
+            await srv_a.close()
+            await srv_b.close()
+        assert bytes(dest) == data
+        assert served["sA"] == 4 and served["sB"] == 4, served
+
+    asyncio.run(main())
+
+
+def test_later_marker_fails_over_to_complete_source():
+    """A mid-pull peer answers "later" for chunks it hasn't committed:
+    the engine falls back to a complete source for those chunks, never
+    treats the swarm member as gone, and the result is byte-exact."""
+    async def main():
+        data = np.random.default_rng(4).bytes(6 * CHUNK + 77)
+        served = {"partial": 0, "full": 0}
+        # The partial holder has only the first two chunks committed.
+        committed_end = 2 * CHUNK
+
+        def partial_answer(off, ln):
+            if off + ln > committed_end:
+                return {"later": True}
+            return None                      # serve normally
+
+        srv_p = _chunk_server("partial", data, served, partial_answer)
+        srv_f = _chunk_server("full", data, served)
+        addr_p = await srv_p.start_tcp("127.0.0.1", 0)
+        addr_f = await srv_f.start_tcp("127.0.0.1", 0)
+        peer_p = await rpc.connect(tuple(addr_p), auth_token=None)
+        peer_f = await rpc.connect(tuple(addr_f), auth_token=None)
+        agent = _mini_agent()
+        dest = bytearray(len(data))
+        view = memoryview(dest)
+        try:
+            await agent._stream_chunks(
+                [peer_p, peer_f], b"o" * 20, len(data),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        finally:
+            view.release()
+            await peer_p.close()
+            await peer_f.close()
+            await srv_p.close()
+            await srv_f.close()
+        assert bytes(dest) == data
+        assert served["full"] >= 4           # carried the uncommitted tail
+
+    asyncio.run(main())
+
+
+@pytest.fixture
+def clean_link_chaos():
+    yield
+    rpc.enable_link_chaos("")
+
+
+def test_striped_pull_survives_asymmetric_partition(clean_link_chaos):
+    """link_chaos blackholes one striped source's replies mid-broadcast
+    (requests still arrive — asymmetric partition): every chunk lands
+    via the surviving source, byte-exact, no truncation."""
+    async def main():
+        data = np.random.default_rng(5).bytes(6 * CHUNK + 13)
+        served = {"dark": 0, "lit": 0}
+        srv_d = _chunk_server("dark", data, served)
+        srv_l = _chunk_server("lit", data, served)
+        addr_d = await srv_d.start_tcp("127.0.0.1", 0)
+        addr_l = await srv_l.start_tcp("127.0.0.1", 0)
+        peer_d = await rpc.connect(tuple(addr_d), name="swarm-dark",
+                                   auth_token=None)
+        peer_l = await rpc.connect(tuple(addr_l), name="swarm-lit",
+                                   auth_token=None)
+        rpc.enable_link_chaos("swarm-dark/in_drop=")
+        agent = _mini_agent(window=2, timeout_s=0.5)
+        dest = bytearray(len(data))
+        view = memoryview(dest)
+        try:
+            await agent._stream_chunks(
+                [peer_d, peer_l], b"o" * 20, len(data),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        finally:
+            view.release()
+            rpc.enable_link_chaos("")
+            await peer_d.close()
+            await peer_l.close()
+            await srv_d.close()
+            await srv_l.close()
+        assert bytes(dest) == data
+        assert served["lit"] >= 6            # the lit source carried it
+
+    asyncio.run(main())
+
+
+def test_all_sources_gone_is_typed_not_truncated():
+    """When EVERY swarm source is gone the outcome is the typed gone
+    verdict (-> ObjectLost upstream), and a partial swarm ("later" +
+    gone) raises ObjectTransferError — never silent truncation."""
+    async def main():
+        from ray_tpu._private.agent import NodeAgent
+        served = {"g1": 0, "g2": 0}
+        gone = lambda off, ln: {"gone": True}          # noqa: E731
+        srv_1 = _chunk_server("g1", b"", served, gone)
+        srv_2 = _chunk_server("g2", b"", served, gone)
+        addr_1 = await srv_1.start_tcp("127.0.0.1", 0)
+        addr_2 = await srv_2.start_tcp("127.0.0.1", 0)
+        peer_1 = await rpc.connect(tuple(addr_1), auth_token=None)
+        peer_2 = await rpc.connect(tuple(addr_2), auth_token=None)
+        agent = _mini_agent(window=2, timeout_s=0.5)
+        dest = bytearray(2 * CHUNK)
+        view = memoryview(dest)
+        with pytest.raises(NodeAgent._ObjectGone):
+            await agent._stream_chunks(
+                [peer_1, peer_2], b"o" * 20, len(dest),
+                make_sink=lambda pos, n: view[pos:pos + n])
+        await peer_1.close()
+        await srv_1.close()
+
+        # gone + perpetually-"later": transient (typed), NOT ObjectGone —
+        # a swarm member that still exists keeps lineage recovery off.
+        later = lambda off, ln: {"later": True}        # noqa: E731
+        srv_3 = _chunk_server("l1", b"", {"l1": 0}, later)
+        addr_3 = await srv_3.start_tcp("127.0.0.1", 0)
+        peer_3 = await rpc.connect(tuple(addr_3), auth_token=None)
+        with pytest.raises(exc.ObjectTransferError):
+            await agent._stream_chunks(
+                [peer_2, peer_3], b"o" * 20, CHUNK,
+                make_sink=lambda pos, n: view[pos:pos + n])
+        view.release()
+        for c in (peer_2, peer_3):
+            await c.close()
+        for s in (srv_2, srv_3):
+            await s.close()
+
+    asyncio.run(main())
+
+
+def test_gray_auto_drain_exempts_bulk_serving_node():
+    """A suspect node moving bulk object-plane traffic is BUSY, not gray:
+    the auto-drain holds while the transfer runs (placement
+    deprioritization via suspicion still applies), and resumes once the
+    flow stops."""
+    from ray_tpu._private.gcs import GcsServer, NodeInfo
+
+    gcs = GcsServer.__new__(GcsServer)
+    node = NodeInfo(b"n" * 16, ("h", 1), {"CPU": 1.0}, {}, "", "")
+    peer = NodeInfo(b"p" * 16, ("h", 2), {"CPU": 1.0}, {}, "", "")
+    node.suspicion = 0.9
+    node.suspect_since = 0.0
+    drained = []
+
+    async def fake_drain(conn, p):
+        drained.append(p)
+    gcs.h_drain_node = fake_drain  # type: ignore
+
+    async def run(bulk_rate):
+        drained.clear()
+        node.bulk_rate = bulk_rate
+        node.draining = None
+        node.suspect_since = 0.0
+        gcs._maybe_gray_drain(node, [node, peer], now=100.0,
+                              sustained_s=5.0, auto=True,
+                              susp_threshold=0.6)
+        await asyncio.sleep(0)          # let the drain spawn run
+        return bool(drained)
+
+    assert not asyncio.run(run(bulk_rate=100 << 20))   # mid-broadcast
+    assert node.suspect_since == 100.0                 # window re-arms
+    assert asyncio.run(run(bulk_rate=0.0))             # idle gray drains
+
+
+# ------------------------------------------------------------- cluster ----
+@pytest.fixture
+def replica_cluster():
+    """One in-process node (driver + agent + GCS) with a tiny chunk
+    size, plus helpers to spawn extra bare agents (pull sinks)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_transfer_chunk_bytes": CHUNK,
+        "object_locality_min_bytes": 64 * 1024,
+        "arg_prefetch_min_bytes": 64 * 1024})
+    core = ray_tpu._core()
+    procs = []
+
+    def spawn_sink(tag):
+        from ray_tpu._private import node as node_mod
+        proc, addr, _sp, node_id = node_mod.start_agent(
+            core.session_dir, core.gcs_address, {"CPU": 0.0},
+            labels={"sink": tag}, store_capacity=64 << 20)
+        procs.append(proc)
+
+        async def _c():
+            return await rpc.connect(tuple(addr), name=f"test->{tag}",
+                                     retries=50)
+        conn = asyncio.run_coroutine_threadsafe(_c(), core.loop).result(30)
+        return conn, tuple(addr), node_id
+
+    def call(conn, method, payload, timeout=60):
+        return asyncio.run_coroutine_threadsafe(
+            conn.call(method, payload, timeout=timeout),
+            core.loop).result(timeout + 15)
+
+    yield core, spawn_sink, call
+    for p in procs:
+        p.terminate()
+    ray_tpu.shutdown()
+
+
+def test_directory_registers_secondary_and_production_pull_gets_backup(
+        replica_cluster):
+    """A completed pull registers the puller as a secondary with the
+    owner; from then on (a) spec hints and owner answers carry BOTH
+    holders, and (b) a production pull payload resolves >=2 sources —
+    the hedged-pull regression: real backups, no chaos seeding."""
+    core, spawn_sink, call = replica_cluster
+    payload = np.arange(4 * CHUNK, dtype=np.uint8)
+    ref = ray_tpu.put(payload)
+    oid = ref.binary()
+    primary = list(core.agent_address)
+    owner = list(core.address)
+    conn_b, addr_b, _ = spawn_sink("b")
+    assert call(conn_b, "pull_object", {
+        "object_id": oid, "from_addrs": [primary],
+        "owner_addr": owner, "priority": 0})
+    # Owner directory now lists B as a secondary holder.
+    entry = core.memory_store.get(oid)
+    assert entry is not None and entry.secondaries == [addr_b]
+    assert core.memory_store.locations(oid) == [
+        tuple(primary), addr_b]
+    # Task-spec hints stamp the full set + size (locality/prefetch feed).
+    entries, *_ = core._build_arg_entries_sync([ref], {})
+    locs = entries[0]["ref"][2]
+    assert len(locs) == 2 and entries[0]["sz"] == entry.size
+    # Production pull (exactly what _read_plasma stamps): a third agent
+    # resolves >=2 sources, so hedging/failover has a real backup.
+    conn_c, _addr_c, _ = spawn_sink("c")
+    assert call(conn_c, "pull_object", {
+        "object_id": oid, "from_addrs": [primary],
+        "owner_addr": owner, "priority": 0})
+    st = call(conn_c, "store_stats", {})
+    assert st["last_pull_sources"] >= 2, st
+    # ... and the steady-state stripe actually drew bytes off B.
+    st_b = call(conn_b, "store_stats", {})
+    assert st_b["bytes_served"] > 0, st_b
+
+
+def test_directory_invalidation_on_free(replica_cluster):
+    """Freeing an object clears every replica: the owner broadcasts the
+    free to secondaries, and nothing keeps serving the bytes."""
+    core, spawn_sink, call = replica_cluster
+    ref = ray_tpu.put(np.arange(4 * CHUNK, dtype=np.uint8))
+    oid = ref.binary()
+    conn_b, addr_b, _ = spawn_sink("b")
+    assert call(conn_b, "pull_object", {
+        "object_id": oid, "from_addrs": [list(core.agent_address)],
+        "owner_addr": list(core.address), "priority": 0})
+    assert core.memory_store.get(oid).secondaries == [addr_b]
+    del ref          # owner refcount -> 0: free broadcasts
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if call(conn_b, "object_info", {"object_id": oid}) is None and \
+                call(conn_b, "store_stats",
+                     {})["replica_registrations"] == 0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("secondary copy or registration outlived the free")
+    assert core.memory_store.get(oid) is None
+
+
+def test_eviction_sweep_deregisters_stale_secondary(replica_cluster):
+    """A secondary whose bytes silently vanish (store eviction) is
+    deregistered — lazily on a failed serve, and by the heartbeat sweep
+    — so directory entries can't outlive copies."""
+    core, spawn_sink, call = replica_cluster
+    ref = ray_tpu.put(np.arange(2 * CHUNK, dtype=np.uint8))
+    oid = ref.binary()
+    conn_b, addr_b, _ = spawn_sink("b")
+    assert call(conn_b, "pull_object", {
+        "object_id": oid, "from_addrs": [list(core.agent_address)],
+        "owner_addr": list(core.address), "priority": 0})
+    assert core.memory_store.get(oid).secondaries == [addr_b]
+    # Simulate eviction: drop B's copy behind the directory's back
+    # (free_objects on a non-owner node == cache eviction here).
+    call(conn_b, "free_objects", {"object_ids": [oid]})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not core.memory_store.get(oid).secondaries:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("directory entry outlived the evicted copy")
+    # The object itself is fine — primary still serves.
+    assert np.array_equal(ray_tpu.get(ref), np.arange(2 * CHUNK,
+                                                      dtype=np.uint8))
+
+
+def test_recovery_promotes_surviving_secondary(replica_cluster):
+    """Primary copy lost but a secondary survives: recovery repoints the
+    owner's record to the survivor (adopt+pin) instead of giving up or
+    re-executing lineage — put objects have no lineage at all."""
+    core, spawn_sink, call = replica_cluster
+    value = np.arange(3 * CHUNK, dtype=np.uint8)
+    ref = ray_tpu.put(value)
+    oid = ref.binary()
+    conn_b, addr_b, _ = spawn_sink("b")
+    assert call(conn_b, "pull_object", {
+        "object_id": oid, "from_addrs": [list(core.agent_address)],
+        "owner_addr": list(core.address), "priority": 0})
+    assert core.memory_store.get(oid).secondaries == [addr_b]
+    # Lose the PRIMARY copy only (local agent drops pins + bytes).
+    asyncio.run_coroutine_threadsafe(
+        core.agent.call("free_objects", {"object_ids": [oid]}),
+        core.loop).result(30)
+    assert core._run(core._recover_object(oid), timeout=60)
+    entry = core.memory_store.get(oid)
+    assert tuple(entry.plasma_node) == addr_b     # promoted
+    # And the survivor is pinned now (adopt_primary took an owner pin).
+    assert call(conn_b, "object_info", {"object_id": oid}) is not None
+    assert np.array_equal(ray_tpu.get(ref, timeout=60), value)
+
+
+def test_drain_during_broadcast_hands_off_and_repoints(replica_cluster):
+    """ISSUE bugfix: a node draining while serving as a swarm source —
+    the mid-stream pull fails over to remaining holders, the drain
+    deregisters the node's secondaries, and its adopt_primary path
+    repoints the owner's directory entry for pinned primaries."""
+    core, spawn_sink, call = replica_cluster
+    value = np.arange(8 * CHUNK, dtype=np.uint8)
+    ref = ray_tpu.put(value)
+    oid = ref.binary()
+    primary = list(core.agent_address)
+    owner = list(core.address)
+    conn_b, addr_b, node_b = spawn_sink("b")
+    conn_c, addr_c, _node_c = spawn_sink("c")
+    # B holds a secondary AND adopts a pinned primary role for the
+    # directory-repoint half of the test.
+    assert call(conn_b, "adopt_primary", {
+        "object_id": oid, "from_addrs": [primary],
+        "owner_addr": owner, "priority": 0})
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        e = core.memory_store.get(oid)
+        if e is not None and tuple(e.plasma_node) == addr_b:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("adopt_primary did not repoint the owner directory")
+    # Start a pull on C striped across [B, original primary], and drain
+    # B while it streams.
+    fut = asyncio.run_coroutine_threadsafe(
+        conn_c.call("pull_object", {
+            "object_id": oid, "from_addrs": [list(addr_b), primary],
+            "owner_addr": owner, "priority": 0}, timeout=120),
+        core.loop)
+    assert ray_tpu.drain_node(node_b, reason="manual", deadline_s=15,
+                              wait=True)
+    assert fut.result(120)                      # pull survived the drain
+    # C's landed copy is byte-exact (typed failover, no truncation):
+    # the store holds the serialized form — deserialize and compare.
+    blob = call(conn_c, "fetch_from_store", {"object_id": oid},
+                timeout=120)
+    from ray_tpu._private.serialization import get_context
+    assert blob is not None and \
+        np.array_equal(get_context().deserialize(memoryview(blob)), value)
+    # The drained node is out of the directory; the primary record moved
+    # off B (drain migration re-adopted it at a live peer).
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        e = core.memory_store.get(oid)
+        locs = [tuple(a) for a in (e.locations() if e else [])]
+        if addr_b not in locs and locs:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"drained node still in directory: {locs}")
+    assert np.array_equal(ray_tpu.get(ref, timeout=60), value)
+
+
+def test_locality_schedules_task_to_byte_holder():
+    """Acceptance: a default-strategy task whose largest arg lives on
+    node B is leased to B when feasible — the bytes don't move, the
+    task does."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    nb = cluster.add_node(num_cpus=2, resources={"b": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(3 << 20, dtype=np.uint8)   # 3 MiB
+
+        @ray_tpu.remote
+        def consume(a):
+            time.sleep(0.1)
+            return bytes(ray_tpu.get_runtime_context().node_id), a.nbytes
+
+        ref = produce.options(resources={"b": 0.01}).remote()
+        # Submit only once the return's plasma location (and size) are
+        # in the owner's directory — that is what the hint stamps.
+        core = ray_tpu._core()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            e = core.memory_store.get(ref.binary())
+            if e is not None and e.plasma_node is not None and e.size:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("producer return never landed")
+        for _ in range(3):      # not a fluke of one lease round
+            node_id, nbytes = ray_tpu.get(consume.remote(ref),
+                                          timeout=60)
+            assert nbytes == 3 << 20
+            assert node_id == nb.node_id, \
+                "task was not routed to the byte-holding node"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_arg_prefetch_starts_before_worker_pickup():
+    """Acceptance: on lease grant the agent starts pulling missing large
+    args — observable as a PREFETCH task event stamped no later than
+    the worker's RUNNING event."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    nb = cluster.add_node(num_cpus=2, resources={"b": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes()
+        head_id = next(bytes(n["node_id"]) for n in ray_tpu.nodes()
+                       if bytes(n["node_id"]) != nb.node_id)
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(3 << 20, dtype=np.uint8)
+
+        @ray_tpu.remote
+        def consume(a):
+            return int(a[-1])
+
+        ref = produce.options(resources={"b": 0.01}).remote()
+        ray_tpu.wait([ref], timeout=60, fetch_local=False)
+        # Pin the consumer AWAY from the byte holder so the grant must
+        # prefetch across nodes.
+        strat = NodeAffinitySchedulingStrategy(head_id, soft=False)
+        out_ref = consume.options(scheduling_strategy=strat).remote(ref)
+        assert ray_tpu.get(out_ref, timeout=60) == 255
+        tid = out_ref.binary()[:-4]
+        from ray_tpu.util import state
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rows = [t for t in state.list_tasks(limit=100_000)
+                    if t["task_id"] == tid.hex()]
+            ev = dict()
+            for name, ts in (rows[0]["events"] if rows else []):
+                ev.setdefault(name, ts)
+            if "PREFETCH" in ev and "RUNNING" in ev:
+                assert ev["PREFETCH"] <= ev["RUNNING"], ev
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail(f"missing PREFETCH/RUNNING events: "
+                        f"{rows[0]['events'] if rows else 'no task row'}")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_swarm_forms_on_concurrent_broadcast(replica_cluster):
+    """1→N: concurrent pulls of one object register-and-query the owner
+    atomically, so the later starters see their siblings (>=2 sources)
+    — the swarm that replaces N serial pulls of the primary."""
+    core, spawn_sink, call = replica_cluster
+    ref = ray_tpu.put(np.arange(16 * CHUNK, dtype=np.uint8))
+    oid = ref.binary()
+    primary = list(core.agent_address)
+    owner = list(core.address)
+    sinks = [spawn_sink(t) for t in ("b", "c", "d")]
+
+    async def broadcast():
+        return await asyncio.gather(*[
+            conn.call("pull_object", {
+                "object_id": oid, "from_addrs": [primary],
+                "owner_addr": owner, "priority": 0}, timeout=120)
+            for conn, _a, _n in sinks])
+
+    oks = asyncio.run_coroutine_threadsafe(
+        broadcast(), core.loop).result(150)
+    assert all(oks), oks
+    widths = [call(conn, "store_stats", {})["last_pull_sources"]
+              for conn, _a, _n in sinks]
+    assert max(widths) >= 2, widths
+    # All three registered as holders afterwards (directory caps apply).
+    entry = core.memory_store.get(oid)
+    assert len(entry.secondaries or ()) == 3

@@ -54,6 +54,61 @@ def test_critical_utilization_uses_worst_dim():
         {"CPU": 1.0, "TPU": 1.0})
     assert u == pytest.approx(1.0)    # TPU dim: (4-1+1)/4
 
+def test_arg_locality_map_from_spec_hints():
+    """Replica-directory hints (list of holders + sz) aggregate into a
+    bytes-per-address map; legacy single-address hints and hintless/
+    inline args are handled."""
+    a1, a2 = ("h1", 1), ("h2", 2)
+    args = [
+        {"ref": [b"x", ["o", 9], [list(a1), list(a2)]], "sz": 100},
+        {"ref": [b"y", ["o", 9], list(a1)], "sz": 40},   # legacy shape
+        {"ref": [b"z", ["o", 9], None]},                 # no hint/size
+        {"v": b"inline"},
+    ]
+    loc = policy.arg_locality(args)
+    assert loc[a1] == 140 and loc[a2] == 100
+    assert policy.locality_bytes(loc, ("h3", 3)) == 0
+
+
+def test_pick_by_locality_respects_feasibility_and_min_bytes():
+    loc = {("h1", 1): 500, ("h2", 2): 100}
+    cands = [
+        ("n1", ("h1", 1), {"CPU": 4.0}, {"CPU": 0.0}),   # most bytes, FULL
+        ("n2", ("h2", 2), {"CPU": 4.0}, {"CPU": 4.0}),
+        ("n3", ("h3", 3), {"CPU": 4.0}, {"CPU": 4.0}),   # no bytes
+    ]
+    # Feasibility outranks locality: n1 holds the most but has no room.
+    assert policy.pick_by_locality(cands, {"CPU": 1.0}, loc) == "n2"
+    # Below min_bytes locality stays silent (caller falls through).
+    assert policy.pick_by_locality(cands, {"CPU": 1.0}, loc,
+                                   min_bytes=1000) is None
+    assert policy.pick_by_locality(cands, {"CPU": 1.0}, {}) is None
+
+
+def test_gcs_pick_node_locality_bias():
+    """GCS placement prefers the feasible node holding the spec's bytes,
+    but never over feasibility (full node loses) or an explicit
+    strategy."""
+    from ray_tpu._private.gcs import NodeInfo
+    a = NodeInfo(b"a" * 16, ("h1", 1), {"CPU": 4.0}, {}, "", "")
+    b = NodeInfo(b"b" * 16, ("h2", 2), {"CPU": 4.0}, {}, "", "")
+    from ray_tpu._private.gcs import GcsServer
+    gcs = GcsServer.__new__(GcsServer)
+    gcs.nodes = {a.node_id: a, b.node_id: b}
+    gcs.placement_groups = {}
+    gcs._pg_rr = {}
+    loc = {("h2", 2): 10 << 20}
+    assert gcs._pick_node({"CPU": 1.0}, None, locality=loc) is b
+    # Full byte-holder: falls back to the normal policy on the other.
+    b.resources_available = {"CPU": 0.0}
+    assert gcs._pick_node({"CPU": 1.0}, None, locality=loc) is a
+    b.resources_available = {"CPU": 4.0}
+    # Explicit affinity to `a` outranks locality toward `b`.
+    assert gcs._pick_node(
+        {"CPU": 1.0}, {"type": "node_affinity", "node_id": a.node_id},
+        locality=loc) is a
+
+
 def test_label_filter_hard_and_soft():
     cands = [("a", {"zone": "z1"}), ("b", {"zone": "z2", "gen": "v5e"}),
              ("c", {"zone": "z2"})]
