@@ -2032,6 +2032,8 @@ class NodeAgent:
                     end = min(off + length, part["size"])
                     if _intervals_cover(part["done"], off, end):
                         piece = bytes(part["buf"][off:end])
+                        rpc.note_copied_bytes("serve_partial_chunk",
+                                              len(piece))
                         self._bytes_served += len(piece)
                         return rpc.RawPayload([piece]) if raw else piece
                 return {"later": True} if raw else None
@@ -2050,7 +2052,9 @@ class NodeAgent:
 
             return rpc.RawPayload([piece], release=_unpin)
         try:
-            return bytes(view[off:off + length])
+            piece = bytes(view[off:off + length])
+            rpc.note_copied_bytes("serve_legacy_chunk", len(piece))
+            return piece
         finally:
             view.release()
             self.store.release(oid)
@@ -2250,6 +2254,7 @@ class NodeAgent:
                 # Legacy peer: msgpack bytes body.
                 if len(res) == n:
                     sink_obj[0:n] = res
+                    rpc.note_copied_bytes("pull_legacy_chunk", n)
                     self._note_peer_latency(peer, time.monotonic() - t0,
                                             n, chunk=True)
                     return "ok", None
@@ -2350,6 +2355,7 @@ class NodeAgent:
                 if winner is t2:
                     # Backup won into its private staging buffer; the
                     # primary is fully settled, so the real sink is ours.
+                    rpc.note_copied_bytes("pull_hedge_staging", n)
                     if commit is None:
                         sink1[0:n] = staging
                     else:
@@ -2952,6 +2958,7 @@ async def _amain(args):
     if chaos_spec:
         rpc.enable_chaos(chaos_spec)
     rpc.enable_link_chaos(get_config().link_chaos)
+    rpc.enable_native_framer(get_config().rpc_native_framer)
     rpc.set_default_call_timeout(get_config().control_call_timeout_s)
     agent = NodeAgent(
         gcs_address=json.loads(args.gcs_address),

@@ -11,9 +11,10 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Optional
+
+from . import native_build
 
 logger = logging.getLogger("ray_tpu")
 
@@ -33,14 +34,7 @@ def _load():
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not (os.path.exists(_SO)
-                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-            tmp = _SO + f".tmp{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-                 "-o", tmp, _SRC],
-                check=True, capture_output=True)
-            os.replace(tmp, _SO)
+        native_build.build_so(_SRC, _SO, fallback_to_stale=True)
         lib = ctypes.CDLL(_SO)
         lib.cg_available.restype = ctypes.c_int
         lib.cg_create.argtypes = [ctypes.c_char_p]

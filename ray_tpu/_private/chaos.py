@@ -139,6 +139,18 @@ class LinkChaos:
             return False
         return rule["dur"] is None or t < rule["after"] + rule["dur"]
 
+    def matches_in(self, desc: str) -> bool:
+        """Any inbound rule that could EVER apply to this link (schedule
+        windows ignored — a rule can activate later).  rpc.Connection
+        disables the native recv-into-arena takeover on such links:
+        delayed/dropped inbound delivery requires buffering the bytes,
+        which is exactly what the takeover bypasses."""
+        for rule in self.rules:
+            if rule["kind"].startswith("in_") and \
+                    (not rule["match"] or rule["match"] in desc):
+                return True
+        return False
+
     def plan(self, direction: str, desc: str, nbytes: int):
         """(drop, delay_s) for `nbytes` moving `direction` ('out'|'in')
         on the link described by `desc`."""

@@ -116,6 +116,15 @@ _define("create_backpressure_timeout_s", 30.0,
         "failing (reference: plasma create_request_queue semantics)")
 _define("rpc_connect_retries", 10)
 _define("rpc_connect_retry_delay_s", 0.2)
+_define("rpc_native_framer", True,
+        "run RPC wire framing through the _rpcframe.so C extension "
+        "(src/rpcframe): C stream scanner + raw chunks recv'd straight "
+        "into the shm arena + vectored writev frame waves (reference: "
+        "Ray keeps its whole rpc/object-transfer plane in C++, "
+        "src/ray/rpc + object_manager).  Per process/node; the wire "
+        "format is identical to the pure-Python framer, so clusters may "
+        "mix modes freely.  Off, a missing compiler, or a corrupt .so "
+        "all fall back to pure Python (warn once, never an error)")
 _define("control_call_timeout_s", 60.0,
         "default deadline for unary control-plane RPCs whose call site "
         "passes no timeout: a half-open connection (gray peer, asymmetric "

@@ -293,6 +293,11 @@ class CoreWorker:
         cfg = get_config()
         rpc.enable_chaos(cfg.rpc_chaos)
         rpc.enable_link_chaos(cfg.link_chaos)
+        # Wire hot path: resolve the framer mode ONCE per process from
+        # config (a per-node _system_config reaches workers through the
+        # agent-forwarded env) so every connection this process opens
+        # agrees — mixed modes are a per-NODE property, never per-conn.
+        rpc.enable_native_framer(cfg.rpc_native_framer)
         # Gray-failure defense: unary control calls get a default bound
         # so a half-open connection can never hang this process forever
         # (explicit timeout=0 at a call site opts out).

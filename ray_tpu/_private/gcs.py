@@ -1583,6 +1583,7 @@ async def _amain(args):
     if chaos_spec:
         rpc.enable_chaos(chaos_spec)
     rpc.enable_link_chaos(_gcfg().link_chaos)
+    rpc.enable_native_framer(_gcfg().rpc_native_framer)
     rpc.set_default_call_timeout(_gcfg().control_call_timeout_s)
     server = GcsServer(port=args.port,
                        journal_path=args.journal or None)

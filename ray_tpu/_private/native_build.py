@@ -1,0 +1,52 @@
+"""Shared build-on-first-use helper for the src/ native extensions.
+
+One implementation of the pattern every ctypes binding used to copy
+(shm_store, cgroup, rpcframe): rebuild the shared object with g++ when
+it is missing or older than its source, under a caller-provided lock,
+writing to a `.tmp<pid>` file and `os.replace`-ing into place so
+concurrent processes race safely.  See src/README.md for the build
+rules (flags, committed artifacts, degradation policy).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+CXX_FLAGS = ["-O2", "-fPIC", "-shared", "-std=c++17"]
+
+
+def build_so(src: str, so: str, ldflags: tuple = (),
+             fallback_to_stale: bool = False) -> str:
+    """Ensure `so` exists and is at least as new as `src`; returns the
+    path.  With fallback_to_stale=True a failed rebuild (no compiler on
+    this host, transient toolchain error) falls back to an EXISTING
+    `so` instead of raising — for committed artifacts that remain
+    loadable even when the checkout gave the source a newer mtime
+    (loaders must gate on their own ABI check).  Callers serialize via
+    their own module lock; this function only does the filesystem
+    dance."""
+    if os.path.exists(so) and (not os.path.exists(src)
+                               or os.path.getmtime(so)
+                               >= os.path.getmtime(src)):
+        return so
+    tmp = so + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(["g++", *CXX_FLAGS, "-o", tmp, src, *ldflags],
+                       check=True, capture_output=True)
+        os.replace(tmp, so)
+    except Exception as e:  # noqa: BLE001 — missing g++, compile error
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if fallback_to_stale and os.path.exists(so):
+            logger.warning(
+                "rebuild of %s failed (%s: %s); using the existing "
+                "artifact", so, type(e).__name__, e)
+            return so
+        raise
+    return so

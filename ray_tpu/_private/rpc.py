@@ -47,6 +47,32 @@ spill file) registered via `call_raw`, or collects them for a plain `call`
 / server-side `take_raw`.  This removes every user-space copy except the one
 memcpy into the destination.
 
+Native framer (config `rpc_native_framer`, default on): the per-byte
+work of this module's hot loops — frame boundary detection, raw-header
+parsing, chunk scatter/gather, and syscall batching — runs in the
+`_rpcframe.so` C extension when it loads (src/rpcframe, built like the
+shm store).  Receive side: a streaming boundary scanner splits each
+socket chunk into control spans (still decoded by msgpack's C Unpacker)
+and raw payload spans with NO Unpacker reset per raw header, and once a
+large raw payload's destination is a writable buffer (a shm arena
+region), the connection temporarily takes over the socket —
+`pause_reading()` + `add_reader` — and `recv()`s the remaining payload
+DIRECTLY into the arena, eliminating the per-read bytes allocation and
+Python scatter entirely.  Send side: a frame wave (or raw header +
+arena payload views) leaves in one looping `writev` — one syscall per
+wave instead of one per frame, zero join copies — and any
+EAGAIN-unsent tail is handed back to the transport so the existing
+high-watermark backpressure (pause_writing/drain) still governs.  The
+pure-Python path remains byte-compatible on the wire and is selected
+per process by config/env, or automatically when the extension cannot
+load; link_chaos composes with both framers: plans are computed in
+Python at the same `_tx`/`_data_received` seam, chaos-delayed bytes
+still flow through the native scanner, and the recv takeover simply
+disengages on links with inbound chaos rules (delayed delivery
+requires buffering by definition).  Per-connection `io_stats` counts
+frames, syscalls and takeovers so tests can pin the syscall budget
+(one submit_batch wave <= 2 transport submissions).
+
 Authentication (reference: src/ray/rpc/authentication/
 authentication_token_validator.cc): when a server is constructed with
 auth_token=..., the first frame on every inbound connection must be the
@@ -158,6 +184,31 @@ def _backoff_delay(attempt: int, retry_delay: float,
     scaled by uniform [0.5, 1.5)."""
     base = min(retry_delay * (1.5 ** attempt), cap)
     return base * (0.5 + _jitter_rng.random())
+
+
+# ---------------------------------------------------------------------------
+# Copy audit (transfer-path side of serialization.copied_part_bytes):
+# every deliberate per-chunk byte materialization on the data plane notes
+# itself here, so tests can PIN copies-per-byte for pull / serve paths —
+# a regression reintroducing an intermediate bytes() per chunk shows up
+# as a counter delta, not a silent throughput loss.  Lives in this module
+# (not serialization) because the transport layer and the agent must stay
+# importable without cloudpickle.
+# ---------------------------------------------------------------------------
+COPY_AUDIT: Dict[str, int] = {}
+
+
+def note_copied_bytes(tag: str, nbytes: int) -> None:
+    """Record `nbytes` deliberately materialized (copied) on a transfer
+    path.  Tags: serve_partial_chunk (swarm mid-pull serves — 1 copy per
+    byte by design: the unsealed buffer's lifetime belongs to the pull),
+    serve_legacy_chunk / pull_legacy_chunk (non-raw peers),
+    pull_hedge_staging (backup attempt landed in its private buffer)."""
+    COPY_AUDIT[tag] = COPY_AUDIT.get(tag, 0) + nbytes
+
+
+def copy_audit_snapshot() -> Dict[str, int]:
+    return dict(COPY_AUDIT)
 
 
 _BG_TASKS: set = set()
@@ -275,6 +326,48 @@ def enable_link_chaos(spec: str, seed: int = 0xC0FFEE):
 
 
 # ---------------------------------------------------------------------------
+# Native framer selection (see module docstring).  None = auto: consult
+# config `rpc_native_framer` and extension availability lazily at
+# connection setup; True/False = explicit process-wide override (tests,
+# daemons honoring per-node _system_config).
+# ---------------------------------------------------------------------------
+_native_framer: Optional[bool] = None
+
+# Raw payloads with at least this many bytes still in flight switch the
+# socket into the native recv-into-arena mode; smaller remainders aren't
+# worth the reader swap.  Tests lower it to exercise the takeover.
+NATIVE_RECV_MIN = 64 * 1024
+
+
+def enable_native_framer(on: Optional[bool]) -> None:
+    """Force the native framer on/off for this process (None restores
+    auto).  'On' still degrades to pure Python when the extension
+    cannot load — never an error."""
+    global _native_framer
+    _native_framer = on
+
+
+def _native_available() -> bool:
+    try:
+        from . import rpcframe
+        return rpcframe.available()
+    except Exception:       # noqa: BLE001 — transport must never die here
+        return False
+
+
+def _resolve_native() -> bool:
+    if _native_framer is not None:
+        return _native_framer and _native_available()
+    try:
+        from .config import get_config
+        if not get_config().rpc_native_framer:
+            return False
+    except Exception:       # config unavailable: bare library use
+        pass
+    return _native_available()
+
+
+# ---------------------------------------------------------------------------
 # Connection
 # ---------------------------------------------------------------------------
 def _pack(obj) -> bytes:
@@ -380,7 +473,8 @@ class Connection:
                  fast_handlers: Dict[str, Callable] | None = None,
                  auth_token: str | None = None,
                  send_token: str | None = None,
-                 on_connect: Callable | None = None):
+                 on_connect: Callable | None = None,
+                 native: bool | None = None):
         self.handlers = handlers if handlers is not None else {}
         # Fast handlers: SYNC callables (conn, payload) -> asyncio.Future
         # | FAST_FALLBACK | immediate result. They run inline in the recv
@@ -446,6 +540,30 @@ class Connection:
         self._rx_q: Any = None
         self._rx_task: Optional[asyncio.Task] = None
         self._link_descr: Optional[str] = None
+        # Native framer state (see module docstring): resolved at
+        # _connection_made (transport/fd known).  `native` here is a
+        # per-connection override for tests/mixed-mode harnesses; None
+        # follows the process-wide config.
+        self._native_pref = native
+        self._use_native = False
+        self._framer = None             # rpcframe.Scanner
+        self._sock_fd = -1
+        self._loop = None
+        self._native_rx = False         # recv-takeover engaged
+        self._rx_export = None          # ctypes export pinning the sink
+        self._rx_addr = 0
+        # dup(2) of the socket fd, created lazily at the first takeover:
+        # asyncio refuses add_reader on a transport-owned fd, but a dup
+        # shares the same socket and passes the ownership check.
+        self._dup_fd = -1
+        # Transport I/O accounting, both framers: 'syscalls' counts
+        # direct socket submissions (writev calls + transport.writes);
+        # a transport.write may buffer rather than send, so the number
+        # is an upper bound on send syscalls — exactly what the
+        # "<= 2 per wave" budget needs.
+        self.io_stats = {"tx_syscalls": 0, "tx_frames": 0,
+                         "tx_writev": 0, "tx_bytes": 0,
+                         "rx_native_bytes": 0, "rx_takeovers": 0}
 
     @property
     def closed(self):
@@ -463,6 +581,27 @@ class Connection:
     # ---------------------------------------------------------- wire events
     def _connection_made(self, transport):
         self.transport = transport
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._loop = asyncio.get_event_loop()
+        use_native = self._native_pref if self._native_pref is not None \
+            else _resolve_native()
+        if use_native and _native_available():
+            try:
+                sock = transport.get_extra_info("socket")
+                fd = sock.fileno() if sock is not None else -1
+                if fd >= 0:
+                    from . import rpcframe
+                    self._framer = rpcframe.Scanner()
+                    self._sock_fd = fd
+                    self._use_native = True
+            except Exception:
+                logger.warning("native framer setup failed on %s; using "
+                               "pure-Python framing", self.name,
+                               exc_info=True)
+                self._framer = None
+                self._use_native = False
         if self._send_token is not None:
             # First frame on the wire, ahead of any queued call: the write
             # path appends in order, so this is guaranteed to arrive first.
@@ -507,6 +646,9 @@ class Connection:
         peer's frame parser mid-message."""
         lc = _link_chaos
         if lc is None:
+            st = self.io_stats
+            st["tx_syscalls"] += 1
+            st["tx_bytes"] += _nbytes(data)
             try:
                 self.transport.write(data)
             except (ConnectionError, OSError):
@@ -591,7 +733,10 @@ class Connection:
 
     def _rx_process(self, data):
         try:
-            self._ingest(memoryview(data))
+            if self._use_native:
+                self._ingest_native(data)
+            else:
+                self._ingest(memoryview(data))
         except Exception:
             # Malformed stream (bad msgpack, oversized buffer, raw-frame
             # desync): drop peer.
@@ -662,6 +807,155 @@ class Connection:
                 self._on_msg(msg)
             if not hit_raw:
                 return
+
+    def _ingest_native(self, data) -> None:
+        """Native-framer ingest: the C scanner splits the chunk into
+        control spans (fed to the msgpack decoder), raw headers and
+        payload spans — no Unpacker reset per raw header, no tell()
+        bookkeeping.  Event semantics mirror _ingest exactly; parity is
+        pinned by tests/test_rpc_framer.py."""
+        from . import rpcframe
+        mv = memoryview(data)
+        n = mv.nbytes
+        pos = 0
+        while pos < n:
+            fr = self._framer
+            if fr is None or self._closed:
+                return      # torn down mid-ingest (a handler's write
+            #                 failed): the rest of the chunk is moot
+            nev, consumed = fr.scan(data, pos)
+            if nev < 0:
+                raise RpcError("malformed stream (native framer)")
+            evt, eva, evb = fr.evt, fr.eva, fr.evb
+            for i in range(nev):
+                if self._closed:
+                    return  # a dispatched handler tore the conn down
+                t = evt[i]
+                if t == rpcframe.EV_CTRL:
+                    a = pos + eva[i]
+                    self._unpacker.feed(mv[a:a + evb[i]])
+                    for msg in self._unpacker:
+                        self._on_msg(msg)
+                elif t == rpcframe.EV_RAW_DATA:
+                    a = pos + eva[i]
+                    self._raw_deliver(mv[a:a + evb[i]])
+                    if self._raw_cur is not None and self._raw_cur[1] == 0:
+                        self._finish_raw()
+                elif t == rpcframe.EV_RAW_BEGIN:
+                    if not self._authed:
+                        raise RpcError("raw frame before auth handshake")
+                    rid, nbytes = eva[i], evb[i]
+                    if nbytes < 0 or nbytes > MAX_FRAME:
+                        raise RpcError(f"bad raw frame length {nbytes!r}")
+                    self._begin_raw(rid, nbytes)
+                    if nbytes == 0:
+                        self._finish_raw()
+                else:  # EV_STASH_CTRL: header-split bytes reclassified
+                    self._unpacker.feed(fr.spill_bytes(eva[i], evb[i]))
+                    for msg in self._unpacker:
+                        self._on_msg(msg)
+            if consumed == 0:
+                raise RpcError("native framer made no progress")
+            pos += consumed
+        self._maybe_native_recv()
+
+    # ------------------------------------------ native recv takeover --
+    def _maybe_native_recv(self) -> None:
+        """If a large raw payload is mid-flight into a writable buffer,
+        stop routing its bytes through the transport: pause the
+        protocol's reading and recv() the remainder straight into the
+        destination (the shm arena region) until it completes."""
+        raw = self._raw_cur
+        if (self._native_rx or raw is None or self._closed
+                or raw[1] < NATIVE_RECV_MIN or self._sock_fd < 0):
+            return
+        sink = raw[2]
+        if not (isinstance(sink, memoryview) and not sink.readonly
+                and sink.c_contiguous):
+            return
+        if raw[3] + raw[1] > sink.nbytes:
+            # Announced payload exceeds the registered sink: never let a
+            # native recv() run past the destination buffer (memory
+            # safety — a buggy or hostile peer must not be able to
+            # corrupt the heap).  The buffered path handles the
+            # overflow: the scatter raises, the sink drops into discard
+            # mode, and the caller's future fails typed.
+            return
+        if self._rx_q:
+            return          # chaos-delayed bytes already queued: keep order
+        lc = _link_chaos
+        if lc is not None and lc.matches_in(self._link_desc()):
+            return          # inbound chaos plans need the buffered path
+        import ctypes as _ct
+        try:
+            # Pins the sink's buffer for the takeover's duration; the
+            # export is dropped the moment the takeover ends so arena
+            # abort/release paths are never blocked by it.
+            export = _ct.c_char.from_buffer(sink)
+            if self._dup_fd < 0:
+                self._dup_fd = os.dup(self._sock_fd)
+                os.set_blocking(self._dup_fd, False)
+            self.transport.pause_reading()
+            self._loop.add_reader(self._dup_fd, self._native_rx_step)
+        except Exception:
+            # Transport/loop without reader control (or an exotic
+            # buffer): the buffered path still works.
+            try:
+                self.transport.resume_reading()
+            except Exception:
+                pass
+            return
+        self._rx_export = export
+        self._rx_addr = _ct.addressof(export)
+        self._native_rx = True
+        self.io_stats["rx_takeovers"] += 1
+
+    def _native_rx_step(self) -> None:
+        """Reader callback while a takeover is active: drain the socket
+        into the sink until the payload completes or would block."""
+        from . import rpcframe
+        raw = self._raw_cur
+        if (self._closed or raw is None
+                or not isinstance(raw[2], memoryview)):
+            # Finished/defused (call_raw timeout) under us: hand the
+            # stream back to the transport — the Python path discards
+            # or completes the remainder with full accounting.
+            self._native_rx_end()
+            return
+        got, state, err, _nsys = rpcframe.recv_into(
+            self._dup_fd, self._rx_addr + raw[3], raw[1])
+        if got:
+            raw[3] += got
+            raw[1] -= got
+            self.io_stats["rx_native_bytes"] += got
+        if raw[1] == 0:
+            self._native_rx_end()
+            self._finish_raw()
+            return
+        if state == rpcframe.RECV_EOF or state == rpcframe.RECV_ERROR:
+            self._native_rx_end(resume=False)
+            self.abort()    # connection_lost -> _teardown, like eof/reset
+
+    def _native_rx_end(self, resume: bool = True) -> None:
+        if not self._native_rx:
+            return
+        self._native_rx = False
+        try:
+            self._loop.remove_reader(self._dup_fd)
+        except Exception:
+            pass
+        self._rx_export = None
+        self._rx_addr = 0
+        # Re-sync the scanner: it never saw the bytes recv'd natively.
+        raw = self._raw_cur
+        if self._framer is not None:
+            self._framer.set_raw_remaining(raw[1] if raw is not None
+                                           else 0)
+        if resume and not self._closed and self.transport is not None:
+            try:
+                self.transport.resume_reading()
+            except Exception:
+                pass
 
     # Orphaned raw payloads kept for a late take_raw (see _begin_raw):
     # bounded by count AND total buffered bytes.  Evicted rids are
@@ -828,14 +1122,29 @@ class Connection:
                 for b in payload.buffers:
                     self._tx_enqueue(bytes(b), drop, delay)
                 return
-            try:
-                self.transport.write(
-                    _pack([0, "__raw__", [rid, payload.nbytes]]))
-                for b in payload.buffers:
-                    self.transport.write(b)
-            except (ConnectionError, OSError):
-                self._teardown()
-                return
+            header = _pack([0, "__raw__", [rid, payload.nbytes]])
+            if self._vectored_ok():
+                # Native gather path: header + payload views (straight
+                # out of the shm arena) leave in one looping writev.
+                # Fully written -> the kernel holds copies, so arena
+                # pins can drop NOW; a backpressured tail went to the
+                # transport and follows the buffer-by-ref rules below.
+                if self._tx_vectored([header] + [
+                        b for b in payload.buffers if _nbytes(b)]):
+                    return          # finally: payload.close()
+                if self._closed:
+                    return
+            else:
+                try:
+                    self.io_stats["tx_syscalls"] += 1 + len(payload.buffers)
+                    self.io_stats["tx_bytes"] += \
+                        len(header) + payload.nbytes
+                    self.transport.write(header)
+                    for b in payload.buffers:
+                        self.transport.write(b)
+                except (ConnectionError, OSError):
+                    self._teardown()
+                    return
             if (self._WRITES_BUFFER_BY_REF and payload.release is not None
                     and not self._closed and self.transport is not None
                     and self.transport.get_write_buffer_size() > 0):
@@ -883,6 +1192,20 @@ class Connection:
                 self.abort()
             return
         if isinstance(a, str):  # request [mid, method, payload]
+            if a == "__raw__":
+                # A raw header that reached frame dispatch instead of
+                # the framer's interception.  Native path: a peer
+                # packed the header in a legal-but-NON-minimal msgpack
+                # encoding the scanner's byte-exact magic (rpcframe.cc
+                # kMagic) didn't claim — the next stream bytes are
+                # payload, not frames, so abort typed before the parser
+                # desyncs into them.  (The Python framer matches on the
+                # DECODED object, so it intercepts non-minimal forms
+                # upstream; here it only sees malformed shapes — mid!=0
+                # or a deadline-carrying 4-frame — which no peer emits.)
+                # Wire invariant: raw headers are packed minimally.
+                raise RpcError("unintercepted __raw__ header "
+                               "(malformed or non-minimal encoding)")
             if a == "__auth__":
                 return  # authed already (or server auth disabled): ignore
             if a == "__batch_resp__":
@@ -938,6 +1261,20 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._native_rx_end(resume=False)
+        if self._dup_fd >= 0:
+            try:
+                os.close(self._dup_fd)
+            except OSError:
+                pass
+            self._dup_fd = -1
+        if self._framer is not None:
+            try:
+                self._framer.close()
+            except Exception:
+                pass
+            self._framer = None
+            self._use_native = False
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
@@ -1278,10 +1615,67 @@ class Connection:
             self._wbuf.clear()
             return
         buf, self._wbuf = self._wbuf, []
+        self.io_stats["tx_frames"] += len(buf)
+        if len(buf) > 1 and self._vectored_ok():
+            # Native framer: the whole wave leaves in one writev — no
+            # join allocation, no per-frame syscalls.
+            self._tx_vectored(buf)
+            return
         # Always one write: on a drained transport each write() is an
         # immediate socket send, so per-frame writes cost a syscall each.
         # _tx is a direct transport.write unless link chaos is enabled.
         self._tx(buf[0] if len(buf) == 1 else b"".join(buf))
+
+    def _vectored_ok(self) -> bool:
+        """Direct gather-writes are only safe when nothing is queued
+        ahead of us: no chaos plan (its delayed queue must see every
+        byte), no transport write buffer (ordering), no pause
+        (backpressure already in force)."""
+        return (self._use_native and _link_chaos is None
+                and not self._paused and self.transport is not None
+                and self.transport.get_write_buffer_size() == 0)
+
+    def _tx_vectored(self, buffers) -> bool:
+        """writev the buffers; an EAGAIN-unsent tail is handed to the
+        transport so its high-watermark machinery (pause_writing ->
+        drain()) keeps governing memory — a vectored wave must respect
+        backpressure, not buffer itself wholesale.  Returns True when
+        every byte reached the kernel (the caller may then release
+        pinned views immediately)."""
+        from . import rpcframe
+        try:
+            w, total, err, nsys = rpcframe.writev(self._sock_fd, buffers)
+        except Exception:
+            logger.warning("vectored write failed on %s; falling back",
+                           self.name, exc_info=True)
+            self._tx(b"".join(bytes(b) for b in buffers))
+            return False
+        st = self.io_stats
+        st["tx_writev"] += 1
+        st["tx_syscalls"] += max(nsys, 1)
+        st["tx_bytes"] += w
+        if err:
+            self._teardown()
+            return False
+        if w == total:
+            return True
+        # Partial: queue the tail on the transport (counts as one more
+        # submission; the transport sends it as the socket drains).
+        st["tx_syscalls"] += 1
+        skip = w
+        try:
+            for b in buffers:
+                nb = _nbytes(b)
+                if skip >= nb:
+                    skip -= nb
+                    continue
+                mv = memoryview(b)
+                self.transport.write(mv[skip:] if skip else mv)
+                st["tx_bytes"] += nb - skip
+                skip = 0
+        except (ConnectionError, OSError):
+            self._teardown()
+        return False
 
     async def close(self):
         # Push out coalesced frames before tearing down — a notify()
@@ -1304,10 +1698,11 @@ class RpcServer:
     def __init__(self, handlers: Dict[str, Callable], name: str = "server",
                  on_client_close: Callable | None = None,
                  fast_handlers: Dict[str, Callable] | None = None,
-                 auth_token=DEFAULT_TOKEN):
+                 auth_token=DEFAULT_TOKEN, native: bool | None = None):
         self.handlers = handlers
         self.fast_handlers = fast_handlers
         self.name = name
+        self.native = native
         self.auth_token = _resolve_token(auth_token)
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
@@ -1327,7 +1722,8 @@ class RpcServer:
         conn = Connection(self.handlers, name=self.name, on_close=_closed,
                           fast_handlers=self.fast_handlers,
                           auth_token=self.auth_token,
-                          on_connect=self.connections.add)
+                          on_connect=self.connections.add,
+                          native=self.native)
         return _WireProtocol(conn)
 
     async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
@@ -1433,14 +1829,15 @@ class ReconnectingConnection:
 async def connect(address, handlers: Dict[str, Callable] | None = None,
                   retries: int = 10, retry_delay: float = 0.2,
                   name: str = "client", on_close: Callable | None = None,
-                  auth_token=DEFAULT_TOKEN) -> Connection:
+                  auth_token=DEFAULT_TOKEN,
+                  native: bool | None = None) -> Connection:
     """address: (host, port) tuple or unix socket path str."""
     loop = asyncio.get_running_loop()
     send_token = _resolve_token(auth_token)
     last_err: Exception | None = None
     for attempt in range(retries):
         conn = Connection(handlers, name=name, on_close=on_close,
-                          send_token=send_token)
+                          send_token=send_token, native=native)
         try:
             if isinstance(address, str):
                 await loop.create_unix_connection(

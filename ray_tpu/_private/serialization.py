@@ -127,6 +127,58 @@ def write_parts_into(parts, dest: memoryview) -> int:
     return off
 
 
+def copied_get_bytes(value, source: memoryview,
+                     threshold: int = 1 << 12) -> int:
+    """Copy-audit helper for the GET/deserialize path, the mirror of
+    copied_part_bytes: bytes held in large ndarray leaves of `value`
+    that do NOT alias `source` (the shm-arena view the object was
+    deserialized from) — i.e. payload bytes that were COPIED out of the
+    arena instead of travelling as pickle-5 views into it.  The
+    zero-copy get discipline keeps this at 0 for large buffers; tests
+    assert it to catch regressions reintroducing a per-buffer copy on
+    deserialize (small leaves are exempt — pickle may inline them).
+
+    Containers (list/tuple/set/dict) are walked; other objects are
+    ignored (an object owning a large hidden buffer should expose it as
+    an ndarray to be auditable)."""
+    import numpy as np
+    base = np.frombuffer(source, np.uint8)
+    lo = base.ctypes.data
+    hi = lo + base.nbytes
+    total = 0
+    stack = [value]
+    seen: set = set()
+    while stack:
+        v = stack.pop()
+        if id(v) in seen:
+            continue
+        seen.add(id(v))
+        if isinstance(v, np.ndarray):
+            if v.nbytes > threshold:
+                ptr = v.__array_interface__["data"][0]
+                span = v.nbytes if v.flags["C_CONTIGUOUS"] else None
+                if span is None:
+                    # Strided view: judge by its base allocation.
+                    b = v
+                    while b.base is not None and isinstance(b.base,
+                                                            np.ndarray):
+                        b = b.base
+                    ptr = b.__array_interface__["data"][0]
+                    span = b.nbytes
+                if not (lo <= ptr and ptr + span <= hi):
+                    total += v.nbytes
+        elif isinstance(v, (bytes, bytearray)):
+            # bytes always materialize on unpickle; only count big ones
+            # (they should have travelled out-of-band as buffers).
+            if len(v) > threshold:
+                total += len(v)
+        elif isinstance(v, (list, tuple, set, frozenset)):
+            stack.extend(v)
+        elif isinstance(v, dict):
+            stack.extend(v.values())
+    return total
+
+
 def copied_part_bytes(parts, threshold: int = 1 << 12) -> int:
     """Copy-audit helper: bytes held in materialized `bytes` parts above
     `threshold` — i.e. payload bytes that were COPIED out of their source

@@ -447,6 +447,85 @@ def bench_weight_broadcast_gigabytes(min_time_s: float, mb: int = 64,
         del ref
 
 
+def _bench_framer(native: bool, min_time_s: float, bulk: bool,
+                  mb: int = 8, batch: int = 256) -> float:
+    """Loopback micro-bench of the RPC framer itself, no cluster: one
+    server + one client Connection on 127.0.0.1 with the framer forced
+    native or pure-Python.  bulk=True measures GiB/s of raw out-of-band
+    payload pulls (call_raw scattering into a preallocated destination —
+    the fetch_chunk shape); bulk=False measures frames/s of batched
+    small request/response waves (the submit_batch shape).  The
+    native-vs-python pair is the acceptance gate on memcpy-bound hosts
+    where end-to-end put_gigabytes saturates the box's copy bandwidth
+    regardless of framing (see docs/data_plane.md)."""
+    import asyncio
+
+    from ray_tpu._private import rpc as rpc_mod
+    from ray_tpu._private import rpcframe
+
+    if native and not rpcframe.available():
+        return 0.0
+
+    async def run():
+        payload = np.random.default_rng(0).bytes(mb << 20) if bulk else b""
+
+        async def h_fetch(conn, p):
+            return rpc_mod.RawPayload([memoryview(payload)])
+
+        def f_ping(conn, p):
+            return p
+
+        srv = rpc_mod.RpcServer({"fetch": h_fetch}, name="framer-bench",
+                                fast_handlers={"ping": f_ping},
+                                auth_token=None, native=native)
+        addr = await srv.start_tcp("127.0.0.1", 0)
+        conn = await rpc_mod.connect(tuple(addr), auth_token=None,
+                                     native=native)
+        try:
+            dest = bytearray(len(payload)) if bulk else None
+            if bulk:
+                async def one():
+                    n = await conn.call_raw("fetch", {},
+                                            memoryview(dest), timeout=60)
+                    assert n == len(payload)
+                    return 1
+            else:
+                async def one():
+                    await asyncio.gather(*conn.call_many(
+                        "ping", list(range(batch))))
+                    return batch
+            await one()                             # warmup
+            t0 = time.perf_counter()
+            ops = 0
+            while True:
+                ops += await one()
+                dt = time.perf_counter() - t0
+                if dt >= min_time_s:
+                    break
+            return (ops * mb / 1024.0 / dt) if bulk else ops / dt
+        finally:
+            await conn.close()
+            await srv.close()
+
+    return asyncio.run(run())
+
+
+def bench_framer_bulk_native(min_time_s):
+    return _bench_framer(True, min_time_s, bulk=True)
+
+
+def bench_framer_bulk_python(min_time_s):
+    return _bench_framer(False, min_time_s, bulk=True)
+
+
+def bench_framer_frames_native(min_time_s):
+    return _bench_framer(True, min_time_s, bulk=False)
+
+
+def bench_framer_frames_python(min_time_s):
+    return _bench_framer(False, min_time_s, bulk=False)
+
+
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     from ray_tpu.util import placement_group, remove_placement_group
 
@@ -478,6 +557,13 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "single_client_wait_1k_refs": bench_wait_many_refs,
     "single_client_get_object_containing_10k_refs": bench_get_containing_10k_refs,
     "placement_group_create_removal": bench_pg_create_removal,
+    # Framer micro-bench (no cluster involvement — a private loopback
+    # connection pair): the native-vs-python A/B of the wire hot path,
+    # reported in the bench tail and the gate on memcpy-bound hosts.
+    "framer_bulk_gibs_native": bench_framer_bulk_native,
+    "framer_bulk_gibs_python": bench_framer_bulk_python,
+    "framer_frames_per_s_native": bench_framer_frames_native,
+    "framer_frames_per_s_python": bench_framer_frames_python,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
     "internode_pull_gigabytes": bench_internode_pull_gigabytes,
@@ -501,6 +587,14 @@ BASELINE = {
     "single_client_wait_1k_refs": 4.4,
     "single_client_get_object_containing_10k_refs": 11.3,
     "placement_group_create_removal": 666.0,
+    # Framer micro-bench anchors: the reference host's loopback raw-pull
+    # and batched-frame rates are not published, so these are the
+    # committed BENCH_r05-era host-class numbers — vs_ref on them reads
+    # as "vs the last recorded run", not vs the 64-core reference.
+    "framer_bulk_gibs_native": 1.0,
+    "framer_bulk_gibs_python": 0.65,
+    "framer_frames_per_s_native": 37000.0,
+    "framer_frames_per_s_python": 37000.0,
     # 1 GiB to 50+ nodes in 14.8 s (BASELINE.md scalability row) ≈ 3.4
     # GiB/s of per-node pull bandwidth on the reference's network.
     "internode_pull_gigabytes": 3.4,
@@ -512,6 +606,10 @@ BASELINE = {
 UNITS = {
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
+    "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
+    "framer_bulk_gibs_python": "GiB/s (loopback raw pull)",
+    "framer_frames_per_s_native": "frames/s (batched waves)",
+    "framer_frames_per_s_python": "frames/s (batched waves)",
     "internode_pull_gigabytes": "GiB/s",
     "weight_broadcast_gigabytes": "GiB/s (aggregate 1→3)",
     "single_client_wait_1k_refs": "waits/s (1k refs)",
@@ -537,13 +635,17 @@ CONTROL_PLANE_METRICS = (
 )
 
 # Data-plane throughput metrics gated alongside the control-plane ones:
-# the agent→agent pull leg and the 1→N swarm broadcast.  Higher is
-# better, same ratio discipline; a 0.0 reading means the bench couldn't
-# run in this environment (agent spawn failure) and is reported but
-# never gated on.
+# the bulk-byte put paths, the agent→agent pull leg, the 1→N swarm
+# broadcast, and the framer's own loopback GiB/s.  Higher is better,
+# same ratio discipline; a 0.0 reading means the bench couldn't run in
+# this environment (agent spawn failure, extension unavailable) and is
+# reported but never gated on.
 DATA_PLANE_METRICS = (
+    "single_client_put_gigabytes",
+    "multi_client_put_gigabytes",
     "internode_pull_gigabytes",
     "weight_broadcast_gigabytes",
+    "framer_bulk_gibs_native",
 )
 
 
@@ -692,6 +794,16 @@ def run_microbenchmarks(min_time_s: float = 1.0,
     results: Dict[str, Dict[str, Any]] = {}
     for name, fn in BENCHES.items():
         if only and name not in only:
+            continue
+        if name.startswith("framer_"):
+            # Loopback-only micro bench: no cluster involvement, so the
+            # quiesce/warmup dance below would be pure dead time.
+            rate = fn(min_time_s)
+            results[name] = {
+                "value": round(rate, 2),
+                "unit": UNITS.get(name, "ops/s"),
+                "vs_ref": round(rate / BASELINE[name], 3),
+            }
             continue
         # Quiesce: let the previous bench's lease returns / worker
         # respawns finish so its cleanup doesn't steal CPU from this

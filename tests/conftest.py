@@ -54,12 +54,81 @@ def pytest_configure(config):
         "with -m 'not slow'")
     config.addinivalue_line(
         "markers",
+        "native_framer: needs the _rpcframe.so C extension; skipped "
+        "(never a collection failure) when no compiler can build it")
+    config.addinivalue_line(
+        "markers",
         "chaos: fault-injection tests (process kills / RPC drops / link "
         "latency+partitions); guarded by a per-test wall-clock watchdog "
         "(RAY_TPU_CHAOS_WATCHDOG_S, default 180) that dumps every "
         "thread/task stack and fails the test instead of hanging; the "
         "long soaks are additionally marked slow — run them with "
         "-m 'chaos and slow'")
+    # Build the native RPC framer ONCE at session start so worker/agent
+    # processes spawned by cluster fixtures just dlopen the committed or
+    # freshly-built .so instead of racing g++ builds.  Failure is fine:
+    # the runtime falls back to the pure-Python framer and the tests
+    # marked native_framer skip themselves.
+    try:
+        from ray_tpu._private import rpcframe
+        rpcframe.ensure_built()
+    except Exception:
+        pass
+
+
+_FRAMER_PARITY_MODULES = ("test_data_plane", "test_replica_plane",
+                          "test_submit_batching")
+
+
+def pytest_generate_tests(metafunc):
+    """Framer parity harness (opt-in, RAY_TPU_FRAMER_PARITY=1): run the
+    data-plane, replica-plane and submit-batching suites under BOTH
+    rpc_native_framer modes.  Off by default — the doubled runtime does
+    not fit the tier-1 budget; tier-1 covers the native default plus the
+    dedicated parity/fallback tests in test_rpc_framer.py.
+
+    framer_parity_mode is AUTOUSE (so it is always in fixturenames —
+    injecting names here is not supported on modern pytest) and a no-op
+    unless this hook parametrizes it."""
+    if not os.environ.get("RAY_TPU_FRAMER_PARITY"):
+        return
+    mod = metafunc.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _FRAMER_PARITY_MODULES:
+        return
+    metafunc.parametrize("framer_parity_mode", ["native", "python"],
+                         indirect=True)
+
+
+@pytest.fixture(autouse=True)
+def framer_parity_mode(request):
+    """Force the RPC framer mode for one test (driver process +
+    RAY_TPU_rpc_native_framer env inherited by every daemon the test's
+    cluster fixture spawns).  Unparametrized (the default, parity
+    harness off) it does nothing."""
+    mode = getattr(request, "param", None)
+    if mode is None:
+        yield None
+        return
+    from ray_tpu._private import rpc as rpc_mod
+    prev_env = os.environ.get("RAY_TPU_rpc_native_framer")
+    os.environ["RAY_TPU_rpc_native_framer"] = \
+        "1" if mode == "native" else "0"
+    rpc_mod.enable_native_framer(mode == "native")
+    # A shared cluster initialized by an EARLIER test keeps its daemons'
+    # (and the driver connections') original framer mode — tear it down
+    # so this test's cluster fixture re-inits under the forced mode
+    # (parity must reach the whole cluster, not just new connections).
+    import ray_tpu as _rt
+    if _rt.is_initialized():
+        _rt.shutdown()
+    try:
+        yield mode
+    finally:
+        rpc_mod.enable_native_framer(None)
+        if prev_env is None:
+            os.environ.pop("RAY_TPU_rpc_native_framer", None)
+        else:
+            os.environ["RAY_TPU_rpc_native_framer"] = prev_env
 
 
 class ChaosWatchdogTimeout(BaseException):

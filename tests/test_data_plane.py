@@ -22,7 +22,8 @@ import pytest
 import ray_tpu
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc
-from ray_tpu._private.serialization import (copied_part_bytes, get_context,
+from ray_tpu._private.serialization import (copied_get_bytes,
+                                            copied_part_bytes, get_context,
                                             write_parts_into)
 
 CHUNK = 256 * 1024          # small transfer chunk so tests straddle it fast
@@ -51,6 +52,32 @@ def test_serialize_keeps_large_buffers_as_views():
                for p in parts)
     # the audit helper does flag materialized copies
     assert copied_part_bytes([bytes(1 << 20)]) == 1 << 20
+
+
+def test_copied_get_bytes_audits_the_deserialize_path():
+    """Get-side mirror of the put copy-audit: buffers deserialized from
+    a source view count 0 when they alias it, full size when copied."""
+    ctx = get_context()
+    arr = np.arange(1 << 20, dtype=np.uint8)
+    parts = ctx.serialize({"a": arr, "small": b"x" * 10})
+    blob = bytearray(ctx.total_size(parts))
+    write_parts_into(parts, memoryview(blob))
+    src = memoryview(blob)
+    out = ctx.deserialize(src)
+    # pickle-5 buffers are views into the source: zero copied bytes.
+    assert copied_get_bytes(out, src) == 0
+    # A materialized copy of the same value is fully counted.
+    assert copied_get_bytes({"a": arr.copy()}, src) == arr.nbytes
+
+
+def test_get_returns_arena_views_not_copies(chunked_cluster):
+    """Large gets deserialize as views into the shm arena: the result
+    array must be READ-ONLY (a copy would be writable) — the get-path
+    copies-per-chunk regression pin."""
+    arr = np.arange(2 * CHUNK + 17, dtype=np.uint8)
+    got = ray_tpu.get(ray_tpu.put(arr), timeout=60)
+    assert np.array_equal(got, arr)
+    assert not got.flags.writeable
 
 
 def test_write_parts_into_single_pass_roundtrip():
