@@ -218,14 +218,37 @@ def get_runtime_context() -> _RuntimeContext:
     return _RuntimeContext()
 
 
-def timeline(filename: Optional[str] = None):
+def timeline(filename: Optional[str] = None,
+             job_id: Optional[bytes] = None,
+             align: bool = True):
     """Chrome-trace dump of task execution (reference: ray.timeline →
     _private/state.py:441 chrome_tracing_dump over GCS task events).
-    Load the result in chrome://tracing or Perfetto."""
+    Load the result in chrome://tracing or Perfetto.
+
+    `align=True` (default) corrects every event into the GCS clock
+    frame using the per-node offsets estimated by the health-loop
+    probes, so cross-node spans nest causally (driver SUBMITTED before
+    remote RUNNING) instead of reflecting raw host-clock disagreement.
+    `job_id` filters to one job's events."""
     import json
-    from ._private.timeline import chrome_trace_events
+    from ._private.timeline import (chrome_trace_events,
+                                    offsets_from_node_views)
     raw = _core().gcs_call("get_task_events", {"limit": 100_000})
-    events = chrome_trace_events(raw)
+    if job_id:
+        # Client-side filter keeping job-UNATTRIBUTED rows: plane-level
+        # flight-recorder spans (lease/transfer) and agent events
+        # (PREFETCH) carry no job id, and a job trace with its transfer
+        # spans silently removed would misread as "no data movement".
+        raw = [e for e in raw
+               if e.get("job_id") in (job_id, b"", None)]
+    offsets = None
+    if align:
+        try:
+            offsets = offsets_from_node_views(
+                _core().gcs_call("get_nodes", {}))
+        except Exception:
+            offsets = None      # alignment is best-effort, never fatal
+    events = chrome_trace_events(raw, offsets=offsets)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -30,7 +30,8 @@ import sys
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from . import protocol, rpc
+from . import clocks, protocol, rpc
+from . import flight_recorder as frec
 from .config import Config, get_config, set_config
 from .ids import NodeID, WorkerID
 from .shm_store import (ObjectExistsError, ShmStore, SpillTruncatedError,
@@ -249,6 +250,15 @@ class NodeAgent:
         self._hedge_budget_frac = cfg.pull_hedge_budget_fraction
         self._hedge_total = 0
         self._hedge_used = 0
+        # Flight-recorder rows whose flush notify failed, kept for the
+        # next heartbeat tick (bounded at ring capacity; overflow folds
+        # into the recorder's drop counter — no silent loss).
+        self._frec_retry: List[dict] = []
+        # Drop-accounting reporter key: PROCESS-stable, deliberately not
+        # node_id — a fresh-id rejoin (_rejoin_with_fresh_id) would
+        # otherwise re-report the same cumulative drop count under a new
+        # key and the GCS would double-count it.
+        self._telemetry_src = os.urandom(8)
         # Parked lease requests: (params, conn, reply_future, deadline),
         # FIFO-granted by _parked_lease_loop as resources free (reference:
         # ClusterLeaseManager's lease queue).
@@ -320,7 +330,13 @@ class NodeAgent:
             "node_info": self.h_node_info,
             "store_stats": self.h_store_stats,
             "list_objects": self.h_list_objects,
-            "ping": lambda conn, p: "pong",
+            # Timestamped ping: the GCS health probe doubles as the
+            # clock-alignment probe (NTP t1/t2 server stamps; clocks.wall
+            # so injected chaos skew is visible to the estimator exactly
+            # like a genuinely off host clock).  Value is ignored by
+            # plain liveness callers.
+            "ping": lambda conn, p: {"pong": True, "t1": clocks.wall(),
+                                     "t2": clocks.wall()},
             "worker_fate": self.h_worker_fate,
             "worker_blocked": self.h_worker_blocked,
             "worker_unblocked": self.h_worker_unblocked,
@@ -419,7 +435,17 @@ class NodeAgent:
                         # column).
                         "transfer": {"bytes_served": self._bytes_served,
                                      "bytes_pulled": self._bytes_pulled},
+                        # Runtime gauges for the node view (CLI summary /
+                        # dashboard node table); the metrics flush below
+                        # exports the same numbers as node-labeled
+                        # series.
+                        "runtime": self._runtime_stats(),
                     })
+                    # Flight-recorder + metrics flush rides THIS tick —
+                    # the batching discipline: no new per-event RPCs,
+                    # one notify per heartbeat when there is anything
+                    # to ship.
+                    self._flush_telemetry()
                     if ok is False and not self._shutdown \
                             and self._draining is None:
                         # Rejected = we're listed dead.  (Never during a
@@ -454,6 +480,91 @@ class NodeAgent:
                 # never kill the loop: a dead report loop freezes this
                 # node's resource view at the GCS and starves scheduling.
                 pass
+
+    # ----------------------------------------------------- telemetry -------
+    def _runtime_stats(self) -> Dict[str, float]:
+        """Small gauge set riding the heartbeat into the node view."""
+        try:
+            st = self.store.stats()
+        except Exception:
+            st = {}
+        return {
+            "lease_queue_depth": float(len(self._parked_leases)),
+            "active_leases": float(len(self.leases)),
+            "num_workers": float(len(self.workers)),
+            "arena_used_bytes": float(st.get("bytes_in_use", 0)),
+            "arena_capacity_bytes": float(st.get("capacity", 0)),
+        }
+
+    def _flush_telemetry(self) -> None:
+        """Ship buffered flight-recorder rows + this daemon's metric
+        snapshot to the GCS sinks.  Fire-and-forget notifies on the
+        existing GCS connection, sent at heartbeat rate — the recorder
+        ring absorbs bursts between ticks and counts what it sheds.
+        A failed notify keeps the drained rows for the next tick
+        (bounded; overflow is COUNTED via note_lost, never silent)."""
+        if self.gcs is None or self.gcs.closed:
+            return
+        rec = frec.recorder()
+        rows = self._frec_retry + rec.drain(node_id=self.node_id)
+        self._frec_retry = []
+        if rows:
+            try:
+                self.gcs.notify("task_events", {
+                    "blob": rpc._pack(rows), "n": len(rows),
+                    "src": self._telemetry_src, "dropped": rec.dropped})
+            except rpc.RpcError:
+                keep = rows[-rec.capacity:]
+                rec.note_lost(len(rows) - len(keep))
+                self._frec_retry = keep
+        if not get_config().metrics_export_enabled:
+            return
+        try:
+            self.gcs.notify("report_metrics", {
+                "worker_id": self.node_id,
+                "node_id": self.node_id,
+                "metrics": self._metrics_snapshot()})
+        except rpc.RpcError:
+            pass
+
+    def _metrics_snapshot(self) -> List[dict]:
+        """This daemon's registry + runtime gauges as metric rows
+        (unified export: the same shape util.metrics snapshots use, so
+        the GCS merges user and runtime series through one sink)."""
+        from ..util import metrics as _metrics
+        now = time.time()
+        # node_id is stamped at the SOURCE (not injected at the GCS) so
+        # runtime series are per-node while user metrics keep whatever
+        # label set their authors chose (a silently injected label
+        # would change user series identity).
+        lab = {"daemon": "agent", "node_id": self.node_id.hex()}
+
+        def row(name, value, typ="gauge", help_="", labels=None):
+            return {"name": name, "type": typ, "help": help_, "ts": now,
+                    "labels": labels or lab, "value": float(value)}
+
+        rt = self._runtime_stats()
+        out = [
+            row("ray_tpu_arena_used_bytes", rt["arena_used_bytes"],
+                help_="shm arena bytes in use"),
+            row("ray_tpu_arena_capacity_bytes",
+                rt["arena_capacity_bytes"]),
+            row("ray_tpu_lease_queue_depth", rt["lease_queue_depth"],
+                help_="parked (queued) lease requests"),
+            row("ray_tpu_active_leases", rt["active_leases"]),
+            row("ray_tpu_node_workers", rt["num_workers"]),
+            row("ray_tpu_transfer_served_bytes_total",
+                self._bytes_served, "counter"),
+            row("ray_tpu_transfer_pulled_bytes_total",
+                self._bytes_pulled, "counter"),
+        ]
+        # Common per-process rows (io_stats, copy audit, recorder
+        # counters): shared with the core worker's export so the two
+        # cannot diverge.
+        out.extend(frec.export_rows(lab))
+        # User/util metrics registered inside this process ride along.
+        out.extend(_metrics.registry_snapshot())
+        return out
 
     async def _reap_loop(self):
         """Detect dead worker processes, release their leases, tell GCS about
@@ -684,6 +795,12 @@ class NodeAgent:
             # degrades its workers the same way (the whole host shares
             # the gray NIC).
             env.setdefault("RAY_TPU_link_chaos", link_spec)
+        skew = get_config().clock_skew_s
+        if skew:
+            # Skewed-NODE mode: processes on one host share the system
+            # clock, so an injected skew must reach the workers too or
+            # the node's own telemetry would disagree with itself.
+            env.setdefault("RAY_TPU_clock_skew_s", str(skew))
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
@@ -898,18 +1015,31 @@ class NodeAgent:
         node_manager.cc:1776; the raylet's ClusterLeaseManager queues
         leases and the RPC replies on grant, it never tells a feasible
         client to poll)."""
+        rec = frec.recorder()
+        t0 = rec.begin()
         if not (self._parked_leases and not p.get("placement_group")):
             # Fast path only while nobody is parked: a fresh request must
             # not jump the FIFO, or a stream of small shapes starves a
             # parked large one forever (the drain loop grants in order).
             res = await self._try_grant_lease(conn, p)
             if res is not None:
+                if isinstance(res, dict) and res.get("granted"):
+                    rec.end("lease", "lease:grant", t0,
+                            id=res.get("lease_id") or b"")
                 return res
+        # Queued: the span covers queued -> granted (or refused), with
+        # the queue depth at park time — the lease-lifecycle leg of the
+        # flight recorder (prefetch/push/RUNNING continue it).
+        depth = len(self._parked_leases)
         fut = asyncio.get_running_loop().create_future()
         deadline = time.monotonic() + float(p.get("max_park_s", 60.0))
         self._parked_leases.append((p, conn, fut, deadline))
         self._kick_parked()
-        return await fut
+        res = await fut
+        if isinstance(res, dict) and res.get("granted"):
+            rec.end("lease", "lease:queued", t0,
+                    id=res.get("lease_id") or b"", depth=depth)
+        return res
 
     async def _try_grant_lease(self, conn, p):
         """One grant attempt. Returns a reply dict, or None when the
@@ -1035,11 +1165,12 @@ class NodeAgent:
 
     async def _prefetch_one(self, oid: bytes, locs, owner) -> None:
         try:
-            await self.h_pull_object(None, {
-                "object_id": oid,
-                "from_addrs": [list(a) for a in locs or ()],
-                "owner_addr": list(owner) if owner else None,
-                "priority": 2})
+            with frec.recorder().span("lease", "prefetch", id=oid):
+                await self.h_pull_object(None, {
+                    "object_id": oid,
+                    "from_addrs": [list(a) for a in locs or ()],
+                    "owner_addr": list(owner) if owner else None,
+                    "priority": 2})
         except Exception:
             # Best-effort: the task's own arg resolution retries and,
             # failing that, the owner-mediated fetch path decides.
@@ -1051,7 +1182,7 @@ class NodeAgent:
         try:
             self.gcs.notify("task_events", {"events": [{
                 "task_id": task_id, "name": "", "event": event,
-                "ts": time.time(), "worker_id": b"",
+                "ts": clocks.wall(), "worker_id": b"",
                 "node_id": self.node_id, "job_id": b""}]})
         except rpc.RpcError:
             pass
@@ -2144,12 +2275,19 @@ class NodeAgent:
                 raise
         fut = asyncio.get_running_loop().create_future()
         self._pull_inflight[oid] = (fut, deadline)
+        rec = frec.recorder()
+        t0 = rec.begin()
         try:
             ok = await self._do_pull(oid, addrs,
                                      p.get("priority", 0),
                                      p.get("timeout_ms", 10000),
                                      deadline=deadline, owner=owner)
             fut.set_result(ok)
+            # Object-transfer timeline: one span per pull, start -> commit
+            # (chunk-wave/hedge events nest inside it, keyed by the same
+            # object id).
+            rec.end("transfer", "pull", t0, id=oid, ok=bool(ok),
+                    sources=self._last_pull_sources)
             return ok
         except Exception as e:
             fut.set_exception(e)
@@ -2335,6 +2473,8 @@ class NodeAgent:
                         return True
                     return False
                 staging = memoryview(bytearray(n))
+                frec.recorder().instant("transfer", "hedge_fired",
+                                        id=oid, offset=pos)
                 t2 = rpc.spawn(try_peer(backup, pos, n, staging,
                                         budget_timeout()))
                 winner = None
@@ -2709,6 +2849,11 @@ class NodeAgent:
             self._replica_owner[oid] = tuple(owner)
             addrs = await self._merge_owner_locations(oid, addrs, owner,
                                                       register=True)
+            # Swarm source set resolved (directory register-and-query):
+            # the width here vs the caller's hint is the broadcast's
+            # fan-in signature in the timeline.
+            frec.recorder().instant("transfer", "swarm_sources", id=oid,
+                                    sources=len(addrs))
         try:
             ok = await self._pull_into_node(oid, addrs, priority,
                                             timeout_ms, deadline, owner)
@@ -2785,10 +2930,16 @@ class NodeAgent:
 
             ok = False
             try:
-                await self._stream_chunks(
-                    peers, oid, size,
-                    make_sink=lambda pos, n: buf[pos:pos + n],
-                    deadline=deadline, on_chunk=on_chunk)
+                # Chunk-wave span: strictly inside this pull's
+                # start/commit span (the cross-node nesting property the
+                # alignment test asserts).
+                with frec.recorder().span("transfer", "chunks", id=oid,
+                                          bytes=size,
+                                          sources=len(peers)):
+                    await self._stream_chunks(
+                        peers, oid, size,
+                        make_sink=lambda pos, n: buf[pos:pos + n],
+                        deadline=deadline, on_chunk=on_chunk)
                 ok = True
             except NodeAgent._ObjectGone:
                 return False
@@ -2807,6 +2958,8 @@ class NodeAgent:
                     self.store.abort(oid)
             self.store.seal(oid)
             self.store.release(oid)
+            frec.recorder().instant("transfer", "commit", id=oid,
+                                    bytes=size)
             # Sealed into the store before the partial record drops:
             # a peer's fetch_chunk always finds one of the two.
             self._partial.pop(oid, None)

@@ -1,0 +1,120 @@
+"""Cluster clock plumbing: skew-injectable wall clock + NTP-style
+offset estimation.
+
+Every telemetry stamp in the runtime (task events, flight-recorder
+spans, the agent's probe-reply timestamps) goes through `wall()` instead
+of `time.time()`, for two reasons:
+
+1. **Chaos injection.** The config knob `clock_skew_s` shifts this
+   process's notion of wall time, so tests can build a cluster whose
+   nodes disagree about "now" — the condition every real multi-host
+   trace lives under — and assert the alignment machinery below actually
+   repairs it.  Per-node `_system_config` reaches the agent's argv and
+   the agent forwards it to its workers' env, so one `add_node(...,
+   _system_config={"clock_skew_s": -5})` skews a whole node coherently
+   (all processes on one host share the system clock; the skew model
+   matches).
+
+2. **One choke point.** When alignment eventually wants a disciplined
+   clock (e.g. folding the GCS-estimated offset back into local stamps)
+   there is exactly one function to teach.
+
+Offset estimation is the classic NTP sample (reference: RFC 5905 §8;
+Ray itself punts on this — its timeline mixes raw per-host clocks,
+which is exactly the artifact PAPER.md's `ray timeline` shows at
+scale): for a probe sent at t0 (local), received remotely at t1, echoed
+at t2, and answered at t3 (local),
+
+    theta = ((t1 - t0) + (t2 - t3)) / 2        # remote - local
+    rtt   = (t3 - t0) - (t2 - t1)
+
+theta's error is bounded by the path ASYMMETRY (|err| <= rtt/2), so the
+estimator keeps a short window of samples and trusts the minimum-RTT
+one — delay spikes inflate rtt, and the bound with it, while the
+lowest-rtt sample of a window is the closest to symmetric the link
+offered (same discipline as NTP's clock filter / Huygens' coded
+probes).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+_skew: Optional[float] = None
+
+
+def _resolve_skew() -> float:
+    global _skew
+    if _skew is None:
+        try:
+            from .config import get_config
+            _skew = float(get_config().clock_skew_s)
+        except Exception:
+            _skew = 0.0
+    return _skew
+
+
+def reset_skew() -> None:
+    """Re-read `clock_skew_s` from config on next use (tests)."""
+    global _skew
+    _skew = None
+
+
+def wall() -> float:
+    """This process's wall clock, including any injected chaos skew.
+    All telemetry timestamps come from here so an injected skew shifts
+    them coherently — exactly like a host whose clock is off."""
+    s = _resolve_skew()
+    return time.time() + s if s else time.time()
+
+
+def mono_ns() -> int:
+    """Monotonic nanoseconds — the flight recorder's stamp (immune to
+    wall-clock steps; converted to wall time only at flush)."""
+    return time.monotonic_ns()
+
+
+def ntp_sample(t0: float, t1: float, t2: float,
+               t3: float) -> Tuple[float, float]:
+    """One NTP four-timestamp sample -> (theta, rtt).
+
+    theta > 0 means the REMOTE clock is ahead of the local one; rtt is
+    the round trip net of remote processing time.  |theta error| is
+    bounded by rtt/2 (worst-case fully-asymmetric path)."""
+    theta = ((t1 - t0) + (t2 - t3)) / 2.0
+    rtt = max(0.0, (t3 - t0) - (t2 - t1))
+    return theta, rtt
+
+
+class OffsetEstimator:
+    """Smoothed per-peer clock offset from repeated NTP samples.
+
+    Keeps the last `window` samples and reports the theta of the
+    minimum-RTT one (NTP clock-filter discipline), smoothed with a
+    light EMA so a single lucky/unlucky probe can't step the estimate.
+    `error_bound()` is the min-RTT/2 asymmetry bound — consumers that
+    compare cross-node timestamps tighter than this are fooling
+    themselves, and the docs say so."""
+
+    def __init__(self, window: int = 8, alpha: float = 0.4):
+        self._samples: deque = deque(maxlen=max(2, window))
+        self._alpha = alpha
+        self.offset: Optional[float] = None     # smoothed remote - local
+        self.last_ts: float = 0.0               # monotonic of last add
+
+    def add(self, t0: float, t1: float, t2: float, t3: float) -> float:
+        theta, rtt = ntp_sample(t0, t1, t2, t3)
+        self._samples.append((rtt, theta))
+        best_theta = min(self._samples)[1]
+        self.offset = best_theta if self.offset is None else (
+            (1 - self._alpha) * self.offset + self._alpha * best_theta)
+        self.last_ts = time.monotonic()
+        return self.offset
+
+    def error_bound(self) -> Optional[float]:
+        """Half the best observed RTT: the asymmetry bound on `offset`."""
+        if not self._samples:
+            return None
+        return min(self._samples)[0] / 2.0

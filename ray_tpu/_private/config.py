@@ -238,6 +238,40 @@ _define("gcs_task_events_max", 100_000,
         "(reference: RAY_task_events_max_num_task_in_gcs)")
 _define("log_rotation_bytes", 100 * 1024 * 1024)
 
+# ---- observability: flight recorder + clock alignment -----------------------
+_define("flight_recorder_enabled", True,
+        "per-process flight recorder: preallocated ring of plane-level "
+        "events (lease lifecycle, object-transfer timelines) flushed "
+        "over the existing heartbeat/telemetry batching — no new "
+        "per-event RPCs (reference: Ray's task_event_buffer + "
+        "src/ray/util/event.h bounded in-memory event rings)")
+_define("flight_recorder_capacity", 4096,
+        "flight-recorder ring slots per process; overflow drops the "
+        "OLDEST record and counts it (exported as "
+        "ray_tpu_flight_recorder_dropped_total)")
+_define("flight_recorder_categories", "",
+        "comma-separated category gate for the flight recorder "
+        "(lease,transfer,sched); empty = all categories on")
+_define("flight_recorder_sample_n", 1,
+        "record 1 of every N instant events per category (spans are "
+        "never sampled away); 1 = record everything")
+_define("clock_align_enabled", True,
+        "estimate per-node wall-clock offsets from the GCS health-loop "
+        "RTT probes (NTP-style theta = ((t1-t0)+(t2-t3))/2, min-RTT "
+        "filtered + smoothed), stamp them into node views, and apply "
+        "them in timeline rendering so cross-node spans nest correctly")
+_define("clock_skew_s", 0.0,
+        "CHAOS: shift this process's telemetry wall clock by this many "
+        "seconds (clocks.wall()).  Set per node via _system_config to "
+        "fake disagreeing host clocks; the agent forwards it to its "
+        "workers' env so the whole node skews coherently")
+_define("metrics_export_enabled", True,
+        "ship each daemon's util.metrics registry + runtime gauges "
+        "(arena occupancy, lease queue depth, io_stats, copy-audit, "
+        "recorder drops) to the GCS on its heartbeat/telemetry tick; "
+        "the dashboard /metrics exposition then carries node_id-labeled "
+        "series for every node")
+
 # ---- TPU specifics ----------------------------------------------------------
 _define("tpu_chips_per_host_default", 4)
 _define("tpu_visible_chips_env", "TPU_VISIBLE_CHIPS")

@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import exceptions as exc
 from ..object_ref import ObjectRef
-from . import deadlines, protocol, rpc
+from . import clocks, deadlines, protocol, rpc
+from . import flight_recorder as frec
 from .config import get_config
 from .ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
                   fast_actor_task_id)
@@ -221,8 +222,14 @@ class CoreWorker:
         self._registering: Dict[bytes, asyncio.Future] = {}
         # Task status/profile events, flushed to the GCS sink periodically
         # (reference: core_worker/task_event_buffer.h:297 AddTaskEvent /
-        # FlushEvents). Bounded: drops oldest under pressure.
+        # FlushEvents). Bounded: drops oldest under pressure — counted,
+        # reported with every flush (no silent caps).
         self._task_events: deque = deque(maxlen=10000)
+        self._task_events_dropped = 0
+        # Flight-recorder rows whose flush notify failed, kept for the
+        # next telemetry tick (bounded at ring capacity; overflow folds
+        # into the recorder's drop counter — no silent loss).
+        self._frec_retry: List[dict] = []
         self._seq_lock = threading.Lock()   # seq/put-id minting, any thread
         # Cross-thread submission mailbox: caller threads append closures
         # and schedule ONE loop wakeup per burst instead of one
@@ -652,9 +659,17 @@ class CoreWorker:
                           **extra):
         """Buffer one task status/profile event; any thread. Stored as a
         tuple — the flush loop expands to the wire dict, so the per-call
-        hot path pays one append instead of a 7-key dict build."""
+        hot path pays one append instead of a 7-key dict build.
+
+        Stamps clocks.wall() (skew-injectable) so cross-node alignment
+        applies to these events like every other telemetry source.  The
+        deque's silent oldest-drop is counted: the total rides every
+        flush to the GCS sink, which surfaces it through the state API
+        instead of presenting a truncated stream as complete."""
+        if len(self._task_events) == self._task_events.maxlen:
+            self._task_events_dropped += 1
         self._task_events.append(
-            (task_id, name, event, time.time(), extra or None))
+            (task_id, name, event, clocks.wall(), extra or None))
 
     async def _telemetry_flush_loop(self):
         """Periodic push of buffered task events + metric deltas to the
@@ -662,9 +677,14 @@ class CoreWorker:
         metrics_agent)."""
         from ..util import metrics as _metrics
         interval = get_config().task_event_flush_interval_s
+        export_metrics = get_config().metrics_export_enabled
         while not self._shutdown:
             await asyncio.sleep(interval)
-            if self._task_events:
+            recorder_rows = self._frec_retry + frec.recorder().drain(
+                node_id=self.node_id or b"",
+                worker_id=self.worker_id or b"")
+            self._frec_retry = []
+            if self._task_events or recorder_rows:
                 raw = []
                 while self._task_events:
                     raw.append(self._task_events.popleft())
@@ -678,6 +698,9 @@ class CoreWorker:
                     if extra:
                         rec.update(extra)
                     batch.append(rec)
+                # Flight-recorder rows ride the SAME batched notify —
+                # the no-new-per-event-RPCs discipline.
+                batch.extend(recorder_rows)
                 try:
                     # Pre-packed blob: the GCS stores it opaquely (no
                     # per-event msgpack decode on its loop) and expands
@@ -685,12 +708,31 @@ class CoreWorker:
                     # event stream is ~3 events/call and GCS-side decode
                     # was a measurable share of the core's CPU.
                     self.gcs.notify("task_events", {
-                        "blob": rpc._pack(batch), "n": len(batch)})
+                        "blob": rpc._pack(batch), "n": len(batch),
+                        "src": wid,
+                        "dropped": (self._task_events_dropped
+                                    + frec.recorder().dropped)})
                 except Exception:
                     # Transient GCS outage: put the batch back for the
-                    # next interval (deque maxlen bounds memory).
+                    # next interval (deque maxlen bounds memory), and
+                    # keep the recorder rows too — BOTH bounded, with
+                    # overflow COUNTED (no silent loss): extendleft on
+                    # a full deque evicts from the opposite (newest)
+                    # end, so count what the re-queue itself sheds.
+                    overflow = (len(self._task_events) + len(raw)
+                                - (self._task_events.maxlen or 0))
+                    if overflow > 0:
+                        self._task_events_dropped += min(overflow,
+                                                         len(raw))
                     self._task_events.extendleft(reversed(raw))
+                    cap = frec.recorder().capacity
+                    keep = recorder_rows[-cap:]
+                    frec.recorder().note_lost(
+                        len(recorder_rows) - len(keep))
+                    self._frec_retry = keep
             snap = _metrics.registry_snapshot()
+            if export_metrics:
+                snap = snap + self._runtime_metrics()
             if snap:
                 try:
                     self.gcs.notify("report_metrics", {
@@ -699,6 +741,47 @@ class CoreWorker:
                         "metrics": snap})
                 except Exception:
                     pass
+
+    def _runtime_metrics(self) -> List[dict]:
+        """This process's runtime series for the unified export: RPC
+        io_stats, copy-audit totals, adaptive submit-window sizes, event
+        drop counters.  Same row shape as util.metrics snapshots;
+        node_id is stamped at the source (user metrics keep their own
+        label sets) and the GCS sums counters across reporters."""
+        now = time.time()
+        lab = {"proc": "driver" if self.mode == "driver" else "worker",
+               "node_id": (self.node_id or b"").hex()}
+
+        def row(name, value, typ="counter", help_="", labels=None):
+            return {"name": name, "type": typ, "help": help_, "ts": now,
+                    "labels": labels or lab, "value": float(value)}
+
+        out = [
+            row("ray_tpu_task_events_buffer_dropped_total",
+                self._task_events_dropped,
+                help_="task events dropped by this process's bounded "
+                      "buffer before flush"),
+        ]
+        # Common per-process rows (io_stats, copy audit, recorder
+        # counters): shared with the agent's export so the two cannot
+        # diverge.
+        out.extend(frec.export_rows(lab))
+        # Adaptive submit windows (control-plane pipelining depth, one
+        # per scheduling key): the widest current window is the useful
+        # scalar — it shows whether the pipeline opened up or is pinned
+        # at the floor by backpressure.  pid label: gauges resolve
+        # most-recent-wins per series, so processes sharing a label set
+        # would flap among unrelated windows; distinct series per
+        # submitter (bounded by the GCS's stale-reporter sweep).
+        windows = [k.window for k in self._keys.values()]
+        if windows:
+            wlab = {**lab, "pid": str(os.getpid())}
+            out.append(row("ray_tpu_submit_window_max", max(windows),
+                           "gauge", labels=wlab))
+            out.append(row("ray_tpu_submit_window_mean",
+                           sum(windows) / len(windows), "gauge",
+                           labels=wlab))
+        return out
 
     def _run(self, coro, timeout=None):
         """Run a coroutine from a sync caller thread."""

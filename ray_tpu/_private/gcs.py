@@ -24,7 +24,7 @@ import struct
 import time
 from typing import Any, Dict, List, Optional
 
-from . import protocol, rpc
+from . import clocks, protocol, rpc
 from . import scheduling_policy as policy
 
 logger = logging.getLogger("ray_tpu.gcs")
@@ -99,6 +99,16 @@ class NodeInfo:
         # suspicion score in [0, 1] (EMA'd; see _update_suspicion).
         self.rtt_ema: Optional[float] = None
         self.rtt_ts: float = 0.0        # monotonic of last probe sample
+        # Clock alignment (NTP-style, fed by the same probes): smoothed
+        # estimate of this node's wall clock MINUS the GCS's, min-RTT
+        # filtered (see clocks.OffsetEstimator).  Stamped into node
+        # views so timeline rendering can correct cross-node order, and
+        # exported as the per-node skew gauge.
+        self.clock = clocks.OffsetEstimator()
+        # Runtime gauges off the agent's heartbeat (lease queue depth,
+        # arena occupancy, ...): the CLI summary / dashboard node table
+        # read them from the node view.
+        self.runtime: Dict[str, float] = {}
         self.peer_rtts: Dict[bytes, tuple] = {}
         # reporter node_id -> (bytes_per_s, ts): peers' observed chunk
         # transfer rates FROM this node — the only signal that catches a
@@ -155,6 +165,17 @@ class NodeInfo:
             "rtt_ms": (None if self.rtt_ema is None
                        else round(self.rtt_ema * 1000.0, 2)),
             "transfer": self.transfer,
+            # Clock alignment: this node's wall clock minus the GCS's
+            # (seconds; None until the first successful timestamped
+            # probe), plus the asymmetry error bound — consumers
+            # comparing cross-node stamps tighter than the bound are
+            # reading noise.
+            "clock_offset_s": (None if self.clock.offset is None
+                               else round(self.clock.offset, 6)),
+            "clock_err_bound_s": (
+                None if self.clock.error_bound() is None
+                else round(self.clock.error_bound(), 6)),
+            "runtime": self.runtime,
         }
 
 
@@ -214,8 +235,16 @@ class GcsServer:
         self._te_blobs: _deque = _deque()
         self._te_blob_total = 0
         self._te_blob_max = _gc().gcs_task_events_max
+        # No silent caps: every event this sink evicts (ring overflow,
+        # blob-budget eviction, undecodable blob) is counted, and
+        # reporters' own buffer drops (the worker-side 10k deque)
+        # accumulate per reporter — queries and /metrics surface the
+        # totals so a truncated view is never presented as complete.
+        self.task_events_dropped = 0
+        self._reporter_drops: Dict[bytes, int] = {}
         # (name, labels_tuple) -> {"type", "value"/"sum"/"buckets", ...}
         self.metrics: Dict[tuple, dict] = {}
+        self._metrics_reports = 0   # report count; drives reporter GC
         # Resource demand reported by core workers whose lease requests
         # came back infeasible (reference: autoscaler.proto resource
         # demand in GcsAutoscalerStateManager).  reporter -> shapes+ts.
@@ -265,6 +294,18 @@ class GcsServer:
 
     # ----------------------------------------------------------- telemetry --
     async def h_task_events(self, conn, p):
+        # Reporter-side drop accounting: senders stamp their cumulative
+        # buffer-overflow count ("dropped") and an id ("src"); the sink
+        # keeps the latest per reporter so totals don't double-count.
+        # Bounded against reporter churn by evicting the longest-silent
+        # reporter (move-to-end on re-report): a bounded undercount of
+        # long-dead reporters' drops, never unbounded memory.
+        if p.get("src") is not None and p.get("dropped") is not None:
+            d = self._reporter_drops
+            d.pop(p["src"], None)
+            d[p["src"]] = int(p["dropped"])
+            while len(d) > 8192:
+                d.pop(next(iter(d)))
         blob = p.get("blob")
         if blob is not None:
             # Opaque batch: one bin decode on the RPC frame instead of
@@ -280,8 +321,15 @@ class GcsServer:
                    and len(self._te_blobs) > 1):
                 dn, _ = self._te_blobs.popleft()
                 self._te_blob_total -= dn
+                self.task_events_dropped += dn
             return True
-        self.task_events.extend(p["events"])
+        events = p["events"]
+        overflow = (len(self.task_events) + len(events)
+                    - (self.task_events.maxlen or 0))
+        if overflow > 0:
+            # deque(maxlen) evicts silently on extend; count it.
+            self.task_events_dropped += min(overflow, len(events))
+        self.task_events.extend(events)
         return True
 
     def _expanded_task_events(self):
@@ -292,22 +340,46 @@ class GcsServer:
             self._te_blob_total = 0
             for _n, blob in blobs:
                 try:
-                    self.task_events.extend(rpc._unpack(blob))
+                    rows = rpc._unpack(blob)
                 except Exception:
                     # One corrupt blob (sender died mid-notify) must not
                     # fail the query or discard the healthy blobs.
                     logger.warning("dropping undecodable task-event blob "
                                    "(%d events)", _n)
+                    self.task_events_dropped += _n
+                    continue
+                overflow = (len(self.task_events) + len(rows)
+                            - (self.task_events.maxlen or 0))
+                if overflow > 0:
+                    self.task_events_dropped += min(overflow, len(rows))
+                self.task_events.extend(rows)
         return self.task_events
+
+    def _events_dropped_total(self) -> int:
+        """Sink-side evictions plus every reporter's own buffer drops."""
+        return self.task_events_dropped + sum(
+            self._reporter_drops.values())
 
     async def h_get_task_events(self, conn, p):
         out = list(self._expanded_task_events())
+        total = len(out)
         if p.get("job_id"):
             out = [e for e in out if e.get("job_id") == p["job_id"]]
         if p.get("task_id"):
             out = [e for e in out if e.get("task_id") == p["task_id"]]
         limit = p.get("limit", 10_000)
-        return out[-limit:]
+        clipped = max(0, len(out) - limit)
+        out = out[-limit:]
+        if p.get("with_meta"):
+            # No silent caps: callers that ask get told how much of the
+            # stream they are NOT seeing — events evicted before they
+            # could be retained (sink ring + reporter buffers) and rows
+            # clipped by this query's own limit.
+            return {"events": out,
+                    "dropped": self._events_dropped_total(),
+                    "clipped": clipped,
+                    "total_retained": total}
+        return out
 
     async def h_report_metrics(self, conn, p):
         """Merge a per-process metric snapshot (reference: per-node
@@ -315,6 +387,11 @@ class GcsServer:
         monotonic per-process totals keyed by worker, so aggregation sums
         the latest value per worker."""
         wid = p["worker_id"]
+        # Recency is judged by GCS RECEIPT time (monotonic), never the
+        # reporter's own wall stamp: a skewed host — the very condition
+        # the clock-alignment feature exists for — must not have its
+        # live metrics judged stale (or its dead ones judged fresh).
+        recv = time.monotonic()
         for m in p["metrics"]:
             key = (m["name"], tuple(sorted(m.get("labels", {}).items())))
             entry = self.metrics.setdefault(key, {
@@ -322,7 +399,25 @@ class GcsServer:
                 "type": m["type"], "help": m.get("help", ""),
                 "per_worker": {}})
             entry["type"] = m["type"]
-            entry["per_worker"][wid] = (m["value"], m.get("ts", 0.0))
+            entry["per_worker"][wid] = (m["value"], recv)
+        # Periodic reporter eviction: worker processes churn (every dead
+        # worker leaves its final snapshot behind), and with the unified
+        # export EVERY process reports — without a sweep the per_worker
+        # maps grow for the cluster's lifetime.  Stale gauge reporters
+        # stop winning most-recent anyway; dropping their counter
+        # contribution after 15min idle trades a bounded undercount for
+        # bounded memory (the reference evicts dead-worker views the
+        # same way).
+        self._metrics_reports += 1
+        if self._metrics_reports % 512 == 0:
+            horizon = time.monotonic() - 900.0
+            for entry in self.metrics.values():
+                pw = entry["per_worker"]
+                for w in [w for w, (_v, ts) in pw.items()
+                          if ts < horizon]:
+                    del pw[w]
+            self.metrics = {k: e for k, e in self.metrics.items()
+                            if e["per_worker"]}
         return True
 
     async def h_get_metrics(self, conn, p):
@@ -330,7 +425,8 @@ class GcsServer:
         for entry in self.metrics.values():
             vals = list(entry["per_worker"].values())   # [(value, ts)]
             if entry["type"] == "gauge":
-                # Most recently REPORTED value wins, not dict order.
+                # Most recently RECEIVED value wins (receipt monotonic,
+                # skew-immune), not dict order.
                 value = max(vals, key=lambda v: v[1])[0] if vals else 0.0
             elif entry["type"] == "histogram":
                 value = {"count": sum(v[0]["count"] for v in vals),
@@ -348,6 +444,41 @@ class GcsServer:
             out.append({"name": entry["name"], "labels": entry["labels"],
                         "type": entry["type"], "help": entry["help"],
                         "value": value})
+        out.extend(self._self_metrics())
+        return out
+
+    def _self_metrics(self) -> List[dict]:
+        """The GCS's own contribution to the unified export: per-node
+        health/clock gauges derived from its tables, and the task-event
+        sink's drop counter (the no-silent-caps satellite)."""
+        out: List[dict] = [{
+            "name": "ray_tpu_gcs_task_events_dropped_total",
+            "labels": {}, "type": "counter",
+            "help": "task events evicted by the GCS sink or dropped in "
+                    "reporter buffers before reaching it",
+            "value": float(self._events_dropped_total())}]
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            lab = {"node_id": node.node_id.hex()}
+            if node.clock.offset is not None:
+                out.append({
+                    "name": "ray_tpu_node_clock_offset_seconds",
+                    "labels": lab, "type": "gauge",
+                    "help": "estimated node wall clock minus GCS wall "
+                            "clock (NTP-style, min-RTT filtered)",
+                    "value": node.clock.offset})
+            if node.rtt_ema is not None:
+                out.append({
+                    "name": "ray_tpu_node_probe_rtt_seconds",
+                    "labels": lab, "type": "gauge",
+                    "help": "GCS health-probe RTT EMA",
+                    "value": node.rtt_ema})
+            out.append({
+                "name": "ray_tpu_node_suspicion",
+                "labels": lab, "type": "gauge",
+                "help": "gray-failure suspicion score in [0, 1]",
+                "value": node.suspicion})
         return out
 
     async def start(self):
@@ -529,6 +660,11 @@ class GcsServer:
             return False
         node.resources_available = p["available"]
         node.last_heartbeat = time.monotonic()
+        if p.get("runtime"):
+            # Runtime gauges (lease queue depth, arena occupancy, ...):
+            # straight into the node view for the CLI summary / dashboard;
+            # the agent separately exports the same numbers as metrics.
+            node.runtime = p["runtime"]
         if p.get("transfer"):
             node.transfer = p["transfer"]
             total = int(node.transfer.get("bytes_served") or 0) + \
@@ -749,13 +885,37 @@ class GcsServer:
         dark while node→GCS heartbeats keep flowing — silence THERE
         must raise suspicion (the EMA and the sustained window still
         require it to persist before anything drains).  Death from
-        total silence stays the heartbeat detector's job."""
-        t0 = time.monotonic()
+        total silence stays the heartbeat detector's job.
+
+        The same round trip doubles as the clock-alignment probe: the
+        agent's ping reply carries its receive/transmit wall stamps
+        (t1, t2), and with our own send/receive stamps (t0, t3) the
+        NTP sample theta = ((t1-t0)+(t2-t3))/2 estimates that node's
+        clock offset — min-RTT filtered and smoothed in
+        node.clock (clocks.OffsetEstimator), exported via the node
+        view and the per-node skew gauge, applied read-side by
+        timeline rendering.  No extra RPC: measurement rides the
+        health loop that already exists."""
+        t0_mono = time.monotonic()
+        t0 = clocks.wall()
+        reply = None
         try:
-            await node.conn.call("ping", {}, timeout=max(bound, 1.0))
-            rtt = time.monotonic() - t0
+            reply = await node.conn.call("ping", {},
+                                         timeout=max(bound, 1.0))
+            rtt = time.monotonic() - t0_mono
         except Exception:
             rtt = max(bound, 1.0)
+        else:
+            t3 = clocks.wall()
+            if isinstance(reply, dict) and "t1" in reply \
+                    and "t2" in reply:
+                from .config import get_config as _gc
+                if _gc().clock_align_enabled:
+                    try:
+                        node.clock.add(t0, float(reply["t1"]),
+                                       float(reply["t2"]), t3)
+                    except (TypeError, ValueError):
+                        pass  # malformed stamps: RTT evidence still counts
         node.rtt_ema = rtt if node.rtt_ema is None \
             else 0.7 * node.rtt_ema + 0.3 * rtt
         node.rtt_ts = time.monotonic()

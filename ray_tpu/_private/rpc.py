@@ -92,6 +92,7 @@ import os
 import random
 import sys
 import time
+import weakref
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
@@ -209,6 +210,35 @@ def note_copied_bytes(tag: str, nbytes: int) -> None:
 
 def copy_audit_snapshot() -> Dict[str, int]:
     return dict(COPY_AUDIT)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide I/O accounting: per-connection io_stats roll up here so the
+# unified metrics export (agent heartbeat / core-worker telemetry tick) can
+# ship ONE syscall/frame/byte series per process instead of a per-socket
+# cardinality explosion.  Live connections are enumerated via a WeakSet;
+# closed connections fold their final counters into _IO_RETIRED at teardown
+# so totals stay monotonic across reconnects.
+# ---------------------------------------------------------------------------
+_LIVE_CONNS: "weakref.WeakSet" = weakref.WeakSet()
+_IO_RETIRED: Dict[str, int] = {}
+
+
+def io_stats_snapshot() -> Dict[str, int]:
+    """Aggregate io_stats across every connection this process has ever
+    opened (live + retired).  Monotonic per key — safe to export as
+    counters."""
+    out = dict(_IO_RETIRED)
+    out.setdefault("connections", 0)
+    if _LIVE_CONNS is not None:
+        for conn in list(_LIVE_CONNS):
+            st = getattr(conn, "io_stats", None)
+            if not st:
+                continue
+            for k, v in st.items():
+                out[k] = out.get(k, 0) + v
+            out["connections"] += 1
+    return out
 
 
 _BG_TASKS: set = set()
@@ -564,6 +594,7 @@ class Connection:
         self.io_stats = {"tx_syscalls": 0, "tx_frames": 0,
                          "tx_writev": 0, "tx_bytes": 0,
                          "rx_native_bytes": 0, "rx_takeovers": 0}
+        _LIVE_CONNS.add(self)
 
     @property
     def closed(self):
@@ -1261,6 +1292,12 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        # Fold final I/O counters into the process-wide retired totals
+        # (io_stats_snapshot) before the connection object goes away.
+        for k, v in self.io_stats.items():
+            _IO_RETIRED[k] = _IO_RETIRED.get(k, 0) + v
+        _IO_RETIRED["connections"] = _IO_RETIRED.get("connections", 0) + 1
+        _LIVE_CONNS.discard(self)
         self._native_rx_end(resume=False)
         if self._dup_fd >= 0:
             try:

@@ -1,19 +1,69 @@
 """Chrome-trace construction from GCS task events — shared by
 ray_tpu.timeline() and the dashboard's /api/timeline (reference:
-python/ray/_private/state.py:441 chrome_tracing_dump)."""
+python/ray/_private/state.py:441 chrome_tracing_dump).
+
+Clock alignment: task events are stamped on each node's OWN wall clock,
+so a raw cross-node trace shows effects before causes (a remote RUNNING
+"earlier" than its driver's SUBMITTED whenever the hosts disagree about
+now — the artifact `ray timeline` exhibits at scale).  `align_events`
+corrects every event into the GCS's reference frame using the per-node
+offsets the health loop estimates (NTP-style; see clocks.py and
+GcsServer._probe_node): corrected = ts - offset(node), since offset is
+that node's clock MINUS the GCS's.  After correction, causality nests:
+driver SUBMITTED strictly precedes remote RUNNING, transfer spans fall
+inside their pull's start/commit span — up to the estimator's asymmetry
+error bound, which node views carry alongside the offset.
+"""
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Optional
 
 
-def chrome_trace_events(raw: List[dict]) -> List[dict]:
+def offsets_from_node_views(nodes: List[dict]) -> Dict[bytes, float]:
+    """node_id -> clock offset (node wall minus GCS wall, seconds) from
+    GCS node views; nodes without an estimate are treated as aligned."""
+    out: Dict[bytes, float] = {}
+    for n in nodes or []:
+        off = n.get("clock_offset_s")
+        if off:
+            out[bytes(n["node_id"])] = float(off)
+    return out
+
+
+def align_events(raw: List[dict],
+                 offsets: Optional[Dict[bytes, float]]) -> List[dict]:
+    """Shallow-copied events with every timestamp field corrected into
+    the GCS reference frame by its node's estimated offset."""
+    if not offsets:
+        return list(raw)
+    out = []
+    for e in raw:
+        off = offsets.get(bytes(e.get("node_id") or b""))
+        if not off:
+            out.append(e)
+            continue
+        e = dict(e)
+        e["ts"] = e["ts"] - off
+        if "start_us" in e:
+            e["start_us"] = e["start_us"] - int(off * 1e6)
+        out.append(e)
+    return out
+
+
+def chrome_trace_events(raw: List[dict],
+                        offsets: Optional[Dict[bytes, float]] = None
+                        ) -> List[dict]:
     """Pair RUNNING → FINISHED/FAILED/CANCELLED per task into duration
-    events; submit times become instant events. Load the result in
-    chrome://tracing or Perfetto."""
-    # Submitter and executor flush on independent clocks, so sink order is
-    # not event order — recorded timestamps are (same-host clocks).
-    raw = sorted(raw, key=lambda e: e["ts"])
+    events; submit times become instant events; SPAN rows (tracing spans
+    and flight-recorder plane spans) become complete events.  Pass
+    `offsets` (node_id -> seconds, from offsets_from_node_views) to
+    correct per-node clocks first.  Load the result in chrome://tracing
+    or Perfetto."""
+    # Submitter and executor flush on independent clocks, so sink order
+    # is not event order; after alignment the sort is causal up to the
+    # estimator's error bound.
+    raw = sorted(align_events(raw, offsets), key=lambda e: e["ts"])
     starts: dict = {}
     events: list = []
     for e in raw:
@@ -41,17 +91,32 @@ def chrome_trace_events(raw: List[dict]) -> List[dict]:
                 "ts": e["ts"] * 1e6, "pid": pid, "tid": wid,
             })
         elif e["event"] == "SPAN":
-            # Tracing spans (util/tracing.py): complete events carrying
-            # the trace/span ids so cross-process causality is visible in
-            # Perfetto without an external collector.
+            # Complete spans: tracing spans (util/tracing.py) carry
+            # trace/span ids; flight-recorder plane spans (lease
+            # lifecycle, object transfers) carry a category instead.
+            # Both ride the same pipeline and render without external
+            # collectors.
+            cat = e.get("cat") or "trace"
+            args = {"trace_id": e.get("trace_id"),
+                    "span_id": e.get("span_id"),
+                    "parent_span_id": e.get("parent_span_id")} \
+                if e.get("trace_id") else dict(e.get("args") or {})
+            if tid:
+                args.setdefault("id", tid.hex())
+            nm = e.get("name") or tid.hex()[:8]
             events.append({
-                "name": f"span:{e.get('name') or tid.hex()[:8]}",
-                "cat": "trace", "ph": "X",
+                "name": f"span:{nm}" if cat == "trace" else f"{cat}:{nm}",
+                "cat": cat, "ph": "X",
                 "ts": e.get("start_us", e["ts"] * 1e6),
                 "dur": e.get("dur_us", 0),
                 "pid": pid, "tid": wid,
-                "args": {"trace_id": e.get("trace_id"),
-                         "span_id": e.get("span_id"),
-                         "parent_span_id": e.get("parent_span_id")},
+                "args": args,
+            })
+        elif e["event"] == "PREFETCH":
+            events.append({
+                "name": "prefetch",
+                "cat": "lease", "ph": "i", "s": "t",
+                "ts": e["ts"] * 1e6, "pid": pid, "tid": wid,
+                "args": {"task_id": tid.hex()},
             })
     return events

@@ -541,8 +541,12 @@ class Executor:
                         f"{spec.get('name') or spec.get('method', '')}"),
                     "deadline exceeded before execution")
                 continue
+            # job_id from the SPEC: a pooled worker serves tasks across
+            # jobs, so the process-level id would mis-attribute (and a
+            # job-filtered timeline would silently lose every RUNNING).
             self.core.record_task_event(
-                tid, spec.get("name") or spec.get("method", ""), "RUNNING")
+                tid, spec.get("name") or spec.get("method", ""),
+                "RUNNING", job_id=spec.get("job_id") or b"")
             try:
                 if spec["args"]:
                     args, kwargs = await self._resolve_arg_entries(
@@ -943,7 +947,7 @@ class Executor:
         self._running[spec["task_id"]] = (asyncio.current_task(), True)
         self.core.record_task_event(
             spec["task_id"], spec.get("name") or spec.get("method", ""),
-            "RUNNING")
+            "RUNNING", job_id=spec.get("job_id") or b"")
         # Ambient deadline for the async paths (arg resolution, coroutine
         # actor methods) — the sync path re-installs it on its executor
         # thread in _run_sync.

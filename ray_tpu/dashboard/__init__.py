@@ -280,9 +280,16 @@ class DashboardHead:
                     + cluster_series_text(nodes, actors, pgs))
             return 200, "text/plain; version=0.0.4", body.encode()
         if path == "/api/grafana/dashboard":
-            from .grafana import dashboard_json
+            # ?which=default|data_plane|control_plane (default: default)
+            from .grafana import DASHBOARDS
+            which = query.get("which", ["default"])[0]
+            factory = DASHBOARDS.get(which)
+            if factory is None:
+                return (404, "text/plain",
+                        f"unknown dashboard {which!r}; one of "
+                        f"{sorted(DASHBOARDS)}".encode())
             return (200, "application/json",
-                    json.dumps(dashboard_json()).encode())
+                    json.dumps(factory()).encode())
         if path == "/api/logs":
             # /api/logs?node=<hex>[&glob=pat] — list; add &name=<file>
             # [&lines=N] to read a tail (reference: dashboard state head
@@ -308,11 +315,19 @@ class DashboardHead:
             return (200, "application/json",
                     json.dumps(_hexify(files)).encode())
         if path == "/api/timeline":
-            from .._private.timeline import chrome_trace_events
+            from .._private.timeline import (chrome_trace_events,
+                                             offsets_from_node_views)
             gcs = await self._gcs()
-            raw = await gcs.call("get_task_events", {"limit": 100_000})
+            raw, nodes = await asyncio.gather(
+                gcs.call("get_task_events", {"limit": 100_000}),
+                gcs.call("get_nodes", {}))
+            # Clock-aligned by default; ?raw=1 shows the uncorrected
+            # per-host stamps (debugging the estimator itself).
+            offsets = None if query.get("raw", ["0"])[0] == "1" \
+                else offsets_from_node_views(nodes)
             return (200, "application/json",
-                    json.dumps(chrome_trace_events(raw)).encode())
+                    json.dumps(chrome_trace_events(
+                        raw, offsets=offsets)).encode())
         table = {
             "/api/nodes": ("get_nodes", {}),
             "/api/actors": ("list_actors", {}),

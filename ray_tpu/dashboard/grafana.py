@@ -97,6 +97,95 @@ def dashboard_json() -> Dict[str, Any]:
     }
 
 
+def data_plane_dashboard_json() -> Dict[str, Any]:
+    """Object/data-plane dashboard over the unified per-daemon export
+    (node_id-labeled series the agents ship on every heartbeat tick):
+    arena occupancy, transfer volume, io syscall/byte rates, copy-audit
+    totals, flight-recorder health."""
+    panels = [
+        _panel(1, "Arena occupancy by node",
+               [("ray_tpu_arena_used_bytes", "{{node_id}} used"),
+                ("ray_tpu_arena_capacity_bytes",
+                 "{{node_id}} capacity")], y=0, x=0, unit="bytes"),
+        _panel(2, "Transfer rate by node",
+               [("rate(ray_tpu_transfer_served_bytes_total[1m])",
+                 "{{node_id}} served"),
+                ("rate(ray_tpu_transfer_pulled_bytes_total[1m])",
+                 "{{node_id}} pulled")], y=0, x=12, unit="Bps"),
+        _panel(3, "RPC tx syscalls / frames",
+               [("rate(ray_tpu_io_tx_syscalls_total[1m])",
+                 "{{node_id}} syscalls/s"),
+                ("rate(ray_tpu_io_tx_frames_total[1m])",
+                 "{{node_id}} frames/s")], y=8, x=0),
+        _panel(4, "RPC tx bytes",
+               [("rate(ray_tpu_io_tx_bytes_total[1m])",
+                 "{{node_id}}")], y=8, x=12, unit="Bps"),
+        _panel(5, "Deliberate copies (copy audit)",
+               [("rate(ray_tpu_copied_bytes_total[1m])",
+                 "{{node_id}} {{tag}}")], y=16, x=0, unit="Bps"),
+        _panel(6, "Flight recorder drops",
+               [("rate(ray_tpu_flight_recorder_dropped_total[1m])",
+                 "{{node_id}} recorder"),
+                ("ray_tpu_gcs_task_events_dropped_total",
+                 "gcs sink")], y=16, x=12),
+    ]
+    return {
+        "uid": "ray_tpu_data_plane",
+        "title": "ray_tpu data plane",
+        "timezone": "browser",
+        "refresh": "5s",
+        "schemaVersion": 39,
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+        "templating": {"list": []},
+        "annotations": {"list": []},
+    }
+
+
+def control_plane_dashboard_json() -> Dict[str, Any]:
+    """Control-plane dashboard: lease queue depth, adaptive submit
+    windows, probe RTT / suspicion / clock skew per node — the series
+    ROADMAP item 1's O(N)-wall hunt reads."""
+    panels = [
+        _panel(1, "Lease queue depth by node",
+               [("ray_tpu_lease_queue_depth", "{{node_id}}")], y=0, x=0),
+        _panel(2, "Active leases / workers",
+               [("ray_tpu_active_leases", "{{node_id}} leases"),
+                ("ray_tpu_node_workers", "{{node_id}} workers")],
+               y=0, x=12),
+        _panel(3, "Adaptive submit window",
+               [("ray_tpu_submit_window_max", "{{node_id}} max"),
+                ("ray_tpu_submit_window_mean", "{{node_id}} mean")],
+               y=8, x=0),
+        _panel(4, "GCS probe RTT by node",
+               [("ray_tpu_node_probe_rtt_seconds", "{{node_id}}")],
+               y=8, x=12, unit="s"),
+        _panel(5, "Clock offset vs GCS (skew)",
+               [("ray_tpu_node_clock_offset_seconds", "{{node_id}}")],
+               y=16, x=0, unit="s"),
+        _panel(6, "Gray suspicion by node",
+               [("ray_tpu_node_suspicion", "{{node_id}}")], y=16, x=12),
+    ]
+    return {
+        "uid": "ray_tpu_control_plane",
+        "title": "ray_tpu control plane",
+        "timezone": "browser",
+        "refresh": "5s",
+        "schemaVersion": 39,
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+        "templating": {"list": []},
+        "annotations": {"list": []},
+    }
+
+
+DASHBOARDS = {
+    "default": dashboard_json,
+    "data_plane": data_plane_dashboard_json,
+    "control_plane": control_plane_dashboard_json,
+}
+
+
 def provision(root: str, prom_url: str = "http://127.0.0.1:9090") -> str:
     """Write Grafana provisioning files under `root` (reference: the
     metrics module writing grafana_ini / provisioning into the session
@@ -126,8 +215,10 @@ def provision(root: str, prom_url: str = "http://127.0.0.1:9090") -> str:
             "    type: file\n"
             "    options:\n"
             f"      path: {dash_dir}\n")
-    with open(os.path.join(dash_dir, "ray_tpu_default.json"), "w") as f:
-        json.dump(dashboard_json(), f, indent=1)
+    for name, factory in DASHBOARDS.items():
+        with open(os.path.join(dash_dir, f"ray_tpu_{name}.json"),
+                  "w") as f:
+            json.dump(factory(), f, indent=1)
     return prov
 
 

@@ -218,9 +218,67 @@ def cmd_logs(args) -> int:
 
 def cmd_timeline(args) -> int:
     ray_tpu = _connect(args)
+    job_id = None
+    if args.job:
+        # Accept a job-id hex prefix; resolve against the GCS job table.
+        jobs = ray_tpu._core().gcs_call("get_jobs", {})
+        matches = [j["job_id"] for j in jobs
+                   if j["job_id"].hex().startswith(args.job)]
+        if not matches:
+            print(f"no job matching {args.job!r} "
+                  f"(known: {[j['job_id'].hex()[:8] for j in jobs]})",
+                  file=sys.stderr)
+            return 1
+        job_id = matches[0]
     out = args.output or f"/tmp/ray_tpu/timeline-{int(time.time())}.json"
-    events = ray_tpu.timeline(out)
-    print(f"wrote {len(events)} events to {out}")
+    events = ray_tpu.timeline(out, job_id=job_id,
+                              align=not args.no_align)
+    print(f"wrote {len(events)} events to {out}"
+          + (f" (job {job_id.hex()[:8]})" if job_id else ""))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    """One-screen cluster summary: task-state counts plus a per-node
+    transfer/skew/queue-depth table (reference: `ray summary tasks` +
+    the state API's per-node columns)."""
+    _connect(args)
+    from ray_tpu.util import state
+    counts = state.summarize_tasks()
+    dropped = counts.pop("_events_dropped", 0)
+    print("tasks:")
+    for k in sorted(counts):
+        print(f"  {k:10s} {counts[k]}")
+    if not counts:
+        print("  (no task events)")
+    if dropped:
+        print(f"  WARNING: {dropped} task events dropped by bounded "
+              f"buffers — counts above are a floor, not the truth")
+    nodes = state.list_nodes()
+    print(f"\nnodes ({sum(1 for n in nodes if n['state'] == 'ALIVE')} "
+          f"alive / {len(nodes)}):")
+    hdr = (f"  {'node':12s} {'state':9s} {'served':>9s} {'pulled':>9s} "
+           f"{'skew_ms':>8s} {'±err':>6s} {'queue':>5s} {'arena':>12s}")
+    print(hdr)
+
+    def mib(b):
+        return f"{(b or 0) / (1 << 20):.0f}M"
+
+    for n in nodes:
+        tr = n.get("transfer") or {}
+        rt = n.get("runtime") or {}
+        off = n.get("clock_offset_s")
+        err = n.get("clock_err_bound_s")
+        cap = rt.get("arena_capacity_bytes") or 0
+        arena = (f"{mib(rt.get('arena_used_bytes'))}/{mib(cap)}"
+                 if cap else "-")
+        print(f"  {n['node_id'][:12]:12s} {n['state']:9s} "
+              f"{mib(tr.get('bytes_served')):>9s} "
+              f"{mib(tr.get('bytes_pulled')):>9s} "
+              f"{(f'{off * 1000:+.1f}' if off is not None else '-'):>8s} "
+              f"{(f'{err * 1000:.1f}' if err is not None else '-'):>6s} "
+              f"{int(rt.get('lease_queue_depth') or 0):>5d} "
+              f"{arena:>12s}")
     return 0
 
 
@@ -304,9 +362,18 @@ def main(argv=None) -> int:
                    help="lines from the end")
     p.set_defaults(fn=cmd_logs)
 
-    p = sub.add_parser("timeline", help="dump a chrome trace")
+    p = sub.add_parser("timeline", help="dump a chrome trace "
+                                        "(clock-aligned across nodes)")
     p.add_argument("--output", "-o", default=None)
+    p.add_argument("--job", default=None,
+                   help="filter to one job (job id hex prefix)")
+    p.add_argument("--no-align", action="store_true",
+                   help="keep raw per-host clocks (debug the estimator)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("summary", help="task-state counts + per-node "
+                                       "transfer/skew/queue table")
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("memory", help="object store contents")
     p.add_argument("--limit", type=int, default=50)

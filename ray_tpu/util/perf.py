@@ -720,6 +720,17 @@ def _host_matches(base_host, this_host, speed_slack: float = 1.5) -> bool:
     return True
 
 
+def committed_host_mismatch(repo_root: str = ".") -> bool:
+    """True when the newest committed BENCH_*.json carries a host
+    fingerprint that doesn't match this machine (absolute gates then
+    report informationally)."""
+    _path, parsed = _latest_committed_bench(repo_root)
+    base_host = parsed[1] if parsed else None
+    if base_host is None:
+        return False
+    return not _host_matches(base_host, _host_fingerprint())
+
+
 def check_against_committed(min_time_s: float = 2.0,
                             threshold: float = 0.20,
                             repo_root: str = ".",
@@ -779,6 +790,93 @@ def check_against_committed(min_time_s: float = 2.0,
                           "threshold": threshold}))
         return 1
     print(json.dumps({"check": "ok", "baseline": path}))
+    return 0
+
+
+# The recorder-overhead A/B gate measures exactly the per-call paths the
+# flight recorder touches: sync round trips (driver submit/complete +
+# worker RUNNING events) and the batched async actor pipeline.
+RECORDER_AB_METRICS = ("single_client_tasks_sync",
+                       "1_1_actor_calls_async")
+
+
+def check_recorder_overhead(min_time_s: float = 2.0,
+                            threshold: float = 0.03,
+                            rounds: int = 3,
+                            informational: bool = False) -> int:
+    """Same-host A/B of the flight recorder: run the per-call benches
+    with the recorder ON vs OFF (alternating rounds, best-of per mode —
+    the same co-tenant-noise discipline _timeit's windows use) and gate
+    recorder-on within `threshold` of recorder-off.  The toggle travels
+    via RAY_TPU_flight_recorder_enabled, which child_env hands to every
+    daemon/worker the re-init spawns, so both sides of the A/B cover the
+    whole cluster, not just the driver.
+
+    `informational=True` (host-fingerprint mismatch vs the committed
+    baseline — same rule as the absolute gates) reports but exits 0."""
+    import os as _os
+
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private import flight_recorder as frec_mod
+
+    results = {"on": {m: [] for m in RECORDER_AB_METRICS},
+               "off": {m: [] for m in RECORDER_AB_METRICS}}
+    prev = _os.environ.get("RAY_TPU_flight_recorder_enabled")
+
+    def _cluster(mode: str):
+        _os.environ["RAY_TPU_flight_recorder_enabled"] = \
+            "1" if mode == "on" else "0"
+        # The driver's own config/recorder singletons predate the env
+        # flip — rebuild them so the driver side of the A/B toggles too.
+        config_mod.set_config(config_mod.Config())
+        frec_mod.reset()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        import multiprocessing
+        ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
+        warmup_cluster(60)
+
+    try:
+        for _ in range(max(1, rounds)):
+            # Interleaved A/B pairs: co-tenant drift hits both modes.
+            for mode in ("on", "off"):
+                _cluster(mode)
+                for m in RECORDER_AB_METRICS:
+                    results[mode][m].append(BENCHES[m](min_time_s))
+                ray_tpu.shutdown()
+    finally:
+        if prev is None:
+            _os.environ.pop("RAY_TPU_flight_recorder_enabled", None)
+        else:
+            _os.environ["RAY_TPU_flight_recorder_enabled"] = prev
+        config_mod.set_config(config_mod.Config())
+        frec_mod.reset()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+
+    failures = []
+    for m in RECORDER_AB_METRICS:
+        on = max(results["on"][m])
+        off = max(results["off"][m])
+        ratio = on / off if off else 1.0
+        row = {"metric": m, "recorder_on": round(on, 2),
+               "recorder_off": round(off, 2), "ratio": round(ratio, 3)}
+        if ratio < 1.0 - threshold:
+            row["RECORDER_OVERHEAD"] = True
+            failures.append(m)
+        print(json.dumps(row))
+    if failures:
+        if informational:
+            print(json.dumps({
+                "recorder_check": "host-mismatch-informational",
+                "would_have_failed": failures,
+                "threshold": threshold}))
+            return 0
+        print(json.dumps({"recorder_check": "FAIL",
+                          "over_threshold": failures,
+                          "threshold": threshold}))
+        return 1
+    print(json.dumps({"recorder_check": "ok", "threshold": threshold}))
     return 0
 
 
@@ -849,6 +947,13 @@ def main(argv=None):
     ap.add_argument("--check-force", action="store_true",
                     help="gate even when the committed baseline was "
                          "recorded on a different host class")
+    ap.add_argument("--no-check-recorder", action="store_true",
+                    help="skip the flight-recorder overhead A/B gate "
+                         "(recorder-on must stay within 3%% of "
+                         "recorder-off on tasks_sync and "
+                         "1_1_actor_calls_async)")
+    ap.add_argument("--recorder-threshold", type=float, default=0.03)
+    ap.add_argument("--recorder-rounds", type=int, default=3)
     args = ap.parse_args(argv)
     owns = not ray_tpu.is_initialized()
     if owns:
@@ -858,10 +963,23 @@ def main(argv=None):
         ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
     try:
         if args.check:
-            raise SystemExit(check_against_committed(
+            rc = check_against_committed(
                 min_time_s=args.min_time_s,
                 threshold=args.check_threshold,
-                force=args.check_force))
+                force=args.check_force)
+            if not args.no_check_recorder:
+                # Recorder overhead A/B (same informational rule: a
+                # host that doesn't match the committed baseline's
+                # fingerprint reports without gating, unless forced) —
+                # runs its own init/shutdown cycles to flip the
+                # recorder across the whole cluster.
+                rc = rc or check_recorder_overhead(
+                    min_time_s=args.min_time_s,
+                    threshold=args.recorder_threshold,
+                    rounds=args.recorder_rounds,
+                    informational=(committed_host_mismatch()
+                                   and not args.check_force))
+            raise SystemExit(rc)
         results = run_microbenchmarks(min_time_s=args.min_time_s)
         if args.compact:
             # [value, vs_ref, cpu_saturation, cpu_by_role] — saturation
@@ -875,7 +993,9 @@ def main(argv=None):
             for name, r in results.items():
                 print(json.dumps({"metric": name, **r}))
     finally:
-        if owns:
+        # The recorder A/B manages its own init/shutdown cycles, so the
+        # cluster this run owned may already be gone.
+        if owns and ray_tpu.is_initialized():
             ray_tpu.shutdown()
 
 

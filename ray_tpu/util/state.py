@@ -38,6 +38,13 @@ def list_nodes() -> List[Dict[str, Any]]:
             # Data-plane transfer counters (replica plane): bytes this
             # node has served to peers / pulled from peers since start.
             "transfer": n.get("transfer") or {},
+            # Clock alignment: node wall minus GCS wall (seconds) and
+            # the estimator's asymmetry error bound.
+            "clock_offset_s": n.get("clock_offset_s"),
+            "clock_err_bound_s": n.get("clock_err_bound_s"),
+            # Runtime gauges off the latest heartbeat (lease queue
+            # depth, arena occupancy, ...).
+            "runtime": n.get("runtime") or {},
         })
     return out
 
@@ -80,14 +87,41 @@ def list_jobs() -> List[Dict[str, Any]]:
 
 
 def list_tasks(job_id: Optional[bytes] = None,
-               limit: int = 1000) -> List[Dict[str, Any]]:
+               limit: int = 1000,
+               with_meta: bool = False):
     """Latest status per task, derived from the GCS task-event sink
-    (reference: state API tasks view over GcsTaskManager)."""
-    events = _gcs("get_task_events", {"job_id": job_id, "limit": 100_000})
+    (reference: state API tasks view over GcsTaskManager).
+
+    The sink and every reporter's buffer are bounded rings; with
+    `with_meta=True` the return is `(tasks, meta)` where meta carries
+    `events_dropped` (events evicted before retention — the view may be
+    missing whole tasks or terminal transitions) and `events_clipped`
+    (rows cut by the query limit).  Without it, a truncation warning is
+    logged once per call so the cap is never silent."""
+    res = _gcs("get_task_events", {"job_id": job_id, "limit": 100_000,
+                                   "with_meta": True})
+    if isinstance(res, dict):
+        events = res.get("events", [])
+        meta = {"events_dropped": int(res.get("dropped", 0)),
+                "events_clipped": int(res.get("clipped", 0))}
+    else:           # pre-meta GCS payload
+        events, meta = res, {"events_dropped": 0, "events_clipped": 0}
+    if not with_meta and (meta["events_dropped"]
+                          or meta["events_clipped"]):
+        import logging
+        logging.getLogger("ray_tpu.state").warning(
+            "task-event view is incomplete: %d events dropped by "
+            "bounded buffers, %d clipped by the query limit",
+            meta["events_dropped"], meta["events_clipped"])
     _RANK = {"SUBMITTED": 0, "RUNNING": 1,
              "FINISHED": 2, "FAILED": 2, "CANCELLED": 2}
     tasks: Dict[bytes, Dict[str, Any]] = {}
     for e in events:
+        if e["event"] == "SPAN":
+            # Plane-level flight-recorder spans and tracing spans ride
+            # the same sink but are keyed by lease/object/span ids —
+            # they are timeline material, not task rows.
+            continue
         t = tasks.setdefault(e["task_id"], {
             "task_id": e["task_id"].hex(),
             "name": e.get("name", ""),
@@ -109,6 +143,8 @@ def list_tasks(job_id: Optional[bytes] = None,
     for t in tasks.values():
         t["events"].sort(key=lambda ev: ev[1])
     out = list(tasks.values())[-limit:]
+    if with_meta:
+        return out, meta
     return out
 
 
@@ -135,9 +171,17 @@ def list_objects(limit: int = 10_000) -> List[Dict[str, Any]]:
 
 
 def summarize_tasks() -> Dict[str, int]:
+    """Task-state counts.  When bounded buffers evicted events before
+    they could be counted, the summary carries an `_events_dropped` key
+    — the counts are then a floor, not the truth, and callers (CLI
+    summary) must say so instead of presenting a truncated view as
+    complete."""
+    tasks, meta = list_tasks(limit=100_000, with_meta=True)
     counts: Dict[str, int] = {}
-    for t in list_tasks(limit=100_000):
+    for t in tasks:
         counts[t.get("state", "?")] = counts.get(t.get("state", "?"), 0) + 1
+    if meta["events_dropped"]:
+        counts["_events_dropped"] = meta["events_dropped"]
     return counts
 
 

@@ -86,7 +86,10 @@ def execution_span(core, spec: Dict[str, Any]):
     span = {"trace_id": parent["trace_id"], "span_id": _new_id(8)}
     token = _ctx.set(span)
     name = spec.get("name") or spec.get("method", "task")
-    t0 = time.time()
+    # Skew-injectable stamp (clocks.wall): execution spans align across
+    # nodes the same way task events do.
+    from ray_tpu._private import clocks as _clocks
+    t0 = _clocks.wall()
     otel = _otel_tracer()
     om = otel.start_as_current_span(name) if otel is not None else None
     if om is not None:
@@ -104,7 +107,7 @@ def execution_span(core, spec: Dict[str, Any]):
                 span_id=span["span_id"],
                 parent_span_id=parent["span_id"],
                 start_us=int(t0 * 1e6),
-                dur_us=int((time.time() - t0) * 1e6))
+                dur_us=int((_clocks.wall() - t0) * 1e6))
         except Exception:   # pragma: no cover - tracing must not fail tasks
             pass
 

@@ -1,0 +1,211 @@
+"""Multi-node flight recorder end-to-end: deliberate clock skew between
+nodes, offset recovery via the GCS health probes, cross-node causal
+nesting after correction, plane-level transfer spans, and the unified
+node_id-labeled /metrics exposition — the acceptance surface of the
+cluster flight recorder.
+
+The skewed node's ENTIRE telemetry clock (agent + its workers) runs
+`clock_skew_s` seconds off via the chaos knob in clocks.py — the same
+condition a real multi-host cluster is in whenever NTP drifts — so the
+raw trace genuinely shows effects before causes until the estimated
+offsets repair it.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.timeline import (align_events, chrome_trace_events,
+                                       offsets_from_node_views)
+from ray_tpu.cluster_utils import Cluster
+from test_flight_recorder import assert_valid_prometheus
+
+# Node B's clock runs 6s BEHIND: its RUNNING stamps predate the
+# driver's SUBMITTED stamps until correction (negative skew is the
+# direction that actually breaks causality in the raw trace).
+SKEW_S = -6.0
+
+
+def _wait(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.4)
+    pytest.fail(msg)
+
+
+@pytest.fixture
+def skewed_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    node_b = cluster.add_node(
+        num_cpus=2, resources={"skewed": 4.0},
+        _system_config={"clock_skew_s": SKEW_S})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    try:
+        yield cluster, node_b
+    finally:
+        cluster.shutdown()
+
+
+def test_skewed_trace_aligns_and_metrics_export(skewed_cluster):
+    cluster, node_b = skewed_cluster
+    core = ray_tpu._core()
+
+    # ---- 1. the GCS health probes recover the injected offset --------
+    def offset_estimated():
+        for n in core.gcs_call("get_nodes", {}):
+            if bytes(n["node_id"]) == node_b.node_id:
+                off = n.get("clock_offset_s")
+                if off is not None and abs(off - SKEW_S) < 0.5:
+                    return n
+        return None
+    view_b = _wait(offset_estimated, 60,
+                   "GCS never recovered the injected clock skew")
+    assert view_b.get("clock_err_bound_s") is not None
+    assert view_b["clock_err_bound_s"] < 0.5
+
+    # ---- 2. run tasks on the skewed node, with a cross-node arg ------
+    payload = ray_tpu.put(np.arange(3 << 20, dtype=np.uint8))
+    oid = payload.binary()
+
+    @ray_tpu.remote(resources={"skewed": 1})
+    def crunch(a, i):
+        return int(a[i])
+
+    assert ray_tpu.get([crunch.remote(payload, i) for i in range(4)],
+                       timeout=120) == [0, 1, 2, 3]
+
+    # ---- 3. raw trace shows effect-before-cause; corrected nests -----
+    def full_lifecycles():
+        raw = core.gcs_call("get_task_events", {"limit": 100_000})
+        by_task = {}
+        for e in raw:
+            if e.get("name") == "crunch":
+                by_task.setdefault(e["task_id"], {})[e["event"]] = e["ts"]
+        done = {t: evs for t, evs in by_task.items()
+                if {"SUBMITTED", "RUNNING", "FINISHED"} <= set(evs)}
+        return (raw, done) if len(done) >= 4 else None
+    raw, lifecycles = _wait(full_lifecycles, 60,
+                            "task lifecycles never reached the sink")
+
+    # Uncorrected: the skewed node's RUNNING stamps PREDATE the
+    # driver's SUBMITTED stamps — the artifact this PR exists to fix.
+    assert all(evs["RUNNING"] < evs["SUBMITTED"]
+               for evs in lifecycles.values()), \
+        "skew injection had no effect — test preconditions broken"
+
+    offsets = offsets_from_node_views(core.gcs_call("get_nodes", {}))
+    assert offsets.get(node_b.node_id) == pytest.approx(SKEW_S, abs=0.5)
+    fixed = align_events(raw, offsets)
+    by_task = {}
+    for e in fixed:
+        if e.get("name") == "crunch":
+            by_task.setdefault(e["task_id"], {})[e["event"]] = e["ts"]
+    for tid, evs in by_task.items():
+        if not {"SUBMITTED", "RUNNING", "FINISHED"} <= set(evs):
+            continue
+        assert evs["SUBMITTED"] < evs["RUNNING"] < evs["FINISHED"], \
+            f"corrected lifecycle out of order for {tid.hex()}: {evs}"
+    # The chrome render agrees: every crunch X-span starts after its
+    # submit instant.
+    trace = chrome_trace_events(raw, offsets=offsets)
+    subs = [e["ts"] for e in trace if e["cat"] == "submit"
+            and e["name"] == "submit:crunch"]
+    spans = [e for e in trace if e["cat"] == "task"
+             and e["name"] == "crunch"]
+    assert spans and subs
+    assert min(e["ts"] for e in spans) > min(subs)
+
+    # ---- 4. transfer spans nest inside their pull's start/commit -----
+    def transfer_spans():
+        raw2 = core.gcs_call("get_task_events", {"limit": 100_000})
+        rows = [e for e in raw2 if e.get("event") == "SPAN"
+                and e.get("cat") == "transfer"
+                and e.get("task_id") == oid]
+        pulls = [e for e in rows if e["name"] == "pull"]
+        chunks = [e for e in rows if e["name"] == "chunks"]
+        commits = [e for e in rows if e["name"] == "commit"]
+        return (pulls, chunks, commits) if (pulls and chunks
+                                            and commits) else None
+    pulls, chunks, commits = _wait(
+        transfer_spans, 60,
+        "transfer spans for the cross-node pull never arrived")
+    fixed_rows = align_events(pulls + chunks + commits, offsets)
+    pull = next(e for e in fixed_rows if e["name"] == "pull")
+    p0 = pull["start_us"]
+    p1 = p0 + pull["dur_us"]
+    eps = 2_000     # 2ms slack: commit fires between span end and seal
+    for e in fixed_rows:
+        if e["name"] == "chunks":
+            assert p0 - eps <= e["start_us"] and \
+                e["start_us"] + e["dur_us"] <= p1 + eps, \
+                f"chunk wave escapes its pull span: {e} vs {pull}"
+        if e["name"] == "commit":
+            assert p0 - eps <= e["start_us"] <= p1 + eps
+    assert pull.get("args", {}).get("ok") is True
+
+    # ---- 5. /metrics: node_id-labeled gauges for every node ----------
+    import asyncio
+    import threading
+    from ray_tpu.dashboard import DashboardHead
+    box, started, stop = {}, threading.Event(), {}
+
+    def run():
+        async def go():
+            head = DashboardHead(core.gcs_address)
+            box["addr"] = await head.start()
+            stop["ev"] = asyncio.Event()
+            stop["loop"] = asyncio.get_running_loop()
+            started.set()
+            await stop["ev"].wait()
+            await head.close()
+        asyncio.run(go())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(15)
+    from ray_tpu._private import rpc as _rpc
+    token = _rpc._resolve_token(_rpc.DEFAULT_TOKEN)
+    addr = box["addr"]
+
+    node_ids = {n.node_id.hex() for n in cluster.nodes}
+    assert len(node_ids) == 2
+
+    def scraped():
+        req = urllib.request.Request(
+            f"http://{addr[0]}:{addr[1]}/metrics",
+            headers={"Authorization": f"Bearer {token}"} if token else {})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        series = assert_valid_prometheus(text)
+        for name in ("ray_tpu_arena_used_bytes",
+                     "ray_tpu_lease_queue_depth",
+                     "ray_tpu_io_tx_syscalls_total"):
+            have = {lab.get("node_id") for lab in series.get(name, [])}
+            if not node_ids <= have:
+                return None
+        # The skew gauge the GCS itself contributes.
+        skews = {lab.get("node_id")
+                 for lab in series.get(
+                     "ray_tpu_node_clock_offset_seconds", [])}
+        if node_b.node_id.hex() not in skews:
+            return None
+        return series
+    series = _wait(scraped, 45,
+                   "node_id-labeled gauges never appeared in /metrics")
+    # Recorder drop counters are exported (zero here, but present).
+    assert "ray_tpu_flight_recorder_dropped_total" in series
+    assert "ray_tpu_gcs_task_events_dropped_total" in series
+    stop["loop"].call_soon_threadsafe(stop["ev"].set)
+    t.join(timeout=10)

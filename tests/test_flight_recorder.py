@@ -1,0 +1,328 @@
+"""Cluster flight recorder + clock alignment: unit coverage.
+
+- ring-buffer overflow/drop accounting, category gating, sampling
+- NTP offset math: exactness under symmetry, the rtt/2 error bound
+  under ASYMMETRIC delay, min-RTT filtering
+- offset recovery over a real RPC probe loop with link_chaos
+  asymmetric delay injected on the probe direction
+- timeline correction (align_events / offsets_from_node_views)
+- trace context rides the submit-frame DELTA, never the prefix
+  (PR-2 stable-prefix discipline)
+- Prometheus exposition parses with node_id labels intact
+
+The multi-node end-to-end (skewed cluster, cross-node nesting,
+/metrics scrape) lives in test_cluster_flight_recorder.py.
+"""
+
+import asyncio
+import re
+import time
+
+import pytest
+
+from ray_tpu._private import clocks, protocol, rpc
+from ray_tpu._private.flight_recorder import FlightRecorder
+
+
+# ------------------------------------------------------------- recorder ----
+def test_ring_overflow_drops_oldest_and_counts():
+    r = FlightRecorder(capacity=16)
+    for i in range(40):
+        r.instant("transfer", "ev", id=b"%02d" % i)
+    st = r.stats()
+    assert st["recorded"] == 40
+    assert st["dropped"] == 24          # exactly the overwritten ones
+    assert st["pending"] == 16
+    rows = r.drain(node_id=b"n" * 16)
+    assert len(rows) == 16
+    # Oldest-first drop: the survivors are the NEWEST 16, in order.
+    assert [x["task_id"] for x in rows] == [b"%02d" % i
+                                            for i in range(24, 40)]
+    assert all(rows[i]["ts"] <= rows[i + 1]["ts"] for i in range(15))
+    # Drain resets the ring but not the monotonic counters.
+    assert r.stats()["pending"] == 0
+    assert r.stats()["dropped"] == 24
+    # Rows are task-event-sink shaped (ride existing batched notifies).
+    assert rows[0]["event"] == "SPAN" and rows[0]["cat"] == "transfer"
+    assert rows[0]["node_id"] == b"n" * 16
+
+
+def test_span_records_duration_and_nests():
+    r = FlightRecorder(capacity=64)
+    with r.span("transfer", "pull", id=b"o" * 8):
+        time.sleep(0.02)
+        with r.span("transfer", "chunks", id=b"o" * 8):
+            time.sleep(0.01)
+    rows = r.drain()
+    by_name = {x["name"]: x for x in rows}
+    pull, chunks = by_name["pull"], by_name["chunks"]
+    assert pull["dur_us"] >= 25_000
+    assert chunks["dur_us"] >= 8_000
+    # The inner span nests strictly inside the outer one.
+    assert pull["start_us"] <= chunks["start_us"]
+    assert (chunks["start_us"] + chunks["dur_us"]
+            <= pull["start_us"] + pull["dur_us"])
+
+
+def test_category_gating_and_sampling():
+    r = FlightRecorder(capacity=64, categories={"transfer"})
+    r.instant("lease", "nope")
+    with r.span("lease", "nope-span"):
+        pass
+    r.instant("transfer", "yes")
+    assert [x["name"] for x in r.drain()] == ["yes"]
+
+    r = FlightRecorder(capacity=256, sample_n=4)
+    for _ in range(40):
+        r.instant("transfer", "hot")
+    for _ in range(3):
+        with r.span("transfer", "span"):
+            pass
+    rows = r.drain()
+    # 1-in-4 sampling on instants; spans are NEVER sampled away.
+    assert sum(1 for x in rows if x["name"] == "hot") == 10
+    assert sum(1 for x in rows if x["name"] == "span") == 3
+    assert r.stats()["sampled_out"] == 30
+
+    r = FlightRecorder(capacity=64, enabled=False)
+    r.instant("transfer", "off")
+    assert r.drain() == [] and r.stats()["recorded"] == 0
+
+
+def test_note_lost_folds_into_drop_accounting():
+    """Rows drained but never delivered (failed flush notify, retry
+    buffer overflow) count as dropped — flush-path loss is never
+    silent either."""
+    r = FlightRecorder(capacity=32)
+    r.instant("transfer", "ev")
+    rows = r.drain()
+    assert rows and r.stats()["dropped"] == 0
+    r.note_lost(len(rows))
+    assert r.stats()["dropped"] == len(rows)
+    r.note_lost(0)
+    r.note_lost(-3)     # defensive: never decrements
+    assert r.stats()["dropped"] == len(rows)
+
+
+def test_export_rows_shared_shape():
+    """The common unified-export rows (io_stats / copy audit / recorder
+    counters) come from ONE helper with the caller's labels applied."""
+    from ray_tpu._private import flight_recorder as frec
+    rows = frec.export_rows({"daemon": "agent", "node_id": "ab" * 16})
+    names = {x["name"] for x in rows}
+    assert "ray_tpu_flight_recorder_dropped_total" in names
+    assert any(n.startswith("ray_tpu_io_") for n in names)
+    assert all(x["labels"].get("node_id") == "ab" * 16 for x in rows)
+    assert all(x["type"] == "counter" for x in rows)
+
+
+def test_drain_wall_times_follow_clock_skew(monkeypatch):
+    """Drain anchors mono-ns stamps to clocks.wall(): an injected skew
+    shifts recorder rows exactly like every other telemetry stamp."""
+    r = FlightRecorder(capacity=8)
+    r.instant("transfer", "ev")
+    monkeypatch.setattr(clocks, "_skew", 100.0)
+    try:
+        rows = r.drain()
+    finally:
+        monkeypatch.setattr(clocks, "_skew", None)
+    assert abs(rows[0]["ts"] - (time.time() + 100.0)) < 2.0
+
+
+# ----------------------------------------------------------- clock math ----
+def test_ntp_sample_exact_under_symmetry():
+    # Remote is 7s ahead; 2ms symmetric path; 1ms server hold.
+    t0 = 1000.0
+    t1 = t0 + 0.002 + 7.0
+    t2 = t1 + 0.001
+    t3 = t0 + 0.002 + 0.001 + 0.002
+    theta, rtt = clocks.ntp_sample(t0, t1, t2, t3)
+    assert abs(theta - 7.0) < 1e-9
+    assert abs(rtt - 0.004) < 1e-9
+
+
+def test_ntp_asymmetric_delay_error_bounded():
+    """Asymmetric path: the estimate is off by (d_out - d_in)/2, which
+    is within the rtt/2 bound — the documented limit of the model."""
+    skew, d_out, d_in = -3.0, 0.080, 0.010
+    t0 = 500.0
+    t1 = t0 + d_out + skew
+    t2 = t1
+    t3 = t0 + d_out + d_in
+    theta, rtt = clocks.ntp_sample(t0, t1, t2, t3)
+    err = abs(theta - skew)
+    assert abs(err - (d_out - d_in) / 2) < 1e-9
+    assert err <= rtt / 2 + 1e-9
+
+
+def test_offset_estimator_prefers_min_rtt_sample():
+    """One symmetric (low-RTT) sample among asymmetric spikes: the
+    estimator's min-RTT filter keeps the estimate near truth even when
+    most probes crossed a congested (asymmetric) path."""
+    est = clocks.OffsetEstimator(window=8)
+    skew = 5.0
+    t = 100.0
+    for d_out in (0.200, 0.150, 0.180):     # spiky, asymmetric
+        est.add(t, t + d_out + skew, t + d_out + skew, t + d_out + 0.01)
+        t += 1.0
+    est.add(t, t + 0.001 + skew, t + 0.001 + skew, t + 0.002)  # clean
+    for d_out in (0.170, 0.190):
+        est.add(t, t + d_out + skew, t + d_out + skew, t + d_out + 0.01)
+        t += 1.0
+    assert abs(est.offset - skew) <= est.error_bound() + 0.02
+    assert est.error_bound() <= 0.002
+
+
+def test_offset_recovery_over_rpc_with_asymmetric_link_chaos():
+    """End-to-end probe loop against a real RPC server whose ping
+    handler stamps a skewed clock, with link_chaos delaying the probe
+    REQUEST direction only (the asymmetric case): the recovered offset
+    lands within the estimator's own error bound of the injected skew."""
+    SKEW = -4.0
+
+    async def main():
+        def ping(conn, p):
+            return {"pong": True, "t1": time.time() + SKEW,
+                    "t2": time.time() + SKEW}
+
+        srv = rpc.RpcServer({"ping": ping}, name="skewed-agent",
+                            auth_token=None)
+        addr = await srv.start_tcp("127.0.0.1", 0)
+        conn = await rpc.connect(tuple(addr), name="align-probe",
+                                 auth_token=None)
+        rpc.enable_link_chaos("align-probe/out_delay=0.04")
+        est = clocks.OffsetEstimator()
+        try:
+            for _ in range(6):
+                t0 = time.time()
+                reply = await conn.call("ping", {}, timeout=5)
+                t3 = time.time()
+                est.add(t0, reply["t1"], reply["t2"], t3)
+        finally:
+            rpc.enable_link_chaos("")
+            await conn.close()
+            await srv.close()
+        return est
+
+    est = asyncio.run(main())
+    # 40ms one-way asymmetry -> ~20ms estimator error, within the
+    # rtt/2 bound it reports (plus scheduling slop).
+    assert abs(est.offset - SKEW) <= est.error_bound() + 0.05
+    assert est.error_bound() >= 0.015     # the bound admits the asymmetry
+
+
+# ------------------------------------------------------------- timeline ----
+def test_align_events_and_offsets_from_views():
+    from ray_tpu._private.timeline import (align_events,
+                                           offsets_from_node_views)
+    nid_a, nid_b = b"a" * 16, b"b" * 16
+    offsets = offsets_from_node_views([
+        {"node_id": nid_a, "clock_offset_s": None},
+        {"node_id": nid_b, "clock_offset_s": -5.0},
+    ])
+    assert offsets == {nid_b: -5.0}
+    raw = [
+        {"task_id": b"t", "event": "SUBMITTED", "ts": 100.0,
+         "node_id": nid_a},
+        {"task_id": b"t", "event": "RUNNING", "ts": 95.2,
+         "node_id": nid_b, "start_us": 95_200_000},
+    ]
+    fixed = align_events(raw, offsets)
+    assert fixed[0]["ts"] == 100.0                  # reference frame
+    assert abs(fixed[1]["ts"] - 100.2) < 1e-6       # cause before effect
+    assert fixed[1]["start_us"] == 100_200_000
+    # Inputs are not mutated (dashboard reuses the raw rows).
+    assert raw[1]["ts"] == 95.2
+
+
+def test_chrome_trace_orders_after_correction():
+    from ray_tpu._private.timeline import chrome_trace_events
+    nid_a, nid_b = b"a" * 16, b"b" * 16
+    raw = [
+        {"task_id": b"t1", "name": "f", "event": "SUBMITTED",
+         "ts": 100.0, "node_id": nid_a, "worker_id": b""},
+        {"task_id": b"t1", "name": "f", "event": "RUNNING",
+         "ts": 94.0, "node_id": nid_b, "worker_id": b"w"},
+        {"task_id": b"t1", "name": "f", "event": "FINISHED",
+         "ts": 94.5, "node_id": nid_b, "worker_id": b"w"},
+    ]
+    # Uncorrected: RUNNING predates SUBMITTED -> the X span pairs, but
+    # the submit instant lands AFTER it (effect before cause).
+    uncorrected = chrome_trace_events(raw)
+    span = next(e for e in uncorrected if e["ph"] == "X")
+    sub = next(e for e in uncorrected if e["cat"] == "submit")
+    assert span["ts"] < sub["ts"]
+    corrected = chrome_trace_events(raw, offsets={nid_b: -6.5})
+    span = next(e for e in corrected if e["ph"] == "X")
+    sub = next(e for e in corrected if e["cat"] == "submit")
+    assert sub["ts"] < span["ts"]
+    assert span["dur"] == pytest.approx(0.5e6)
+
+
+# ------------------------------------------------- trace context / delta ----
+def test_trace_context_rides_delta_not_prefix():
+    """PR-2 stable-prefix discipline: a per-call trace context must land
+    in the spec DELTA; the encoded prefix blob stays byte-identical
+    across calls (a context that forced a prefix rebuild would wreck
+    the submit-batch cache)."""
+    base = dict(task_id=b"t1", job_id=b"j", fn_id=b"f" * 16, args=[],
+                nreturns=1, owner_addr=["h", 1], resources={"CPU": 1.0})
+    spec1 = protocol.make_task_spec(**base)
+    prefix = protocol.spec_prefix_of(spec1)
+    blob1 = protocol.encode_prefix(prefix)
+    assert prefix["trace"] is None      # per-call field reset in prefix
+
+    spec2 = protocol.make_task_spec(**{**base, "task_id": b"t2"})
+    spec2["trace"] = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    delta = protocol.spec_delta(prefix, spec2)
+    assert delta["trace"] == spec2["trace"]          # context in delta
+    assert protocol.encode_prefix(
+        protocol.spec_prefix_of(spec1)) == blob1     # prefix untouched
+    assert {**prefix, **delta} == spec2              # exact reconstruction
+
+
+# ----------------------------------------------------------- exposition ----
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"            # metric name
+    r"(\{([a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\",?)*\})?"   # labels
+    r" [0-9eE+.\-]+$")                       # value
+
+
+def assert_valid_prometheus(text: str) -> dict:
+    """Parse a text exposition; returns {metric_name: [label_dicts]}.
+    Fails on any line that is neither a comment nor a valid sample."""
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        labels = dict(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                 line))
+        series.setdefault(name, []).append(labels)
+    return series
+
+
+def test_prometheus_text_with_node_labels_parses():
+    from ray_tpu.dashboard import prometheus_text
+    metrics = [
+        {"name": "ray_tpu_arena_used_bytes", "type": "gauge",
+         "help": "shm arena bytes in use",
+         "labels": {"node_id": "ab" * 16, "daemon": "agent"},
+         "value": 12345.0},
+        {"name": "ray_tpu_io_tx_syscalls_total", "type": "counter",
+         "help": "", "labels": {"node_id": "cd" * 16}, "value": 42},
+        {"name": "obs_latency", "type": "histogram", "help": "h",
+         "labels": {"node_id": "ab" * 16},
+         "value": {"count": 3, "sum": 0.6, "boundaries": [0.1, 1.0],
+                   "buckets": [1, 1, 1]}},
+    ]
+    series = assert_valid_prometheus(prometheus_text(metrics))
+    assert {"node_id": "ab" * 16, "daemon": "agent"} in \
+        series["ray_tpu_arena_used_bytes"]
+    assert any(lab.get("node_id") == "cd" * 16
+               for lab in series["ray_tpu_io_tx_syscalls_total"])
+    # Histogram renders bucket/sum/count with labels intact.
+    assert any(lab.get("le") == "+Inf"
+               for lab in series["obs_latency_bucket"])
+    assert "obs_latency_count" in series and "obs_latency_sum" in series

@@ -165,6 +165,102 @@ def test_tracing_spans_chain_across_tasks(ray_start_regular):
         tracing._enabled = False
 
 
+def test_sink_drop_counters_surface_not_silent():
+    """No silent caps: a GCS sink sized below the event stream reports
+    what it shed — through the query meta, summarize_tasks, and the
+    exported drop counter — instead of presenting the truncated view as
+    complete."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={"gcs_task_events_max": 40})
+    try:
+        @ray_tpu.remote
+        def tick(i):
+            return i
+
+        # ~3 events per task (SUBMITTED/RUNNING/FINISHED) x 60 tasks
+        # >> the 40-event sink.
+        assert len(ray_tpu.get([tick.remote(i) for i in range(60)],
+                               timeout=60)) == 60
+        core = ray_tpu._core()
+
+        def _dropped():
+            res = core.gcs_call("get_task_events",
+                                {"limit": 100_000, "with_meta": True})
+            return res if res.get("dropped", 0) > 0 else None
+        res = _wait_for(_dropped, msg="sink never reported drops")
+        assert len(res["events"]) <= 40
+        # summarize_tasks carries the floor marker.
+        summary = state.summarize_tasks()
+        assert summary.get("_events_dropped", 0) > 0
+        # ... and the same total is exported as a metric.
+        snap = {m["name"]: m for m in metrics.get_metrics()}
+        assert snap["ray_tpu_gcs_task_events_dropped_total"]["value"] > 0
+        # list_tasks without meta still works (and logs the warning).
+        assert isinstance(state.list_tasks(), list)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cli_summary_and_timeline_job(ray_start_regular, tmp_path,
+                                      capsys):
+    """`ray_tpu summary` prints task-state counts + the per-node
+    transfer/skew/queue table; `ray_tpu timeline --job` filters to one
+    job's events."""
+    from ray_tpu.scripts import cli
+
+    @ray_tpu.remote
+    def summed(x):
+        return x * 2
+
+    assert ray_tpu.get([summed.remote(i) for i in range(3)],
+                       timeout=30) == [0, 2, 4]
+    _wait_for(lambda: [t for t in state.list_tasks()
+                       if t["name"] == "summed"
+                       and t.get("state") == "FINISHED"] or None,
+              msg="task events never arrived")
+
+    assert cli.main(["summary"]) == 0
+    out = capsys.readouterr().out
+    assert "tasks:" in out and "FINISHED" in out
+    assert "skew_ms" in out and "queue" in out
+    # Every live node renders a row with its id prefix.
+    for n in state.list_nodes():
+        assert n["node_id"][:12] in out
+
+    job_hex = ray_tpu._core().job_id.hex()
+    trace_path = tmp_path / "trace.json"
+    assert cli.main(["timeline", "--job", job_hex[:8],
+                     "-o", str(trace_path)]) == 0
+    import json as _json
+    events = _json.load(open(trace_path))
+    assert any(e.get("name") == "submit:summed" for e in events)
+    # An unknown job prefix is a clean error, not a stack trace.
+    assert cli.main(["timeline", "--job", "ffffffffffff",
+                     "-o", str(trace_path)]) == 1
+
+
+def test_recorder_spans_reach_timeline(ray_start_regular):
+    """Plane-level flight-recorder spans (lease lifecycle) ride the
+    task-event pipeline and render in the chrome trace under their
+    category."""
+    @ray_tpu.remote
+    def traced_lease():
+        return 1
+
+    assert ray_tpu.get([traced_lease.remote() for _ in range(3)],
+                       timeout=30) == [1, 1, 1]
+
+    def _lease_spans():
+        evs = ray_tpu.timeline()
+        spans = [e for e in evs if e.get("cat") == "lease"
+                 and e["ph"] == "X"]
+        return spans or None
+    spans = _wait_for(_lease_spans,
+                      msg="lease spans never reached the timeline")
+    assert any(e["name"].startswith("lease:") for e in spans)
+
+
 def test_live_profiling_endpoints(ray_start_regular):
     """Worker stack dumps + sampling CPU profile through the agent
     (reference: dashboard/modules/reporter/profile_manager.py py-spy

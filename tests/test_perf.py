@@ -2,6 +2,8 @@
 by release infra, not unit-tested; here a fast smoke keeps the harness from
 bit-rotting)."""
 
+import pytest
+
 import ray_tpu
 from ray_tpu.util import perf
 
@@ -12,6 +14,28 @@ def test_microbenchmarks_smoke(ray_start_regular):
     for name, r in results.items():
         assert r["value"] > 0, name
         assert r["vs_ref"] > 0, name
+
+
+@pytest.mark.slow
+def test_recorder_overhead_ab_gate():
+    """`perf --check`'s flight-recorder A/B: toggles the recorder
+    across full cluster re-inits and gates recorder-on within 3% of
+    recorder-off.  Informational here (the gate itself is exercised;
+    its verdict on a noisy co-tenant box is not a correctness
+    signal)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    rc = perf.check_recorder_overhead(min_time_s=0.4, rounds=1,
+                                      informational=True)
+    assert rc == 0
+    assert not ray_tpu.is_initialized()   # leaves no cluster behind
+
+
+def test_committed_host_fingerprint_probe():
+    """The shared informational rule: the fingerprint probe runs and
+    returns a bool (the A/B gate consumes it for its informational
+    downgrade, same as the absolute gates)."""
+    assert perf.committed_host_mismatch(".") in (True, False)
 
 
 def test_submit_fast_path_rate(ray_start_regular):
