@@ -146,12 +146,22 @@ def main():
     except Exception:    # pragma: no cover - defensive
         host = {"cpu_cores": os.cpu_count()}
 
+    # LLM serving open-loop numbers (continuous batching + streaming +
+    # prefix cache behind Serve): surfaced as their own field so the
+    # serving trajectory reads without digging through the micro table.
+    # The rows also stay in micro_value_vs_ref for the perf --check gate
+    # (serving_ttft_p50_ms is lower-is-better; the gate inverts it).
+    serving = {k: micro[k] for k in ("serving_ttft_p50_ms",
+                                     "serving_tokens_per_s_per_replica")
+               if isinstance(micro, dict) and k in micro}
+
     print(json.dumps({
         "metric": "train_mfu_pct",
         "value": round(mfu, 2),
         "unit": "%% of chip peak (tokens/s/chip=%d, model=%dM params)" % (
             int(tok_s), cfg.param_count() // 1_000_000),
         "vs_baseline": round(mfu / 40.0, 3),
+        "serving": serving,
         "micro_value_vs_ref": micro,
         "micro_host": host,
     }))

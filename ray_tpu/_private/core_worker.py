@@ -3045,12 +3045,14 @@ class CoreWorker:
                 spec, exc.TaskCancelledError(f"{spec['name']} cancelled"))
         else:
             err = get_context().loads_code(reply["error"])
-            if isinstance(err, exc.DeadlineExceededError):
+            if isinstance(err, (exc.DeadlineExceededError,
+                                exc.OverloadedError)):
                 # Worker-side expiry (refused-before-execution, or a
-                # nested hop's budget ran out inside user code): surface
-                # the TYPED error — wrapped in RayTaskError it would slip
-                # past the `except DeadlineExceededError` contract the
-                # docs promise.
+                # nested hop's budget ran out inside user code) and
+                # serving load-shed both surface TYPED — wrapped in
+                # RayTaskError they would slip past the
+                # `except DeadlineExceededError` / `except
+                # OverloadedError` contracts the docs promise.
                 self._store_task_exception(spec, err)
             else:
                 wrapped = exc.RayTaskError(

@@ -22,6 +22,9 @@ Categories ("plane" granularity, gated via config
                stream, hedge fired, swarm source set
     sched      submit-side plane (reserved; SUBMITTED task events
                already cover the per-task view)
+    request    LLM serving lifecycle (llm/serving.py + llm/engine.py):
+               request:admit, prefill (w/ cached_tokens), decode
+               (per tick, w/ batch), sample_sync, request:cancelled
 
 Overflow drops the OLDEST record and counts it (`dropped`) — the
 counter is exported as a metric and stamped into every flush, so a

@@ -24,6 +24,12 @@ Item ``i`` (0-based) is stored under return index ``i + 2`` of the task —
 index 1 is the generator's *completion* object, which resolves (via the
 normal push-task reply path) to None on success or the task's exception,
 so ``gen.completed()`` composes with get/wait like any ref.
+
+Producer-side cleanup is DETERMINISTIC: when the consumer abandons or
+cancels the stream, the executing worker acloses the user's (async)
+generator immediately (worker_main._run_streaming), not at a later GC
+cycle — the contract the LLM serving path relies on to retire a
+cancelled request and free its KV pages mid-decode.
 """
 
 from __future__ import annotations

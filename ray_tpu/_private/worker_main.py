@@ -405,9 +405,16 @@ class Executor:
             try:
                 is_coro = self._coro_method_cache[name]
             except KeyError:
+                import inspect as _inspect
                 method = getattr(self.actor, name, None)
-                is_coro = method is not None and \
+                # Async GENERATOR methods (streaming responses) are not
+                # iscoroutinefunction but must share the async
+                # concurrency budget: serializing them would run one
+                # stream at a time — the opposite of continuous
+                # batching, where N streams feed one engine loop.
+                is_coro = method is not None and (
                     asyncio.iscoroutinefunction(method)
+                    or _inspect.isasyncgenfunction(method))
                 self._coro_method_cache[name] = is_coro
             if is_coro:
                 async with sem:
@@ -845,6 +852,15 @@ class Executor:
                     for fut in pending:
                         fut.cancel()
                     await asyncio.gather(*pending, return_exceptions=True)
+                    # Close the generator NOW (async-for only closes on
+                    # clean exhaustion): a dropped/cancelled stream must
+                    # run the producer's cleanup (e.g. the serving
+                    # engine retiring the request and freeing its KV
+                    # pages) immediately, not at a later GC cycle.
+                    try:
+                        await agen.aclose()
+                    except Exception:
+                        pass
             else:
                 gen = fn(*args, **kwargs)
                 if not hasattr(gen, "__iter__"):
@@ -1049,8 +1065,11 @@ class Executor:
         self.actor_id = spec["actor_id"]
         self.core.current_actor_id = spec["actor_id"]
         max_conc = spec.get("max_concurrency", 1) or 1
+        import inspect as _inspect
         self._actor_is_async = any(
             asyncio.iscoroutinefunction(getattr(type(self.actor), m, None))
+            or _inspect.isasyncgenfunction(getattr(type(self.actor), m,
+                                                   None))
             for m in dir(type(self.actor)) if not m.startswith("__"))
         if self._actor_is_async and max_conc == 1:
             max_conc = 1000  # async actors default to high concurrency

@@ -95,6 +95,45 @@ class TaskCancelledError(RayError):
     pass
 
 
+class OverloadedError(RayError):
+    """A serving admission queue shed this request (load shedding).
+
+    Raised by the LLM serving path when a replica's admission queue
+    exceeds its bound — either the absolute ``max_queue`` or the
+    deadline-aware bound (the estimated queue wait already exceeds the
+    request's remaining deadline budget, so admitting it would only burn
+    decode capacity on a result the caller has written off).  Carries
+    ``retry_after_s``, the replica's own estimate of when capacity frees
+    up; the HTTP front door maps it to ``429`` + a ``Retry-After``
+    header.  Typed end to end (surfaced unwrapped, like
+    DeadlineExceededError, never hidden inside RayTaskError): callers
+    back off and retry, they never see a hang or a generic 500."""
+
+    def __init__(self, message: str = "overloaded",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class StreamBrokenError(RayError):
+    """A streaming response died mid-stream and cannot be transparently
+    resumed.
+
+    The serve router re-dispatches a streaming request whose replica died
+    BEFORE the first item was consumed (nothing observable was lost).
+    Once items have been delivered, a silent re-dispatch would replay the
+    stream from index 0 — duplicating tokens the client already rendered
+    — so the failure surfaces typed instead, carrying
+    ``tokens_emitted`` (items delivered before the break) so clients can
+    resume at the application level (e.g. re-prompt with the partial
+    completion)."""
+
+    def __init__(self, message: str = "stream broken",
+                 tokens_emitted: int = 0):
+        super().__init__(message)
+        self.tokens_emitted = int(tokens_emitted)
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

@@ -32,13 +32,16 @@ vllm's engine surface so reference users can map concepts 1:1.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import math
-from typing import Any, Dict, List, Optional, Sequence
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .._private import flight_recorder
 from ..models.transformer import (TransformerConfig, apply_rope, init_params,
                                   param_logical_axes, rms_norm, rope_angles)
 
@@ -59,6 +62,18 @@ class _Request:
     slot: int = -1
     pages: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
+    # Why generation ended: "stop" (eos), "length" (max_tokens/max_len),
+    # "cancelled" (client disconnect / deadline expiry) — OpenAI naming.
+    finish_reason: str = ""
+    # Prefix-cache bookkeeping: pages borrowed from the cache (ref-held,
+    # never written by this request) and how many prompt tokens they cover.
+    shared_pages: List[int] = dataclasses.field(default_factory=list)
+    prefix_len: int = 0
+    no_cache: bool = False
+    # P/D external admission: a shipped KV blob installed at admission
+    # instead of running prefill (add_external_request).
+    kv_blob: Optional[dict] = None
+    first_token: int = -1
 
 
 # --------------------------------------------------------------------------
@@ -214,6 +229,149 @@ def _decode_fn(params, pool_k, pool_v, tables, last_tokens, lengths, active,
     return pool_k, pool_v, nxt
 
 
+def _suffix_prefill_fn(params, pool_k, pool_v, pages, tokens, prefix_len,
+                       length, cfg: TransformerConfig, page: int):
+    """Suffix half of a prefix-cache hit: run the transformer over ONLY
+    tokens[prefix_len:] while attending to the cached KV of
+    tokens[:prefix_len] already resident in the pool's shared pages.
+
+    pages: (P,) a full page-table row — shared prefix pages first, then
+    the freshly reserved pages whose contents are garbage (masked, like
+    decode's scratch reads; prefix_len is page-aligned by construction).
+    tokens: (1, Sb) the PADDED suffix; length = real suffix length.
+    Returns (last-token logits, suffix ks, vs (L, Sb, KV, D)) — the same
+    contract as _prefill_fn, so the install path is shared."""
+    B, Sb = tokens.shape
+    P = pages.shape[0]
+    T = P * page
+    groups = cfg.num_heads // cfg.num_kv_heads
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    # RoPE at absolute positions prefix_len + i.
+    freqs = 1.0 / (cfg.rope_theta
+                   ** (jnp.arange(0, cfg.head_dim_, 2, jnp.float32)
+                      / cfg.head_dim_))
+    pos = prefix_len + jnp.arange(Sb, dtype=jnp.int32)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    # Key t (over [cached T | suffix Sb]) is valid for suffix query s iff
+    # it is a REAL cached prefix position or a suffix position <= s.
+    tpos = jnp.arange(T + Sb)
+    qpos = jnp.arange(Sb)
+    valid = (tpos[None, :] < prefix_len) | (
+        (tpos[None, :] >= T) & (tpos[None, :] - T <= qpos[:, None]))
+
+    def body(x, layer):
+        lp, pk, pv = layer                  # pk/pv: (N, page, KV, D)
+        h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q, k, v = _layer_qkv(lp, h, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = pk[pages].reshape(T, -1, cfg.head_dim_)
+        cv = pv[pages].reshape(T, -1, cfg.head_dim_)
+        kk = jnp.concatenate([ck[None], k], axis=1)   # (1, T+Sb, KV, D)
+        vv = jnp.concatenate([cv[None], v], axis=1)
+        kr = jnp.repeat(kk, groups, axis=2)
+        vr = jnp.repeat(vv, groups, axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", q, kr) \
+            / jnp.sqrt(jnp.asarray(cfg.head_dim_, jnp.float32)).astype(q.dtype)
+        scores = jnp.where(valid[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+        o = jnp.einsum("bhst,bthd->bshd", p, vr)
+        o = jnp.einsum("bshd,hde->bse", o,
+                       lp["attn"]["wo"].astype(cfg.dtype))
+        x = _mlp(lp, x + o, cfg)
+        return x, (k[0], v[0])
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], pool_k, pool_v))
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    last = x[0, length - 1]
+    logits = jnp.einsum("e,ev->v", last, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+class _PrefixCache:
+    """Page-granular KV prefix reuse (vLLM's PagedAttention block
+    sharing, Kwon et al. SOSP'23, mapped onto the paged pool): every
+    FULL prompt page is keyed by the rolling hash of all tokens up to
+    its end, so requests sharing a prompt prefix share the physical
+    pages — skipping both the page allocation and the prefill compute
+    for the shared span.
+
+    Entries are LRU-ordered; eviction is driven by pool pressure (the
+    reserve path evicts until the new request fits or the cache is dry).
+    Pages are ref-counted by the engine: cache membership holds one ref
+    per entry, each active request one — a page returns to the free
+    list only when the last holder lets go, so evicting an entry out
+    from under an in-flight request is safe."""
+
+    def __init__(self, page: int):
+        self.page = page
+        # rolling-hash key -> page ids covering the whole prefix
+        self._entries: "OrderedDict[bytes, List[int]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.hit_pages = 0          # pages whose prefill was skipped
+        self.evictions = 0
+
+    def _keys(self, prompt: Sequence[int], upto: int) -> List[bytes]:
+        """Rolling hash at every page boundary 1..upto."""
+        h = hashlib.blake2b(digest_size=16)
+        out = []
+        for k in range(1, upto + 1):
+            h.update(np.asarray(prompt[(k - 1) * self.page: k * self.page],
+                                np.int32).tobytes())
+            out.append(h.copy().digest())
+        return out
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached prefix usable by this prompt: (token count,
+        page ids).  Capped at S-1 tokens — the last prompt token's
+        logits must be computed, so at least a one-token suffix always
+        runs through prefill."""
+        usable = (len(prompt) - 1) // self.page
+        if usable <= 0:
+            return 0, []
+        keys = self._keys(prompt, usable)
+        for k in range(usable, 0, -1):
+            pages = self._entries.get(keys[k - 1])
+            if pages is not None:
+                self._entries.move_to_end(keys[k - 1])
+                self.hits += 1
+                self.hit_pages += k
+                return k * self.page, list(pages)
+        self.misses += 1
+        return 0, []
+
+    def insert(self, prompt: Sequence[int], table_row, incref) -> None:
+        """Register every full prompt page of a freshly admitted request
+        (decode writes land strictly after them, so they are immutable)."""
+        full = len(prompt) // self.page
+        if full <= 0:
+            return
+        keys = self._keys(prompt, full)
+        for k in range(1, full + 1):
+            key = keys[k - 1]
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            pages = [int(p) for p in table_row[:k]]
+            self._entries[key] = pages
+            for p in pages:
+                incref(p)
+
+    def evict_lru(self, decref) -> bool:
+        """Drop the least-recently-used entry; True if one was dropped.
+        Pages still held by active requests stay allocated (ref > 0)."""
+        if not self._entries:
+            return False
+        _, pages = self._entries.popitem(last=False)
+        self.evictions += 1
+        for p in pages:
+            decref(p)
+        return True
+
+
 # --------------------------------------------------------------------------
 # Engine
 # --------------------------------------------------------------------------
@@ -226,10 +384,15 @@ class LLMEngine:
     def __init__(self, cfg: TransformerConfig, params=None, *,
                  max_batch: int = 4, max_len: int = 256, seed: int = 0,
                  mesh=None, rules=None, page_size: int = 64,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: bool = False):
         """kv_pages sizes the shared pool (default: enough for every slot
         at max_len — set it lower to oversubscribe: admission then queues
-        until pages free up).  mesh: shard weights + KV over its tp axis."""
+        until pages free up).  mesh: shard weights + KV over its tp axis.
+        prefix_cache=True enables page-granular KV prefix reuse (shared
+        full prompt pages skip prefill; LRU-evicted under pool
+        pressure) — off by default: retired pages then linger in the
+        cache instead of returning to the free list immediately."""
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
@@ -271,9 +434,17 @@ class LLMEngine:
         self._rng = jax.random.key(seed + 1)
         self._free_slots = list(range(max_batch))
         self._free_pages = list(range(1, self.n_pages))
+        # page -> holder count (requests + cache entries); a page leaves
+        # _free_pages with count 1 and returns when the count hits 0.
+        self._page_refs: Dict[int, int] = {}
+        self._cache = _PrefixCache(self.page) if prefix_cache else None
         self._tables = np.zeros((max_batch, self.pages_per_slot), np.int32)
         self._slots: Dict[int, _Request] = {}
         self._waiting: List[_Request] = []
+        # Live requests by id (waiting + active): cancel_request and the
+        # serving layer's stream fan-out address requests through this.
+        self._requests: Dict[int, _Request] = {}
+        self._tick_events: List[Tuple[int, int, bool]] = []
         self._next_id = 0
         self._last = np.zeros(max_batch, np.int32)
         self._lengths = np.zeros(max_batch, np.int32)
@@ -295,26 +466,116 @@ class LLMEngine:
         return math.ceil(min(budget, self.max_len) / self.page)
 
     def add_request(self, prompt_tokens: Sequence[int],
-                    params: Optional[SamplingParams] = None) -> int:
+                    params: Optional[SamplingParams] = None, *,
+                    no_cache: bool = False) -> int:
         if len(prompt_tokens) >= self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt_tokens)}) >= max_len ({self.max_len})")
         req = _Request(self._next_id, list(prompt_tokens),
                        params or SamplingParams())
+        req.no_cache = no_cache
         need = self._pages_needed(req)
         if need > self.n_pages - 1:
             raise ValueError(
                 f"request needs {need} KV pages but the pool only has "
                 f"{self.n_pages - 1} — raise kv_pages or lower max_tokens")
         self._next_id += 1
+        self._requests[req.req_id] = req
         self._waiting.append(req)
         return req.req_id
+
+    def add_external_request(self, kv_blob: dict, first_token: int,
+                             params: Optional[SamplingParams] = None, *,
+                             prompt_tokens: Optional[Sequence[int]] = None
+                             ) -> int:
+        """Queue a request whose prefill ran elsewhere (the P/D decode
+        half): the shipped KV blob installs at admission time, through
+        the SAME admission queue, page accounting and — when the real
+        prompt tokens are supplied — prefix cache as locally-prefilled
+        requests, so deadline expiry, pool pressure and cancellation
+        behave identically."""
+        params = params or SamplingParams()
+        S = int(kv_blob["len"])
+        if S >= self.max_len:
+            raise ValueError(f"prompt ({S}) >= max_len ({self.max_len})")
+        prompt = (list(prompt_tokens) if prompt_tokens is not None
+                  else [0] * S)
+        if len(prompt) != S:
+            raise ValueError(
+                f"prompt_tokens length ({len(prompt)}) != kv blob length "
+                f"({S})")
+        req = _Request(self._next_id, prompt, params)
+        req.no_cache = prompt_tokens is None
+        req.kv_blob = kv_blob
+        req.first_token = int(first_token)
+        need = self._pages_needed(req)
+        if need > self.n_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.n_pages - 1} — raise kv_pages or lower max_tokens")
+        self._next_id += 1
+        self._requests[req.req_id] = req
+        self._waiting.append(req)
+        return req.req_id
+
+    def cancel_request(self, req_id: int) -> bool:
+        """Retire a request mid-flight (client disconnect, deadline
+        expiry): its pages return to the pool IMMEDIATELY — mid-decode,
+        not at end of batch.  True if the request was live."""
+        req = self._requests.get(req_id)
+        if req is None:
+            return False
+        req.finished = True
+        req.finish_reason = req.finish_reason or "cancelled"
+        if req.slot >= 0 and self._slots.get(req.slot) is req:
+            self._retire(req.slot)
+        else:
+            try:
+                self._waiting.remove(req)
+            except ValueError:
+                pass
+            self._requests.pop(req_id, None)
+        return True
+
+    def take_tick_events(self) -> List[Tuple[int, int, bool]]:
+        """(req_id, token, finished) tuples emitted by the last step() —
+        admission first-tokens and decode tokens, in emission order.
+        The serving layer drains these to fan tokens out to per-request
+        streams."""
+        ev = self._tick_events
+        self._tick_events = []
+        return ev
 
     def has_unfinished(self) -> bool:
         return bool(self._waiting or self._slots)
 
     def kv_pages_free(self) -> int:
         return len(self._free_pages)
+
+    @property
+    def kv_pages_total(self) -> int:
+        return self.n_pages - 1
+
+    def kv_page_occupancy(self) -> float:
+        return 1.0 - len(self._free_pages) / max(1, self.n_pages - 1)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def active_requests(self) -> int:
+        return len(self._slots)
+
+    def prefix_cache_stats(self) -> Dict[str, Any]:
+        if self._cache is None:
+            return {"enabled": False}
+        return {"enabled": True, "entries": len(self._cache._entries),
+                "hits": self._cache.hits, "misses": self._cache.misses,
+                "hit_pages": self._cache.hit_pages,
+                "evictions": self._cache.evictions,
+                "allocated_pages": len(self._page_refs),
+                "free_pages": len(self._free_pages)}
 
     # ---------------------------------------------------------------- step --
     def _bucket(self, n: int) -> int:
@@ -336,15 +597,52 @@ class LLMEngine:
         toks[0, :S] = prompt
         return self._prefill_jit[Sb](self.params, jnp.asarray(toks), S)
 
+    # ------------------------------------------------------ page refcounts --
+    def _alloc_page(self) -> int:
+        p = self._free_pages.pop(0)
+        self._page_refs[p] = 1
+        return p
+
+    def _incref(self, p: int) -> None:
+        self._page_refs[p] += 1
+
+    def _decref(self, p: int) -> None:
+        n = self._page_refs[p] - 1
+        if n > 0:
+            self._page_refs[p] = n
+        else:
+            del self._page_refs[p]
+            self._free_pages.append(p)
+
     def _reserve(self, req: _Request) -> bool:
-        """Reserve slot + pages for a request; False = wait for capacity."""
-        need = self._pages_needed(req)
-        if not self._free_slots or len(self._free_pages) < need:
+        """Reserve slot + pages for a request; False = wait for capacity.
+        With the prefix cache on, shared prefix pages are reused
+        (ref-counted, never re-allocated) and LRU entries are evicted
+        under pool pressure before giving up."""
+        if not self._free_slots:
+            return False
+        c, shared = 0, []
+        if self._cache is not None and not req.no_cache:
+            c, shared = self._cache.lookup(req.prompt)
+        total = self._pages_needed(req)
+        need = total - len(shared)
+        # Hold the shared pages before any eviction can touch them.
+        for p in shared:
+            self._incref(p)
+        while len(self._free_pages) < need and self._cache is not None \
+                and self._cache.evict_lru(self._decref):
+            pass
+        if len(self._free_pages) < need:
+            for p in shared:
+                self._decref(p)
             return False
         req.slot = self._free_slots.pop(0)
-        req.pages = [self._free_pages.pop(0) for _ in range(need)]
+        req.pages = [self._alloc_page() for _ in range(need)]
+        req.shared_pages = shared
+        req.prefix_len = c
         row = np.zeros(self.pages_per_slot, np.int32)
-        row[:need] = req.pages
+        row[:len(shared)] = shared
+        row[len(shared):total] = req.pages
         self._tables[req.slot] = row
         return True
 
@@ -353,38 +651,136 @@ class LLMEngine:
         self._pk, self._pv = self._install_jit(
             self._pk, self._pv, ks, vs, pages)
 
+    def _install_pages(self, page_ids: Sequence[int], ks, vs):
+        """Install KV into specific pool pages (ks/vs start page-aligned
+        on page_ids[0]; trailing scratch-page writes are masked reads by
+        contract, same as _install)."""
+        pages = np.zeros(self.pages_per_slot, np.int32)
+        pages[:len(page_ids)] = page_ids
+        self._pk, self._pv = self._install_jit(
+            self._pk, self._pv, ks, vs, jnp.asarray(pages))
+
+    def _install_new_pages(self, req: _Request, ks, vs):
+        """Install suffix KV into the request's NEWLY reserved pages (the
+        suffix starts page-aligned at prefix_len, so it maps exactly onto
+        them; the shared prefix pages are already resident and are never
+        written)."""
+        self._install_pages(req.pages, ks, vs)
+
+    def _run_suffix(self, prompt: Sequence[int], prefix_len: int,
+                    pages_row):
+        """Jit-cached suffix prefill against resident prefix pages."""
+        suf = prompt[prefix_len:]
+        S = len(suf)
+        Sb = self._bucket(S)
+        key = ("suffix", Sb)
+        if key not in self._prefill_jit:
+            cfg, page = self.cfg, self.page
+            self._prefill_jit[key] = jax.jit(
+                lambda p, pk, pv, pg, t, pl, n: _suffix_prefill_fn(
+                    p, pk, pv, pg, t, pl, n, cfg, page))
+        toks = np.zeros((1, Sb), np.int32)
+        toks[0, :S] = suf
+        return self._prefill_jit[key](
+            self.params, self._pk, self._pv, jnp.asarray(pages_row),
+            jnp.asarray(toks), prefix_len, S)
+
     def _admit(self):
+        rec = flight_recorder.recorder()
+        admitted = []
         while self._waiting and self._reserve(self._waiting[0]):
             req = self._waiting.pop(0)
             S = len(req.prompt)
-            logits, ks, vs = self._run_prefill(req.prompt)
-            self._install(req.slot, ks, vs)
-            first = self._sample_host(logits, req.params)
+            active_before = len(self._slots)
+            t0 = rec.begin()
+            if req.kv_blob is not None:
+                self._install_external(req)
+            elif req.prefix_len:
+                logits, ks, vs = self._run_suffix(
+                    req.prompt, req.prefix_len, self._tables[req.slot])
+                self._install_new_pages(req, ks, vs)
+            else:
+                logits, ks, vs = self._run_prefill(req.prompt)
+                self._install(req.slot, ks, vs)
+            rec.end("request", "prefill", t0,
+                    id=req.req_id.to_bytes(8, "little"), tokens=S,
+                    cached_tokens=req.prefix_len, active=active_before)
+            if self._cache is not None and not req.no_cache:
+                self._cache.insert(req.prompt, self._tables[req.slot],
+                                   self._incref)
             self._lengths[req.slot] = S
-            self._last[req.slot] = first
             self._temps[req.slot] = req.params.temperature
             self._slots[req.slot] = req
-            self._emit(req, int(first))
+            if req.kv_blob is not None:
+                req.kv_blob = None          # release the host copy
+                self._last[req.slot] = req.first_token
+                self._emit(req, int(req.first_token))
+            else:
+                admitted.append((req, logits))
+        if admitted:
+            firsts = self._sample_batch([lg for _, lg in admitted],
+                                        [r.params for r, _ in admitted])
+            for (req, _), first in zip(admitted, firsts):
+                self._last[req.slot] = first
+                self._emit(req, int(first))
+
+    def _install_external(self, req: _Request):
+        """Install a shipped KV blob; on a prefix-cache hit only the
+        suffix pages are written (the shared span is already resident)."""
+        blob = req.kv_blob
+        ks = jnp.asarray(blob["k"], self.cfg.dtype)
+        vs = jnp.asarray(blob["v"], self.cfg.dtype)
+        if req.prefix_len:
+            self._install_new_pages(req, ks[:, req.prefix_len:],
+                                    vs[:, req.prefix_len:])
+        else:
+            self._install(req.slot, ks, vs)
+
+    def _sample_batch(self, logits_list, params_list) -> List[int]:
+        """Sample first tokens for a whole admission wave in ONE
+        device->host transfer (the previous per-request host pull was a
+        blocking sync per request per tick); the sync cost is stamped as
+        a `sample_sync` recorder span so the serving harness sees it."""
+        rec = flight_recorder.recorder()
+        t0 = rec.begin()
+        lg = jnp.stack(logits_list)                       # (N, V) f32
+        temps = np.asarray([p.temperature for p in params_list],
+                           np.float32)
+        greedy = jnp.argmax(lg, -1).astype(jnp.int32)
+        if (temps > 0).any():
+            self._rng, key = jax.random.split(self._rng)
+            keys = jax.random.split(key, len(params_list))
+            tj = jnp.asarray(temps)
+            sampled = jax.vmap(
+                lambda k, l, t: jax.random.categorical(
+                    k, l / jnp.maximum(t, 1e-6)))(keys, lg, tj)
+            toks = jnp.where(tj > 0, sampled.astype(jnp.int32), greedy)
+        else:
+            toks = greedy
+        out = np.asarray(toks)                            # the one sync
+        rec.end("request", "sample_sync", t0, batch=len(params_list))
+        return [int(t) for t in out]
 
     def _sample_host(self, logits, params: SamplingParams) -> int:
-        if params.temperature <= 0:
-            return int(jnp.argmax(logits))
-        self._rng, key = jax.random.split(self._rng)
-        return int(jax.random.categorical(
-            key, logits / max(params.temperature, 1e-6)))
+        return self._sample_batch([logits], [params])[0]
 
     def _emit(self, req: _Request, token: int):
         req.out.append(token)
         p = req.params
-        if (p.eos_id is not None and token == p.eos_id) \
-                or len(req.out) >= p.max_tokens \
+        if p.eos_id is not None and token == p.eos_id:
+            req.finished = True
+            req.finish_reason = req.finish_reason or "stop"
+        elif len(req.out) >= p.max_tokens \
                 or len(req.prompt) + len(req.out) >= self.max_len - 1:
             req.finished = True
+            req.finish_reason = req.finish_reason or "length"
+        self._tick_events.append((req.req_id, token, req.finished))
 
     def step(self) -> List[_Request]:
         """Admit waiting requests, run ONE decode step for all active
         slots, retire finished requests.  Returns requests finished in
         this step (vllm engine.step parity)."""
+        self._tick_events = []
         self._admit()
         done: List[_Request] = []
         # Retire requests that finished at admission (eos on first token).
@@ -396,12 +792,15 @@ class LLMEngine:
         active = np.zeros(self.max_batch, bool)
         for slot in self._slots:
             active[slot] = True
+        rec = flight_recorder.recorder()
+        t0 = rec.begin()
         self._rng, key = jax.random.split(self._rng)
         self._pk, self._pv, nxt = self._decode_jit(
             self.params, self._pk, self._pv, jnp.asarray(self._tables),
             jnp.asarray(self._last), jnp.asarray(self._lengths),
             jnp.asarray(active), jnp.asarray(self._temps), key)
         nxt = np.asarray(nxt)
+        rec.end("request", "decode", t0, batch=len(self._slots))
         for slot, req in list(self._slots.items()):
             self._lengths[slot] += 1          # the token we just attended
             tok = int(nxt[slot])
@@ -414,10 +813,15 @@ class LLMEngine:
     def _retire(self, slot: int) -> _Request:
         req = self._slots.pop(slot)
         self._free_slots.append(slot)
-        self._free_pages.extend(req.pages)
+        for p in req.pages:
+            self._decref(p)
+        for p in req.shared_pages:
+            self._decref(p)
         req.pages = []
+        req.shared_pages = []
         self._tables[slot] = 0
         self._lengths[slot] = 0
+        self._requests.pop(req.req_id, None)
         return req
 
     # ------------------------------------------------------------ generate --
@@ -439,35 +843,70 @@ class LLMEngine:
         llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py):
         returns (kv_blob, first_token) to ship to a decode node via the
         object store.  With a sharded engine this is the KV-transfer path:
-        np.asarray gathers the tp-sharded cache to host for the wire."""
+        np.asarray gathers the tp-sharded cache to host for the wire.
+        With the prefix cache on, a hit computes only the suffix and
+        gathers the shared span straight out of the resident pages."""
         params = params or SamplingParams()
         S = len(prompt_tokens)
         if S >= self.max_len:
             raise ValueError(f"prompt ({S}) >= max_len ({self.max_len})")
-        logits, ks, vs = self._run_prefill(prompt_tokens)
+        prompt = list(prompt_tokens)
+        rec = flight_recorder.recorder()
+        t0 = rec.begin()
+        c, shared = 0, []
+        if self._cache is not None:
+            c, shared = self._cache.lookup(prompt)
+        if c:
+            row = np.zeros(self.pages_per_slot, np.int32)
+            row[:len(shared)] = shared
+            logits, ks, vs = self._run_suffix(prompt, c, row)
+            ck = np.asarray(self._pk[:, np.asarray(shared)]).reshape(
+                self.cfg.num_layers, c, self.cfg.num_kv_heads, -1)
+            cv = np.asarray(self._pv[:, np.asarray(shared)]).reshape(
+                self.cfg.num_layers, c, self.cfg.num_kv_heads, -1)
+            k_full = np.concatenate([ck, np.asarray(ks[:, :S - c])], 1)
+            v_full = np.concatenate([cv, np.asarray(vs[:, :S - c])], 1)
+        else:
+            logits, ks, vs = self._run_prefill(prompt)
+            k_full = np.asarray(ks[:, :S])
+            v_full = np.asarray(vs[:, :S])
+        # Populate the cache from this prefill: a prefill-only engine
+        # (the P/D prefill half) runs no admission, so this is its only
+        # insertion point.  The full prompt pages beyond the cached
+        # prefix install into fresh pool pages held alive by the cache
+        # entries alone (skipped under pool pressure — eviction is the
+        # admission path's call, not an insert's).
+        full = S // self.page
+        new_cnt = full - len(shared)
+        if self._cache is not None and new_cnt > 0 \
+                and len(self._free_pages) >= new_cnt:
+            fresh = [self._alloc_page() for _ in range(new_cnt)]
+            span = full * self.page - c       # tokens [c, full*page)
+            self._install_pages(fresh, ks[:, :span], vs[:, :span])
+            row = np.zeros(self.pages_per_slot, np.int32)
+            row[:len(shared)] = shared
+            row[len(shared):full] = fresh
+            self._cache.insert(prompt, row, self._incref)
+            for p in fresh:
+                self._decref(p)               # cache refs keep them
+        rec.end("request", "prefill", t0, tokens=S, cached_tokens=c,
+                external=True)
         first = self._sample_host(logits, params)
-        return {"k": np.asarray(ks[:, :S]), "v": np.asarray(vs[:, :S]),
-                "len": S}, int(first)
+        return {"k": k_full, "v": v_full, "len": S}, int(first)
 
     def decode_from(self, kv_blob: dict, first_token: int,
-                    params: Optional[SamplingParams] = None) -> List[int]:
-        """Decode-node half: install a shipped prefill and run decode."""
-        params = params or SamplingParams()
-        S = kv_blob["len"]
-        if S >= self.max_len:
-            raise ValueError(f"prompt ({S}) >= max_len ({self.max_len})")
-        req = _Request(self._next_id, [0] * S, params)
-        self._next_id += 1
-        if not self._reserve(req):
-            raise RuntimeError("no free slots/pages on decode engine")
-        ks = jnp.asarray(kv_blob["k"], self.cfg.dtype)
-        vs = jnp.asarray(kv_blob["v"], self.cfg.dtype)
-        self._install(req.slot, ks, vs)
-        self._lengths[req.slot] = S
-        self._last[req.slot] = first_token
-        self._temps[req.slot] = params.temperature
-        self._slots[req.slot] = req
-        self._emit(req, int(first_token))
-        while req.slot in self._slots:
-            self.step()
-        return req.out
+                    params: Optional[SamplingParams] = None, *,
+                    prompt_tokens: Optional[Sequence[int]] = None
+                    ) -> List[int]:
+        """Decode-node half: install a shipped prefill and run decode to
+        completion (closed-loop convenience over add_external_request —
+        the serving layer streams the same admission instead)."""
+        rid = self.add_external_request(kv_blob, first_token, params,
+                                       prompt_tokens=prompt_tokens)
+        req = self._requests[rid]
+        while self.has_unfinished():
+            for done in self.step():
+                if done.req_id == rid:
+                    return done.out
+        raise RuntimeError(
+            f"decode request {req.req_id} was dropped without finishing")

@@ -3,9 +3,17 @@
 Reference surface: python/ray/llm/_internal/serve/ — the reference's
 `build_openai_app` exposes vLLM engines behind /v1/models,
 /v1/completions and /v1/chat/completions with the OpenAI JSON shapes.
-TPU-native: the same routes over the continuous-batching JAX engine
-(engine.py), as a Serve ingress deployment (HTTP proxy -> router ->
+TPU-native: the same routes over the continuous-batching serving core
+(serving.EngineReplica — iteration-level admission, paged KV + prefix
+cache), as a Serve ingress deployment (HTTP proxy -> router ->
 replicas, all the usual autoscaling/multiplexing machinery applies).
+
+``stream: true`` returns Server-Sent Events: the ingress hands the
+proxy a :class:`~ray_tpu.serve.StreamingResponse` descriptor and the
+proxy re-dispatches it as a STREAMING call — tokens flow replica ->
+router -> chunked HTTP as they decode, a disconnect cancels the request
+typed (pages freed mid-decode), and the final chunk carries the real
+``finish_reason`` (``stop`` | ``length`` | ``cancelled``).
 
 Tokenization is pluggable (`tokenizer=`): pass anything with
 encode(str)->List[int] / decode(List[int])->str (e.g. a transformers
@@ -16,13 +24,15 @@ air-gapped smoke runs work out of the box.
 
 from __future__ import annotations
 
+import codecs
+import json
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 from .. import serve
 from ..models import PRESETS
-from .engine import LLMEngine, SamplingParams
+from .serving import EngineReplica
 
 
 class ByteTokenizer:
@@ -43,31 +53,67 @@ class ByteTokenizer:
                      ).decode("utf-8", errors="replace")
 
 
+class _Detokenizer:
+    """Incremental token -> text for streaming deltas.  Byte-level
+    tokenizers hold incomplete UTF-8 sequences back (a multi-byte char
+    split across chunks must not emit replacement glyphs); generic
+    tokenizers fall back to full-decode prefix deltas."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._byte = isinstance(tokenizer, ByteTokenizer)
+        if self._byte:
+            self._dec = codecs.getincrementaldecoder("utf-8")("replace")
+        else:
+            self._all: List[int] = []
+            self._emitted = ""
+
+    def feed(self, token: int) -> str:
+        if self._byte:
+            if token < ByteTokenizer.OFFSET:
+                return ""
+            return self._dec.decode(
+                bytes([max(0, min(255, token - ByteTokenizer.OFFSET))]))
+        self._all.append(token)
+        text = self._tok.decode(self._all)
+        delta = text[len(self._emitted):]
+        self._emitted = text
+        return delta
+
+
 class OpenAIServer:
-    """Ingress deployment: routes the OpenAI surface onto the engine."""
+    """Ingress deployment: routes the OpenAI surface onto the
+    continuous-batching serving core."""
 
     def __init__(self, preset: str = "tiny", model_name: str = "ray-tpu",
                  max_batch: int = 4, max_len: int = 128,
-                 tokenizer: Any = None, seed: int = 0):
+                 tokenizer: Any = None, seed: int = 0,
+                 page_size: int = 16, kv_pages: Optional[int] = None,
+                 prefix_cache: bool = True, max_queue: int = 64):
         cfg = PRESETS[preset]
         self.model_name = model_name
         self.max_len = max_len
-        self.engine = LLMEngine(cfg, max_batch=max_batch,
-                                max_len=max_len, seed=seed)
+        self.serving = EngineReplica(
+            preset, max_batch=max_batch, max_len=max_len,
+            page_size=page_size, kv_pages=kv_pages,
+            prefix_cache=prefix_cache, max_queue=max_queue, seed=seed)
         self.tokenizer = tokenizer or ByteTokenizer(cfg.vocab_size)
         self._created = int(time.time())
 
+    def __serve_load__(self) -> float:
+        return self.serving.__serve_load__()
+
     # ------------------------------------------------------------ helpers --
-    def _completion(self, prompt: str, max_tokens: int,
-                    temperature: float) -> Dict[str, Any]:
+    async def _completion(self, prompt: str, max_tokens: int,
+                          temperature: float) -> Dict[str, Any]:
         toks = self.tokenizer.encode(prompt)[: self.max_len - 2]
-        params = SamplingParams(max_tokens=max_tokens,
-                                temperature=temperature)
-        out = self.engine.generate([toks], params)[0]
+        res = await self.serving.generate(
+            toks, {"max_tokens": max_tokens, "temperature": temperature})
         return {
-            "text": self.tokenizer.decode(out),
+            "text": self.tokenizer.decode(res["tokens"]),
+            "finish_reason": res["finish_reason"] or "length",
             "prompt_tokens": len(toks),
-            "completion_tokens": len(out),
+            "completion_tokens": len(res["tokens"]),
         }
 
     @staticmethod
@@ -78,8 +124,68 @@ class OpenAIServer:
             "error": {"message": msg, "type": "invalid_request_error",
                       "code": code}})
 
+    def _stream_response(self, kind: str, prompt: str, max_tokens: int,
+                         temperature: float, model: str):
+        toks = self.tokenizer.encode(prompt)[: self.max_len - 2]
+        return serve.StreamingResponse(
+            "sse_stream",
+            (kind, toks, {"max_tokens": max_tokens,
+                          "temperature": temperature}, model),
+            content_type="text/event-stream")
+
+    async def sse_stream(self, kind: str, prompt_tokens: List[int],
+                         opts: dict, model: str):
+        """Async generator of SSE frames: one chunk per decoded delta,
+        a final chunk carrying finish_reason, then [DONE].  Dispatched
+        by the proxy as a streaming request — any replica can serve it
+        (everything it needs rides the args)."""
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if kind == "chat"
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        created = int(time.time())
+        detok = _Detokenizer(self.tokenizer)
+        if kind == "chat":
+            first = {"id": rid, "object": "chat.completion.chunk",
+                     "created": created, "model": model,
+                     "choices": [{"index": 0,
+                                  "delta": {"role": "assistant"},
+                                  "finish_reason": None}]}
+            yield f"data: {json.dumps(first)}\n\n"
+
+        def chunk(delta_text: Optional[str], finish: Optional[str]):
+            if kind == "chat":
+                delta = ({} if delta_text is None
+                         else {"content": delta_text})
+                choice = {"index": 0, "delta": delta,
+                          "finish_reason": finish}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta_text or "",
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return ("data: " + json.dumps(
+                {"id": rid, "object": obj, "created": created,
+                 "model": model, "choices": [choice]}) + "\n\n")
+
+        finish = "length"
+        gen = self.serving.stream_generate(prompt_tokens, opts)
+        try:
+            async for item in gen:
+                if isinstance(item, dict):
+                    finish = item.get("finish_reason") or finish
+                    break
+                delta = detok.feed(item)
+                if delta:
+                    yield chunk(delta, None)
+        finally:
+            await gen.aclose()
+        # On client disconnect this generator is simply closed (the
+        # engine request is cancelled typed); terminal frames only go to
+        # clients that are still listening.
+        yield chunk(None, finish)
+        yield "data: [DONE]\n\n"
+
     # --------------------------------------------------------------- routes --
-    def __call__(self, request):
+    async def __call__(self, request):
         path = request.path
         if path.endswith("/models"):
             return {"object": "list", "data": [{
@@ -100,6 +206,8 @@ class OpenAIServer:
         except (TypeError, ValueError):
             return self._error(
                 400, "max_tokens/temperature must be numbers")
+        stream = bool(body.get("stream"))
+        model = body.get("model", self.model_name)
         if path.endswith("/chat/completions"):
             msgs = body.get("messages") or []
             if not msgs:
@@ -109,16 +217,19 @@ class OpenAIServer:
             prompt = "\n".join(
                 f"{m.get('role', 'user')}: {m.get('content', '')}"
                 for m in msgs) + "\nassistant:"
-            res = self._completion(prompt, max_tokens, temperature)
+            if stream:
+                return self._stream_response("chat", prompt, max_tokens,
+                                             temperature, model)
+            res = await self._completion(prompt, max_tokens, temperature)
             return {
                 "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
                 "object": "chat.completion",
                 "created": int(time.time()),
-                "model": body.get("model", self.model_name),
+                "model": model,
                 "choices": [{"index": 0,
                              "message": {"role": "assistant",
                                          "content": res["text"]},
-                             "finish_reason": "length"}],
+                             "finish_reason": res["finish_reason"]}],
                 "usage": {
                     "prompt_tokens": res["prompt_tokens"],
                     "completion_tokens": res["completion_tokens"],
@@ -130,18 +241,30 @@ class OpenAIServer:
             if prompt is None:
                 return self._error(400, "prompt is required")
             prompts = prompt if isinstance(prompt, list) else [prompt]
+            if stream:
+                if len(prompts) != 1:
+                    return self._error(
+                        400, "stream=true supports a single prompt")
+                return self._stream_response("text", str(prompts[0]),
+                                             max_tokens, temperature,
+                                             model)
+            # Concurrent: the prompts share decode ticks in one
+            # continuous batch instead of running back-to-back.
+            import asyncio
+            results = await asyncio.gather(*[
+                self._completion(str(p), max_tokens, temperature)
+                for p in prompts])
             choices, pt, ct = [], 0, 0
-            for i, p in enumerate(prompts):
-                res = self._completion(str(p), max_tokens, temperature)
+            for i, res in enumerate(results):
                 pt += res["prompt_tokens"]
                 ct += res["completion_tokens"]
                 choices.append({"index": i, "text": res["text"],
-                                "finish_reason": "length"})
+                                "finish_reason": res["finish_reason"]})
             return {
                 "id": f"cmpl-{uuid.uuid4().hex[:24]}",
                 "object": "text_completion",
                 "created": int(time.time()),
-                "model": body.get("model", self.model_name),
+                "model": model,
                 "choices": choices,
                 "usage": {"prompt_tokens": pt, "completion_tokens": ct,
                           "total_tokens": pt + ct},
@@ -154,15 +277,20 @@ def build_openai_app(preset: str = "tiny", *,
                      num_replicas: int = 1,
                      max_batch: int = 4, max_len: int = 128,
                      tokenizer: Any = None,
-                     ray_actor_options: Optional[dict] = None):
+                     ray_actor_options: Optional[dict] = None,
+                     autoscaling_config: Optional[dict] = None,
+                     **engine_kwargs):
     """`serve.run(build_openai_app(...), route_prefix="/v1")` and any
     OpenAI client pointed at the proxy works (reference:
-    llm/_internal/serve build_openai_app)."""
+    llm/_internal/serve build_openai_app) — including
+    ``stream=true`` SSE.  `autoscaling_config` enables queue-driven
+    replica scaling (min_replicas=0 for scale-to-zero)."""
     dep = serve.deployment(
         OpenAIServer, name=f"openai_{model_name}",
         num_replicas=num_replicas,
         ray_actor_options=ray_actor_options or {"num_cpus": 1},
-        route_prefix="/v1")
+        route_prefix="/v1",
+        autoscaling_config=autoscaling_config)
     return dep.bind(preset=preset, model_name=model_name,
                     max_batch=max_batch, max_len=max_len,
-                    tokenizer=tokenizer)
+                    tokenizer=tokenizer, **engine_kwargs)

@@ -1,13 +1,25 @@
-"""LLM serving patterns on Serve: data-parallel replicas and
-prefill/decode disaggregation.
+"""LLM serving patterns on Serve, built on the production serving core.
 
 Reference: python/ray/llm/_internal/serve/serving_patterns/ —
 data_parallel/dp_server.py (N identical engine replicas behind the
 router) and prefill_decode/pd_server.py (prefill nodes compute the KV
-cache, ship it, decode nodes stream tokens).  TPU-native: the KV blob
-rides the shared-memory object plane between replicas (zero-copy on one
-host, chunked transfer across hosts); each replica owns its chip(s) via
-the TPU resource.
+cache, ship it, decode nodes stream tokens).
+
+Every pattern deploys :class:`~ray_tpu.llm.serving.EngineReplica` — the
+continuous-batching actor (per-tick admission/retirement, token
+streaming, KV-prefix cache, deadline-aware shedding) — instead of a
+closed-loop ``generate()`` server:
+
+- ``build_llm_app``: THE production path — autoscaled data-parallel
+  replicas (queue-depth × page-occupancy driven, scale-to-zero capable)
+  with streaming via ``handle.options(stream=True,
+  method_name="stream_generate")``.
+- ``build_dp_deployment``: fixed-size data-parallel app (compat
+  surface; same replica class).
+- ``run_pd_app``: prefill/decode disaggregation — the KV blob rides
+  the shared-memory object plane between replicas and enters the decode
+  replica through the SAME admission queue as local requests, so
+  deadlines and shedding compose.
 """
 
 from __future__ import annotations
@@ -15,85 +27,79 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from .. import serve
-from ..models import PRESETS
-from .engine import LLMEngine, SamplingParams
+from .serving import EngineReplica
 
 
-class _LLMServer:
-    """One engine behind @serve.batch: single-prompt requests coalesce
-    into one continuous-batching generate call (reference:
-    dp_server.py + serve/batching.py)."""
+def build_llm_app(preset: str = "tiny", *, name: Optional[str] = None,
+                  min_replicas: int = 0, max_replicas: int = 4,
+                  target_load: float = 4.0,
+                  downscale_delay_s: float = 10.0,
+                  max_batch: int = 4, max_len: int = 128,
+                  page_size: int = 16, kv_pages: Optional[int] = None,
+                  prefix_cache: bool = True, max_queue: int = 64,
+                  max_tokens: int = 16, temperature: float = 0.0,
+                  eos_id: Optional[int] = None, seed: int = 0,
+                  num_cpus: float = 1.0, num_tpus: float = 0.0):
+    """Autoscaled continuous-batching LLM app.
 
-    def __init__(self, preset: str = "tiny", max_batch: int = 4,
-                 max_len: int = 128, max_tokens: int = 16,
-                 temperature: float = 0.0, eos_id: Optional[int] = None,
-                 seed: int = 0):
-        self.engine = LLMEngine(PRESETS[preset], max_batch=max_batch,
-                                max_len=max_len, seed=seed)
-        self.sampling = SamplingParams(max_tokens=max_tokens,
-                                       temperature=temperature,
-                                       eos_id=eos_id)
-        self._batched = serve.batch(
-            self._generate_batch, max_batch_size=max_batch,
-            batch_wait_timeout_s=0.01)
+        handle = serve.run(build_llm_app("tiny"))
+        for item in handle.options(
+                stream=True, method_name="stream_generate").remote(
+                prompt_tokens, {"max_tokens": 64}):
+            ...  # int tokens, then {"finish_reason": ...}
 
-    async def _generate_batch(self, prompts: List[Sequence[int]]
-                              ) -> List[List[int]]:
-        return self.engine.generate(prompts, self.sampling)
-
-    async def __call__(self, prompt_tokens: Sequence[int]) -> List[int]:
-        return await self._batched(list(prompt_tokens))
+    Replica count follows each replica's ``__serve_load__`` (admission
+    queue depth × page-pool occupancy): bursts scale 1→N, idle decays to
+    ``min_replicas`` (0 = scale-to-zero; router demand revives it)."""
+    opts = {"num_cpus": num_cpus}
+    if num_tpus:
+        opts["resources"] = {"TPU": num_tpus}
+    dep = serve.deployment(
+        EngineReplica, name=name or f"llm-{preset}",
+        ray_actor_options=opts,
+        autoscaling_config={
+            "min_replicas": min_replicas,
+            "max_replicas": max_replicas,
+            "target_ongoing_requests": target_load,
+            "upscale_delay_s": 0.0,
+            "downscale_delay_s": downscale_delay_s,
+        })
+    return dep.bind(preset, max_batch=max_batch, max_len=max_len,
+                    page_size=page_size, kv_pages=kv_pages,
+                    prefix_cache=prefix_cache, max_queue=max_queue,
+                    max_tokens=max_tokens, temperature=temperature,
+                    eos_id=eos_id, seed=seed)
 
 
 def build_dp_deployment(preset: str = "tiny", *, num_replicas: int = 1,
                         max_batch: int = 4, max_len: int = 128,
                         max_tokens: int = 16, temperature: float = 0.0,
                         eos_id: Optional[int] = None, seed: int = 0,
-                        num_cpus: float = 1.0, num_tpus: float = 0.0):
-    """Data-parallel LLM app: `serve.run(build_dp_deployment(...))`."""
+                        num_cpus: float = 1.0, num_tpus: float = 0.0,
+                        prefix_cache: bool = True,
+                        page_size: int = 16):
+    """Fixed-size data-parallel LLM app: `serve.run(build_dp_deployment
+    (...))`.  Each replica is a full continuous-batching engine —
+    concurrent requests to one replica batch per decode tick instead of
+    queueing behind a closed-loop generate call."""
     opts = {"num_cpus": num_cpus}
     if num_tpus:
         opts["resources"] = {"TPU": num_tpus}
     dep = serve.deployment(
-        _LLMServer, name=f"llm-{preset}", num_replicas=num_replicas,
+        EngineReplica, name=f"llm-{preset}", num_replicas=num_replicas,
         ray_actor_options=opts)
-    return dep.bind(preset=preset, max_batch=max_batch, max_len=max_len,
+    return dep.bind(preset, max_batch=max_batch, max_len=max_len,
                     max_tokens=max_tokens, temperature=temperature,
-                    eos_id=eos_id, seed=seed)
-
-
-class _PrefillServer:
-    """Prefill half of P/D disaggregation: returns (kv_blob, first_token)
-    as one value — Serve ships it through the object plane."""
-
-    def __init__(self, preset: str, max_len: int, seed: int):
-        self.engine = LLMEngine(PRESETS[preset], max_batch=1,
-                                max_len=max_len, seed=seed)
-
-    async def __call__(self, prompt_tokens: Sequence[int],
-                       max_tokens: int = 16,
-                       temperature: float = 0.0):
-        sp = SamplingParams(max_tokens=max_tokens, temperature=temperature)
-        return self.engine.prefill_only(list(prompt_tokens), sp)
-
-
-class _DecodeServer:
-    def __init__(self, preset: str, max_batch: int, max_len: int,
-                 seed: int):
-        self.engine = LLMEngine(PRESETS[preset], max_batch=max_batch,
-                                max_len=max_len, seed=seed)
-
-    async def __call__(self, kv_blob: dict, first_token: int,
-                       max_tokens: int = 16, temperature: float = 0.0,
-                       eos_id: Optional[int] = None) -> List[int]:
-        sp = SamplingParams(max_tokens=max_tokens, temperature=temperature,
-                            eos_id=eos_id)
-        return self.engine.decode_from(kv_blob, first_token, sp)
+                    eos_id=eos_id, seed=seed, prefix_cache=prefix_cache,
+                    page_size=page_size)
 
 
 class _PDIngress:
     """Front door chaining prefill → decode handles (reference:
-    pd_server.py PDProxyServer)."""
+    pd_server.py PDProxyServer).  The KV blob travels prefill-replica →
+    object plane → decode-replica; the decode half enters the remote
+    admission queue (deadline-aware) and the real prompt tokens ride
+    along so the decode replica's prefix cache learns the prompt."""
 
     def __init__(self, prefill_name: str, decode_name: str):
         self.prefill = serve.get_deployment_handle(prefill_name)
@@ -102,25 +108,31 @@ class _PDIngress:
     async def __call__(self, prompt_tokens: Sequence[int],
                        max_tokens: int = 16, temperature: float = 0.0,
                        eos_id: Optional[int] = None) -> List[int]:
-        kv_blob, first = await self.prefill.remote(
-            list(prompt_tokens), max_tokens, temperature)
-        return await self.decode.remote(
-            kv_blob, first, max_tokens, temperature, eos_id)
+        opts = {"max_tokens": max_tokens, "temperature": temperature,
+                "eos_id": eos_id}
+        prompt = list(prompt_tokens)
+        blob, first = await self.prefill.prefill.remote(prompt, opts)
+        res = await self.decode.decode.remote(blob, first, opts, prompt)
+        return res["tokens"]
 
 
 def run_pd_app(preset: str = "tiny", *, prefill_replicas: int = 1,
                decode_replicas: int = 1, max_batch: int = 4,
-               max_len: int = 128, seed: int = 0):
+               max_len: int = 128, seed: int = 0,
+               prefix_cache: bool = True):
     """Deploy the three-deployment P/D app; returns the ingress handle.
     Prefill and decode scale independently — the point of the pattern."""
     serve.run(serve.deployment(
-        _PrefillServer, name=f"pd-prefill-{preset}",
-        num_replicas=prefill_replicas).bind(preset, max_len, seed),
+        EngineReplica, name=f"pd-prefill-{preset}",
+        num_replicas=prefill_replicas).bind(
+            preset, max_batch=1, max_len=max_len, seed=seed,
+            prefix_cache=prefix_cache),
         name=f"pd-prefill-{preset}")
     serve.run(serve.deployment(
-        _DecodeServer, name=f"pd-decode-{preset}",
-        num_replicas=decode_replicas).bind(preset, max_batch, max_len,
-                                           seed),
+        EngineReplica, name=f"pd-decode-{preset}",
+        num_replicas=decode_replicas).bind(
+            preset, max_batch=max_batch, max_len=max_len, seed=seed,
+            prefix_cache=prefix_cache),
         name=f"pd-decode-{preset}")
     return serve.run(serve.deployment(
         _PDIngress, name=f"pd-ingress-{preset}").bind(

@@ -1,0 +1,513 @@
+"""Production LLM serving: continuous batching, token streaming,
+KV-prefix cache, and queue-driven autoscaling.
+
+The subsystem composes pieces earlier layers already ship — the paged-KV
+engine (engine.py), Serve's controller/router/replica, streaming
+generators (`num_returns="streaming"` riding raw out-of-band frames),
+and the flight recorder — into the one path a real deployment needs:
+
+  client ── proxy (SSE/chunked) ── router (pow-2, death retry)
+         ── EngineReplica actor ── LLMEngine (paged KV + prefix cache)
+
+Design anchors: Orca's iteration-level scheduling (Yu et al., OSDI'22)
+— admission and retirement happen per decode tick, so a late arrival
+joins the running batch instead of waiting behind it — and vLLM's
+PagedAttention block sharing (Kwon et al., SOSP'23) for the page-level
+prefix cache the engine implements.
+
+:class:`EngineReplica` is the Serve deployment callable.  One asyncio
+decode loop owns the engine; every request is a per-request stream fed
+from the loop's tick events:
+
+  - **Continuous batching** — ``stream_generate`` enqueues into the
+    engine's admission queue and returns immediately; the decode loop
+    admits per tick against page-pool occupancy and retires per tick.
+  - **Token streaming** — each emitted token lands in the request's
+    queue and flows engine → router → client as ``ObjectRefGenerator``
+    items; per-stream backpressure is the streaming layer's delayed-ack
+    window; a client disconnect cancels the request typed and its pages
+    return to the pool mid-decode.
+  - **Deadlines** — the ambient task deadline (``.options(timeout_s=)``)
+    is captured at enqueue; queued requests whose budget expires are
+    failed typed (`DeadlineExceededError`) without ever occupying a
+    slot, and admitted ones are cancelled mid-decode.
+  - **Load shedding** — admission sheds with a typed
+    :class:`~ray_tpu.exceptions.OverloadedError` (+ ``retry_after_s``)
+    once the queue exceeds ``max_queue`` or the deadline-aware bound
+    (estimated queue wait > remaining budget).
+  - **Autoscaling** — ``__serve_load__`` exports queue depth × page-pool
+    occupancy; the Serve controller scales replica counts on it,
+    including scale-to-zero (see serve/_private/controller.py).
+
+Observability: every phase is stamped into the flight recorder under
+the ``request`` category — ``request:admit`` (enqueue → admitted, with
+queue depth and the count of requests already decoding), ``prefill``
+(with ``cached_tokens`` for prefix-cache hits), ``decode`` (per tick,
+with batch size) and ``sample_sync`` (the batched device→host sample
+pull) — and rides the existing telemetry flush to the GCS sink.
+
+`run_open_loop` is the arrival-rate-driven (never closed-loop) load
+harness: it offers requests on a fixed schedule regardless of
+completions and reports p50/p99 TTFT, inter-token latency, and
+tokens/s/replica.  `bench.py` / ``perf --check`` gate on its numbers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .._private import deadlines, flight_recorder
+from ..exceptions import (DeadlineExceededError, OverloadedError,
+                          StreamBrokenError)
+from .engine import LLMEngine, SamplingParams
+
+logger = logging.getLogger("ray_tpu.llm.serving")
+
+__all__ = ["EngineReplica", "run_open_loop"]
+
+
+class _StreamEnd:
+    """Terminal stream item: generation finished."""
+
+    __slots__ = ("finish_reason", "n_tokens")
+
+    def __init__(self, finish_reason: str, n_tokens: int):
+        self.finish_reason = finish_reason
+        self.n_tokens = n_tokens
+
+
+class EngineReplica:
+    """One continuous-batching engine behind Serve.
+
+    Deploy with ``serve_patterns.build_llm_app`` (autoscaled) or
+    ``build_dp_deployment``; or use directly as a
+    ``ray_tpu.remote(EngineReplica)`` actor (the P/D chaos tests do).
+    All public methods are async — they run on the replica's event loop
+    while the device work happens on executor threads, so admissions,
+    stream acks and health pings keep flowing mid-decode."""
+
+    def __init__(self, preset: str = "tiny", *, max_batch: int = 4,
+                 max_len: int = 128, page_size: int = 16,
+                 kv_pages: Optional[int] = None, prefix_cache: bool = True,
+                 max_queue: int = 64, max_tokens: int = 16,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: int = 0, mesh=None):
+        from ..models import PRESETS
+        cfg = PRESETS[preset] if isinstance(preset, str) else preset
+        self.engine = LLMEngine(cfg, max_batch=max_batch, max_len=max_len,
+                                seed=seed, mesh=mesh, page_size=page_size,
+                                kv_pages=kv_pages,
+                                prefix_cache=prefix_cache)
+        self.defaults = SamplingParams(max_tokens=max_tokens,
+                                       temperature=temperature,
+                                       eos_id=eos_id)
+        self.max_queue = int(max_queue)
+        self._lock = asyncio.Lock()        # serializes ALL engine access
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        # req_id -> consumer queue / metadata for in-flight streams.
+        self._waiters: Dict[int, asyncio.Queue] = {}
+        self._meta: Dict[int, Dict[str, Any]] = {}
+        # EMA of request wall time: the shed path's queue-wait estimate.
+        self._req_s_ema = 0.25
+        self._ticks = 0
+        self._max_active = 0
+        self._shed = 0
+        self._cancelled = 0
+        self._expired = 0
+        self._completed = 0
+        self._tokens_out = 0
+
+    # ------------------------------------------------------------ helpers --
+    def _params(self, opts: Optional[dict]) -> SamplingParams:
+        o = opts or {}
+        d = self.defaults
+        return SamplingParams(
+            max_tokens=int(o.get("max_tokens", d.max_tokens)),
+            temperature=float(o.get("temperature", d.temperature)),
+            eos_id=o.get("eos_id", d.eos_id))
+
+    def __serve_load__(self) -> float:
+        """Autoscaling metric: queue depth × page-pool occupancy.  A deep
+        queue against a full pool reads as heavy load; the same queue
+        against a mostly-free pool (admission imminent) reads lighter;
+        idle reads exactly 0 so scale-to-zero can trigger."""
+        e = self.engine
+        occ = e.kv_page_occupancy()
+        return e.queue_depth * (1.0 + occ) + e.active_requests * max(occ,
+                                                                     0.25)
+
+    def _maybe_shed(self, deadline: Optional[float]) -> None:
+        qd = self.engine.queue_depth
+        est_wait = (qd / max(1, self.engine.max_batch)) * self._req_s_ema
+        if qd >= self.max_queue:
+            self._shed += 1
+            raise OverloadedError(
+                f"admission queue full ({qd} >= {self.max_queue})",
+                retry_after_s=max(0.05, est_wait))
+        if deadline is None:
+            return
+        now = time.time()
+        if now > deadline:
+            # Budget already spent (e.g. parked behind a compiling
+            # tick): that's an expiry, not an overload — retrying the
+            # same request would not help.
+            self._expired += 1
+            raise DeadlineExceededError(
+                "deadline exceeded before serving admission queue")
+        if now + est_wait > deadline:
+            # Deadline-aware bound: admitting would burn decode capacity
+            # on a result the caller has already written off.
+            self._shed += 1
+            raise OverloadedError(
+                f"estimated queue wait {est_wait:.2f}s exceeds the "
+                f"request's remaining deadline budget",
+                retry_after_s=max(0.05, est_wait))
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._decode_loop())
+
+    # --------------------------------------------------------- decode loop --
+    async def _decode_loop(self):
+        """The continuous-batching tick: admit per tick, ONE compiled
+        decode step for every active slot, retire per tick, fan tokens
+        out to their streams.  Engine compute runs on an executor thread
+        so this loop (and the whole worker runtime) stays responsive."""
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                async with self._lock:
+                    self._expire_overdue()
+                    if self.engine.has_unfinished():
+                        done = await loop.run_in_executor(
+                            None, self.engine.step)
+                        self._ticks += 1
+                        self._max_active = max(self._max_active,
+                                               self.engine.active_requests
+                                               + len(done))
+                        self._fan_out(self.engine.take_tick_events(), done)
+                if not self.engine.has_unfinished():
+                    self._wake.clear()
+                    await self._wake.wait()
+                else:
+                    # One loop turn between ticks: lets freshly arrived
+                    # requests enqueue (the lock is FIFO-fair) so they are
+                    # admitted on the NEXT tick — iteration-level
+                    # scheduling, not batch-level.
+                    await asyncio.sleep(0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("decode loop tick failed")
+                await asyncio.sleep(0.2)
+
+    def _expire_overdue(self) -> None:
+        """Fail queued requests whose deadline passed (typed, without
+        ever occupying a slot) and cancel admitted ones mid-decode."""
+        now = time.time()
+        for rid, meta in list(self._meta.items()):
+            dl = meta.get("deadline")
+            if dl is None or now <= dl or meta.get("finished"):
+                continue
+            self._expired += 1
+            self.engine.cancel_request(rid)
+            q = self._waiters.get(rid)
+            if q is not None:
+                q.put_nowait(DeadlineExceededError(
+                    "deadline exceeded in serving admission queue"
+                    if not meta.get("admitted")
+                    else "deadline exceeded mid-decode"))
+            meta["finished"] = True
+
+    def _fan_out(self, events, done_reqs) -> None:
+        rec = flight_recorder.recorder()
+        done_by_id = {r.req_id: r for r in done_reqs}
+        for rid, tok, fin in events:
+            meta = self._meta.get(rid)
+            if meta is None:
+                continue
+            if not meta.get("admitted"):
+                meta["admitted"] = True
+                meta["t_adm"] = time.monotonic()
+                rec.end("request", "request:admit", meta["t0"],
+                        id=rid.to_bytes(8, "little"),
+                        queued=self.engine.queue_depth,
+                        decoding=max(0, self.engine.active_requests - 1
+                                     + len(done_by_id)))
+            q = self._waiters.get(rid)
+            if q is not None:
+                q.put_nowait(int(tok))
+        for rid, req in done_by_id.items():
+            meta = self._meta.get(rid)
+            if meta is not None and not meta.get("finished"):
+                meta["finished"] = True
+                self._completed += 1
+                self._tokens_out += len(req.out)
+                # SERVICE time (admission -> finish), not enqueue ->
+                # finish: folding queue wait into the EMA would make
+                # the shed estimate grow quadratically with depth.
+                dur = time.monotonic() - meta.get("t_adm",
+                                                  meta["t_mono"])
+                self._req_s_ema += 0.2 * (dur - self._req_s_ema)
+                q = self._waiters.get(rid)
+                if q is not None:
+                    q.put_nowait(_StreamEnd(req.finish_reason,
+                                            len(req.out)))
+
+    # ------------------------------------------------------------ streams --
+    async def _stream(self, prompt_tokens: Optional[Sequence[int]],
+                      opts: Optional[dict], *, external: Optional[tuple]
+                      = None, cache_prompt: Optional[Sequence[int]] = None
+                      ) -> AsyncIterator[Any]:
+        """Shared producer for stream_generate / generate / decode: yields
+        int tokens then one `_StreamEnd`.  Typed failures (shed, deadline,
+        engine rejection) raise out of the first `anext`."""
+        params = self._params(opts)
+        deadline = deadlines.get()
+        rec = flight_recorder.recorder()
+        async with self._lock:
+            # Shed check INSIDE the lock: concurrent arrivals during a
+            # decode tick must each see the true queue depth, not a
+            # pre-tick snapshot (they would all pass a stale bound).
+            self._maybe_shed(deadline)
+            if external is not None:
+                blob, first = external
+                rid = self.engine.add_external_request(
+                    blob, first, params, prompt_tokens=cache_prompt)
+            else:
+                rid = self.engine.add_request(list(prompt_tokens), params)
+            q: asyncio.Queue = asyncio.Queue()
+            self._waiters[rid] = q
+            self._meta[rid] = {"deadline": deadline, "t0": rec.begin(),
+                               "t_mono": time.monotonic(),
+                               "admitted": False, "finished": False}
+        self._ensure_loop()
+        self._wake.set()
+        try:
+            while True:
+                item = await q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+                if isinstance(item, _StreamEnd):
+                    return
+        finally:
+            await self._release(rid)
+
+    async def _release(self, rid: int) -> None:
+        meta = self._meta.pop(rid, None)
+        self._waiters.pop(rid, None)
+        if meta is not None and not meta.get("finished"):
+            # Consumer went away mid-generation (client disconnect /
+            # typed cancellation): retire now, pages return mid-decode.
+            self._cancelled += 1
+            flight_recorder.recorder().instant(
+                "request", "request:cancelled",
+                id=rid.to_bytes(8, "little"))
+            async with self._lock:
+                self.engine.cancel_request(rid)
+
+    async def stream_generate(self, prompt_tokens: Sequence[int],
+                              opts: Optional[dict] = None
+                              ) -> AsyncIterator[Any]:
+        """Async generator: int tokens as they decode, then one terminal
+        dict ``{"finish_reason": ..., "n_tokens": ...}``.  This is the
+        method the serve router dispatches with
+        ``num_returns="streaming"``; each yielded item becomes its own
+        object the client can consume while decode continues."""
+        it = self._stream(prompt_tokens, opts)
+        try:
+            async for item in it:
+                if isinstance(item, _StreamEnd):
+                    yield {"finish_reason": item.finish_reason,
+                           "n_tokens": item.n_tokens}
+                else:
+                    yield item
+        finally:
+            # async-for does not close the inner generator on early exit;
+            # close it NOW so an abandoned stream cancels its request (and
+            # frees its pages) deterministically, not at a later GC.
+            await it.aclose()
+
+    async def generate(self, prompt_tokens: Sequence[int],
+                       opts: Optional[dict] = None) -> Dict[str, Any]:
+        """Non-streaming completion over the same continuous-batching
+        machinery: {"tokens": [...], "finish_reason": ...}."""
+        out: List[int] = []
+        reason = ""
+        async for item in self._stream(prompt_tokens, opts):
+            if isinstance(item, _StreamEnd):
+                reason = item.finish_reason
+            else:
+                out.append(item)
+        return {"tokens": out, "finish_reason": reason}
+
+    async def __call__(self, prompt_tokens: Sequence[int],
+                       opts: Optional[dict] = None) -> List[int]:
+        """DP-pattern compatibility surface: plain token list."""
+        return (await self.generate(prompt_tokens, opts))["tokens"]
+
+    # -------------------------------------------------- P/D disaggregation --
+    async def prefill(self, prompt_tokens: Sequence[int],
+                      opts: Optional[dict] = None):
+        """Prefill half: (kv_blob, first_token) for a decode replica.
+        Prefix-cache hits skip the shared span's compute."""
+        params = self._params(opts)
+        if deadlines.expired():
+            raise DeadlineExceededError(
+                "deadline exceeded before prefill started")
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            return await loop.run_in_executor(
+                None, lambda: self.engine.prefill_only(
+                    list(prompt_tokens), params))
+
+    async def decode(self, kv_blob: dict, first_token: int,
+                     opts: Optional[dict] = None,
+                     prompt_tokens: Optional[Sequence[int]] = None
+                     ) -> Dict[str, Any]:
+        """Decode half: admit a shipped KV blob through the SAME
+        admission queue as local requests (deadline-aware, shed-bounded)
+        and decode to completion."""
+        out: List[int] = []
+        reason = ""
+        async for item in self._stream(None, opts, external=(
+                kv_blob, first_token), cache_prompt=prompt_tokens):
+            if isinstance(item, _StreamEnd):
+                reason = item.finish_reason
+            else:
+                out.append(item)
+        return {"tokens": out, "finish_reason": reason}
+
+    # ------------------------------------------------------------- introspect
+    async def debug_stats(self) -> Dict[str, Any]:
+        e = self.engine
+        return {"ticks": self._ticks, "max_active": self._max_active,
+                "shed": self._shed, "cancelled": self._cancelled,
+                "expired": self._expired, "completed": self._completed,
+                "tokens_out": self._tokens_out,
+                "queue_depth": e.queue_depth,
+                "active": e.active_requests,
+                "kv_pages_free": e.kv_pages_free(),
+                "kv_pages_total": e.kv_pages_total,
+                "load": self.__serve_load__(),
+                "prefix_cache": e.prefix_cache_stats()}
+
+    async def pid(self) -> int:
+        import os
+        return os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# Open-loop load harness
+# ---------------------------------------------------------------------------
+
+def _pctl(xs: List[float], p: float) -> float:
+    return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
+
+
+def run_open_loop(submit, *, rate_hz: float, duration_s: float,
+                  prompt_fn, num_replicas: int = 1,
+                  request_timeout_s: float = 120.0) -> Dict[str, Any]:
+    """Arrival-rate-driven load harness — OPEN loop, never closed: the
+    next request is offered on schedule whether or not earlier ones
+    completed, so queueing delay shows up in the latency numbers instead
+    of silently throttling the offered load (the classic closed-loop
+    measurement bug).
+
+    ``submit(prompt) -> iterable`` must yield stream items (int tokens,
+    then a terminal dict with ``finish_reason``); for Serve use
+    ``lambda p: handle.options(stream=True).remote(p, opts)``.
+
+    Returns a report with p50/p99 TTFT (ms), p50/p99 inter-token latency
+    (ms), tokens/s (total and per replica), max concurrent in-flight
+    requests, and shed/error counts."""
+    n = max(1, int(rate_hz * duration_s))
+    lock = threading.Lock()
+    state = {"active": 0, "max_active": 0}
+    results: List[Dict[str, Any]] = []
+    threads: List[threading.Thread] = []
+    t_start = time.perf_counter()
+
+    def _one(i: int):
+        rec: Dict[str, Any] = {"ok": False, "shed": False, "error": None,
+                               "broken": False}
+        with lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"], state["active"])
+        t_sub = time.perf_counter()
+        try:
+            first = prev = None
+            gaps: List[float] = []
+            ntok = 0
+            for item in submit(prompt_fn(i)):
+                now = time.perf_counter()
+                if isinstance(item, dict):
+                    rec["finish_reason"] = item.get("finish_reason")
+                    break
+                ntok += 1
+                if first is None:
+                    first = now
+                if prev is not None:
+                    gaps.append(now - prev)
+                prev = now
+            rec.update(ok=True, ttft_s=(first - t_sub) if first else None,
+                       total_s=time.perf_counter() - t_sub, gaps=gaps,
+                       tokens=ntok)
+        except OverloadedError as e:
+            rec["shed"] = True
+            rec["retry_after_s"] = e.retry_after_s
+        except StreamBrokenError as e:
+            rec["broken"] = True
+            rec["tokens_emitted"] = e.tokens_emitted
+        except Exception as e:  # noqa: BLE001 — the harness reports, never dies
+            rec["error"] = repr(e)
+        finally:
+            with lock:
+                state["active"] -= 1
+            with lock:
+                results.append(rec)
+
+    for i in range(n):
+        target = t_start + i / rate_hz
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=_one, args=(i,), daemon=True)
+        th.start()
+        threads.append(th)
+    deadline = time.perf_counter() + request_timeout_s
+    for th in threads:
+        th.join(max(0.0, deadline - time.perf_counter()))
+    wall = time.perf_counter() - t_start
+
+    done = [r for r in results if r.get("ok")]
+    ttfts = [r["ttft_s"] * 1e3 for r in done if r.get("ttft_s") is not None]
+    gaps = [g * 1e3 for r in done for g in r.get("gaps", ())]
+    tokens = sum(r.get("tokens", 0) for r in done)
+    return {
+        "offered": n,
+        "completed": len(done),
+        "shed": sum(1 for r in results if r.get("shed")),
+        "broken": sum(1 for r in results if r.get("broken")),
+        "errors": [r["error"] for r in results if r.get("error")],
+        "unfinished": n - len(results),
+        "max_inflight": state["max_active"],
+        "ttft_p50_ms": _pctl(ttfts, 50),
+        "ttft_p99_ms": _pctl(ttfts, 99),
+        "total_p50_ms": _pctl([r["total_s"] * 1e3 for r in done], 50),
+        "itl_p50_ms": _pctl(gaps, 50),
+        "itl_p99_ms": _pctl(gaps, 99),
+        "tokens_total": tokens,
+        "duration_s": wall,
+        "tokens_per_s": tokens / wall if wall > 0 else 0.0,
+        "tokens_per_s_per_replica":
+            tokens / wall / max(1, num_replicas) if wall > 0 else 0.0,
+    }

@@ -8,14 +8,15 @@ long_poll.py:228, batching.py).
 
 from ._private.batching import batch
 from ._private.multiplex import get_multiplexed_model_id, multiplexed
-from ._private.proxy import HTTPResponse, Request
+from ._private.proxy import HTTPResponse, Request, StreamingResponse
 from .api import (Application, Deployment, DeploymentHandle,
-                  DeploymentResponse, delete, deployment,
+                  DeploymentResponse, ServeStream, delete, deployment,
                   get_deployment_handle, run, shutdown, start, status)
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
-    "DeploymentResponse", "run", "start", "shutdown", "status", "delete",
-    "get_deployment_handle", "batch", "Request", "HTTPResponse",
+    "DeploymentResponse", "ServeStream", "run", "start", "shutdown",
+    "status", "delete", "get_deployment_handle", "batch", "Request",
+    "HTTPResponse", "StreamingResponse",
     "multiplexed", "get_multiplexed_model_id",
 ]

@@ -41,6 +41,10 @@ class ServeControllerImpl:
         self._confirmed: set = set()              # replicas that ponged once
         self._version_event: Optional[asyncio.Event] = None
         self._model_ids: Dict[bytes, List[str]] = {}  # replica -> models
+        # Router-reported demand: name -> (depth, monotonic stamp).  The
+        # scale-from-zero signal — a deployment with no replicas has
+        # nobody to report load, so routers report waiting requests.
+        self._demand: Dict[str, tuple] = {}
         self._reconcile_lock = asyncio.Lock()
         self._reconcile_task = None
         self._shutdown = False
@@ -131,13 +135,11 @@ class ServeControllerImpl:
         autoscale = dict(autoscaling_config) if autoscaling_config else None
         if autoscale:
             autoscale.setdefault("min_replicas", 1)
-            if autoscale["min_replicas"] < 1:
-                # Scale-from-zero needs handle-side queue metrics (no
-                # replica exists to report load); not supported yet.
+            if autoscale["min_replicas"] < 0:
                 raise ValueError(
-                    "autoscaling_config.min_replicas must be >= 1")
+                    "autoscaling_config.min_replicas must be >= 0")
             autoscale.setdefault("max_replicas", max(
-                autoscale["min_replicas"], int(num_replicas)))
+                autoscale["min_replicas"], int(num_replicas), 1))
             autoscale.setdefault("target_ongoing_requests", 2.0)
             autoscale.setdefault("upscale_delay_s", 0.0)
             autoscale.setdefault("downscale_delay_s", 10.0)
@@ -147,7 +149,11 @@ class ServeControllerImpl:
                 # back to min would kill busy replicas with no hysteresis.
                 num_replicas = prev["num_replicas"]
             else:
-                num_replicas = autoscale["min_replicas"]
+                # min_replicas=0 (scale-to-zero) still STARTS with one
+                # replica: serve.run waits for a live replica, and the
+                # first request shouldn't pay a cold start.  Idle decay
+                # takes it to zero; router demand brings it back.
+                num_replicas = max(1, autoscale["min_replicas"])
         self.deployments[name] = {
             "blob": blob, "init_args": init_args, "init_kwargs": init_kwargs,
             "num_replicas": int(num_replicas),
@@ -231,13 +237,44 @@ class ServeControllerImpl:
         async with self._reconcile_lock:
             await self._reconcile_locked()
 
+    async def report_demand(self, name: str, depth: float = 1.0) -> bool:
+        """A router has requests waiting on this deployment (called when
+        its routing table is empty).  For an autoscaled-to-zero
+        deployment this is the ONLY load signal — no replica exists to
+        report queue depth — so it immediately scales 0 -> 1."""
+        self._demand[name] = (float(depth), time.monotonic())
+        dep = self.deployments.get(name)
+        if dep is not None and dep.get("autoscale") \
+                and dep["num_replicas"] == 0 and depth > 0:
+            logger.info("autoscale %s: 0 -> 1 (router demand)", name)
+            dep["num_replicas"] = min(1, dep["autoscale"]["max_replicas"])
+            dep["_below_since"] = None
+            await self._reconcile_once()
+        return True
+
+    def _fresh_demand(self, name: str, max_age_s: float = 10.0) -> float:
+        d = self._demand.get(name)
+        if d is None or time.monotonic() - d[1] > max_age_s:
+            return 0.0
+        return d[0]
+
     async def _autoscale(self, name: str, dep: Dict[str, Any]):
         """Load-driven replica count (reference: autoscaling_policy.py —
         desired = total ongoing / target, clamped, with upscale/downscale
-        delays for hysteresis)."""
+        delays for hysteresis).  Replica-reported load (a deployment
+        callable may override it via __serve_load__ — the LLM path
+        reports queue depth × page-pool occupancy) plus router-reported
+        demand for the zero-replica case."""
         cfg = dep["autoscale"]
         replicas = dep["replicas"]
-        if cfg is None or not replicas:
+        if cfg is None:
+            return
+        if not replicas:
+            # Scaled to zero: router demand is the only wake signal
+            # (report_demand also fast-paths this outside the tick).
+            if dep["num_replicas"] == 0 and self._fresh_demand(name) > 0:
+                logger.info("autoscale %s: 0 -> 1 (demand)", name)
+                dep["num_replicas"] = min(1, cfg["max_replicas"])
             return
         async def _one(r):
             try:

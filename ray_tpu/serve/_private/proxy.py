@@ -30,24 +30,57 @@ class HTTPResponse:
     """Deployment return value carrying an explicit status code
     (reference: starlette JSONResponse(status_code=...) returns from
     Serve ingress deployments).  body: dict/list (JSON), str, or
-    bytes."""
+    bytes.  `headers` adds extra response headers (e.g. Retry-After on
+    a 429)."""
 
-    def __init__(self, status: int, body, content_type: str = None):
+    def __init__(self, status: int, body, content_type: str = None,
+                 headers: Optional[Dict[str, str]] = None):
         self.status = int(status)
         self.body = body
         self.content_type = content_type
+        self.headers = dict(headers or {})
 
     def render(self):
         reason = _REASONS.get(self.status, "Status")
         status = f"{self.status} {reason}"
         if isinstance(self.body, bytes):
             return status, self.body, (self.content_type
-                                       or "application/octet-stream")
+                                       or "application/octet-stream"), \
+                self.headers
         if isinstance(self.body, str):
             return status, self.body.encode(), (self.content_type
-                                                or "text/plain")
+                                                or "text/plain"), \
+                self.headers
         return (status, json.dumps(self.body).encode(),
-                self.content_type or "application/json")
+                self.content_type or "application/json", self.headers)
+
+
+class StreamingResponse:
+    """Marker an ingress deployment returns to stream a generator call
+    over chunked HTTP (SSE when content_type is text/event-stream).
+
+    The proxy dispatches `method` on the same deployment as a STREAMING
+    request (router → replica generator → ObjectRefGenerator items) and
+    writes each yielded str/bytes item as one chunk, flushed
+    immediately — the client sees tokens as they decode.  On client
+    disconnect the stream is cancelled typed: the producing replica's
+    generator closes and (on the LLM path) the request's KV pages
+    return to the pool mid-decode.
+
+    A plain data carrier (picklable): the proxy, not the replica, owns
+    the streaming dispatch, so the response replica and the streaming
+    replica may differ — everything the stream needs must ride args."""
+
+    def __init__(self, method: str, args: tuple = (), kwargs: dict = None,
+                 *, content_type: str = "text/event-stream",
+                 headers: Optional[Dict[str, str]] = None,
+                 backpressure: int = 8):
+        self.method = method
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+        self.content_type = content_type
+        self.headers = dict(headers or {})
+        self.backpressure = int(backpressure)
 
 
 class Request:
@@ -126,11 +159,15 @@ class ProxyActor:
                 clen = int(headers.get("content-length", "0") or 0)
                 if clen:
                     body = await reader.readexactly(clen)
-                status, payload, ctype = await self._dispatch(
-                    method, target, headers, body)
+                out = await self._dispatch(method, target, headers, body)
+                if isinstance(out, tuple) and out and out[0] == "STREAM":
+                    await self._stream_response(writer, out[1], out[2])
+                    continue
+                status, payload, ctype, extra = out
+                hdrs = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
-                    f"Content-Length: {len(payload)}\r\n"
+                    f"Content-Length: {len(payload)}\r\n{hdrs}"
                     f"Connection: keep-alive\r\n\r\n".encode() + payload)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -138,6 +175,109 @@ class ProxyActor:
         finally:
             try:
                 writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    def _error_payload(e: Exception):
+        """(status, payload, ctype, headers) for a failed request — the
+        ONE typed-error mapping both the unary and streaming paths use:
+        OverloadedError -> 429 + Retry-After (shed, back off),
+        DeadlineExceededError -> 503, anything else -> 500."""
+        import math
+
+        from ray_tpu import exceptions as exc
+        if isinstance(e, exc.OverloadedError):
+            return ("429 Too Many Requests",
+                    json.dumps({"error": str(e),
+                                "retry_after_s": e.retry_after_s}).encode(),
+                    "application/json",
+                    {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))})
+        if isinstance(e, exc.DeadlineExceededError):
+            return ("503 Service Unavailable",
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json", {})
+        logger.exception("request failed")
+        return ("500 Internal Server Error",
+                json.dumps({"error": str(e)}).encode(),
+                "application/json", {})
+
+    async def _stream_response(self, writer, sr: StreamingResponse,
+                               dep: str):
+        """Write a StreamingResponse as chunked transfer encoding, one
+        chunk per stream item, flushed per item (SSE-compatible).
+
+        The stream is dispatched AND its first item pulled BEFORE the
+        status line goes out: a shed (OverloadedError), an expired
+        deadline, or a dead deployment still gets its real typed status
+        (429/503/500) instead of a committed 200 — only then do the
+        chunked headers commit.  A write failure after that = client
+        disconnect -> typed cancellation of the producing stream."""
+        loop = asyncio.get_running_loop()
+        stream = None
+        first = None
+        ended = False
+        try:
+            from ..api import ServeStream
+            router = self._router_for(dep)
+            # Sync dispatch off-loop (same as the unary path).
+            stream = await loop.run_in_executor(
+                None, lambda: ServeStream(
+                    router, sr.method, sr.args, sr.kwargs,
+                    backpressure=sr.backpressure))
+            try:
+                first = await stream.__anext__()
+            except StopAsyncIteration:
+                ended = True
+        except Exception as e:  # noqa: BLE001 — nothing committed yet:
+            # a full typed HTTP error response, not protocol garbage.
+            status, payload, ctype, extra = self._error_payload(e)
+            hdrs = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n{hdrs}"
+                f"Connection: keep-alive\r\n\r\n".encode() + payload)
+            await writer.drain()
+            return
+        try:
+            hdrs = "".join(f"{k}: {v}\r\n" for k, v in sr.headers.items())
+            writer.write(
+                f"HTTP/1.1 200 OK\r\nContent-Type: {sr.content_type}\r\n"
+                f"Transfer-Encoding: chunked\r\nCache-Control: no-cache\r\n"
+                f"{hdrs}Connection: keep-alive\r\n\r\n".encode())
+            await writer.drain()
+
+            async def _items():
+                if not ended:
+                    yield first
+                    async for item in stream:
+                        yield item
+
+            async for item in _items():
+                data = item if isinstance(item, bytes) else str(item).encode()
+                if not data:
+                    continue
+                writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            # Client went away mid-stream: cancel the producer so the
+            # engine frees the request's pages mid-decode.  cancel()
+            # uses the sync core API — off-loop.
+            if stream is not None:
+                await loop.run_in_executor(None, stream.cancel)
+            raise
+        except Exception as e:  # noqa: BLE001 — headers already sent:
+            logger.exception("streaming response failed")
+            # best effort terminal chunk so the client sees a clean end.
+            try:
+                if stream is not None:
+                    await loop.run_in_executor(None, stream.cancel)
+                msg = json.dumps({"error": str(e)}).encode()
+                writer.write(f"{len(msg):x}\r\n".encode() + msg
+                             + b"\r\n0\r\n\r\n")
+                await writer.drain()
             except Exception:
                 pass
 
@@ -153,7 +293,7 @@ class ProxyActor:
                 break
         if match is None:
             return "404 Not Found", b'{"error": "no route"}', \
-                "application/json"
+                "application/json", {}
         req = Request(method, path, dict(parse_qsl(parts.query)), headers,
                       body)
         try:
@@ -164,18 +304,20 @@ class ProxyActor:
                 None,
                 lambda: self._router_for(dep).assign("__call__", (req,), {}))
             result = await ref
+            if isinstance(result, StreamingResponse):
+                return ("STREAM", result, dep)
             if isinstance(result, HTTPResponse):
                 return result.render()
             if isinstance(result, bytes):
-                return "200 OK", result, "application/octet-stream"
+                return "200 OK", result, "application/octet-stream", {}
             if isinstance(result, str):
-                return "200 OK", result.encode(), "text/plain"
+                return "200 OK", result.encode(), "text/plain", {}
             # Inside the try: a non-JSON-serializable return (numpy arrays
             # etc.) must surface as a 500, not kill the connection.
             return ("200 OK", json.dumps(result).encode(),
-                    "application/json")
+                    "application/json", {})
         except Exception as e:  # noqa: BLE001 — HTTP surface reports all
-            logger.exception("request failed")
-            return ("500 Internal Server Error",
-                    json.dumps({"error": str(e)}).encode(),
-                    "application/json")
+            # Load shed gets a REAL 429 with Retry-After, deadline
+            # expiry a 503 (never a hang, never a generic 500) so
+            # clients back off correctly.
+            return self._error_payload(e)

@@ -64,6 +64,57 @@ class ReplicaActor:
         finally:
             self._ongoing -= 1
 
+    async def handle_request_streaming(self, method: str, args: tuple,
+                                       kwargs: dict):
+        """Streaming request execution: delegates to a generator method
+        of the deployment callable and re-yields its items — the router
+        dispatches this with ``num_returns="streaming"``, so every item
+        becomes its own owner-owned object the client consumes while the
+        replica keeps producing (reference: serve streaming responses
+        over Ray's streaming generators)."""
+        fn = (self.callable if method in ("__call__", "")
+              else getattr(self.callable, method))
+        self._ongoing += 1
+        try:
+            gen = fn(*args, **kwargs)
+            if hasattr(gen, "__anext__"):
+                try:
+                    async for item in gen:
+                        yield item
+                finally:
+                    # async-for leaves abandoned generators to the GC;
+                    # close NOW so a client disconnect propagates to the
+                    # producer (typed cancellation, pages freed) the
+                    # moment the stream is dropped.
+                    await gen.aclose()
+            elif hasattr(gen, "__next__"):
+                # Sync generator: drive each __next__ on an executor
+                # thread — same discipline as sync unary callables, so a
+                # slow item never freezes the replica's loop (pings,
+                # concurrent requests keep flowing).
+                loop = asyncio.get_running_loop()
+                done = object()
+
+                def _next():
+                    try:
+                        return next(gen)
+                    except StopIteration:
+                        return done
+                try:
+                    while True:
+                        item = await loop.run_in_executor(None, _next)
+                        if item is done:
+                            break
+                        yield item
+                finally:
+                    gen.close()
+            else:
+                raise TypeError(
+                    f"streaming request to {method!r} requires a "
+                    f"generator method, got {type(gen).__name__}")
+        finally:
+            self._ongoing -= 1
+
     async def handle_request_multiplexed(self, method: str, args: tuple,
                                          kwargs: dict, model_id: str
                                          ) -> Any:
@@ -102,9 +153,22 @@ class ReplicaActor:
 
         rpc.spawn(_go())
 
-    async def ongoing_requests(self) -> int:
+    async def ongoing_requests(self) -> float:
         """Autoscaling metric (reference: replica queue length stats
-        feeding autoscaling_state.py)."""
+        feeding autoscaling_state.py).  A deployment callable that
+        defines ``__serve_load__`` overrides the default in-flight count
+        with its own load signal — the LLM serving path reports
+        admission-queue depth × page-pool occupancy, which reads 0 when
+        idle so scale-to-zero can trigger."""
+        hook = getattr(self.callable, "__serve_load__", None)
+        if hook is not None:
+            try:
+                v = hook()
+                if inspect.isawaitable(v):
+                    v = await v
+                return float(v)
+            except Exception:
+                pass
         return self._ongoing
 
     async def ping(self) -> str:

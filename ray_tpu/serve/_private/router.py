@@ -103,6 +103,16 @@ class Router:
             return age > self._SUBSCRIBED_SAFETY_REFRESH_S
         return age > _FALLBACK_REFRESH_S
 
+    def _report_demand(self):
+        """Tell the controller a request is waiting on a replica-less
+        deployment (fire-and-forget): the demand signal is what scales
+        an autoscaled-to-zero deployment back up — no replica exists to
+        report load, so the router is the only source."""
+        try:
+            self._controller.report_demand.remote(self._deployment, 1)
+        except Exception:
+            pass
+
     def _refresh(self, wait_nonempty_s: float = 30.0):
         if not self._stale():
             return
@@ -115,6 +125,7 @@ class Router:
             self._apply_table(table)
             if self._replicas or time.monotonic() >= deadline:
                 return
+            self._report_demand()
             # Empty table: with a live subscription, wait for the push
             # instead of hammering the long-poll.
             if self._subscribed:
@@ -137,6 +148,7 @@ class Router:
             self._apply_table(table)
             if self._replicas or time.monotonic() >= deadline:
                 return
+            self._report_demand()
             known = self._version
 
     # ------------------------------------------------------------ dispatch --
@@ -227,6 +239,42 @@ class Router:
             lambda _: self._inflight.__setitem__(
                 rid, max(0, self._inflight.get(rid, 1) - 1)))
         return ref, rid
+
+    def assign_streaming_with_origin(self, method: str, args: tuple,
+                                     kwargs: dict, *,
+                                     model_id: Optional[str] = None,
+                                     backpressure: int = 0,
+                                     timeout_s=None):
+        """Dispatch a STREAMING request: returns (ObjectRefGenerator,
+        replica_actor_id).  Items flow back as streaming-generator
+        objects (raw out-of-band frames for large values); consumer lag
+        beyond `backpressure` items stalls the producing replica via the
+        streaming layer's delayed acks.  `timeout_s` rides the task spec
+        as an absolute deadline — the replica's admission queue fails
+        expired requests typed."""
+        self._refresh()
+        replicas = self._alive(self._replicas)
+        if not replicas:
+            raise RuntimeError(
+                f"no replicas available for deployment "
+                f"{self._deployment!r}")
+        replica = self._pick(replicas, model_id)
+        rid = replica._actor_id
+        self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        try:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming",
+                _generator_backpressure_num_objects=backpressure,
+                timeout_s=timeout_s).remote(method, args, kwargs)
+        except Exception:
+            self._inflight[rid] -= 1
+            self.invalidate()
+            raise
+        fut = gen.completed().future()
+        fut.add_done_callback(
+            lambda _: self._inflight.__setitem__(
+                rid, max(0, self._inflight.get(rid, 1) - 1)))
+        return gen, rid
 
     def invalidate(self) -> None:
         """Drop the cached routing table (a request just failed with a

@@ -145,11 +145,138 @@ class DeploymentResponse:
         return self._ref.__await__()
 
 
+class ServeStream:
+    """Iterator over a streaming deployment response: yields the VALUES
+    the remote generator produced (sync and async iteration), with the
+    router's death handling folded in.
+
+    A replica that dies BEFORE the first item was consumed is retried
+    transparently on another replica (nothing observable was lost, same
+    contract as the unary retry path).  A death MID-stream raises a
+    typed :class:`~ray_tpu.exceptions.StreamBrokenError` carrying
+    ``tokens_emitted`` — silently re-dispatching would replay the stream
+    from index 0 and duplicate items the client already consumed.
+
+    ``cancel()`` (or just abandoning the iterator) propagates a typed
+    cancellation to the producing replica: the LLM serving path then
+    retires the request mid-decode and its KV pages return to the
+    pool."""
+
+    def __init__(self, router, method: str, args: tuple, kwargs: dict,
+                 model_id: Optional[str] = None, backpressure: int = 8,
+                 timeout_s=None):
+        self._router = router
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._model_id = model_id
+        self._bp = backpressure
+        self._timeout_s = timeout_s
+        self._emitted = 0
+        self._retries = _DEATH_RETRIES
+        # Dispatch is LAZY (first iteration): the router's table refresh
+        # blocks (ray_tpu.get, up to ~30s on an autoscaled-to-zero
+        # deployment), so construction must stay cheap — async consumers
+        # hop the dispatch through an executor in __anext__ instead of
+        # stalling their event loop.
+        self._gen = None
+        self._origin = None
+
+    def _start(self):
+        self._gen, self._origin = \
+            self._router.assign_streaming_with_origin(
+                self._method, self._args, self._kwargs,
+                model_id=self._model_id, backpressure=self._bp,
+                timeout_s=self._timeout_s)
+
+    def _on_death(self, e):
+        from ray_tpu.exceptions import StreamBrokenError
+        self._router.exclude(self._origin)
+        if self._emitted == 0 and self._retries > 0:
+            self._retries -= 1
+            self._start()
+            return
+        raise StreamBrokenError(
+            f"replica died after {self._emitted} streamed item(s)",
+            tokens_emitted=self._emitted) from e
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from ray_tpu.exceptions import ActorDiedError
+        if self._gen is None:
+            self._start()
+        while True:
+            try:
+                ref = next(self._gen)
+                val = ray_tpu.get(ref)
+            except StopIteration:
+                raise
+            except ActorDiedError as e:
+                self._on_death(e)
+                continue
+            self._emitted += 1
+            return val
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        from ray_tpu.exceptions import ActorDiedError
+        loop = asyncio.get_running_loop()
+        if self._gen is None:
+            # Dispatch (blocking router refresh) off-loop.
+            await loop.run_in_executor(None, self._start)
+        while True:
+            try:
+                ref = await self._gen.__anext__()
+                val = await ref
+            except StopAsyncIteration:
+                raise
+            except ActorDiedError as e:
+                # The retry re-dispatch uses the sync router API
+                # (blocking table refresh): hop off the event loop.
+                await loop.run_in_executor(None, self._on_death, e)
+                continue
+            self._emitted += 1
+            return val
+
+    @property
+    def tokens_emitted(self) -> int:
+        return self._emitted
+
+    def cancel(self) -> None:
+        """Typed cancellation of the producing request (client
+        disconnect): the replica's generator is closed and the engine
+        frees the request's pages mid-decode.  No-op if never
+        dispatched."""
+        import ray_tpu as _rt
+        if self._gen is None:
+            return
+        try:
+            _rt.cancel(self._gen)
+        except Exception:
+            pass
+
+    def completed(self):
+        """Ref resolving when the remote generator finishes (dispatches
+        the stream if iteration hasn't started; sync context only)."""
+        if self._gen is None:
+            self._start()
+        return self._gen.completed()
+
+
 class DeploymentHandle:
     """reference: serve/handle.py:692; method access via attribute chaining
     (handle.method.remote(...)), plain calls via handle.remote(...).
     .options(multiplexed_model_id=...) tags requests for model-affine
-    routing (reference: handle.py options + multiplex)."""
+    routing (reference: handle.py options + multiplex);
+    .options(stream=True) makes .remote() return a :class:`ServeStream`
+    over the replica method's generator output (reference: handle
+    streaming responses over Ray streaming generators)."""
 
     # Routers are shared per (deployment, process): handle copies and
     # .options() clones reuse one pushed routing table + inflight map.
@@ -157,22 +284,36 @@ class DeploymentHandle:
     _routers_lock = threading.Lock()
 
     def __init__(self, deployment_name: str, method: str = "__call__",
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 stream: bool = False, stream_backpressure: int = 8,
+                 timeout_s=None):
         self._deployment = deployment_name
         self._method = method
         self._model_id = multiplexed_model_id
+        self._stream = stream
+        self._stream_bp = stream_backpressure
+        self._timeout_s = timeout_s
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return DeploymentHandle(self._deployment, item, self._model_id)
+        return DeploymentHandle(self._deployment, item, self._model_id,
+                                self._stream, self._stream_bp,
+                                self._timeout_s)
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
-                method_name: Optional[str] = None) -> "DeploymentHandle":
+                method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                stream_backpressure: Optional[int] = None,
+                timeout_s=None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._deployment, method_name or self._method,
             multiplexed_model_id
-            if multiplexed_model_id is not None else self._model_id)
+            if multiplexed_model_id is not None else self._model_id,
+            self._stream if stream is None else stream,
+            (self._stream_bp if stream_backpressure is None
+             else stream_backpressure),
+            self._timeout_s if timeout_s is None else timeout_s)
 
     def _get_router(self, controller=None) -> Router:
         # Locked check-then-act: concurrent first calls from several
@@ -187,8 +328,17 @@ class DeploymentHandle:
                 self._routers[self._deployment] = router
             return router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         import asyncio
+        if self._stream:
+            # Streaming dispatch: returns a ServeStream (sync + async
+            # iterable of values).  Router construction/dispatch use the
+            # sync API — inside an event loop, hop through an executor
+            # (the HTTP proxy does exactly that).
+            return ServeStream(self._get_router(), self._method, args,
+                               kwargs, model_id=self._model_id,
+                               backpressure=self._stream_bp,
+                               timeout_s=self._timeout_s)
         try:
             asyncio.get_running_loop()
         except RuntimeError:
@@ -236,7 +386,8 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self._deployment, self._method,
-                                   self._model_id))
+                                   self._model_id, self._stream,
+                                   self._stream_bp, self._timeout_s))
 
 
 def _get_or_create_controller():

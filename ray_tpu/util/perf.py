@@ -526,6 +526,66 @@ def bench_framer_frames_python(min_time_s):
     return _bench_framer(False, min_time_s, bulk=False)
 
 
+# ---------------------------------------------------------------------------
+# LLM serving open-loop bench (tiny model, CPU): spins one
+# continuous-batching EngineReplica behind Serve, offers an
+# arrival-rate-driven load (OPEN loop — the next request goes out on
+# schedule whether or not earlier ones finished) through the streaming
+# handle path, and reports TTFT / tokens-per-s.  One run feeds both
+# gated metrics; cached per process so the suite pays it once.
+_serving_report_cache: Dict[str, float] = {}
+
+
+def _serving_report(min_time_s: float) -> Dict[str, float]:
+    if _serving_report_cache:
+        return _serving_report_cache
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_dp_deployment
+        from ray_tpu.llm.serving import run_open_loop
+        serve.start()
+        try:
+            h = serve.run(build_dp_deployment(
+                "tiny", num_replicas=1, max_len=64, max_tokens=16,
+                page_size=8), name="llm-perf")
+            opts = {"max_tokens": 16}
+
+            def submit(p):
+                return h.options(
+                    stream=True,
+                    method_name="stream_generate").remote(p, opts)
+
+            for _ in submit([1, 2, 3]):     # warmup: compile + admit
+                pass
+            rep = run_open_loop(
+                submit, rate_hz=4.0, duration_s=max(4.0, min_time_s),
+                prompt_fn=lambda i: [(i % 37) + 1, (i % 11) + 2, 7],
+                num_replicas=1)
+            _serving_report_cache.update({
+                "serving_ttft_p50_ms": rep["ttft_p50_ms"],
+                "serving_tokens_per_s_per_replica":
+                    rep["tokens_per_s_per_replica"],
+            })
+        finally:
+            serve.shutdown()
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning("serving bench failed: %s", e)
+        _serving_report_cache.update({
+            "serving_ttft_p50_ms": 0.0,
+            "serving_tokens_per_s_per_replica": 0.0})
+    return _serving_report_cache
+
+
+def bench_serving_ttft(min_time_s: float) -> float:
+    return _serving_report(min_time_s)["serving_ttft_p50_ms"]
+
+
+def bench_serving_tokens_per_s(min_time_s: float) -> float:
+    return _serving_report(min_time_s)[
+        "serving_tokens_per_s_per_replica"]
+
+
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     from ray_tpu.util import placement_group, remove_placement_group
 
@@ -564,6 +624,11 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "framer_bulk_gibs_python": bench_framer_bulk_python,
     "framer_frames_per_s_native": bench_framer_frames_native,
     "framer_frames_per_s_python": bench_framer_frames_python,
+    # Serving open-loop harness (spins a Serve controller + one engine
+    # replica; shuts Serve down after): near the end so its actor churn
+    # doesn't overlap the per-call measurements.
+    "serving_ttft_p50_ms": bench_serving_ttft,
+    "serving_tokens_per_s_per_replica": bench_serving_tokens_per_s,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
     "internode_pull_gigabytes": bench_internode_pull_gigabytes,
@@ -601,9 +666,17 @@ BASELINE = {
     # Same anchor, aggregate across a 1→3 swarm: near-linear scaling
     # (Orchestra/Cornet) puts the bar at ~3x the per-node rate.
     "weight_broadcast_gigabytes": 10.2,
+    # Serving anchors: no published reference — committed host-class
+    # numbers (tiny model, CPU, 1 replica); vs_ref reads as "vs the
+    # last recorded run".  TTFT is LOWER-is-better (see
+    # LOWER_IS_BETTER; the gate inverts its ratio).
+    "serving_ttft_p50_ms": 8.5,
+    "serving_tokens_per_s_per_replica": 67.0,
 }
 
 UNITS = {
+    "serving_ttft_p50_ms": "ms p50 TTFT (open-loop, lower is better)",
+    "serving_tokens_per_s_per_replica": "tok/s/replica (open-loop)",
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
@@ -647,6 +720,19 @@ DATA_PLANE_METRICS = (
     "weight_broadcast_gigabytes",
     "framer_bulk_gibs_native",
 )
+
+# Serving-path metrics gated like the data-plane ones: a 0.0 reading
+# means the bench couldn't run here (Serve spin-up failure) and is
+# reported but never gated on; host-fingerprint mismatch downgrades to
+# informational like every absolute gate.
+SERVING_METRICS = (
+    "serving_ttft_p50_ms",
+    "serving_tokens_per_s_per_replica",
+)
+
+# Metrics where SMALLER readings are better (latencies): the gate
+# inverts their ratio so "regression" always means "got worse".
+LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms"})
 
 
 def _latest_committed_bench(repo_root: str = "."):
@@ -754,7 +840,7 @@ def check_against_committed(min_time_s: float = 2.0,
     this_host = _host_fingerprint()
     host_mismatch = base_host is not None and \
         not _host_matches(base_host, this_host)
-    gated = CONTROL_PLANE_METRICS + DATA_PLANE_METRICS
+    gated = CONTROL_PLANE_METRICS + DATA_PLANE_METRICS + SERVING_METRICS
     results = run_microbenchmarks(min_time_s=min_time_s,
                                   only=set(gated))
     failures = []
@@ -762,13 +848,17 @@ def check_against_committed(min_time_s: float = 2.0,
         if name not in results or name not in committed:
             continue
         now, ref = results[name]["value"], committed[name]
-        if name in DATA_PLANE_METRICS and (not now or not ref):
+        if name in DATA_PLANE_METRICS + SERVING_METRICS \
+                and (not now or not ref):
             # 0.0 = the bench couldn't spawn its extra agents here (or
             # the baseline predates the metric): report, never gate.
             print(json.dumps({"metric": name, "now": now,
                               "committed": ref, "skipped": True}))
             continue
-        ratio = now / ref if ref else 1.0
+        if name in LOWER_IS_BETTER:
+            ratio = ref / now if now else 1.0
+        else:
+            ratio = now / ref if ref else 1.0
         row = {"metric": name, "now": now, "committed": ref,
                "ratio": round(ratio, 3)}
         if ratio < 1.0 - threshold:
@@ -922,10 +1012,12 @@ def run_microbenchmarks(min_time_s: float = 1.0,
         # idle artifact. (Worker exits during the window under-count
         # slightly: a dead pid's cumulative time drops out of the sum.)
         cpu = {k: round(max(0.0, cpu1[k] - cpu0[k]), 2) for k in cpu1}
+        vs_ref = (BASELINE[name] / rate if name in LOWER_IS_BETTER and rate
+                  else rate / BASELINE[name])
         results[name] = {
             "value": round(rate, 2),
             "unit": UNITS.get(name, "ops/s"),
-            "vs_ref": round(rate / BASELINE[name], 3),
+            "vs_ref": round(vs_ref, 3),
             "cpu_s": cpu,
             "cpu_saturation": round(sum(cpu.values()) / max(wall, 1e-9), 2),
         }

@@ -64,6 +64,12 @@ def pytest_configure(config):
         "thread/task stack and fails the test instead of hanging; the "
         "long soaks are additionally marked slow — run them with "
         "-m 'chaos and slow'")
+    config.addinivalue_line(
+        "markers",
+        "serving: LLM serving subsystem (continuous batching, token "
+        "streaming, prefix cache, queue-driven autoscaling); the "
+        "tier-1 open-loop load test stays under ~60s on a tiny "
+        "TransformerConfig, CPU devices")
     # Build the native RPC framer ONCE at session start so worker/agent
     # processes spawned by cluster fixtures just dlopen the committed or
     # freshly-built .so instead of racing g++ builds.  Failure is fine:
