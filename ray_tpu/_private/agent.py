@@ -27,10 +27,11 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from . import clocks, protocol, rpc
+from . import clocks, loopmon, protocol, rpc
 from . import flight_recorder as frec
 from .config import Config, get_config, set_config
 from .ids import NodeID, WorkerID
@@ -167,6 +168,12 @@ class WorkerHandle:
 
 
 class NodeAgent:
+    # Transfer counters are bumped from I/O shard threads too
+    # (shard-local chunk serving): exact under the lock, which is
+    # ns-scale against a multi-MiB chunk serve.  Class-level so
+    # skeletal test instances share it.
+    _served_lock = threading.Lock()
+
     def __init__(self, *, gcs_address, session_dir: str, node_id: bytes,
                  resources: Dict[str, float], labels: Dict[str, str],
                  store_capacity: int, host: str = "127.0.0.1"):
@@ -265,8 +272,25 @@ class NodeAgent:
         from collections import deque as _dq
         self._parked_leases: _dq = _dq()
         self._park_event = asyncio.Event()
-        self._server = rpc.RpcServer(self._handlers(), name="agent",
-                                     on_client_close=self._on_client_close)
+        # Daemon I/O sharding (config daemon_io_shards): accepted
+        # connections live on shard event-loop threads.  Shard-local
+        # handlers are the pure arena/io ones — `ping` and the sealed-
+        # object `fetch_chunk` fast path (the shm store is cross-process
+        # shared memory, so cross-thread reads are its normal operating
+        # mode); every state-touching branch FAST_FALLBACKs into the
+        # batched main-loop hop.
+        self._io_shards = rpc.make_io_shard_pool("agent")
+        self._server = rpc.RpcServer(
+            self._handlers(), name="agent",
+            on_client_close=self._on_client_close,
+            io_shards=self._io_shards,
+            shard_handlers={
+                # The SAME callable as the main handlers dict: the
+                # t1/t2 stamp semantics feed clock-offset estimation
+                # and must never diverge between modes.
+                "ping": self._h_ping,
+                "fetch_chunk": self._sh_fetch_chunk,
+            })
         self.gcs: Optional[rpc.Connection] = None
         self._spawn_lock = asyncio.Lock()
         self._peer_conns: Dict[tuple, rpc.Connection] = {}
@@ -334,9 +358,9 @@ class NodeAgent:
             # clock-alignment probe (NTP t1/t2 server stamps; clocks.wall
             # so injected chaos skew is visible to the estimator exactly
             # like a genuinely off host clock).  Value is ignored by
-            # plain liveness callers.
-            "ping": lambda conn, p: {"pong": True, "t1": clocks.wall(),
-                                     "t2": clocks.wall()},
+            # plain liveness callers.  Shared with shard_handlers —
+            # sharded and single-loop mode must stamp identically.
+            "ping": self._h_ping,
             "worker_fate": self.h_worker_fate,
             "worker_blocked": self.h_worker_blocked,
             "worker_unblocked": self.h_worker_unblocked,
@@ -346,10 +370,18 @@ class NodeAgent:
             "shutdown": self.h_shutdown,
         }
 
+    @staticmethod
+    def _h_ping(conn, p):
+        return {"pong": True, "t1": clocks.wall(), "t2": clocks.wall()}
+
     # ------------------------------------------------------------ lifecycle --
     async def start(self) -> tuple:
         addr = await self._server.start_tcp(self.host, 0)
         self.address = addr
+        # Busy-fraction probe for the main loop (I/O shards install
+        # their own under shard<i>): exported per node so single-core
+        # daemon saturation is a gauge, not an inference.
+        loopmon.install("main")
 
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, name="agent->gcs",
@@ -396,6 +428,10 @@ class NodeAgent:
             "labels": self.labels,
             "store_path": self.store_path,
             "session_dir": self.session_dir,
+            # The agent never reads the cluster view from the reply;
+            # skipping it keeps a mass (re-)registration wave O(N) on
+            # the GCS instead of O(N^2) view-building.
+            "view": False,
         })
 
     async def _rejoin_with_fresh_id(self):
@@ -418,6 +454,13 @@ class NodeAgent:
     async def _report_loop(self):
         cfg = get_config()
         period = cfg.resource_report_period_ms / 1000.0
+        # Phase desync (like rpc._backoff_delay's jitter): seed this
+        # agent's tick with a pid-derived phase offset so N agents
+        # started (or healed) together spread their heartbeat+telemetry
+        # bursts across the period instead of stampeding the GCS on the
+        # same tick — at fleet size the synchronized burst is visible as
+        # p99 spikes on everything the GCS serves.
+        await asyncio.sleep(period * rpc._jitter_rng.random())
         while not self._shutdown:
             await asyncio.sleep(period)
             try:
@@ -488,12 +531,21 @@ class NodeAgent:
             st = self.store.stats()
         except Exception:
             st = {}
+        lm = loopmon.snapshot()
+        shard_busy = [v for k, v in lm.items() if k.startswith("shard")]
         return {
             "lease_queue_depth": float(len(self._parked_leases)),
             "active_leases": float(len(self.leases)),
             "num_workers": float(len(self.workers)),
             "arena_used_bytes": float(st.get("bytes_in_use", 0)),
             "arena_capacity_bytes": float(st.get("capacity", 0)),
+            # Loop saturation for `ray_tpu summary`'s busy column:
+            # main-loop busy fraction / max across I/O shards.
+            "loop_busy": float(lm.get("main", 0.0)),
+            "loop_busy_shard_max": float(max(shard_busy)
+                                         if shard_busy else 0.0),
+            "io_shards": float(len(self._io_shards)
+                               if self._io_shards else 0),
         }
 
     def _flush_telemetry(self) -> None:
@@ -558,6 +610,19 @@ class NodeAgent:
             row("ray_tpu_transfer_pulled_bytes_total",
                 self._bytes_pulled, "counter"),
         ]
+        # Per-loop busy fractions: main + every I/O shard, node-labeled
+        # (the gcs exports its own under daemon="gcs").
+        for label, ratio in loopmon.snapshot().items():
+            out.append(row("ray_tpu_daemon_loop_busy_ratio", ratio,
+                           labels={**lab, "loop": label},
+                           help_="CPU-seconds per wall-second burned by "
+                                 "the thread running this event loop"))
+        sst = self._server.shard_stats()
+        if sst["shards"]:
+            out.append(row("ray_tpu_daemon_io_shard_hops_total",
+                           sst["hops"], "counter"))
+            out.append(row("ray_tpu_daemon_io_shard_requests_total",
+                           sst["submitted"], "counter"))
         # Common per-process rows (io_stats, copy audit, recorder
         # counters): shared with the core worker's export so the two
         # cannot diverge.
@@ -697,6 +762,10 @@ class NodeAgent:
             except ProcessLookupError:
                 pass
         await self._server.close()
+        if self._io_shards is not None:
+            # After the server: bridged connection closes need the
+            # shard loops alive to run.
+            self._io_shards.close()
         self.store.close()
         if self._worker_cgroup is not None:
             # rmdir on a cgroup with live members returns EBUSY: give the
@@ -2089,6 +2158,47 @@ class NodeAgent:
             view.release()
             self.store.release(oid)
 
+    def _note_served(self, n: int) -> None:
+        # Shard threads and the main loop both serve chunks; += on an
+        # attribute is a read-modify-write that drops counts under races.
+        with self._served_lock:
+            self._bytes_served += n
+
+    def _sh_fetch_chunk(self, conn, p):
+        """SHARD-LOCAL fetch_chunk fast path (see _handlers wiring): a
+        SEALED shm object is served straight off the connection's I/O
+        shard — store lookup, arena subview pin, and the raw writev all
+        stay on the shard thread, so N peers pulling N objects spread
+        across cores instead of serializing on the agent's main loop.
+        Anything stateful — spilled objects, mid-pull partial serves,
+        gone-handling (directory retraction) — returns FAST_FALLBACK and
+        takes the exact h_fetch_chunk path on the main loop."""
+        # Bind every field BEFORE pinning (store.get): a malformed
+        # request erroring after the pin would leak it permanently.
+        oid, off, length = p["object_id"], p["offset"], p["length"]
+        raw = p.get("raw", False)
+        if oid in self.spilled:
+            return rpc.FAST_FALLBACK
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return rpc.FAST_FALLBACK
+        self._note_served(min(length, max(0, len(view) - off)))
+        if raw:
+            piece = view[off:off + length]
+
+            def _unpin(v=view, oid=oid):
+                v.release()
+                self.store.release(oid)
+
+            return rpc.RawPayload([piece], release=_unpin)
+        try:
+            piece = bytes(view[off:off + length])
+            rpc.note_copied_bytes("serve_legacy_chunk", len(piece))
+            return piece
+        finally:
+            view.release()
+            self.store.release(oid)
+
     async def h_object_info(self, conn, p):
         """Size + presence probe that precedes a chunked pull."""
         oid = p["object_id"]
@@ -2147,7 +2257,7 @@ class NodeAgent:
             data = await asyncio.get_running_loop().run_in_executor(
                 None, _read_spill_chunk)
             if data is not None:
-                self._bytes_served += len(data)
+                self._note_served(len(data))
                 return rpc.RawPayload([data]) if raw else data
         view = self.store.get(oid, timeout_ms=0)
         if view is None:
@@ -2165,7 +2275,7 @@ class NodeAgent:
                         piece = bytes(part["buf"][off:end])
                         rpc.note_copied_bytes("serve_partial_chunk",
                                               len(piece))
-                        self._bytes_served += len(piece)
+                        self._note_served(len(piece))
                         return rpc.RawPayload([piece]) if raw else piece
                 return {"later": True} if raw else None
             # No copy at all: if the directory still lists us, retract
@@ -2173,7 +2283,7 @@ class NodeAgent:
             # stop being routed here.
             self._drop_replica_registration(oid)
             return {"gone": True} if raw else None
-        self._bytes_served += min(length, max(0, len(view) - off))
+        self._note_served(min(length, max(0, len(view) - off)))
         if raw:
             piece = view[off:off + length]
 

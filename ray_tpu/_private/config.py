@@ -125,6 +125,20 @@ _define("rpc_native_framer", True,
         "format is identical to the pure-Python framer, so clusters may "
         "mix modes freely.  Off, a missing compiler, or a corrupt .so "
         "all fall back to pure Python (warn once, never an error)")
+_define("daemon_io_shards", -1,
+        "I/O shards for the daemon RPC planes (GCS and node agents): "
+        "accepted connections are distributed round-robin across this "
+        "many per-shard event-loop THREADS, each running the full wire "
+        "path (framing, msgpack codec, native-framer recv/writev) for "
+        "its connections; handlers that only touch the arena/io run "
+        "entirely on their shard, state-mutating handlers hop to the "
+        "daemon's main loop in ONE batched call_soon_threadsafe per "
+        "ready-wave.  -1 = auto (min(4, cpu cores)); 0 = single-loop "
+        "mode (everything on the main loop, exactly the pre-shard "
+        "behavior).  The wire format is identical in both modes, so "
+        "clusters may mix sharded and unsharded daemons freely "
+        "(reference: Ray's GCS and raylet run their gRPC services on "
+        "dedicated C++ executor thread pools)")
 _define("control_call_timeout_s", 60.0,
         "default deadline for unary control-plane RPCs whose call site "
         "passes no timeout: a half-open connection (gray peer, asymmetric "
@@ -319,6 +333,16 @@ def _parse(typ, s: str):
     if typ in (dict, list):
         return json.loads(s)
     return s
+
+
+def resolve_io_shards(cfg: "Config" | None = None) -> int:
+    """Effective daemon I/O shard count: the configured value, with -1
+    (auto) resolving to min(4, cpu cores).  0 disables sharding."""
+    cfg = cfg or get_config()
+    n = int(cfg.daemon_io_shards)
+    if n < 0:
+        n = min(4, os.cpu_count() or 1)
+    return max(0, n)
 
 
 _global: Config | None = None

@@ -2457,12 +2457,38 @@ class CoreWorker:
 
     async def _cluster_nodes(self, force: bool = False):
         """GCS node view, cached briefly (strategy routing must not add
-        a GCS round trip per lease request)."""
+        a GCS round trip per lease request).  Refreshes are DELTA
+        queries (`get_nodes {"since": epoch}`): the GCS ships only the
+        views whose scheduling-relevant state changed since our last
+        poll, so N polling clients cost the GCS O(changes) per tick
+        instead of O(nodes) full-view builds each.  A plain-list reply
+        (pre-delta GCS) keeps working unchanged."""
         now = time.monotonic()
         cached = getattr(self, "_nodes_cache", None)
         if not force and cached is not None and now - cached[0] < 2.0:
             return cached[1]
-        nodes = await self.gcs.call("get_nodes", {})
+        by_id = getattr(self, "_nodes_by_id", None)
+        since = getattr(self, "_nodes_epoch", None)
+        res = await self.gcs.call(
+            "get_nodes",
+            {"since": since if by_id and since is not None else -1})
+        if isinstance(res, list):
+            by_id = {n["node_id"]: n for n in res}
+            self._nodes_epoch = None
+        else:
+            if by_id is None or since is None:
+                by_id = {}
+            for v in res["changed"]:
+                by_id[v["node_id"]] = v
+            if res.get("total") is not None and res["total"] != len(by_id):
+                # Node-table reset under us (GCS restarted without its
+                # journal): ghosts in our merge would never be sent as
+                # dead — bootstrap the view from scratch.
+                res = await self.gcs.call("get_nodes", {"since": -1})
+                by_id = {v["node_id"]: v for v in res["changed"]}
+            self._nodes_epoch = res["epoch"]
+        self._nodes_by_id = by_id
+        nodes = list(by_id.values())
         self._nodes_cache = (now, nodes)
         return nodes
 

@@ -24,7 +24,7 @@ import struct
 import time
 from typing import Any, Dict, List, Optional
 
-from . import clocks, protocol, rpc
+from . import clocks, loopmon, protocol, rpc
 from . import scheduling_policy as policy
 
 logger = logging.getLogger("ray_tpu.gcs")
@@ -84,6 +84,13 @@ class NodeInfo:
         self.store_path = store_path
         self.session_dir = session_dir
         self.alive = True
+        # Delta node views: the GCS's _view_epoch value at this node's
+        # last SCHEDULING-RELEVANT change (registration, death/drain
+        # transitions, resources_available movement, suspicion crossing
+        # the trust threshold).  `get_nodes {"since": e}` returns only
+        # views newer than e — heartbeats that change nothing no longer
+        # make every polling client re-ship the full cluster view.
+        self.view_version = 0
         self.last_heartbeat = time.monotonic()
         self.conn: Optional[rpc.Connection] = None  # GCS→agent client
         # {"reason", "deadline"} while the two-phase drain runs (NODE_DRAINING)
@@ -205,6 +212,11 @@ class ActorInfo:
         }
 
 
+def _h_ping(conn, p):
+    # Liveness ping; served shard-local under daemon_io_shards.
+    return "pong"
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  journal_path: Optional[str] = None):
@@ -254,9 +266,27 @@ class GcsServer:
         # Bumped on every node registration; pending-actor scheduling resets
         # its deadline when this moves (new capacity may fit the actor).
         self._node_epoch = 0
+        # Delta node views (see NodeInfo.view_version): monotonically
+        # bumped on every scheduling-relevant view change.
+        self._view_epoch = 0
+        # alive-address -> NodeInfo index for heartbeat peer-stats
+        # folding: rebuilt only when membership changes — building it
+        # per heartbeat was O(N) x N heartbeats/tick = O(N^2) per tick
+        # at fleet size.
+        self._addr_index: Optional[Dict[str, NodeInfo]] = None
         self._closing = False
-        self._server = rpc.RpcServer(self._handlers(), name="gcs",
-                                     on_client_close=self._on_client_close)
+        # Daemon I/O sharding (config daemon_io_shards): accepted
+        # connections live on shard event-loop threads; only `ping`
+        # (pure I/O, no table access) is served shard-local — every
+        # other handler mutates the tables and hops to this loop.
+        self._io_shards = rpc.make_io_shard_pool("gcs")
+        self._server = rpc.RpcServer(
+            self._handlers(), name="gcs",
+            on_client_close=self._on_client_close,
+            io_shards=self._io_shards,
+            # Same callable as the handlers dict: sharded and
+            # single-loop mode must answer ping identically.
+            shard_handlers={"ping": _h_ping})
         self._health_task: Optional[asyncio.Task] = None
 
     def _handlers(self):
@@ -286,11 +316,25 @@ class GcsServer:
             "get_task_events": self.h_get_task_events,
             "report_metrics": self.h_report_metrics,
             "get_metrics": self.h_get_metrics,
-            "ping": lambda conn, p: "pong",
+            "ping": _h_ping,
             "get_cluster_info": self.h_get_cluster_info,
             "report_demand": self.h_report_demand,
             "get_demand": self.h_get_demand,
         }
+
+    def _mark_view_dirty(self, node: NodeInfo) -> None:
+        """Record a scheduling-relevant change to `node`'s view so
+        delta-polling clients (`get_nodes {"since": e}`) pick it up."""
+        self._view_epoch += 1
+        node.view_version = self._view_epoch
+
+    def _alive_by_addr(self) -> Dict[str, NodeInfo]:
+        idx = self._addr_index
+        if idx is None:
+            idx = self._addr_index = {
+                f"{n.address[0]}:{n.address[1]}": n
+                for n in self.nodes.values() if n.alive}
+        return idx
 
     # ----------------------------------------------------------- telemetry --
     async def h_task_events(self, conn, p):
@@ -457,6 +501,31 @@ class GcsServer:
             "help": "task events evicted by the GCS sink or dropped in "
                     "reporter buffers before reaching it",
             "value": float(self._events_dropped_total())}]
+        # Per-loop busy fractions (loopmon): single-core saturation of
+        # the GCS main loop — or of any I/O shard — is a gauge, not an
+        # inference from host CPU.
+        for label, ratio in loopmon.snapshot().items():
+            out.append({
+                "name": "ray_tpu_daemon_loop_busy_ratio",
+                "labels": {"daemon": "gcs", "loop": label},
+                "type": "gauge",
+                "help": "CPU-seconds per wall-second burned by the "
+                        "thread running this event loop (1.0 = one "
+                        "core saturated)",
+                "value": ratio})
+        st = self._server.shard_stats()
+        if st["shards"]:
+            out.append({
+                "name": "ray_tpu_daemon_io_shard_hops_total",
+                "labels": {"daemon": "gcs"}, "type": "counter",
+                "help": "batched shard->main-loop crossings",
+                "value": float(st["hops"])})
+            out.append({
+                "name": "ray_tpu_daemon_io_shard_requests_total",
+                "labels": {"daemon": "gcs"}, "type": "counter",
+                "help": "requests forwarded to the main loop by I/O "
+                        "shards (requests/hops = wave batching factor)",
+                "value": float(st["submitted"])})
         for node in self.nodes.values():
             if not node.alive:
                 continue
@@ -487,6 +556,9 @@ class GcsServer:
             self.journal = Journal(self.journal_path)
         addr = await self._server.start_tcp(self.host, self.port)
         self.address = addr
+        # Busy-fraction probe for the main loop (shards install their
+        # own): saturation of the state-mutating loop becomes a gauge.
+        loopmon.install("main")
         self._health_task = asyncio.ensure_future(self._health_loop())
         # Re-kick interrupted placement/scheduling loops (their coroutines
         # died with the previous process; agents re-register shortly).
@@ -575,6 +647,10 @@ class GcsServer:
         if self._health_task:
             self._health_task.cancel()
         await self._server.close()
+        if self._io_shards is not None:
+            # After the server: bridged connection closes need the
+            # shard loops alive to run.
+            self._io_shards.close()
 
     # ------------------------------------------------------------------ KV --
     async def h_kv_put(self, conn, p):
@@ -630,6 +706,8 @@ class GcsServer:
         node.client_conn = conn
         self.nodes[node.node_id] = node
         self._node_epoch += 1
+        self._addr_index = None
+        self._mark_view_dirty(node)
         self._log("node", {
             "node_id": node.node_id, "address": list(node.address),
             "resources": node.resources_total, "labels": node.labels,
@@ -637,6 +715,13 @@ class GcsServer:
             "session_dir": node.session_dir})
         rpc.spawn(self._connect_agent(node))
         self._publish(protocol.CH_NODE, {"event": "alive", "node": node.view()})
+        if not p.get("view", True):
+            # Registrants that don't consume the cluster view (agents,
+            # the soak harness) skip the O(N) reply: a wave of N
+            # registrations otherwise does O(N^2) view-building on this
+            # loop, which is exactly the mass-(re)registration moment
+            # the GCS can least afford it.
+            return {"node_id": node.node_id, "num_nodes": len(self.nodes)}
         return {"cluster_nodes": [n.view() for n in self.nodes.values()]}
 
     async def _connect_agent(self, node: NodeInfo):
@@ -646,7 +731,22 @@ class GcsServer:
             logger.warning("cannot connect to agent %s", node.address)
 
     async def h_get_nodes(self, conn, p):
-        return [n.view() for n in self.nodes.values()]
+        """Full node views, or — with {"since": epoch} — only the views
+        whose SCHEDULING-RELEVANT state changed after `epoch` (see
+        _mark_view_dirty; pass since=-1 for a full delta-form bootstrap).
+        Observability-only fields (runtime gauges, transfer counters,
+        rtt/clock) do not dirty a view: dashboards and the CLI use the
+        full form, scheduling clients (core_worker's 2s-cached view) use
+        deltas so N pollers cost O(changes), not O(N) each."""
+        since = (p or {}).get("since")
+        if since is None:
+            return [n.view() for n in self.nodes.values()]
+        if since > self._view_epoch:
+            since = -1      # GCS restarted with a fresh epoch: resend all
+        return {"epoch": self._view_epoch,
+                "changed": [n.view() for n in self.nodes.values()
+                            if n.view_version > since],
+                "total": len(self.nodes)}
 
     async def h_report_resources(self, conn, p):
         node = self.nodes.get(p["node_id"])
@@ -658,6 +758,8 @@ class GcsServer:
             # tell the agent its reports are going nowhere: it re-registers
             # under a FRESH node id and rejoins instead of zombieing.
             return False
+        if node.resources_available != p["available"]:
+            self._mark_view_dirty(node)
         node.resources_available = p["available"]
         node.last_heartbeat = time.monotonic()
         if p.get("runtime"):
@@ -682,8 +784,7 @@ class GcsServer:
             # seeing high RTT to one node is the strongest gray signal
             # there is (differential observability).
             now = time.monotonic()
-            by_addr = {f"{n.address[0]}:{n.address[1]}": n
-                       for n in self.nodes.values() if n.alive}
+            by_addr = self._alive_by_addr()
             for addr_s, st in peer_stats.items():
                 target = by_addr.get(addr_s)
                 if target is None or target.node_id == p["node_id"]:
@@ -722,6 +823,7 @@ class GcsServer:
             node.draining = {"reason": reason,
                              "deadline": time.monotonic() + deadline_s}
             node.drain_reason = reason
+            self._mark_view_dirty(node)     # schedulable flipped off
             logger.warning("node %s draining (reason=%s, deadline=%.1fs)",
                            node.node_id.hex()[:8], reason, deadline_s)
             self._publish(protocol.CH_NODE, {
@@ -1038,7 +1140,12 @@ class GcsServer:
                 # Heartbeats late but not yet fatal: the gray zone
                 # between healthy and the crash detector's verdict.
                 raw = max(raw, min(1.0, hb_age / death_bound))
+            was_suspect = node.suspicion >= policy.SUSPECT_THRESHOLD
             node.suspicion = 0.7 * node.suspicion + 0.3 * raw
+            if (node.suspicion >= policy.SUSPECT_THRESHOLD) != was_suspect:
+                # Trust-tier flip is what delta-view consumers
+                # (prefer_trusted) act on; sub-threshold EMA drift is not.
+                self._mark_view_dirty(node)
             if node.suspicion >= susp_threshold:
                 if node.suspect_since is None:
                     node.suspect_since = now
@@ -1115,6 +1222,8 @@ class GcsServer:
             return
         node.alive = False
         node.draining = None
+        self._addr_index = None
+        self._mark_view_dirty(node)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish(protocol.CH_NODE, {"event": "dead", "node": node.view(),
                                          "reason": reason})

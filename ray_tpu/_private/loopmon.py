@@ -1,0 +1,67 @@
+"""Per-event-loop busy-fraction probes.
+
+Each daemon event loop (the main loop and every I/O shard thread) runs a
+small self-rescheduling callback that samples its OWN thread's CPU time
+(`time.thread_time`) against wall time.  The ratio — CPU seconds burned
+per wall second by the thread that runs the loop — is the "loop busy"
+gauge: ~1.0 means the loop is saturated on one core (the condition the
+I/O sharding exists to relieve), ~0.0 means idle.  Exported as
+`ray_tpu_daemon_loop_busy_ratio{daemon=...,loop=main|shard<i>}` through
+the unified metrics export and shown in `ray_tpu summary`, so
+single-core daemon saturation is diagnosable from the gauges instead of
+inferred from host CPU.
+
+Thread model: each probe writes only its own label's slot; `snapshot()`
+reads the dict from any thread (GIL-consistent; values are immutable
+tuples).  Stale entries (a stopped shard) age out of snapshots.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+DEFAULT_PERIOD_S = 0.5
+
+# label -> (busy_ratio in [0,1], monotonic stamp of the sample)
+_RATIOS: Dict[str, tuple] = {}
+
+
+def install(label: str, loop=None, period: float = DEFAULT_PERIOD_S) -> None:
+    """Start a busy probe for `loop` under `label`.  Must be called from
+    the thread that runs (or will run) the loop, BEFORE or WHILE it runs;
+    the first ratio appears after one period.  Idempotent per label
+    (reinstalling restarts the sampling baseline)."""
+    import asyncio
+    if loop is None:
+        loop = asyncio.get_event_loop()
+    state = {"cpu": None, "wall": None}
+
+    def _tick():
+        if loop.is_closed():
+            _RATIOS.pop(label, None)
+            return
+        cpu, wall = time.thread_time(), time.monotonic()
+        if state["cpu"] is not None:
+            dw = wall - state["wall"]
+            if dw > 0:
+                _RATIOS[label] = (min(1.0, max(0.0, (cpu - state["cpu"])
+                                               / dw)), wall)
+        state["cpu"], state["wall"] = cpu, wall
+        loop.call_later(period, _tick)
+
+    loop.call_soon(_tick)
+
+
+def snapshot(max_age_s: float = 10.0) -> Dict[str, float]:
+    """Fresh busy ratios by label.  Entries older than `max_age_s`
+    (stopped loop, wedged thread) are dropped from the view — a frozen
+    reading must not masquerade as a live gauge."""
+    now = time.monotonic()
+    return {label: ratio for label, (ratio, ts) in list(_RATIOS.items())
+            if now - ts <= max_age_s}
+
+
+def busy(label: str) -> Optional[float]:
+    v = snapshot().get(label)
+    return v

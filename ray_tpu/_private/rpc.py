@@ -73,6 +73,28 @@ requires buffering by definition).  Per-connection `io_stats` counts
 frames, syscalls and takeovers so tests can pin the syscall budget
 (one submit_batch wave <= 2 transport submissions).
 
+Daemon I/O shards (config `daemon_io_shards`, default min(4, cores); 0
+restores the single-loop mode): a daemon RpcServer constructed with an
+`IoShardPool` moves every ACCEPTED connection off the daemon's main
+loop onto one of N per-shard event-loop threads.  The whole wire path
+— framing, msgpack codec, native-framer recv takeover and vectored
+writev — runs on the owning shard, so control-plane byte work scales
+with cores instead of serializing on the daemon's one loop.  Handlers
+that only touch the arena / per-connection I/O (declared in the
+server's `shard_handlers`) run entirely on their shard; every other
+request hops to the daemon's main loop through a per-shard
+`ShardRouter` that batches a ready-wave of requests into ONE
+`call_soon_threadsafe` crossing (never one per frame), preserving
+arrival order.  Replies hop back through the cross-thread seam built
+into `_maybe_reply`, and the public Connection API (`call`, `notify`,
+`close`, ...) transparently bridges to the owning loop when invoked
+from a foreign thread, so daemon code may keep references to inbound
+connections (pubsub subscribers, registered workers) and use them from
+the main loop unchanged.  The wire format is IDENTICAL in both modes —
+mixed sharded/unsharded clusters interoperate freely (reference: the
+Ray GCS/raylet run their gRPC services on dedicated C++ thread pools
+while the application state stays single-threaded behind a post queue).
+
 Authentication (reference: src/ray/rpc/authentication/
 authentication_token_validator.cc): when a server is constructed with
 auth_token=..., the first frame on every inbound connection must be the
@@ -94,6 +116,8 @@ import sys
 import time
 import weakref
 from typing import Any, Awaitable, Callable, Dict, Optional
+
+import threading
 
 import msgpack
 
@@ -197,6 +221,7 @@ def _backoff_delay(attempt: int, retry_delay: float,
 # importable without cloudpickle.
 # ---------------------------------------------------------------------------
 COPY_AUDIT: Dict[str, int] = {}
+_COPY_AUDIT_LOCK = threading.Lock()
 
 
 def note_copied_bytes(tag: str, nbytes: int) -> None:
@@ -204,8 +229,11 @@ def note_copied_bytes(tag: str, nbytes: int) -> None:
     path.  Tags: serve_partial_chunk (swarm mid-pull serves — 1 copy per
     byte by design: the unsealed buffer's lifetime belongs to the pull),
     serve_legacy_chunk / pull_legacy_chunk (non-raw peers),
-    pull_hedge_staging (backup attempt landed in its private buffer)."""
-    COPY_AUDIT[tag] = COPY_AUDIT.get(tag, 0) + nbytes
+    pull_hedge_staging (backup attempt landed in its private buffer).
+    Locked: serve paths run on daemon I/O shard threads too, and a
+    copy-audit counter the tests PIN must stay exact."""
+    with _COPY_AUDIT_LOCK:
+        COPY_AUDIT[tag] = COPY_AUDIT.get(tag, 0) + nbytes
 
 
 def copy_audit_snapshot() -> Dict[str, int]:
@@ -222,22 +250,26 @@ def copy_audit_snapshot() -> Dict[str, int]:
 # ---------------------------------------------------------------------------
 _LIVE_CONNS: "weakref.WeakSet" = weakref.WeakSet()
 _IO_RETIRED: Dict[str, int] = {}
+# Connections live and die on daemon I/O shard threads too: the WeakSet
+# and the retired fold must not race the main-thread metrics snapshot.
+_IO_LOCK = threading.Lock()
 
 
 def io_stats_snapshot() -> Dict[str, int]:
     """Aggregate io_stats across every connection this process has ever
     opened (live + retired).  Monotonic per key — safe to export as
     counters."""
-    out = dict(_IO_RETIRED)
-    out.setdefault("connections", 0)
-    if _LIVE_CONNS is not None:
-        for conn in list(_LIVE_CONNS):
-            st = getattr(conn, "io_stats", None)
-            if not st:
-                continue
-            for k, v in st.items():
-                out[k] = out.get(k, 0) + v
-            out["connections"] += 1
+    with _IO_LOCK:
+        out = dict(_IO_RETIRED)
+        out.setdefault("connections", 0)
+        conns = list(_LIVE_CONNS)
+    for conn in conns:
+        st = getattr(conn, "io_stats", None)
+        if not st:
+            continue
+        for k, v in list(st.items()):
+            out[k] = out.get(k, 0) + v
+        out["connections"] += 1
     return out
 
 
@@ -312,6 +344,10 @@ class _Chaos:
     def __init__(self, spec: str):
         self.rules: Dict[str, list] = {}
         self._rng = random.Random(0xC0FFEE)
+        # Injection decisions can race across daemon I/O shard threads;
+        # the budget decrement must stay exact or a "fails at most N
+        # times" chaos test goes flaky under sharding.
+        self._lock = threading.Lock()
         for part in filter(None, (p.strip() for p in spec.split(","))):
             name, rhs = part.split("=")
             fields = rhs.split(":")
@@ -324,10 +360,13 @@ class _Chaos:
         rule = self.rules.get(method)
         if not rule or rule[0] <= 0:
             return False
-        p = rule[1] if phase == "req" else rule[2]
-        if self._rng.randint(1, 100) <= p:
-            rule[0] -= 1
-            return True
+        with self._lock:
+            if rule[0] <= 0:
+                return False
+            p = rule[1] if phase == "req" else rule[2]
+            if self._rng.randint(1, 100) <= p:
+                rule[0] -= 1
+                return True
         return False
 
 
@@ -594,7 +633,12 @@ class Connection:
         self.io_stats = {"tx_syscalls": 0, "tx_frames": 0,
                          "tx_writev": 0, "tx_bytes": 0,
                          "rx_native_bytes": 0, "rx_takeovers": 0}
-        _LIVE_CONNS.add(self)
+        # Daemon I/O sharding: set by a sharded RpcServer's accept loop.
+        # Non-None routes every request not in the router's shard-local
+        # set to the daemon's main loop (batched, order-preserving).
+        self._router: Optional["ShardRouter"] = None
+        with _IO_LOCK:
+            _LIVE_CONNS.add(self)
 
     @property
     def closed(self):
@@ -605,7 +649,49 @@ class Connection:
     def writer(self):
         return self
 
+    # ------------------------------------------- cross-thread seam --
+    # A connection is OWNED by the loop that created its transport
+    # (self._loop) — for daemon-sharded connections that is an I/O shard
+    # thread, while daemon code holding a reference (pubsub subscribers,
+    # registered workers, hop-dispatched handlers) runs on the main
+    # loop.  Transports and the framing state are not thread-safe, so
+    # every public entry point bridges to the owning loop when invoked
+    # from a foreign thread.  Single-loop processes never take these
+    # branches (the owner check is one get_running_loop + identity
+    # compare).
+
+    def _on_owner_loop(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            return False
+
+    async def _bridge(self, coro) -> Any:
+        """Run `coro` on the owning loop; await its outcome here."""
+        try:
+            cf = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        except RuntimeError:
+            # Owning loop already closed (daemon teardown): same typed
+            # outcome a same-loop caller gets from a dead connection.
+            coro.close()
+            raise ConnectionLost(
+                f"connection {self.name} loop closed") from None
+        try:
+            return await asyncio.wrap_future(cf)
+        finally:
+            if not cf.done():
+                cf.cancel()
+
     def abort(self):
+        if self._loop is not None and not self._on_owner_loop():
+            try:
+                self._loop.call_soon_threadsafe(self._abort_local)
+            except RuntimeError:
+                pass        # owner loop gone: nothing left to abort
+            return
+        self._abort_local()
+
+    def _abort_local(self):
         if self.transport is not None:
             self.transport.abort()
 
@@ -1095,6 +1181,11 @@ class Connection:
         """Server-side: await the raw payload a peer announced for request
         `rid` (callers put their request's msg_id in the payload so the
         handler knows it).  Returns the collected bytes."""
+        if self._loop is not None and not self._on_owner_loop():
+            # Hop-dispatched handler on the daemon's main loop: the raw
+            # orphan/taker state (and the future _finish_raw resolves)
+            # belong to the owning shard loop.
+            return await self._bridge(self.take_raw(rid, timeout))
         orphan = self._raw_orphans.pop(rid, None)
         if orphan is not None:
             if orphan[1]:
@@ -1257,8 +1348,17 @@ class Connection:
                 # with its own response frame, so the semantics are
                 # identical to K pipelined call()s — only the framing
                 # overhead is amortized.
+                rt = self._router
                 fhs = self.fast_handlers
                 for sub in b:
+                    if rt is not None:
+                        lh = rt.local.get(sub[1])
+                        if lh is None:
+                            rt.submit(self, sub[0], sub[1], sub[2])
+                        else:
+                            self._dispatch_fast(sub[0], sub[1], lh,
+                                                sub[2], fallback=rt)
+                        continue
                     fh = fhs.get(sub[1])
                     if fh is not None:
                         self._dispatch_fast(sub[0], sub[1], fh, sub[2])
@@ -1274,6 +1374,20 @@ class Connection:
                         mid, a, 1,
                         f"DeadlineExceededError: deadline exceeded "
                         f"before dispatch of {a}")
+                return
+            rt = self._router
+            if rt is not None:
+                # Sharded daemon connection: shard-local handlers run
+                # right here on the shard's loop; everything else joins
+                # the batched hop to the daemon's main loop (one
+                # call_soon_threadsafe per ready-wave, arrival order
+                # preserved).
+                lh = rt.local.get(a)
+                if lh is None:
+                    rt.submit(self, mid, a, b, deadline=dl)
+                else:
+                    self._dispatch_fast(mid, a, lh, b, deadline=dl,
+                                        fallback=rt)
                 return
             fh = self.fast_handlers.get(a)
             if fh is not None:
@@ -1292,12 +1406,7 @@ class Connection:
         if self._closed:
             return
         self._closed = True
-        # Fold final I/O counters into the process-wide retired totals
-        # (io_stats_snapshot) before the connection object goes away.
-        for k, v in self.io_stats.items():
-            _IO_RETIRED[k] = _IO_RETIRED.get(k, 0) + v
-        _IO_RETIRED["connections"] = _IO_RETIRED.get("connections", 0) + 1
-        _LIVE_CONNS.discard(self)
+        self._retire_io_stats()
         self._native_rx_end(resume=False)
         if self._dup_fd >= 0:
             try:
@@ -1336,10 +1445,32 @@ class Connection:
             except Exception:
                 logger.exception("on_close callback failed")
 
+    def _retire_io_stats(self) -> None:
+        """Fold final I/O counters into the process-wide retired totals
+        (io_stats_snapshot) before the connection object goes away.
+        Idempotent: also reached by the bridged-close path when the
+        owning loop died before a real teardown could run — the
+        counters' export contract is monotonic, so a live-then-GC'd
+        connection must never make them go backward."""
+        if getattr(self, "_stats_retired", False):
+            return
+        self._stats_retired = True
+        with _IO_LOCK:
+            for k, v in self.io_stats.items():
+                _IO_RETIRED[k] = _IO_RETIRED.get(k, 0) + v
+            _IO_RETIRED["connections"] = \
+                _IO_RETIRED.get("connections", 0) + 1
+            _LIVE_CONNS.discard(self)
+
     def _dispatch_fast(self, mid: int, method: str, fh, payload,
-                       deadline: Optional[float] = None):
+                       deadline: Optional[float] = None,
+                       fallback: Optional["ShardRouter"] = None):
         """Inline dispatch for fast handlers (see __init__): no Task per
-        request.  Chaos injection and error replies match _dispatch."""
+        request.  Chaos injection and error replies match _dispatch.
+        `fallback` routes a FAST_FALLBACK result through the shard
+        router's main-loop hop instead of a local coroutine dispatch
+        (shard-local handlers bail out to the daemon's main loop for
+        their slow/state-mutating branches)."""
         if _chaos and _chaos.should_fail(method, "req"):
             return  # drop silently; caller times out / retries
         tok = _handler_deadline.set(deadline) if deadline is not None \
@@ -1361,8 +1492,13 @@ class Connection:
         if res is FAST_FALLBACK:
             # The request-side chaos check already ran above — skip it in
             # _dispatch or fallback requests would see a doubled drop rate.
-            spawn(self._dispatch(mid, method, payload,
-                                 skip_req_chaos=True, deadline=deadline))
+            if fallback is not None:
+                fallback.submit(self, mid, method, payload,
+                                deadline=deadline, skip_req_chaos=True)
+            else:
+                spawn(self._dispatch(mid, method, payload,
+                                     skip_req_chaos=True,
+                                     deadline=deadline))
             return
         if isinstance(res, RawPayload) and mid == 0:
             res.close()
@@ -1385,6 +1521,28 @@ class Connection:
             self._maybe_reply(mid, method, 0, res)
 
     def _maybe_reply(self, mid: int, method: str, status: int, body):
+        if self._loop is not None and not self._on_owner_loop():
+            # Hop-dispatched handler completing on the daemon's main
+            # loop: hand the reply to the owning shard loop.  A
+            # RawPayload's release callback touches state owned by THIS
+            # loop (store pins, arena refcounts) — wrap it to run back
+            # here once the shard is done with the bytes.
+            if isinstance(body, RawPayload) and body.release is not None:
+                try:
+                    here = asyncio.get_running_loop()
+                except RuntimeError:
+                    here = None
+                if here is not None:
+                    rel = body.release
+                    body.release = \
+                        lambda: here.call_soon_threadsafe(rel)
+            try:
+                self._loop.call_soon_threadsafe(
+                    self._maybe_reply, mid, method, status, body)
+            except RuntimeError:        # owner loop already closed
+                if isinstance(body, RawPayload):
+                    body.close()
+            return
         if isinstance(body, RawPayload):
             if (_chaos and _chaos.should_fail(method, "resp")) \
                     or self._closed or mid == 0:
@@ -1452,7 +1610,11 @@ class Connection:
 
     async def drain(self):
         """Wait until the transport's write buffer falls below the high
-        watermark (cheap no-op when unpaused — matches StreamWriter.drain)."""
+        watermark (cheap no-op when unpaused — matches StreamWriter.drain).
+        Not cross-thread bridged: the waiter future must live on the
+        owning loop (resume_writing resolves it there)."""
+        if self._loop is not None and not self._on_owner_loop():
+            raise RpcError("drain() is not cross-thread safe")
         if self._paused and not self._closed:
             w = asyncio.get_running_loop().create_future()
             self._drain_waiters.append(w)
@@ -1482,6 +1644,9 @@ class Connection:
     async def call(self, method: str, payload=None,
                    timeout: float | None = None,
                    deadline: float | None = None):
+        if self._loop is not None and not self._on_owner_loop():
+            return await self._bridge(
+                self.call(method, payload, timeout, deadline))
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
         eff_timeout = self._effective_timeout(timeout, deadline)
@@ -1519,6 +1684,9 @@ class Connection:
         Resolves to the byte count scattered.  A peer replying with a
         normal msgpack frame instead (absence marker, typed error, or a
         legacy bytes body) resolves to that value — callers handle both."""
+        if self._loop is not None and not self._on_owner_loop():
+            return await self._bridge(
+                self.call_raw(method, payload, sink, timeout, deadline))
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
         eff_timeout = self._effective_timeout(timeout, deadline)
@@ -1569,6 +1737,9 @@ class Connection:
         handler can `await conn.take_raw(raw_id)`), immediately followed
         by the raw frame.  Returns the response; timed-out entries are
         reaped from _pending like call()/call_raw()."""
+        if self._loop is not None and not self._on_owner_loop():
+            return await self._bridge(
+                self.call_with_raw(method, payload, body, timeout))
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
         mid = self._next_id
@@ -1602,7 +1773,23 @@ class Connection:
     def notify(self, method: str, payload=None):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
+        if self._loop is not None and not self._on_owner_loop():
+            # Fire-and-forget from a foreign thread (e.g. a daemon main
+            # loop publishing to a shard-owned subscriber): hand the
+            # frame to the owning loop.  A close racing the hop drops
+            # the notify exactly like a close racing a same-loop send.
+            try:
+                self._loop.call_soon_threadsafe(
+                    self._notify_local, method, payload)
+            except RuntimeError:
+                raise ConnectionLost(
+                    f"connection {self.name} loop closed") from None
+            return
         self._send_frame([0, method, payload])
+
+    def _notify_local(self, method: str, payload) -> None:
+        if not self._closed:
+            self._send_frame([0, method, payload])
 
     def call_many(self, method: str, payloads) -> list:
         """Issue many independent calls in ONE frame; returns their futures.
@@ -1616,6 +1803,12 @@ class Connection:
         only). Connection loss fails all returned futures."""
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
+        if self._loop is not None and not self._on_owner_loop():
+            # Deliberately NOT bridged (the returned futures would
+            # belong to the wrong loop): fail loudly instead of
+            # corrupting framing state from a foreign thread.
+            raise RpcError("call_many is not cross-thread safe; "
+                           "issue individual call()s instead")
         loop = asyncio.get_running_loop()
         futs, batch = [], []
         for p in payloads:
@@ -1715,6 +1908,16 @@ class Connection:
         return False
 
     async def close(self):
+        if self._loop is not None and not self._on_owner_loop() \
+                and not self._closed:
+            try:
+                await self._bridge(self.close())
+            except (ConnectionLost, RuntimeError):
+                # Owner loop gone: no transport work is possible, but
+                # the accounting contract still holds.
+                self._closed = True
+                self._retire_io_stats()
+            return
         # Push out coalesced frames before tearing down — a notify()
         # immediately followed by close() (e.g. the GCS's kill delivery)
         # must still reach the peer.
@@ -1729,13 +1932,178 @@ class Connection:
 
 
 # ---------------------------------------------------------------------------
+# Daemon I/O shards (see module docstring).
+# ---------------------------------------------------------------------------
+class IoShard:
+    """One shard: a dedicated thread running its own event loop."""
+
+    __slots__ = ("index", "label", "loop", "thread")
+
+    def __init__(self, index: int, label: str, loop, thread):
+        self.index = index
+        self.label = label
+        self.loop = loop
+        self.thread = thread
+
+
+class IoShardPool:
+    """N event-loop threads owning a daemon's accepted connections.
+
+    Each shard thread runs a plain asyncio loop (eager tasks enabled,
+    busy-fraction probe installed under the label `shard<i>`); the
+    accepting server distributes connections round-robin with
+    `pick()`.  `close()` stops the loops and joins the threads —
+    always AFTER the server has closed its connections, or bridged
+    closes would hang."""
+
+    def __init__(self, n: int, name: str = "daemon",
+                 busy_probes: bool = True):
+        self.shards: list = []
+        self._rr = 0
+        for i in range(n):
+            loop = asyncio.new_event_loop()
+            label = f"shard{i}"
+            ready = threading.Event()
+
+            def _run(loop=loop, label=label, ready=ready):
+                asyncio.set_event_loop(loop)
+                enable_eager_tasks(loop)
+                if busy_probes:
+                    try:
+                        from . import loopmon
+                        loopmon.install(label, loop)
+                    except Exception:   # probes are never load-bearing
+                        pass
+                loop.call_soon(ready.set)
+                try:
+                    loop.run_forever()
+                finally:
+                    try:
+                        loop.close()
+                    except Exception:
+                        pass
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name=f"{name}-io-{label}")
+            t.start()
+            # Wait for the loop to actually run before putting the
+            # shard in the accept rotation: a connection adopted by a
+            # never-started loop would hang its clients silently.
+            if ready.wait(timeout=5.0):
+                self.shards.append(IoShard(i, label, loop, t))
+            else:
+                logger.warning("I/O shard %s/%s failed to start; "
+                               "excluding it from the rotation",
+                               name, label)
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def pick(self) -> IoShard:
+        sh = self.shards[self._rr % len(self.shards)]
+        self._rr += 1
+        return sh
+
+    def close(self, timeout: float = 2.0) -> None:
+        for sh in self.shards:
+            try:
+                sh.loop.call_soon_threadsafe(sh.loop.stop)
+            except RuntimeError:
+                pass
+        for sh in self.shards:
+            sh.thread.join(timeout)
+        self.shards = []
+
+
+class ShardRouter:
+    """Per-shard seam into the daemon's main loop.
+
+    Shard threads own the wire; the daemon's shared state stays
+    single-threaded on the main loop.  `local` maps method name ->
+    SYNC shard-local handler (fast-handler contract: returns a result,
+    a Future, or FAST_FALLBACK to punt this request to the main loop).
+    Every other request is `submit()`ed: a ready-wave of submissions
+    (all frames processed in the current shard-loop iteration, across
+    all of this shard's connections) crosses to the main loop in ONE
+    call_soon_threadsafe, and `_run_batch` dispatches them there in
+    arrival order through the normal `Connection._dispatch` path —
+    identical semantics (chaos, deadlines, error contract), different
+    thread.  Replies hop back through `Connection._maybe_reply`'s
+    cross-thread seam."""
+
+    __slots__ = ("shard_loop", "main_loop", "local", "_buf",
+                 "_scheduled", "hops", "submitted")
+
+    def __init__(self, shard_loop, main_loop,
+                 local: Dict[str, Callable] | None = None):
+        self.shard_loop = shard_loop
+        self.main_loop = main_loop
+        self.local = local or {}
+        self._buf: list = []
+        self._scheduled = False
+        # Observability (asserted by tests, exported by the daemons):
+        # hops counts main-loop crossings, submitted counts requests —
+        # submitted/hops is the wave-batching factor.
+        self.hops = 0
+        self.submitted = 0
+
+    def submit(self, conn: "Connection", mid: int, method: str, payload,
+               deadline: Optional[float] = None,
+               skip_req_chaos: bool = False) -> None:
+        # Shard-loop only; no lock needed (the flush is scheduled on
+        # the same loop, so buffer and flag are single-threaded).
+        self.submitted += 1
+        self._buf.append((conn, mid, method, payload, deadline,
+                          skip_req_chaos))
+        if not self._scheduled:
+            self._scheduled = True
+            self.shard_loop.call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._scheduled = False
+        buf, self._buf = self._buf, []
+        if not buf:
+            return
+        self.hops += 1
+        try:
+            self.main_loop.call_soon_threadsafe(self._run_batch, buf)
+        except RuntimeError:
+            # Main loop gone (daemon teardown): the peers' calls fail
+            # via connection close, same as a daemon crash.
+            pass
+
+    def _run_batch(self, buf: list) -> None:
+        # MAIN loop: dispatch in arrival order.  With eager tasks the
+        # common non-suspending handler completes (and queues its
+        # reply hop) inline here.
+        for conn, mid, method, payload, deadline, skip in buf:
+            if conn._closed:
+                continue
+            spawn(conn._dispatch(mid, method, payload,
+                                 skip_req_chaos=skip, deadline=deadline))
+
+
+def make_io_shard_pool(name: str) -> Optional[IoShardPool]:
+    """Config-driven pool for a daemon: None when `daemon_io_shards`
+    resolves to 0 (single-loop mode)."""
+    try:
+        from .config import resolve_io_shards
+        n = resolve_io_shards()
+    except Exception:
+        return None
+    return IoShardPool(n, name=name) if n > 0 else None
+
+
+# ---------------------------------------------------------------------------
 # Server
 # ---------------------------------------------------------------------------
 class RpcServer:
     def __init__(self, handlers: Dict[str, Callable], name: str = "server",
                  on_client_close: Callable | None = None,
                  fast_handlers: Dict[str, Callable] | None = None,
-                 auth_token=DEFAULT_TOKEN, native: bool | None = None):
+                 auth_token=DEFAULT_TOKEN, native: bool | None = None,
+                 io_shards: "IoShardPool | None" = None,
+                 shard_handlers: Dict[str, Callable] | None = None):
         self.handlers = handlers
         self.fast_handlers = fast_handlers
         self.name = name
@@ -1747,24 +2115,112 @@ class RpcServer:
         # agent reclaim leases whose owner died (reference: raylet
         # returning leases on client disconnect).
         self.on_client_close = on_client_close
+        # Daemon I/O sharding: with a pool, accepted connections are
+        # adopted by shard loops; `shard_handlers` (method -> SYNC
+        # callable, FAST_FALLBACK allowed) run shard-local, everything
+        # else hops to this server's home loop.  connections/close
+        # callbacks always run on the home loop regardless of mode.
+        self.io_shards = io_shards if io_shards and len(io_shards) else None
+        self.shard_handlers = shard_handlers or {}
+        self._routers: Dict[int, ShardRouter] = {}
+        self._home_loop = None
+        self._lsock = None
+        self._accept_task: Optional[asyncio.Task] = None
+
+    def _conn_closed(self, c: "Connection") -> None:
+        self.connections.discard(c)
+        if self.on_client_close is not None:
+            try:
+                self.on_client_close(c)
+            except Exception:
+                logger.exception("on_client_close failed")
 
     def _factory(self) -> _WireProtocol:
-        def _closed(c):
-            self.connections.discard(c)
-            if self.on_client_close is not None:
-                try:
-                    self.on_client_close(c)
-                except Exception:
-                    logger.exception("on_client_close failed")
-        conn = Connection(self.handlers, name=self.name, on_close=_closed,
+        conn = Connection(self.handlers, name=self.name,
+                          on_close=self._conn_closed,
                           fast_handlers=self.fast_handlers,
                           auth_token=self.auth_token,
                           on_connect=self.connections.add,
                           native=self.native)
         return _WireProtocol(conn)
 
+    def _router_for(self, shard: IoShard) -> ShardRouter:
+        rt = self._routers.get(shard.index)
+        if rt is None:
+            rt = self._routers[shard.index] = ShardRouter(
+                shard.loop, self._home_loop, self.shard_handlers)
+        return rt
+
+    def shard_stats(self) -> Dict[str, int]:
+        """Hop/batching counters for the unified export and tests."""
+        return {
+            "shards": len(self.io_shards) if self.io_shards else 0,
+            "hops": sum(rt.hops for rt in self._routers.values()),
+            "submitted": sum(rt.submitted
+                             for rt in self._routers.values()),
+        }
+
+    async def _accept_loop(self, sock) -> None:
+        """Sharded accept: the home loop accepts, a shard loop adopts.
+        connect_accepted_socket builds the transport ON the shard loop,
+        so every byte of this connection's wire work lands there."""
+        loop = asyncio.get_running_loop()
+        home = loop
+        while True:
+            try:
+                client, _addr = await loop.sock_accept(sock)
+            except asyncio.CancelledError:
+                raise
+            except OSError:
+                if self._lsock is None:
+                    return              # closed under us: normal exit
+                await asyncio.sleep(0.05)
+                continue
+            shard = self.io_shards.pick()
+            rt = self._router_for(shard)
+            conn = Connection(
+                self.handlers, name=self.name,
+                # Lifecycle callbacks touch daemon state: always home.
+                on_close=lambda c: home.call_soon_threadsafe(
+                    self._conn_closed, c),
+                fast_handlers=self.fast_handlers,
+                auth_token=self.auth_token,
+                on_connect=lambda c: home.call_soon_threadsafe(
+                    self.connections.add, c),
+                native=self.native)
+            conn._router = rt
+            try:
+                cf = asyncio.run_coroutine_threadsafe(
+                    shard.loop.connect_accepted_socket(
+                        lambda c=conn: _WireProtocol(c), client),
+                    shard.loop)
+            except RuntimeError:        # shard loop gone (teardown race)
+                client.close()
+                continue
+
+            def _done(f, sock_=client):
+                try:
+                    f.result()
+                except Exception:
+                    logger.warning("shard adoption failed on %s",
+                                   self.name, exc_info=True)
+                    try:
+                        sock_.close()
+                    except OSError:
+                        pass
+
+            cf.add_done_callback(_done)
+
     async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
         loop = asyncio.get_running_loop()
+        if self.io_shards is not None:
+            import socket
+            sock = socket.create_server((host, port), backlog=1024)
+            sock.setblocking(False)
+            self._lsock = sock
+            self._home_loop = loop
+            self._accept_task = spawn(self._accept_loop(sock))
+            return sock.getsockname()[:2]
         self._server = await loop.create_server(self._factory, host, port)
         return self._server.sockets[0].getsockname()[:2]
 
@@ -1774,10 +2230,28 @@ class RpcServer:
         return path
 
     async def close(self):
+        if self._accept_task is not None:
+            task, self._accept_task = self._accept_task, None
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._lsock is not None:
+            sock, self._lsock = self._lsock, None
+            try:
+                sock.close()
+            except OSError:
+                pass
         if self._server:
             self._server.close()
         for c in list(self.connections):
-            await c.close()
+            try:
+                # Bounded: a bridged close must not hang server teardown
+                # if a shard loop is already wedged/stopped.
+                await asyncio.wait_for(c.close(), 2)
+            except (asyncio.TimeoutError, RuntimeError):
+                pass
         if self._server:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 2)

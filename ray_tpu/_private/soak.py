@@ -1,0 +1,283 @@
+"""Many-node control-plane soak harness: simulated agent fleets.
+
+Drives one REAL GCS (typically a subprocess via `node.start_gcs`) with
+N simulated nodes — each is a minimal RPC server (timestamped `ping`
+for the health/clock probes, `drain`/`shutdown` stubs) plus the real
+control-plane client traffic an agent generates: one registration, then
+a phase-jittered heartbeat loop shipping `report_resources` (with
+peer_stats so the GCS's evidence-folding path runs at fleet width),
+`task_events` telemetry blobs, and `report_metrics` snapshots every
+tick.  No workers, no object store: the point is to load exactly the
+per-node control traffic that multiplies at fleet size (ROADMAP item 1
+— the O(N) walls live in `_health_loop`, node-view distribution, and
+the metrics sink) and to measure it from the outside: registration
+latency percentiles, steady-state control RPC latency, heartbeat
+rejections, and the GCS's own no-silent-caps drop counters.
+
+Used by tests/test_control_soak.py (100 nodes in tier-1, 500 behind
+`-m 'soak and slow'`); docs/control_plane.md documents the how-to.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import time
+from typing import Dict, List, Optional
+
+from . import clocks, rpc
+
+__all__ = ["SimulatedNode", "run_soak", "percentile"]
+
+
+def percentile(values: List[float], p: float) -> float:
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class SimulatedNode:
+    """One fake agent against a real GCS (see module docstring)."""
+
+    def __init__(self, gcs_address: tuple, index: int,
+                 period_s: float = 0.25, metrics_rows: int = 8,
+                 telemetry_rows: int = 4):
+        from .ids import NodeID
+        self.gcs_address = tuple(gcs_address)
+        self.index = index
+        self.node_id = NodeID.from_random().binary()
+        self.period_s = period_s
+        self.metrics_rows = metrics_rows
+        self.telemetry_rows = telemetry_rows
+        self.resources = {"CPU": 1.0, "memory": float(1 << 30)}
+        # Deterministic per-node phase (mirrors the real agent's
+        # pid-jittered heartbeat phase): N nodes spread their ticks
+        # across the period instead of stampeding the same instant.
+        self._rng = random.Random(0x50AC ^ index)
+        self.server: Optional[rpc.RpcServer] = None
+        self.address: Optional[tuple] = None
+        self.gcs: Optional[rpc.Connection] = None
+        self._beat_task: Optional[asyncio.Task] = None
+        # Stop flag alongside cancellation, like the real daemons'
+        # `while not self._shutdown` loops: on 3.10, asyncio.wait_for
+        # can SWALLOW a cancellation that races the inner future's
+        # completion (bpo-37658 family) — under a saturated GCS whose
+        # replies arrive in bursts that race is routinely hit at fleet
+        # size, and a swallowed cancel would leave a bare `while True`
+        # beat loop immortal (stop() then hangs on awaiting it).
+        self._stopping = False
+        self._peer_addrs: List[str] = []
+        # Outcomes the soak asserts on:
+        self.reg_latency_s: float = -1.0
+        self.heartbeats_sent = 0
+        self.heartbeats_rejected = 0
+        self.errors: List[str] = []
+        self.drain_requests = 0
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        self.server = rpc.RpcServer({
+            "ping": lambda conn, p: {"pong": True, "t1": clocks.wall(),
+                                     "t2": clocks.wall()},
+            "drain": self._h_drain,
+            "shutdown": lambda conn, p: True,
+        }, name=f"sim-agent-{self.index}")
+        self.address = await self.server.start_tcp("127.0.0.1", 0)
+        self.gcs = await rpc.connect(self.gcs_address,
+                                     name=f"sim{self.index}->gcs")
+        t0 = time.monotonic()
+        reply = await self.gcs.call("register_node", {
+            "node_id": self.node_id,
+            "address": list(self.address),
+            "resources": self.resources,
+            "labels": {"sim": "1"},
+            "store_path": "",
+            "session_dir": "",
+            "view": False,          # the slim O(1) registration reply
+        }, timeout=30)
+        self.reg_latency_s = time.monotonic() - t0
+        if not isinstance(reply, dict) or "num_nodes" not in reply:
+            self.errors.append(f"unexpected register reply: {reply!r}")
+
+    async def _h_drain(self, conn, p):
+        self.drain_requests += 1
+        return True
+
+    def set_peers(self, peer_addrs: List[str]) -> None:
+        """Addresses ("host:port") this node pretends to observe, so
+        heartbeats carry peer_stats and the GCS folds fleet-width
+        evidence every tick (the path the cached addr index serves)."""
+        self._peer_addrs = list(peer_addrs)
+
+    def start_beating(self) -> None:
+        self._beat_task = asyncio.ensure_future(self._beat_loop())
+
+    async def _beat_loop(self) -> None:
+        await asyncio.sleep(self.period_s * self._rng.random())
+        while not self._stopping:
+            t0 = time.monotonic()
+            try:
+                ok = await self.gcs.call("report_resources", {
+                    "node_id": self.node_id,
+                    "available": self.resources,
+                    "peer_stats": {
+                        a: {"rtt": 0.001 + self._rng.random() * 0.001,
+                            "rate": 5e8, "age_s": 0.0}
+                        for a in self._peer_addrs},
+                    "transfer": {"bytes_served": 0, "bytes_pulled": 0},
+                    "runtime": {"lease_queue_depth": 0.0},
+                }, timeout=30)
+                self.heartbeats_sent += 1
+                if ok is False:
+                    self.heartbeats_rejected += 1
+                self._flush_telemetry()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:   # noqa: BLE001 — recorded, asserted on
+                self.errors.append(f"heartbeat: {type(e).__name__}: {e}")
+            dt = time.monotonic() - t0
+            await asyncio.sleep(max(0.0, self.period_s - dt))
+
+    def _flush_telemetry(self) -> None:
+        """The PR-7 batching discipline an agent follows: recorder rows
+        and a metric snapshot ride the heartbeat tick as notifies."""
+        now = time.time()
+        rows = [{"task_id": os.urandom(8), "event": "soak", "ts": now,
+                 "node_id": self.node_id}
+                for _ in range(self.telemetry_rows)]
+        self.gcs.notify("task_events", {
+            "blob": rpc._pack(rows), "n": len(rows),
+            "src": self.node_id, "dropped": 0})
+        self.gcs.notify("report_metrics", {
+            "worker_id": self.node_id,
+            "metrics": [{"name": f"ray_tpu_soak_gauge_{i}",
+                         "labels": {"node_id": self.node_id.hex()},
+                         "type": "gauge", "value": float(i)}
+                        for i in range(self.metrics_rows)]})
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._beat_task is not None:
+            self._beat_task.cancel()
+            try:
+                # Bounded even if the cancel was swallowed (see
+                # _stopping): the flagged loop exits within one period
+                # + the in-flight call's own 30s timeout.
+                await asyncio.wait_for(self._beat_task,
+                                       35.0 + self.period_s)
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self.gcs is not None and not self.gcs.closed:
+            await self.gcs.close()
+        if self.server is not None:
+            await self.server.close()
+
+
+async def run_soak(gcs_address: tuple, n_nodes: int, duration_s: float,
+                   period_s: float = 0.25,
+                   register_concurrency: int = 32,
+                   log=None) -> Dict:
+    """Register `n_nodes` simulated nodes in one wave, heartbeat for
+    `duration_s`, and return the measured outcome dict (see keys below).
+    Assertion thresholds belong to the caller; `log` (e.g. print) gets
+    one line per stage."""
+    log = log or (lambda *_: None)
+    nodes = [SimulatedNode(gcs_address, i, period_s=period_s)
+             for i in range(n_nodes)]
+    t_wave = time.monotonic()
+    await rpc.gather_windowed(
+        lambda i: nodes[i].start(), range(n_nodes),
+        window=register_concurrency)
+    wave_s = time.monotonic() - t_wave
+    log(f"registered {n_nodes} nodes in {wave_s:.2f}s")
+    # Every node observes a couple of ring neighbours: fleet-width
+    # peer-stats folding on every heartbeat.
+    addrs = [f"{n.address[0]}:{n.address[1]}" for n in nodes]
+    for i, n in enumerate(nodes):
+        n.set_peers([addrs[(i + 1) % n_nodes], addrs[(i + 2) % n_nodes]])
+    for n in nodes:
+        n.start_beating()
+
+    # Control probe: a separate connection sampling GCS responsiveness
+    # (kv round trips) through the soak — an O(N) stall on the GCS main
+    # loop shows up here as a latency spike, which is the measurable
+    # form of "no O(N) per-tick work left on the main loop".
+    probe = await rpc.connect(tuple(gcs_address), name="soak-probe")
+    probe_lat: List[float] = []
+    # Flag alongside cancellation, same reason as SimulatedNode._stopping:
+    # a 3.10 wait_for can swallow the cancel, and a bare `while True`
+    # loop would then be immortal.
+    probe_stop = False
+
+    async def _probe_loop():
+        while not probe_stop:
+            t0 = time.monotonic()
+            try:
+                await probe.call("kv_get", {"ns": "soak", "key": "probe"},
+                                 timeout=30)
+                probe_lat.append(time.monotonic() - t0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:   # noqa: BLE001 — surfaced via sample count
+                pass
+            await asyncio.sleep(0.05)
+
+    probe_task = asyncio.ensure_future(_probe_loop())
+    await asyncio.sleep(duration_s)
+    log(f"soak phase done ({duration_s}s)")
+
+    # Steady-state delta poll: with nothing changing, a since-query must
+    # return (near-)zero views — the "broadcasts are deltas" assertion.
+    full = await probe.call("get_nodes", {"since": -1}, timeout=30)
+    epoch = full["epoch"]
+    await asyncio.sleep(max(2.0, 3 * period_s))
+    delta = await probe.call("get_nodes", {"since": epoch}, timeout=30)
+    metrics = await probe.call("get_metrics", {}, timeout=60)
+    log(f"queries done (delta={len(delta['changed'])})")
+
+    probe_stop = True
+    probe_task.cancel()
+    try:
+        await asyncio.wait_for(probe_task, 35.0)
+    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+        pass
+    await probe.close()
+    for batch_start in range(0, n_nodes, 64):
+        await asyncio.gather(
+            *[n.stop() for n in nodes[batch_start:batch_start + 64]])
+    log("stopped")
+
+    by_name = {}
+    for m in metrics:
+        by_name.setdefault(m["name"], []).append(m)
+    reg = [n.reg_latency_s for n in nodes]
+    alive = sum(1 for v in full["changed"] if v["alive"])
+    return {
+        "nodes": n_nodes,
+        "wave_s": wave_s,
+        "reg_p50_s": percentile(reg, 50),
+        "reg_p99_s": percentile(reg, 99),
+        "heartbeats_sent": sum(n.heartbeats_sent for n in nodes),
+        "heartbeats_rejected": sum(n.heartbeats_rejected for n in nodes),
+        "drain_requests": sum(n.drain_requests for n in nodes),
+        "errors": [e for n in nodes for e in n.errors],
+        "alive_at_end": alive,
+        "delta_changed": len(delta["changed"]),
+        "delta_total": delta["total"],
+        "probe_p50_s": percentile(probe_lat, 50),
+        "probe_p99_s": percentile(probe_lat, 99),
+        "probe_samples": len(probe_lat),
+        "gcs_dropped_rows": next(
+            (m[0]["value"] for k, m in by_name.items()
+             if k == "ray_tpu_gcs_task_events_dropped_total"), None),
+        "soak_metric_series": sum(
+            len(v) for k, v in by_name.items()
+            if k.startswith("ray_tpu_soak_gauge_")),
+        "gcs_loop_busy": {
+            tuple(sorted(m["labels"].items())): m["value"]
+            for m in by_name.get("ray_tpu_daemon_loop_busy_ratio", [])
+            if m["labels"].get("daemon") == "gcs"},
+    }

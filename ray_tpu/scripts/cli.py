@@ -258,7 +258,8 @@ def cmd_summary(args) -> int:
     print(f"\nnodes ({sum(1 for n in nodes if n['state'] == 'ALIVE')} "
           f"alive / {len(nodes)}):")
     hdr = (f"  {'node':12s} {'state':9s} {'served':>9s} {'pulled':>9s} "
-           f"{'skew_ms':>8s} {'±err':>6s} {'queue':>5s} {'arena':>12s}")
+           f"{'skew_ms':>8s} {'±err':>6s} {'queue':>5s} {'busy':>9s} "
+           f"{'arena':>12s}")
     print(hdr)
 
     def mib(b):
@@ -272,12 +273,20 @@ def cmd_summary(args) -> int:
         cap = rt.get("arena_capacity_bytes") or 0
         arena = (f"{mib(rt.get('arena_used_bytes'))}/{mib(cap)}"
                  if cap else "-")
+        # Agent loop saturation (main / max I/O shard, 0..1): ~1.00 on
+        # the left means the daemon's state loop is the bottleneck —
+        # the condition daemon_io_shards exists to relieve.
+        lb = rt.get("loop_busy")
+        busy = "-" if lb is None else (
+            f"{lb:.2f}/{rt.get('loop_busy_shard_max', 0.0):.2f}"
+            if rt.get("io_shards") else f"{lb:.2f}")
         print(f"  {n['node_id'][:12]:12s} {n['state']:9s} "
               f"{mib(tr.get('bytes_served')):>9s} "
               f"{mib(tr.get('bytes_pulled')):>9s} "
               f"{(f'{off * 1000:+.1f}' if off is not None else '-'):>8s} "
               f"{(f'{err * 1000:.1f}' if err is not None else '-'):>6s} "
               f"{int(rt.get('lease_queue_depth') or 0):>5d} "
+              f"{busy:>9s} "
               f"{arena:>12s}")
     return 0
 

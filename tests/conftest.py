@@ -66,6 +66,13 @@ def pytest_configure(config):
         "-m 'chaos and slow'")
     config.addinivalue_line(
         "markers",
+        "soak: many-node control-plane soak (simulated node fleets "
+        "registering/heartbeating/reporting against one GCS, no real "
+        "workers); the 100-node smoke runs in tier-1 (~30s), the "
+        "500-node version is additionally marked slow — run it with "
+        "-m 'soak and slow'")
+    config.addinivalue_line(
+        "markers",
         "serving: LLM serving subsystem (continuous batching, token "
         "streaming, prefix cache, queue-driven autoscaling); the "
         "tier-1 open-loop load test stays under ~60s on a tiny "
