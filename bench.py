@@ -152,8 +152,19 @@ def main():
     # The rows also stay in micro_value_vs_ref for the perf --check gate
     # (serving_ttft_p50_ms is lower-is-better; the gate inverts it).
     serving = {k: micro[k] for k in ("serving_ttft_p50_ms",
-                                     "serving_tokens_per_s_per_replica")
+                                     "serving_tokens_per_s_per_replica",
+                                     "serving_pd_ttft_p50_ms",
+                                     "serving_pd_tokens_per_s_per_replica")
                if isinstance(micro, dict) and k in micro}
+
+    # Compiled-DAG pipeline numbers: the compiled-vs-chained pair is the
+    # per-step-overhead A/B (same 3 actors, same chain), cross_node adds
+    # the agent-bridged variant; serving_pd_* above A/B against the
+    # colocated serving_* rows on the same open-loop harness.
+    dag = {k: micro[k] for k in ("compiled_dag_steps_per_s",
+                                 "chained_pipeline_steps_per_s",
+                                 "compiled_dag_cross_node_steps_per_s")
+           if isinstance(micro, dict) and k in micro}
 
     print(json.dumps({
         "metric": "train_mfu_pct",
@@ -162,6 +173,7 @@ def main():
             int(tok_s), cfg.param_count() // 1_000_000),
         "vs_baseline": round(mfu / 40.0, 3),
         "serving": serving,
+        "dag": dag,
         "micro_value_vs_ref": micro,
         "micro_host": host,
     }))

@@ -280,6 +280,11 @@ class NodeAgent:
         # mode); every state-touching branch FAST_FALLBACKs into the
         # batched main-loop hop.
         self._io_shards = rpc.make_io_shard_pool("agent")
+        # Compiled-DAG channel plane: rings created here on behalf of
+        # remote compilers + bridge threads pumping cross-node edges
+        # (see _private/dag_channels.py and docs/dag.md).
+        from .dag_channels import DagChannelManager
+        self._dag_chans = DagChannelManager(self.store)
         self._server = rpc.RpcServer(
             self._handlers(), name="agent",
             on_client_close=self._on_client_close,
@@ -368,6 +373,7 @@ class NodeAgent:
             "list_logs": self.h_list_logs,
             "read_log": self.h_read_log,
             "shutdown": self.h_shutdown,
+            **self._dag_chans.handlers(),
         }
 
     @staticmethod
@@ -747,6 +753,7 @@ class NodeAgent:
 
     async def close(self):
         self._shutdown = True
+        self._dag_chans.stop_all()
         for t in self._tasks:
             t.cancel()
         z = getattr(self, "_zygote", None)

@@ -1,0 +1,297 @@
+"""Compiled-DAG channel plane: agent-side ring management + cross-node
+bridges.
+
+Same-node compiled-graph edges are futex rings inside the shared arena
+(`shm_store.Channel` over store.cc `rts_chan_*`) — two futex wakes and a
+memcpy per hop, no RPC at all.  When an edge spans nodes, compilation
+splits it into a HOME ring on the producer's node and a MIRROR ring on
+each consumer node, stitched by an agent-resident bridge:
+
+    producer ──home ring──> [bridge thread @ source agent]
+        ──call_with_raw("dag_chan_write") over the native framer──>
+    [dest agent] ──mirror ring──> consumers
+
+The bridge reads the home ring as one of its registered readers (so ring
+backpressure includes the wire leg), ships the message body as a raw
+out-of-band frame (vectored writev on the native framer — no msgpack of
+the payload), and the destination agent's write blocks while the mirror
+ring is full, which stalls the bridge's call, which stalls the home
+ring, which blocks the producer: backpressure is end-to-end without any
+credit protocol.  Per steady-state step the only traffic is ONE
+agent→agent data frame per cross-node edge — no GCS, no owner
+bookkeeping, no leases (reference: compiled graphs registering channel
+readers/writers once at compile time, compiled_dag_node.py:805).
+
+EOF and failure propagate the same way values do: a closed home ring
+makes the bridge forward `dag_chan_close` to the mirror; an unreachable
+destination makes the bridge close the home ring, cascading EOF to every
+endpoint of the pipeline (the driver surfaces it as a typed
+DAGBrokenError).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from . import rpc
+from .shm_store import Channel, ChannelClosed
+from ..dag import _transport
+
+logger = logging.getLogger("ray_tpu.dag.channels")
+
+
+def _mint_spill_id() -> bytes:
+    # Spilled ring messages are raw arena objects addressed only through
+    # the ring that carries their id — never owned, never tracked by an
+    # owner — so a random id is sufficient (and collision-safe at 160
+    # bits).
+    return os.urandom(20)
+
+
+class DagChannelManager:
+    """Owns the compiled-graph rings created on THIS node by remote
+    compilers, and the bridge threads pumping cross-node edges out of
+    local home rings.  One instance per agent; all handlers are
+    registered into the agent's RPC handler table under dag_*."""
+
+    def __init__(self, store):
+        self.store = store
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # chan_id -> (Channel, nreaders, slot_bytes) for rings this agent
+        # created (it holds their creator pins until dag_chan_destroy).
+        self._created: Dict[bytes, Tuple[Channel, int, int]] = {}
+        # chan_id -> per-DAG spill-id prefix (mirror writes mint under
+        # it so the destroy-time orphan sweep can reclaim them).
+        self._spill_prefixes: Dict[bytes, bytes] = {}
+        # (chan_id, reader) -> _Bridge
+        self._bridges: Dict[Tuple[bytes, int], "_Bridge"] = {}
+        self._conns: Dict[tuple, rpc.Connection] = {}
+        # Dedicated pool for mirror-ring writes: a write blocks while the
+        # ring is full (that IS the backpressure), and parking those on
+        # the daemon's shared default executor could starve spill I/O.
+        self._write_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="dagchan")
+        self._shutdown = False
+
+    def handlers(self) -> dict:
+        return {
+            "dag_chan_create": self.h_chan_create,
+            "dag_chan_write": self.h_chan_write,
+            "dag_chan_close": self.h_chan_close,
+            "dag_chan_destroy": self.h_chan_destroy,
+            "dag_bridge_start": self.h_bridge_start,
+            "dag_bridge_stop": self.h_bridge_stop,
+            "dag_chan_stats": self.h_chan_stats,
+        }
+
+    # -------------------------------------------------------- compile-time --
+    async def h_chan_create(self, conn, p):
+        """Create a ring in this node's arena on behalf of a remote
+        compiler (the driver compiles once; this is compile-time traffic,
+        exempt from the zero-RPC steady-state budget)."""
+        chan = p["chan"]
+        if chan in self._created:       # idempotent retry
+            return True
+        ch = Channel.create(self.store, chan, nslots=int(p["nslots"]),
+                            slot_bytes=int(p["slot_bytes"]),
+                            nreaders=int(p["nreaders"]))
+        self._created[chan] = (ch, int(p["nreaders"]),
+                               int(p["slot_bytes"]))
+        if p.get("spill_prefix"):
+            self._spill_prefixes[chan] = bytes(p["spill_prefix"])
+        return True
+
+    async def h_bridge_start(self, conn, p):
+        key = (p["chan"], int(p["reader"]))
+        if key in self._bridges:
+            return True
+        self._loop = asyncio.get_running_loop()
+        br = _Bridge(self, chan=p["chan"], reader=int(p["reader"]),
+                     dest_addr=tuple(p["dest_addr"]),
+                     dest_chan=p["dest_chan"])
+        self._bridges[key] = br
+        br.start()
+        return True
+
+    # -------------------------------------------------------- steady state --
+    async def h_chan_write(self, conn, p):
+        """Bridge ingress: one message body into a local mirror ring.
+        The reply is deliberately withheld until the ring accepted the
+        message — a full mirror stalls the sender end-to-end."""
+        body = await conn.take_raw(p["raw_id"])
+        ent = self._created.get(p["chan"])
+        if ent is None:
+            return {"err": "unknown_channel"}
+        ch, nreaders, slot_bytes = ent
+        prefix = self._spill_prefixes.get(p["chan"])
+        mint = _transport.mint_for(prefix) if prefix else _mint_spill_id
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                self._write_pool,
+                lambda: _transport.send(self.store, ch, bytes(body),
+                                        nreaders, slot_bytes, mint,
+                                        timeout_ms=600_000))
+        except ChannelClosed:
+            return {"err": "closed"}
+        except Exception as e:  # noqa: BLE001 — typed to the bridge
+            return {"err": f"{type(e).__name__}: {e}"}
+        return True
+
+    # ------------------------------------------------------------ teardown --
+    async def h_chan_close(self, conn, p):
+        ent = self._created.get(p["chan"])
+        if ent is None:
+            return False
+        ent[0].close()
+        return True
+
+    async def h_chan_destroy(self, conn, p):
+        """Drain leftover spill pins, then destroy the ring (drops the
+        creator pin).  Quiescence is the caller's contract: the driver
+        destroys only after every serve loop and bridge has exited."""
+        ent = self._created.pop(p["chan"], None)
+        if ent is None:
+            return False
+        ch, _, _ = ent
+        prefix = self._spill_prefixes.pop(p["chan"], None)
+
+        def _destroy():
+            _transport.destroy_quiescent(self.store, ch)
+            if prefix:
+                # Quiescent by the caller's contract: any surviving
+                # object under this DAG's prefix (writer killed before
+                # its ring write landed) is garbage on this arena.
+                _transport.sweep_orphan_spills(self.store, prefix)
+
+        await asyncio.get_running_loop().run_in_executor(
+            self._write_pool, _destroy)
+        return True
+
+    async def h_bridge_stop(self, conn, p):
+        stopped = []
+        for chan in p.get("chans", []):
+            for key, br in list(self._bridges.items()):
+                if key[0] == chan:
+                    br.stop()
+                    self._bridges.pop(key, None)
+                    stopped.append(br)
+        if stopped:
+            # Join before acking: the caller destroys the home ring next,
+            # and a bridge still inside a futex peek on it would read
+            # recycled arena memory.  Bounded — the recv poll is 1s; a
+            # bridge stuck in a forward call is woken when the mirror
+            # ring's own destroy closes it.
+            def _join():
+                for br in stopped:
+                    br.join(timeout=5)
+
+            await asyncio.get_running_loop().run_in_executor(
+                self._write_pool, _join)
+        return True
+
+    async def h_chan_stats(self, conn, p):
+        ent = self._created.get(p["chan"])
+        if ent is None:
+            return None
+        return ent[0].stats()
+
+    def stop_all(self) -> None:
+        self._shutdown = True
+        for br in self._bridges.values():
+            br.stop()
+        self._bridges.clear()
+        self._write_pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------- helpers --
+    async def _dest_conn(self, addr: tuple) -> rpc.Connection:
+        conn = self._conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(addr, name="agent->dagbridge",
+                                     retries=2)
+            self._conns[addr] = conn
+        return conn
+
+
+class _Bridge(threading.Thread):
+    """Pumps one home-ring reader to one remote mirror ring.  A plain
+    daemon thread: channel reads are blocking futex waits, which must
+    never park the agent's event loop.  One message in flight (the
+    forward call's reply IS the flow-control window)."""
+
+    def __init__(self, mgr: DagChannelManager, *, chan: bytes, reader: int,
+                 dest_addr: tuple, dest_chan: bytes):
+        super().__init__(daemon=True,
+                         name=f"dagbridge-{chan[:4].hex()}r{reader}")
+        self._mgr = mgr
+        self._chan = chan
+        self._reader = reader
+        self._dest_addr = dest_addr
+        self._dest_chan = dest_chan
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def _forward(self, body: bytes):
+        conn = await self._mgr._dest_conn(self._dest_addr)
+        return await conn.call_with_raw(
+            "dag_chan_write", {"chan": self._dest_chan},
+            rpc.RawPayload([body]), timeout=620)
+
+    def _notify_dest_close(self) -> None:
+        async def _close():
+            try:
+                conn = await self._mgr._dest_conn(self._dest_addr)
+                conn.notify("dag_chan_close", {"chan": self._dest_chan})
+            except Exception:
+                pass        # destination already gone
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _close(), self._mgr._loop).result(timeout=10)
+        except Exception:
+            pass
+
+    def run(self) -> None:
+        store = self._mgr.store
+        try:
+            ch = Channel.attach(store, self._chan)
+        except Exception:
+            logger.exception("bridge: attach %s failed", self._chan.hex())
+            return
+        try:
+            while not self._stop.is_set():
+                try:
+                    body = _transport.recv(store, ch, self._reader,
+                                           timeout_ms=1000)
+                except TimeoutError:
+                    continue
+                except ChannelClosed:
+                    # Clean upstream EOF: cascade it across the wire.
+                    self._notify_dest_close()
+                    break
+                try:
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self._forward(body), self._mgr._loop)
+                    res = fut.result(timeout=640)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("bridge %s: forward failed: %s",
+                                   self._chan[:4].hex(), e)
+                    res = None
+                if res is not True:
+                    # Destination unreachable or mirror closed: break the
+                    # pipeline LOUDLY by closing the home ring — every
+                    # endpoint (and the driver's fetch) sees EOF instead
+                    # of hanging on a step that will never arrive.
+                    ch.close()
+                    self._notify_dest_close()
+                    break
+        except Exception:
+            logger.exception("bridge %s crashed", self._chan[:4].hex())
+        finally:
+            ch.close()      # idempotent; releases this thread's attach pin

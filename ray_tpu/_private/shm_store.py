@@ -75,6 +75,8 @@ def _load():
     lib.rts_list_evictable.restype = ctypes.c_int
     lib.rts_list_objects.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
     lib.rts_list_objects.restype = ctypes.c_int
+    lib.rts_list_unsealed.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.rts_list_unsealed.restype = ctypes.c_int
     lib.rts_put_iov.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                 ctypes.POINTER(ctypes.c_void_p),
                                 ctypes.POINTER(ctypes.c_uint64),
@@ -323,6 +325,18 @@ class ShmStore:
                         int.from_bytes(p[28:32], "little")))
         return out
 
+    def list_unsealed(self, max_ids: int = 4096) -> list[tuple]:
+        """(object_id, size) snapshot of allocated-but-unsealed slots —
+        orphan candidates when their writer died mid-copy (reclaim with
+        abort())."""
+        rec = 20 + 8
+        buf = ctypes.create_string_buffer(rec * max_ids)
+        n = self._lib.rts_list_unsealed(self._h, buf, max_ids)
+        raw = buf.raw
+        return [(raw[i * rec:i * rec + 20],
+                 int.from_bytes(raw[i * rec + 20:i * rec + 28], "little"))
+                for i in range(n)]
+
     def list_evictable(self, max_ids: int = 1024) -> list[bytes]:
         buf = ctypes.create_string_buffer(20 * max_ids)
         n = self._lib.rts_list_evictable(self._h, buf, max_ids)
@@ -402,6 +416,42 @@ class Channel:
         data = bytes(self._store._view[moff.value:moff.value + mlen.value])
         self._lib.rts_chan_advance(self._store._h, self._off, reader)
         return data
+
+    # C ChanHdr field offsets (store.cc): magic u32@0, nslots u32@4,
+    # slot_bytes u64@8, nreaders u32@16, closed u32@20, wfutex u32@24,
+    # rfutex u32@28, wseq u64@32, rseq u64[8]@40; ring data at
+    # align_up(sizeof(ChanHdr)=104, kAlign=64) = 128, slot stride
+    # align_up(8 + slot_bytes, 64).
+    _HDR_DATA_OFF = 128
+
+    def stats(self) -> dict:
+        """Unsynchronized header snapshot: write/read sequence numbers and
+        ring occupancy (wseq - slowest reader).  Races with concurrent
+        endpoints are benign (torn reads impossible: each field is one
+        aligned word) — occupancy gauges and teardown draining use this."""
+        import struct
+        v = self._store._view
+        nslots, = struct.unpack_from("<I", v, self._off + 4)
+        slot_bytes, = struct.unpack_from("<Q", v, self._off + 8)
+        nreaders, closed = struct.unpack_from("<II", v, self._off + 16)
+        wseq, = struct.unpack_from("<Q", v, self._off + 32)
+        rseq = list(struct.unpack_from("<8Q", v, self._off + 40))[:nreaders]
+        return {"nslots": nslots, "slot_bytes": slot_bytes,
+                "nreaders": nreaders, "closed": bool(closed), "wseq": wseq,
+                "rseq": rseq,
+                "occupancy": wseq - (min(rseq) if rseq else 0)}
+
+    def peek_at(self, seq: int) -> bytes:
+        """Copy out the message at absolute write-sequence `seq` WITHOUT
+        consuming it.  Only meaningful while `seq` is still resident
+        (within nslots of wseq) and the ring is quiescent — the teardown
+        spill-pin drain is the only caller."""
+        import struct
+        st = self.stats()
+        stride = (8 + st["slot_bytes"] + 63) & ~63
+        base = self._off + self._HDR_DATA_OFF + (seq % st["nslots"]) * stride
+        mlen, = struct.unpack_from("<Q", self._store._view, base)
+        return bytes(self._store._view[base + 8:base + 8 + mlen])
 
     def close(self) -> None:
         """Signal EOF to all endpoints (idempotent; does not free)."""

@@ -712,31 +712,64 @@ class Executor:
         """Resident compiled-graph stage loop: block on input channels,
         run the bound method, write the result downstream.  Errors are
         serialized and PROPAGATED as messages (the pipeline keeps
-        running); channel closure cascades a clean shutdown."""
+        running); channel closure cascades a clean shutdown.  Every step
+        stamps a `dag:step` span (execute→write, with the channel-wait
+        time as an arg) and updates the ring-occupancy gauge — both ride
+        the existing telemetry flush, nothing new per step on the wire."""
         import pickle
         from ..dag import _transport
+        from . import flight_recorder
         from .shm_store import Channel, ChannelClosed
+        from ..util.metrics import Gauge
         store = self.core.store
         ctx = get_context()
+        rec = flight_recorder.recorder()
         ins = [(Channel.attach(store, s["chan"]), s["reader"])
                for s in stage["in"]]
         if not ins:
             raise exc.RayError(
                 "compiled DAG stage has no channel inputs (every stage "
                 "must consume the InputNode or an upstream stage)")
-        out = Channel.attach(store, stage["out_chan"])
+        out = (Channel.attach(store, stage["out_chan"])
+               if stage.get("out_chan") else None)
         method = getattr(self.actor, stage["method"])
+        method_name = stage["method"]
         slot_bytes = stage["slot_bytes"]
-        nreaders = stage["out_readers"]
+        nreaders = stage.get("out_readers", 1)
+        # DAG-prefixed spill ids: lets teardown sweep orphans left by a
+        # writer killed between spill creation and the ring write.
+        prefix = stage.get("spill_prefix")
+        mint = (_transport.mint_for(prefix) if prefix
+                else self.core._next_put_id)
         coll = stage.get("collective")
+        # Channel-lowered collective: my contribution ring + one reader
+        # per peer's ring (all local to this node — cross-node rings are
+        # agent-bridged mirrors).  Pre-lowering specs carried a KV
+        # rendezvous group name instead; that form is gone.
+        coll_out = None
+        coll_ins: list = []
+        if coll:
+            coll_out = Channel.attach(store, coll["out_chan"])
+            coll_ins = [(Channel.attach(store, s["chan"]), s["reader"])
+                        for s in coll["in"]]
+        span_id = bytes(stage.get("out_chan") or stage["in"][0]["chan"])[:8]
+        occ_gauge = Gauge(
+            "ray_tpu_dag_ring_occupancy",
+            "Compiled-DAG output-ring occupancy (messages buffered; "
+            "nslots = the compile-time backpressure window)",
+            tag_keys=("chan", "method"))
+        occ_tags = {"chan": span_id.hex(), "method": method_name}
         consts = {}      # unpickled once
         try:
             while True:
+                t_wait = rec.begin()
                 try:
                     bodies = [_transport.recv(store, ch, r)
                               for ch, r in ins]
                 except ChannelClosed:
                     break
+                t0 = rec.begin()
+                wait_us = max(0, (t0 - t_wait) // 1000)
                 err_body = next(
                     (b for b in bodies if b[:1] == _transport.ERR), None)
                 result = None
@@ -767,42 +800,68 @@ class Executor:
                         err_body = self._dag_err_body(ctx, e)
                 if coll:
                     # Collective stages stay in LOCKSTEP even on error
-                    # steps: every rank allgathers its ok/err flag first,
-                    # and the value-allreduce runs only when all ranks
-                    # are ok — otherwise every rank emits an error for
-                    # this step.  Skipping the collective on one rank
-                    # would permanently desync the group's sequence
-                    # numbers and silently pair tensors from different
-                    # steps (reference: collective_node.py executes the
-                    # collective unconditionally per step).
+                    # steps: every rank writes exactly one contribution
+                    # per step (its value, or its error) and reads one
+                    # from every peer — the status byte IS the ok/err
+                    # flag, so flag-gather and value-exchange are ONE
+                    # channel round.  The reduce runs only when all
+                    # ranks are ok; otherwise every rank emits an error
+                    # for this step.  Skipping the exchange on one rank
+                    # would permanently desync the rings and silently
+                    # pair tensors from different steps (reference:
+                    # collective_node.py executes the collective
+                    # unconditionally per step).
                     import numpy as np
-                    from .. import collective as _c
                     try:
-                        flags = _c.allgather(
-                            np.asarray([0.0 if err_body is not None
-                                        else 1.0]),
-                            group_name=coll["group"])
-                        all_ok = bool(np.all(np.asarray(flags) > 0.5))
-                        if all_ok:
-                            result = _c.allreduce(
-                                np.asarray(result),
-                                group_name=coll["group"], op=coll["op"])
+                        mine = (err_body if err_body is not None
+                                else b"".join([_transport.OK,
+                                               *ctx.serialize(
+                                                   np.asarray(result))]))
+                        _transport.send(store, coll_out, mine,
+                                        coll["out_readers"], slot_bytes,
+                                        mint)
+                        peer_bodies = [_transport.recv(store, ch, r)
+                                       for ch, r in coll_ins]
+                        bad = next((b for b in [mine] + peer_bodies
+                                    if b[:1] == _transport.ERR), None)
+                        if bad is None:
+                            parts = [np.asarray(result)] + [
+                                ctx.deserialize(memoryview(b)[1:])
+                                for b in peer_bodies]
+                            from ..collective.collective import REDUCE_OPS
+                            op = coll["op"]
+                            if op not in REDUCE_OPS:
+                                raise ValueError(
+                                    f"unsupported collective op {op!r}")
+                            result = REDUCE_OPS[op](parts)
                         elif err_body is None:
                             err_body = self._dag_err_body(
                                 ctx, exc.RayError(
                                     "collective peer failed this step"))
+                    except ChannelClosed:
+                        # A peer's ring closed mid-step (teardown or peer
+                        # death): the pipeline is coming down.
+                        break
                     except BaseException as e:  # noqa: BLE001
                         if err_body is None:
                             err_body = self._dag_err_body(ctx, e)
-                if err_body is not None:
-                    body = err_body
-                else:
-                    body = b"".join([_transport.OK, *ctx.serialize(result)])
-                _transport.send(store, out, body, nreaders, slot_bytes,
-                                self.core._next_put_id)
+                if out is not None:
+                    if err_body is not None:
+                        body = err_body
+                    else:
+                        body = b"".join([_transport.OK,
+                                         *ctx.serialize(result)])
+                    _transport.send(store, out, body, nreaders, slot_bytes,
+                                    mint)
+                    occ_gauge.set(out.stats()["occupancy"], tags=occ_tags)
+                rec.end("dag", "dag:step", t0, id=span_id,
+                        method=method_name, wait_us=wait_us)
         finally:
-            out.close()   # cascade EOF downstream
-            for ch, _ in ins:
+            if out is not None:
+                out.close()   # cascade EOF downstream
+            if coll_out is not None:
+                coll_out.close()
+            for ch, _ in ins + coll_ins:
                 try:
                     if ch._attached:
                         store.release(ch.channel_id)

@@ -7,20 +7,40 @@ CompiledDAG (dag/compiled_dag_node.py:805) executing over channels
 src/ray/core_worker/experimental_mutable_object_manager.cc), collective
 nodes (dag/collective_node.py).
 
-TPU-native design: compilation wires the bound graph into MUTABLE SHM
-CHANNELS — fixed futex-synchronized rings inside the node's object-store
-arena (src/object_store/store.cc rts_chan_*). Each actor runs a resident
-serve loop (worker_main._dag_serve) that blocks on its input channels,
-invokes the bound method, and writes the result to its output channel: a
-step costs two futex wakes and a memcpy per hop — no sockets, RPC frames,
-or per-call task bookkeeping. execute() writes the input into the first
-ring and returns a CompiledDAGRef whose get() reads the output ring, so
-consecutive executions pipeline across stages naturally; the ring depth
-IS the reference's _max_inflight_executions backpressure.
+TPU-native design: compilation happens ONCE — the bound graph is
+topo-sorted, actor placement resolved, and every edge wired into MUTABLE
+SHM CHANNELS: fixed futex-synchronized rings inside each node's
+object-store arena (src/object_store/store.cc rts_chan_*), ring depth =
+`_max_inflight_executions` (the ring IS the backpressure window).  Each
+actor runs a resident serve loop (worker_main._dag_serve) that blocks on
+its input channels, invokes the bound method, and writes the result to
+its output channel: a same-node hop costs two futex wakes and a memcpy —
+no sockets, RPC frames, task specs, leases, or owner bookkeeping per
+step.
 
-When the graph spans nodes (actors not co-located with the driver's
-arena) compilation falls back to chained actor tasks through the object
-store — same semantics, RPC-path performance.
+Edges that SPAN nodes compile into pre-registered channel pairs bridged
+by the node agents (_private/dag_channels.py): the producer's HOME ring
+gains one bridge reader per consumer node, and a resident agent thread
+forwards each message as a raw out-of-band frame over the native framer
+into a MIRROR ring in the consumer node's arena.  Backpressure is
+end-to-end (a full mirror stalls the bridge call, the home ring, and
+finally the producer); steady state costs ONE agent→agent data frame per
+cross-node edge per step and still zero GCS/owner traffic.
+
+Collective edges (`allreduce_bind`) lower to compiled channels too: each
+rank's contribution gets its own ring read by every peer (bridged when
+ranks span nodes), so in-graph allreduce runs in lockstep with zero
+per-step rendezvous traffic — unlike the KV-rendezvous host collective,
+nothing touches the GCS after compile.
+
+Failure semantics: a dead stage actor (or lost bridge destination)
+breaks the pipeline LOUDLY — every ring closes, outstanding and future
+`CompiledDAGRef.get()`/`execute()` calls raise a typed
+:class:`~ray_tpu.exceptions.DAGBrokenError`, and `teardown()` reclaims
+every ring and in-flight spilled message (no leaked arena regions).
+
+See docs/dag.md for the authoring API and the full memory/ownership
+rules.
 """
 
 from __future__ import annotations
@@ -89,8 +109,9 @@ def allreduce_bind(nodes: List[ClassMethodNode], op: str = "sum"
     """Bind an in-graph allreduce across stages on distinct actors
     (reference: ray.experimental.collective.allreduce.bind →
     dag/collective_node.py). Each step, after the bound methods produce
-    their values, the participating actors allreduce them through the
-    collective library and every returned node yields the reduced value."""
+    their values, the participating stages exchange them over compiled
+    contribution channels (one ring per rank, bridged across nodes) and
+    every returned node yields the reduced value."""
     if not nodes:
         raise ValueError("allreduce_bind needs at least one node")
     group = {"op": op, "nodes": nodes}
@@ -124,6 +145,11 @@ class CompiledDAGRef:
 
     def __repr__(self):
         return f"CompiledDAGRef(exec={self._idx}, out={self._j})"
+
+
+# Hard limit from store.cc kMaxChanReaders: local consumers + one bridge
+# reader per remote consumer node must fit.
+_MAX_READERS = 8
 
 
 class CompiledDAG:
@@ -173,24 +199,30 @@ class CompiledDAG:
 
         self._channel_mode = False
         self._broken: Optional[BaseException] = None
+        # Every spilled message this DAG mints (driver input sends, stage
+        # outputs, collective contributions, agent mirror writes) carries
+        # this id prefix, so teardown can sweep orphans that died outside
+        # any ring (writer killed pre-write).
+        import os as _os
+        self._spill_prefix = b"\xdaG" + _os.urandom(6)
+        # Ring bookkeeping (filled by _compile_channels):
+        self._rings_local: Dict[bytes, Any] = {}     # driver-created
+        self._rings_attached: Dict[bytes, Any] = {}  # driver attach pins
+        self._rings_agent: List[Tuple[tuple, bytes]] = []  # (addr, chan)
+        self._bridge_stops: List[Tuple[tuple, List[bytes]]] = []
         try:
             self._compile_channels()
             self._channel_mode = True
         except Exception as e:  # noqa: BLE001 — any setup failure falls back
-            # Partially created channels hold creator pins (never evicted):
-            # reclaim them before falling back.
-            for ch in getattr(self, "_channels", {}).values():
-                try:
-                    ch.destroy()
-                except Exception:
-                    pass
-            self._channels = {}
+            # Partially created channels hold creator pins (never
+            # evicted): reclaim them before falling back.
+            self._cleanup_rings(destroy=True)
             if any(getattr(n, "collective", None) or
                    isinstance(n, CollectiveOutNode)
                    for n in self._plan + self._outputs):
                 raise RuntimeError(
-                    "DAG collective nodes require the shm-channel path "
-                    f"(all actors on the driver's node); setup failed: {e}"
+                    "DAG collective nodes require the shm-channel path; "
+                    f"setup failed: {e}"
                 ) from e
             logger.info("compiled DAG falling back to task chaining: %s", e)
 
@@ -199,8 +231,16 @@ class CompiledDAG:
     def _producer(node) -> Any:
         return node.upstream if isinstance(node, CollectiveOutNode) else node
 
+    def _agent_call(self, addr, method: str, payload: dict, timeout=60):
+        core = self._core
+
+        async def _c():
+            conn = await core._peer_owner(tuple(addr))
+            return await conn.call(method, payload, timeout=timeout)
+
+        return core._run(_c())
+
     def _compile_channels(self):
-        from .._private.serialization import get_context
         from .._private.shm_store import Channel
         from ..actor import ActorMethod
         from .._private.worker import global_runtime
@@ -209,132 +249,234 @@ class CompiledDAG:
         core = global_runtime().core
         self._core = core
         store = core.store
+        nslots = max(2, self._max_inflight)
 
-        # Locality: every actor must share the driver's arena.
-        actor_ids = []
+        # ---- placement: actor -> node, node -> agent address -------------
+        driver_node = core.node_id
+        actor_node: Dict[bytes, bytes] = {}
         for node in self._plan:
             aid = node.actor_method._handle._actor_id
-            if aid not in actor_ids:
-                actor_ids.append(aid)
-        for aid in actor_ids:
+            if aid in actor_node:
+                continue
             info = core.gcs_call("get_actor", {"actor_id": aid,
                                                "wait_alive": True})
-            if info is None or info.get("node_id") != core.node_id:
-                raise RuntimeError(
-                    "actor not co-located with the driver's object store")
+            if not info or not info.get("node_id"):
+                raise RuntimeError("actor placement unresolved (actor not "
+                                   "alive at compile time)")
+            actor_node[aid] = info["node_id"]
 
-        # Consumers per producer (plan nodes and InputNode instances);
-        # the driver consumes the output nodes.
-        consumers: Dict[int, list] = {}
-        producers: Dict[int, Any] = {}
+        agent_addr: Dict[bytes, tuple] = {
+            driver_node: tuple(core.agent_address)}
+        needed = set(actor_node.values()) | {driver_node}
+        if needed - set(agent_addr):
+            for v in core._run(core._cluster_nodes(force=True)):
+                if v.get("alive", True):
+                    agent_addr[v["node_id"]] = tuple(v["address"])
+        missing = needed - set(agent_addr)
+        if missing:
+            raise RuntimeError(
+                f"no live agent for node(s) {[m.hex()[:8] for m in missing]}")
+        self._node_agents = agent_addr
 
-        def _note(producer, consumer):
-            key = id(producer)
+        def node_of_stage(n: ClassMethodNode) -> bytes:
+            return actor_node[n.actor_method._handle._actor_id]
+
+        # ---- producer/consumer graph -------------------------------------
+        # Producers: InputNode instances (driver writes), plan stages
+        # (value outputs), and ("coll", stage) collective contributions.
+        # Consumers: id(stage), ("coll", id(stage)), or "driver".
+        producers: Dict[Any, Any] = {}
+        consumers: Dict[Any, list] = {}
+        prod_node: Dict[Any, bytes] = {}
+        cons_node: Dict[Any, bytes] = {"driver": driver_node}
+
+        def _note(key, producer, pnode, consumer, cnode):
             producers[key] = producer
+            prod_node[key] = pnode
+            cons_node[consumer] = cnode
             consumers.setdefault(key, [])
             if consumer not in consumers[key]:
                 consumers[key].append(consumer)
 
+        def _prod_key(a):
+            if isinstance(a, InputNode):
+                return id(a), a, driver_node
+            p = self._producer(a)
+            return id(p), p, node_of_stage(p)
+
         for node in self._plan:
+            my = node_of_stage(node)
             for a in list(node.args) + list(node.kwargs.values()):
-                if isinstance(a, InputNode) or isinstance(a, DAGNode):
-                    if isinstance(a, (InputNode, ClassMethodNode,
-                                      CollectiveOutNode)):
-                        _note(self._producer(a) if not isinstance(
-                            a, InputNode) else a, id(node))
-        for out in self._outputs:
-            _note(self._producer(out) if not isinstance(out, InputNode)
-                  else out, "driver")
-
-        # One channel per producer; ring depth = max_inflight so the ring
-        # is the backpressure window.
-        nslots = max(2, self._max_inflight)
-        self._channels: Dict[int, Channel] = {}
-        self._chan_ids: Dict[int, bytes] = {}
-        self._chan_readers: Dict[int, int] = {}       # nreaders
-        reader_of: Dict[Tuple[int, Any], int] = {}    # (producer, consumer)
-        for key, cons in consumers.items():
-            cid = core._next_put_id()
-            ch = Channel.create(store, cid, nslots=nslots,
-                                slot_bytes=self._slot_bytes,
-                                nreaders=len(cons))
-            self._channels[key] = ch
-            self._chan_ids[key] = cid
-            self._chan_readers[key] = len(cons)
-            for ridx, c in enumerate(cons):
-                reader_of[(key, c)] = ridx
-
-        # Input channels (written by the driver each execute()).
-        self._input_keys = [id(p) for p in producers.values()
-                            if isinstance(p, InputNode)]
-        # Driver-read output channels, in output order.
-        self._out_readers: List[Tuple[Channel, int, int]] = []
-        for out in self._outputs:
-            p = self._producer(out)
-            key = id(p)
-            self._out_readers.append(
-                (self._channels[key], reader_of[(key, "driver")],
-                 self._chan_readers[key]))
-
-        # Collective groups: one declared group per allreduce_bind call.
-        groups: Dict[int, str] = {}
-        for node in self._plan:
+                if isinstance(a, (InputNode, ClassMethodNode,
+                                  CollectiveOutNode)):
+                    key, p, pn = _prod_key(a)
+                    _note(key, p, pn, id(node), my)
             coll = node.collective
-            if not coll:
-                continue
-            gid = id(coll["_group"])
-            if gid not in groups:
-                from .. import collective as _c
-                name = f"dag_{core.worker_id.hex()[:8]}_{len(groups)}_{gid & 0xffff}"
-                actors = [n.actor_method._handle
-                          for n in coll["_group"]["nodes"]]
-                _c.create_collective_group(
-                    actors, world_size=len(actors), backend="host",
-                    group_name=name)
-                groups[gid] = name
+            if coll:
+                # Rank i's contribution ring, read by every peer rank.
+                for peer in coll["_group"]["nodes"]:
+                    if peer is node:
+                        continue
+                    _note(("coll", id(node)), node, my,
+                          ("coll", id(peer)), node_of_stage(peer))
+                cons_node[("coll", id(node))] = my
+        for out in self._outputs:
+            key, p, pn = _prod_key(out)
+            _note(key, p, pn, "driver", driver_node)
 
-        # Build stage specs + start the serve loops.
-        ctx = get_context()
+        # Plan stages nobody consumes (collective members whose value
+        # output is unused): no ring, serve loop skips the send.
+        # ---- ring layout per producer ------------------------------------
+        # chan_on[(key, node)] -> ring id readable on that node;
+        # reader_of[(key, consumer)] -> reader index on its node's ring.
+        chan_on: Dict[Tuple[Any, bytes], bytes] = {}
+        reader_of: Dict[Tuple[Any, Any], int] = {}
+        ring_readers: Dict[bytes, int] = {}     # chan id -> nreaders
+        bridges: List[tuple] = []   # (src_node, home_chan, idx, dst, mirror)
+
+        def _create(node_id: bytes, cid: bytes, nreaders: int,
+                    via_agent: bool):
+            ring_readers[cid] = nreaders
+            if node_id == driver_node and not via_agent:
+                self._rings_local[cid] = Channel.create(
+                    store, cid, nslots=nslots,
+                    slot_bytes=self._slot_bytes, nreaders=nreaders)
+            else:
+                # Agent-created (remote node, or a mirror a bridge will
+                # write into — the write handler needs it registered).
+                self._agent_call(agent_addr[node_id], "dag_chan_create",
+                                 {"chan": cid, "nslots": nslots,
+                                  "slot_bytes": self._slot_bytes,
+                                  "nreaders": nreaders,
+                                  "spill_prefix": self._spill_prefix})
+                self._rings_agent.append((agent_addr[node_id], cid))
+
+        for key, cons in consumers.items():
+            home = prod_node[key]
+            by_node: Dict[bytes, list] = {}
+            for c in cons:
+                by_node.setdefault(cons_node[c], []).append(c)
+            local = by_node.get(home, [])
+            remotes = [n for n in by_node if n != home]
+            n_home = len(local) + len(remotes)
+            if n_home > _MAX_READERS or any(
+                    len(by_node[r]) > _MAX_READERS for r in remotes):
+                raise RuntimeError(
+                    f"channel fan-out exceeds the {_MAX_READERS}-reader "
+                    "ring limit")
+            home_cid = core._next_put_id()
+            chan_on[(key, home)] = home_cid
+            _create(home, home_cid, n_home, via_agent=(home != driver_node))
+            for i, c in enumerate(local):
+                reader_of[(key, c)] = i
+            for bi, rn in enumerate(remotes):
+                mirror_cid = core._next_put_id()
+                chan_on[(key, rn)] = mirror_cid
+                _create(rn, mirror_cid, len(by_node[rn]), via_agent=True)
+                for j, c in enumerate(by_node[rn]):
+                    reader_of[(key, c)] = j
+                bridges.append((home, home_cid, len(local) + bi,
+                                rn, mirror_cid))
+
+        # ---- bridges (started only after every ring exists) --------------
+        stops: Dict[tuple, List[bytes]] = {}
+        for src, home_cid, idx, dst, mirror_cid in bridges:
+            # Record the stop BEFORE starting: a compile failure later in
+            # this method must be able to stop bridges already running
+            # (stopping a never-started bridge is a no-op).
+            stops.setdefault(agent_addr[src], []).append(home_cid)
+            self._bridge_stops = list(stops.items())
+            self._agent_call(agent_addr[src], "dag_bridge_start", {
+                "chan": home_cid, "reader": idx,
+                "dest_addr": list(agent_addr[dst]),
+                "dest_chan": mirror_cid})
+
+        # ---- driver endpoints --------------------------------------------
+        def _driver_ring(cid: bytes):
+            ch = self._rings_local.get(cid)
+            if ch is None:
+                ch = self._rings_attached.get(cid)
+            if ch is None:
+                ch = Channel.attach(store, cid)
+                self._rings_attached[cid] = ch
+            return ch
+
+        self._input_entries: List[Tuple[Any, int, bytes]] = []
+        for key, p in producers.items():
+            if isinstance(p, InputNode):
+                cid = chan_on[(key, driver_node)]
+                self._input_entries.append(
+                    (_driver_ring(cid), ring_readers[cid], cid))
+        self._out_readers: List[Tuple[Any, int]] = []
+        for out in self._outputs:
+            key = _prod_key(out)[0]
+            cid = chan_on[(key, driver_node)]
+            self._out_readers.append(
+                (_driver_ring(cid), reader_of[(key, "driver")]))
+
+        # ---- stage specs + serve loops -----------------------------------
         self._serve_refs = []
         for node in self._plan:
+            my = node_of_stage(node)
             in_specs: List[dict] = []
-            chan_index: Dict[int, int] = {}
+            chan_index: Dict[Any, int] = {}
 
-            def _chan_slot(producer) -> int:
-                key = id(producer)
+            def _chan_slot(key) -> int:
                 if key not in chan_index:
                     chan_index[key] = len(in_specs)
                     in_specs.append({
-                        "chan": self._chan_ids[key],
+                        "chan": chan_on[(key, my)],
                         "reader": reader_of[(key, id(node))],
                     })
                 return chan_index[key]
 
             def _plan_arg(a):
-                if isinstance(a, InputNode):
-                    return ("ch", _chan_slot(a))
-                if isinstance(a, (ClassMethodNode, CollectiveOutNode)):
-                    return ("ch", _chan_slot(self._producer(a)))
+                if isinstance(a, (InputNode, ClassMethodNode,
+                                  CollectiveOutNode)):
+                    return ("ch", _chan_slot(_prod_key(a)[0]))
                 return ("const", pickle.dumps(a))
 
             argplan = [_plan_arg(a) for a in node.args]
             kwargplan = {k: _plan_arg(v) for k, v in node.kwargs.items()}
+            out_key = id(node)
+            has_out = out_key in consumers
+            coll_spec = None
+            if node.collective:
+                coll = node.collective
+                ckey = ("coll", id(node))
+                coll_spec = {
+                    "op": coll["op"], "rank": coll["rank"],
+                    "world": coll["world"],
+                    "out_chan": chan_on[(ckey, my)],
+                    "out_readers": ring_readers[chan_on[(ckey, my)]],
+                    "in": [{"chan": chan_on[(("coll", id(peer)), my)],
+                            "reader": reader_of[(("coll", id(peer)),
+                                                 ("coll", id(node)))]}
+                           for peer in coll["_group"]["nodes"]
+                           if peer is not node],
+                }
             stage = {
                 "method": node.actor_method._method_name,
                 "in": in_specs,
                 "argplan": argplan,
                 "kwargplan": kwargplan,
-                "out_chan": self._chan_ids[id(node)],
-                "out_readers": self._chan_readers[id(node)],
+                "out_chan": chan_on[(out_key, my)] if has_out else None,
+                "out_readers": (ring_readers[chan_on[(out_key, my)]]
+                                if has_out else 0),
                 "slot_bytes": self._slot_bytes,
-                "collective": (
-                    {"group": groups[id(node.collective["_group"])],
-                     "op": node.collective["op"]}
-                    if node.collective else None),
+                "spill_prefix": self._spill_prefix,
+                "collective": coll_spec,
             }
             serve = ActorMethod(node.actor_method._handle,
                                 "__ray_dag_serve__")
             self._serve_refs.append(serve.remote(stage))
+
+        # Break-detection: a serve loop that exits ABNORMALLY (actor
+        # death, stage crash outside the per-step error path) breaks the
+        # whole pipeline — close every ring so blocked producers/readers
+        # wake typed instead of hanging.
+        for ref in self._serve_refs:
+            ref.future().add_done_callback(self._on_serve_done)
 
         # Producer and consumer sides use separate locks so a blocked
         # input-ring write (backpressure) never prevents the consumer
@@ -350,6 +492,48 @@ class CompiledDAG:
         # it stopped, not re-read advanced channels.
         self._partial: List[Any] = []
 
+    # ------------------------------------------------------- failure path ---
+    def _on_serve_done(self, fut) -> None:
+        if self._torn_down or self._broken is not None:
+            return
+        try:
+            exc = fut.exception()
+        except BaseException:  # noqa: BLE001 — cancelled future
+            return
+        if exc is None:
+            return      # clean EOF exit (teardown cascade)
+        self._broken = exc
+        threading.Thread(target=self._emergency_close, daemon=True,
+                         name="dag-break").start()
+
+    def _emergency_close(self) -> None:
+        """A stage died: close every ring everywhere so all endpoints —
+        including a driver blocked in get()/execute() — wake with
+        ChannelClosed and surface the typed DAGBrokenError."""
+        for ch in list(self._rings_local.values()) + \
+                list(self._rings_attached.values()):
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for addr, cid in self._rings_agent:
+            try:
+                self._agent_call(addr, "dag_chan_close", {"chan": cid},
+                                 timeout=10)
+            except Exception:
+                pass
+
+    def _raise_broken(self):
+        from .. import exceptions as exc
+        if self._torn_down:
+            raise RuntimeError("this compiled DAG was torn down")
+        cause = self._broken
+        raise exc.DAGBrokenError(
+            "compiled DAG pipeline broke"
+            + (f": {cause}" if cause is not None
+               else " (a channel closed unexpectedly — stage actor died?)")
+        ) from cause
+
     # ---------------------------------------------------------- execution ---
     def execute(self, *input_args):
         """Run one item through the pipeline. Channel mode returns
@@ -358,13 +542,12 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("this compiled DAG was torn down")
         if self._broken is not None:
-            raise RuntimeError(
-                "this compiled DAG is broken (a multi-input send partially "
-                f"failed, desyncing the pipeline): {self._broken}")
+            self._raise_broken()
         inp = input_args[0] if len(input_args) == 1 else input_args
         if not self._channel_mode:
             return self._execute_fallback(inp)
         from . import _transport
+        from .._private.shm_store import ChannelClosed
         from .._private.serialization import get_context
         ctx = get_context()
         body = b"".join([_transport.OK, *ctx.serialize(inp)])
@@ -372,16 +555,24 @@ class CompiledDAG:
             idx = self._exec_idx
             sent = 0
             try:
-                for key in self._input_keys:
+                for ch, nreaders, _cid in self._input_entries:
                     _transport.send(
-                        self._core.store, self._channels[key], body,
-                        self._chan_readers[key], self._slot_bytes,
-                        self._core._next_put_id, timeout_ms=600_000)
+                        self._core.store, ch, body, nreaders,
+                        self._slot_bytes,
+                        _transport.mint_for(self._spill_prefix),
+                        timeout_ms=600_000)
                     sent += 1
+            except ChannelClosed as e:
+                if sent and self._broken is None:
+                    # Some stages saw this step's input and some didn't:
+                    # everything downstream would pair mismatched steps —
+                    # the typed raise alone must not leave the DAG
+                    # looking healthy to the next execute().
+                    self._broken = e
+                self._raise_broken()
             except BaseException as e:
                 if sent:
-                    # Some stages saw this step's input and some didn't:
-                    # everything downstream would pair mismatched steps.
+                    # Same partial-delivery poisoning, untyped path.
                     self._broken = e
                 raise
             # Only a fully delivered step consumes an index — a failed
@@ -395,6 +586,7 @@ class CompiledDAG:
 
     def _fetch(self, idx: int, j: int, timeout: Optional[float]):
         from . import _transport
+        from .._private.shm_store import ChannelClosed
         from .._private.serialization import get_context
         from .. import exceptions as exc
         import time as _time
@@ -408,18 +600,23 @@ class CompiledDAG:
             while idx not in self._results:
                 if self._torn_down:
                     raise RuntimeError("this compiled DAG was torn down")
+                if self._broken is not None:
+                    self._raise_broken()
                 # Resume the in-progress step: channels already read for
                 # this step sit in _partial (recv advances the ring, so
                 # re-reading would misalign steps after a timeout).
                 while len(self._partial) < len(self._out_readers):
-                    ch, ridx, _nr = self._out_readers[len(self._partial)]
+                    ch, ridx = self._out_readers[len(self._partial)]
                     if deadline is None:
                         tmo = -1   # block indefinitely, like get()
                     else:
                         tmo = max(0, int((deadline - _time.monotonic())
                                          * 1000))
-                    body = _transport.recv(self._core.store, ch, ridx,
-                                           timeout_ms=tmo)
+                    try:
+                        body = _transport.recv(self._core.store, ch, ridx,
+                                               timeout_ms=tmo)
+                    except ChannelClosed:
+                        self._raise_broken()
                     status, payload = body[:1], body[1:]
                     v = ctx.deserialize(memoryview(payload))
                     self._partial.append(
@@ -474,6 +671,63 @@ class CompiledDAG:
         return refs[0]
 
     # ------------------------------------------------------------ teardown --
+    def _cleanup_rings(self, destroy: bool) -> None:
+        """Close (and optionally destroy) every ring this DAG allocated,
+        local and remote, reclaiming in-flight spilled messages."""
+        from . import _transport
+        # Bridges first: destroying a home ring under a live bridge
+        # thread would let it read recycled arena memory.  teardown()
+        # already stopped them on its path; this covers the
+        # compile-failure fallback (bridge_stop joins before acking, and
+        # re-stopping is a no-op).
+        for addr, chans in self._bridge_stops:
+            try:
+                self._agent_call(addr, "dag_bridge_stop",
+                                 {"chans": chans}, timeout=10)
+            except Exception:
+                pass
+        self._bridge_stops = []
+        # Driver attach pins first: destroying an object we still pin
+        # would leak the pin.
+        for ch in self._rings_attached.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        self._rings_attached.clear()
+        for cid, ch in list(self._rings_local.items()):
+            try:
+                if destroy:
+                    _transport.destroy_quiescent(self._core.store, ch)
+                else:
+                    ch.close()
+            except Exception:
+                pass
+        if destroy:
+            self._rings_local.clear()
+        for addr, cid in list(self._rings_agent):
+            try:
+                self._agent_call(
+                    addr, "dag_chan_destroy" if destroy else
+                    "dag_chan_close", {"chan": cid}, timeout=30)
+            except Exception:
+                pass
+        if destroy:
+            self._rings_agent.clear()
+            # Orphan sweep: a stage SIGKILLed between creating its spill
+            # object and landing the id in a ring leaves bytes no ring
+            # scan can reach; every id this DAG minted carries
+            # _spill_prefix, and at destroy time all endpoints are
+            # quiescent, so survivors are garbage.  (Agents sweep their
+            # own arenas in dag_chan_destroy.)
+            core = getattr(self, "_core", None)
+            if core is not None:
+                n = _transport.sweep_orphan_spills(
+                    core.store, self._spill_prefix)
+                if n:
+                    logger.info("DAG teardown: swept %d orphaned "
+                                "spill(s)", n)
+
     def teardown(self):
         if self._torn_down:
             return
@@ -482,13 +736,12 @@ class CompiledDAG:
             return
         import ray_tpu
         # Closing the input rings cascades: each serve loop drains, closes
-        # its own output, and returns.
-        for key in self._input_keys:
+        # its own output, bridges forward the EOF, and every loop returns.
+        for ch, _nr, _cid in self._input_entries:
             try:
-                self._channels[key].close()
+                ch.close()
             except Exception:
                 pass
-        done = []
         try:
             done, pending = ray_tpu.wait(
                 self._serve_refs, num_returns=len(self._serve_refs),
@@ -503,14 +756,6 @@ class CompiledDAG:
             logger.warning(
                 "DAG teardown: %d serve loop(s) still running; leaving "
                 "channel buffers allocated", len(pending))
-            for ch in self._channels.values():
-                try:
-                    ch.close()
-                except Exception:
-                    pass
+            self._cleanup_rings(destroy=False)
             return
-        for ch in self._channels.values():
-            try:
-                ch.destroy()
-            except Exception:
-                pass
+        self._cleanup_rings(destroy=True)

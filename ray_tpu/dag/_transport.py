@@ -16,6 +16,8 @@ atomically (release_n_and_delete_if), so no extra coordination round.
 
 from __future__ import annotations
 
+import os
+
 from .._private.shm_store import Channel, ShmStore
 
 _INLINE = b"\x00"
@@ -38,7 +40,93 @@ def send(store: ShmStore, chan: Channel, body: bytes, nreaders: int,
     store.seal(oid)
     for _ in range(nreaders - 1):               # one pin per reader total
         store.get(oid)
-    chan.write(_SPILL + oid, timeout_ms=timeout_ms)
+    try:
+        chan.write(_SPILL + oid, timeout_ms=timeout_ms)
+    except BaseException:
+        # The id never reached the ring, so no reader — and no teardown
+        # scan — can ever find it: drop every writer-granted pin and
+        # delete, or the bytes leak for the session (hit when teardown
+        # closes a ring while a stage is mid-send of a spilled result).
+        store.release_n_and_delete_if(oid, nreaders)
+        raise
+
+
+def mint_for(prefix: bytes):
+    """Mint spill ids under a per-DAG prefix so teardown can sweep
+    orphans the ring scan cannot see: a writer SIGKILLed between
+    creating/pinning the spill object and landing its id in the ring
+    leaves an object referenced by NOTHING — only its id prefix ties it
+    back to the DAG that must reclaim it."""
+    pad = 20 - len(prefix)
+
+    def _mint() -> bytes:
+        return prefix + os.urandom(pad)
+
+    return _mint
+
+
+def sweep_orphan_spills(store: ShmStore, prefix: bytes) -> int:
+    """Teardown-time sweep: force-delete every arena object minted under
+    this DAG's spill prefix.  Caller contract is quiescence (every serve
+    loop, bridge, and driver endpoint has exited), so any survivor is
+    garbage by definition — in-ring spills already freed by the ring
+    scan are ENOENT no-ops."""
+    n = 0
+    try:
+        for oid, _size, _rc in store.list_objects():
+            if oid.startswith(prefix):
+                _force_delete(store, oid)
+                n += 1
+        # A writer SIGKILLed mid-copy (between create_buffer and seal)
+        # leaves an ALLOCATED slot no sealed listing sees: abort those.
+        for oid, _size in store.list_unsealed():
+            if oid.startswith(prefix):
+                store.abort(oid)
+                n += 1
+    except Exception:
+        pass
+    return n
+
+
+def _force_delete(store: ShmStore, oid: bytes) -> None:
+    # Atomic "release up to 64 pins and free": at quiescent-destroy time
+    # any surviving pin belongs to a DEAD endpoint (a SIGKILLed stage's
+    # attach or mid-recv pin lives on in shared memory forever — no
+    # process will ever release it), so waiting for it would leak the
+    # bytes for the session.  Bounded loop: each -EBUSY drops one pin.
+    for _ in range(3):
+        try:
+            if store.release_n_and_delete_if(oid, 64):
+                return
+        except Exception:
+            return      # already gone
+
+
+def destroy_quiescent(store: ShmStore, chan: Channel) -> None:
+    """Teardown-time ring destruction with full reclamation: frees the
+    ring buffer AND every spilled message still referenced by it, even
+    when some endpoints died holding pins (actor SIGKILL mid-pipeline).
+    The caller's contract is quiescence — every live serve loop and
+    bridge has exited — so residual pins are dead processes' by
+    definition."""
+    seen = set()
+    try:
+        st = chan.stats()
+        # Scan the WHOLE resident window, not just [rseq, wseq): a reader
+        # killed between advancing the ring and releasing its spill pins
+        # leaves a message that no rseq references but whose object still
+        # holds pins.  Already-freed oids are ENOENT no-ops (ids are
+        # minted fresh, never recycled), so over-scanning is safe.
+        for seq in range(max(0, st["wseq"] - st["nslots"]), st["wseq"]):
+            msg = chan.peek_at(seq)
+            if msg[:1] == _SPILL:
+                seen.add(bytes(msg[1:21]))
+    except Exception:
+        pass
+    chan.close()        # wake + EOF any straggler; drops an attach pin
+    for oid in seen:
+        _force_delete(store, oid)
+    _force_delete(store, chan.channel_id)
 
 
 def recv(store: ShmStore, chan: Channel, reader: int,

@@ -134,6 +134,22 @@ class StreamBrokenError(RayError):
         self.tokens_emitted = int(tokens_emitted)
 
 
+class DAGBrokenError(RayError):
+    """A compiled DAG's pipeline broke and cannot deliver further steps.
+
+    Raised by ``CompiledDAGRef.get()`` and ``CompiledDAG.execute()`` after
+    a stage actor died mid-pipeline (SIGKILL, OOM, node loss), a
+    cross-node bridge lost its destination, or a multi-input send
+    partially failed (stages would pair mismatched steps).  The original
+    failure rides ``__cause__``.  The DAG stays broken — outstanding and
+    future ``get()`` calls all fail typed instead of hanging on a ring
+    that will never be written — and ``teardown()`` reclaims every
+    channel ring (reference: compiled graphs tearing down on
+    RayChannelError, compiled_dag_node.py)."""
+
+    pass
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

@@ -96,14 +96,29 @@ def build_dp_deployment(preset: str = "tiny", *, num_replicas: int = 1,
 
 class _PDIngress:
     """Front door chaining prefill → decode handles (reference:
-    pd_server.py PDProxyServer).  The KV blob travels prefill-replica →
-    object plane → decode-replica; the decode half enters the remote
-    admission queue (deadline-aware) and the real prompt tokens ride
-    along so the decode replica's prefix cache learns the prompt."""
+    pd_server.py PDProxyServer).
 
-    def __init__(self, prefill_name: str, decode_name: str):
+    ``direct=True`` (default): the prefill replica returns a HANDOFF —
+    the KV blob stays pinned in the prefill replica's arena and only its
+    20-byte ref transits this proxy; the decode replica resolves the ref
+    itself, pulling the pages arena-to-arena via the owner's replica
+    directory (PR-5 location hints).  One transfer, zero blob bytes
+    through the proxy process.
+
+    ``direct=False`` (legacy A/B reference): the blob travels BY VALUE —
+    prefill → proxy → decode, two object-plane transfers with the proxy
+    materializing every byte.  Kept so the TTFT win is measurable
+    (tests/test_pd_compiled.py A/Bs both modes).
+
+    Either way the decode half enters the remote admission queue
+    (deadline-aware, shed-bounded) and the real prompt tokens ride along
+    so the decode replica's prefix cache learns the prompt."""
+
+    def __init__(self, prefill_name: str, decode_name: str,
+                 direct: bool = True):
         self.prefill = serve.get_deployment_handle(prefill_name)
         self.decode = serve.get_deployment_handle(decode_name)
+        self.direct = direct
 
     async def __call__(self, prompt_tokens: Sequence[int],
                        max_tokens: int = 16, temperature: float = 0.0,
@@ -111,30 +126,157 @@ class _PDIngress:
         opts = {"max_tokens": max_tokens, "temperature": temperature,
                 "eos_id": eos_id}
         prompt = list(prompt_tokens)
-        blob, first = await self.prefill.prefill.remote(prompt, opts)
-        res = await self.decode.decode.remote(blob, first, opts, prompt)
+        if self.direct:
+            handoff = await self.prefill.prefill_handoff.remote(
+                {"prompt": prompt, "opts": opts})
+            res = await self.decode.decode_handoff.remote(handoff)
+        else:
+            blob, first = await self.prefill.prefill.remote(prompt, opts)
+            res = await self.decode.decode.remote(blob, first, opts,
+                                                  prompt)
         return res["tokens"]
 
 
 def run_pd_app(preset: str = "tiny", *, prefill_replicas: int = 1,
                decode_replicas: int = 1, max_batch: int = 4,
                max_len: int = 128, seed: int = 0,
-               prefix_cache: bool = True):
+               prefix_cache: bool = True, direct: bool = True,
+               name: Optional[str] = None):
     """Deploy the three-deployment P/D app; returns the ingress handle.
     Prefill and decode scale independently — the point of the pattern."""
+    tag = name or preset
     serve.run(serve.deployment(
-        EngineReplica, name=f"pd-prefill-{preset}",
+        EngineReplica, name=f"pd-prefill-{tag}",
         num_replicas=prefill_replicas).bind(
             preset, max_batch=1, max_len=max_len, seed=seed,
             prefix_cache=prefix_cache),
-        name=f"pd-prefill-{preset}")
+        name=f"pd-prefill-{tag}")
     serve.run(serve.deployment(
-        EngineReplica, name=f"pd-decode-{preset}",
+        EngineReplica, name=f"pd-decode-{tag}",
         num_replicas=decode_replicas).bind(
             preset, max_batch=max_batch, max_len=max_len, seed=seed,
             prefix_cache=prefix_cache),
-        name=f"pd-decode-{preset}")
+        name=f"pd-decode-{tag}")
     return serve.run(serve.deployment(
-        _PDIngress, name=f"pd-ingress-{preset}").bind(
-            f"pd-prefill-{preset}", f"pd-decode-{preset}"),
-        name=f"pd-ingress-{preset}")
+        _PDIngress, name=f"pd-ingress-{tag}").bind(
+            f"pd-prefill-{tag}", f"pd-decode-{tag}", direct),
+        name=f"pd-ingress-{tag}")
+
+
+class CompiledPDApp:
+    """P/D disaggregation over a COMPILED actor pipeline — the flagship
+    aDAG workload (reference: Ray LLM pd_server.py + Compiled Graphs).
+
+    N prefill + M decode ``EngineReplica`` actors; each prefill is
+    bound to a decode in a compiled two-stage DAG::
+
+        (prompt, opts) ─ring→ prefill_handoff ─ring→ admit_external → rid
+
+    Steady-state request dispatch therefore does NO per-request GCS or
+    owner RPCs: the request rides the input ring and the KV pages ride
+    the compiled channel itself — written once into the prefill node's
+    arena by the ring's spill path, shipped arena-to-arena by the agent
+    bridge when the pair spans nodes, reclaimed by last-reader delete
+    (no ownership bookkeeping at all).  Admission is the DAG step — decode runs
+    in the replica's continuous batch, so consecutive requests pipeline
+    through prefill while earlier ones decode — and tokens stream back
+    over the existing worker→owner stream frames (zero GCS work per
+    token; pinned by test).
+
+    Static by design: compiled graphs pre-resolve placement, so replica
+    counts are fixed at build time.  For queue-driven autoscaling use
+    ``build_llm_app`` / ``run_pd_app`` — this class is the peak-
+    throughput, lowest-TTFT deployment for a known fleet size."""
+
+    def __init__(self, preset: str = "tiny", *, prefill_replicas: int = 1,
+                 decode_replicas: int = 1, max_batch: int = 4,
+                 max_len: int = 128, page_size: int = 16, seed: int = 0,
+                 prefix_cache: bool = True, max_queue: int = 64,
+                 max_inflight: int = 8,
+                 prefill_options: Optional[dict] = None,
+                 decode_options: Optional[dict] = None):
+        import threading
+
+        import ray_tpu
+        from ..dag import InputNode
+
+        Rep = ray_tpu.remote(EngineReplica)
+        self.prefills = [
+            Rep.options(**(prefill_options or {})).remote(
+                preset, max_batch=1, max_len=max_len,
+                page_size=page_size, seed=seed,
+                prefix_cache=prefix_cache, max_queue=max_queue)
+            for _ in range(prefill_replicas)]
+        self.decodes = [
+            Rep.options(**(decode_options or {})).remote(
+                preset, max_batch=max_batch, max_len=max_len,
+                page_size=page_size, seed=seed,
+                prefix_cache=prefix_cache, max_queue=max_queue)
+            for _ in range(decode_replicas)]
+        # One compiled pair-DAG per (prefill, decode) lane; requests
+        # round-robin across lanes.  More decode than prefill replicas
+        # (or vice versa) is the point of disaggregation — the lanes
+        # cover every replica of the larger side.
+        lanes = max(prefill_replicas, decode_replicas)
+        self._lanes = []
+        for i in range(lanes):
+            p = self.prefills[i % prefill_replicas]
+            d = self.decodes[i % decode_replicas]
+            with InputNode() as inp:
+                dag = d.admit_external.bind(
+                    p.prefill_handoff_channel.bind(inp))
+            self._lanes.append(
+                (dag.experimental_compile(
+                    _max_inflight_executions=max_inflight), d))
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self.num_replicas = decode_replicas
+
+    def _next_lane(self):
+        with self._rr_lock:
+            lane = self._lanes[self._rr % len(self._lanes)]
+            self._rr += 1
+        return lane
+
+    def generate(self, prompt_tokens: Sequence[int],
+                 opts: Optional[dict] = None,
+                 timeout: float = 120.0) -> dict:
+        """Blocking completion: {"tokens": [...], "finish_reason": ...}."""
+        import ray_tpu
+        compiled, decode = self._next_lane()
+        rid = compiled.execute(
+            {"prompt": list(prompt_tokens), "opts": opts or {}}
+        ).get(timeout=timeout)
+        return ray_tpu.get(decode.collect.remote(rid), timeout=timeout)
+
+    def stream(self, prompt_tokens: Sequence[int],
+               opts: Optional[dict] = None, timeout: float = 120.0):
+        """Generator of int tokens then one terminal dict — the
+        run_open_loop submit contract."""
+        import ray_tpu
+        compiled, decode = self._next_lane()
+        rid = compiled.execute(
+            {"prompt": list(prompt_tokens), "opts": opts or {}}
+        ).get(timeout=timeout)
+        gen = decode.collect_stream.options(
+            num_returns="streaming").remote(rid)
+        for item_ref in gen:
+            yield ray_tpu.get(item_ref, timeout=timeout)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for compiled, _ in self._lanes:
+            try:
+                compiled.teardown()
+            except Exception:
+                pass
+        for h in self.prefills + self.decodes:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+
+
+def run_pd_compiled(preset: str = "tiny", **kwargs) -> CompiledPDApp:
+    """Build the compiled P/D deployment (see :class:`CompiledPDApp`)."""
+    return CompiledPDApp(preset, **kwargs)

@@ -358,7 +358,10 @@ class EngineReplica:
     async def prefill(self, prompt_tokens: Sequence[int],
                       opts: Optional[dict] = None):
         """Prefill half: (kv_blob, first_token) for a decode replica.
-        Prefix-cache hits skip the shared span's compute."""
+        Prefix-cache hits skip the shared span's compute.  LEGACY
+        transport: the blob travels BY VALUE (prefill → caller → decode
+        = two object-plane transfers, one through the caller's process).
+        Production paths use :meth:`prefill_handoff`."""
         params = self._params(opts)
         if deadlines.expired():
             raise DeadlineExceededError(
@@ -368,6 +371,133 @@ class EngineReplica:
             return await loop.run_in_executor(
                 None, lambda: self.engine.prefill_only(
                     list(prompt_tokens), params))
+
+    async def prefill_handoff(self, req: dict) -> dict:
+        """Prefill half returning a HANDOFF instead of the blob: the KV
+        pages are put into THIS replica's arena (this worker is the
+        owner; the node's agent pins the primary) and only the 20-byte
+        ref travels onward.  The decode side resolves the ref itself, so
+        the pages move prefill-arena → decode-arena directly via the
+        owner's replica directory (PR-5 location hints stamp the pull's
+        from_addrs) — the proxy/ingress process never touches the bytes.
+
+        ``req = {"prompt": [...], "opts": {...}}`` (single argument so
+        the method binds into a compiled DAG); returns
+        ``{"ref", "first", "opts", "prompt"}``."""
+        import ray_tpu
+        prompt = list(req["prompt"])
+        opts = req.get("opts") or {}
+        params = self._params(opts)
+        if deadlines.expired():
+            raise DeadlineExceededError(
+                "deadline exceeded before prefill started")
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            blob, first = await loop.run_in_executor(
+                None, lambda: self.engine.prefill_only(prompt, params))
+        return {"ref": ray_tpu.put(blob), "first": first, "opts": opts,
+                "prompt": prompt}
+
+    async def prefill_handoff_channel(self, req: dict) -> dict:
+        """Prefill half for COMPILED pipelines: the KV blob rides the
+        compiled channel itself — written once into this node's arena by
+        the ring's spill path, shipped arena-to-arena by the agent
+        bridge when the decode replica lives on another node, reclaimed
+        by last-reader delete.  No ownership bookkeeping at all (an
+        owned ObjectRef pickled through a raw channel would escape-pin
+        the blob forever — by-value transport is the leak-free form
+        here; the serve path uses :meth:`prefill_handoff`'s ref +
+        replica-directory pull instead, where task-spec capture pins it
+        transiently)."""
+        import ray_tpu  # noqa: F401 — parity of env with prefill_handoff
+        prompt = list(req["prompt"])
+        opts = req.get("opts") or {}
+        params = self._params(opts)
+        if deadlines.expired():
+            raise DeadlineExceededError(
+                "deadline exceeded before prefill started")
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            blob, first = await loop.run_in_executor(
+                None, lambda: self.engine.prefill_only(prompt, params))
+        return {"blob": blob, "first": first, "opts": opts,
+                "prompt": prompt}
+
+    async def _resolve_handoff(self, handoff: dict):
+        ref = handoff.get("ref")
+        if ref is not None:
+            # Arena-to-arena pull: the get resolves against the OWNER
+            # (the prefill replica worker), whose directory stamps every
+            # holder into from_addrs — no proxy hop, no GCS lookup.
+            return await ref
+        return handoff["blob"]
+
+    async def admit_external(self, handoff: dict) -> int:
+        """Compiled-DAG decode stage: resolve the KV handoff and admit it
+        into the continuous batch, returning the request id WITHOUT
+        waiting for completion — the DAG step stays cheap (admission
+        only) so consecutive requests pipeline through the prefill stage
+        while this replica decodes.  Tokens are collected with
+        :meth:`collect` / :meth:`collect_stream`."""
+        blob = await self._resolve_handoff(handoff)
+        params = self._params(handoff.get("opts"))
+        deadline = deadlines.get()
+        rec = flight_recorder.recorder()
+        async with self._lock:
+            self._maybe_shed(deadline)
+            rid = self.engine.add_external_request(
+                blob, handoff["first"], params,
+                prompt_tokens=handoff.get("prompt"))
+            q: asyncio.Queue = asyncio.Queue()
+            self._waiters[rid] = q
+            self._meta[rid] = {"deadline": deadline, "t0": rec.begin(),
+                               "t_mono": time.monotonic(),
+                               "admitted": False, "finished": False}
+        self._ensure_loop()
+        self._wake.set()
+        return rid
+
+    async def collect(self, rid: int) -> Dict[str, Any]:
+        """Drain an admitted request's stream to completion:
+        ``{"tokens": [...], "finish_reason": ...}``."""
+        out: List[int] = []
+        reason = ""
+        async for item in self.collect_stream(rid):
+            if isinstance(item, dict):
+                reason = item["finish_reason"]
+            else:
+                out.append(item)
+        return {"tokens": out, "finish_reason": reason}
+
+    async def collect_stream(self, rid: int):
+        """Async generator over an admitted request: int tokens, then one
+        terminal ``{"finish_reason", "n_tokens"}`` dict.  Dispatch with
+        ``num_returns="streaming"`` for live token streaming — the
+        steady-state per-token path is engine tick → waiter queue →
+        worker→owner stream frames: no GCS work per token."""
+        q = self._waiters.get(rid)
+        if q is None:
+            from ..exceptions import RayError
+            raise RayError(f"unknown or already-collected request {rid}")
+        try:
+            while True:
+                item = await q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                if isinstance(item, _StreamEnd):
+                    yield {"finish_reason": item.finish_reason,
+                           "n_tokens": item.n_tokens}
+                    return
+                yield item
+        finally:
+            await self._release(rid)
+
+    async def decode_handoff(self, handoff: dict) -> Dict[str, Any]:
+        """Decode half over a handoff (direct arena pull): admit through
+        the SAME deadline-aware queue as local requests, decode to
+        completion."""
+        rid = await self.admit_external(handoff)
+        return await self.collect(rid)
 
     async def decode(self, kv_blob: dict, first_token: int,
                      opts: Optional[dict] = None,

@@ -586,6 +586,192 @@ def bench_serving_tokens_per_s(min_time_s: float) -> float:
         "serving_tokens_per_s_per_replica"]
 
 
+# ---------------------------------------------------------------------------
+# Compiled-DAG pipeline benches: per-step cost of a 3-stage actor
+# pipeline as a COMPILED graph (futex rings, zero per-step RPC) vs the
+# same chain as eager actor calls (the A/B that justifies compilation),
+# plus the cross-node variant where the middle stage lives on a spawned
+# second agent and the edge rides the agent bridge over the native
+# framer.  One run feeds the gated metric and its A/B reference.
+_dag_report_cache: Dict[str, float] = {}
+
+
+@ray_tpu.remote
+class _PipeStage:  # noqa: D401 — bench fixture actor
+    def fwd(self, x):
+        return x + 1
+
+
+def _dag_report(min_time_s: float) -> Dict[str, float]:
+    if _dag_report_cache:
+        return _dag_report_cache
+    try:
+        from ray_tpu.dag import InputNode
+        stages = [_PipeStage.remote() for _ in range(3)]
+        ray_tpu.get([s.fwd.remote(0) for s in stages], timeout=60)
+        with InputNode() as inp:
+            node = inp
+            for s in stages:
+                node = s.fwd.bind(node)
+        compiled = node.experimental_compile()
+        try:
+            assert compiled._channel_mode, "compile fell back"
+            compiled.execute(0).get(timeout=60)
+
+            def run():
+                n = 100
+                for i in range(n):
+                    compiled.execute(i).get(timeout=60)
+                return n
+
+            _dag_report_cache["compiled_dag_steps_per_s"] = _timeit(
+                run, min_time_s, windows=2)
+        finally:
+            compiled.teardown()
+
+        def run_chain():
+            n = 10
+            for i in range(n):
+                v = i
+                for s in stages:
+                    v = ray_tpu.get(s.fwd.remote(v), timeout=60)
+            return n
+
+        _dag_report_cache["chained_pipeline_steps_per_s"] = _timeit(
+            run_chain, min_time_s, windows=2)
+        for s in stages:
+            ray_tpu.kill(s)
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning("dag bench failed: %s", e)
+        _dag_report_cache.setdefault("compiled_dag_steps_per_s", 0.0)
+        _dag_report_cache.setdefault("chained_pipeline_steps_per_s", 0.0)
+    return _dag_report_cache
+
+
+def bench_compiled_dag_steps(min_time_s: float) -> float:
+    return _dag_report(min_time_s)["compiled_dag_steps_per_s"]
+
+
+def bench_chained_pipeline_steps(min_time_s: float) -> float:
+    return _dag_report(min_time_s)["chained_pipeline_steps_per_s"]
+
+
+def bench_compiled_dag_cross_node_steps(min_time_s: float) -> float:
+    """Steps/s of a 3-stage compiled pipeline whose MIDDLE stage lives on
+    a second node agent: two edges ride agent bridges (one raw data
+    frame each per step, no GCS/owner traffic)."""
+    from ray_tpu._private import node as node_mod
+
+    core = ray_tpu._core()
+    proc = None
+    compiled = None
+    actors = []
+    try:
+        proc, addr, _sp, _nid = node_mod.start_agent(
+            core.session_dir, core.gcs_address,
+            {"CPU": 2.0, "dagbench": 2.0}, labels={"bench": "dag_sink"},
+            store_capacity=256 << 20)
+        from ray_tpu.dag import InputNode
+        a = _PipeStage.remote()
+        b = _PipeStage.options(resources={"dagbench": 0.1}).remote()
+        c = _PipeStage.remote()
+        actors = [a, b, c]
+        ray_tpu.get([s.fwd.remote(0) for s in actors], timeout=120)
+        with InputNode() as inp:
+            dag = c.fwd.bind(b.fwd.bind(a.fwd.bind(inp)))
+        compiled = dag.experimental_compile()
+        assert compiled._channel_mode, "cross-node compile fell back"
+        compiled.execute(0).get(timeout=120)
+
+        def run():
+            n = 50
+            for i in range(n):
+                compiled.execute(i).get(timeout=120)
+            return n
+
+        return _timeit(run, min_time_s, windows=2)
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning(
+            "cross-node dag bench failed: %s", e)
+        return 0.0
+    finally:
+        if compiled is not None:
+            try:
+                compiled.teardown()
+            except Exception:
+                pass
+        for h in actors:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except Exception:
+                pass
+
+
+# Compiled P/D serving bench: the open-loop harness against the
+# CompiledPDApp (prefill→decode over a compiled pipeline, KV riding the
+# channel) — recorded in the bench tail NEXT TO the PR-8 colocated
+# engine's serving_* rows, which IS the required A/B.
+_pd_report_cache: Dict[str, float] = {}
+
+
+def _pd_serving_report(min_time_s: float) -> Dict[str, float]:
+    if _pd_report_cache:
+        return _pd_report_cache
+    app = None
+    try:
+        from ray_tpu.llm.serve_patterns import CompiledPDApp
+        from ray_tpu.llm.serving import run_open_loop
+        app = CompiledPDApp("tiny", prefill_replicas=1,
+                            decode_replicas=1, max_len=64, page_size=8)
+        opts = {"max_tokens": 16}
+
+        def submit(p):
+            return app.stream(p, opts)
+
+        for _ in submit([1, 2, 3]):     # warmup: compile + admit
+            pass
+        rep = run_open_loop(
+            submit, rate_hz=4.0, duration_s=max(4.0, min_time_s),
+            prompt_fn=lambda i: [(i % 37) + 1, (i % 11) + 2, 7],
+            num_replicas=1)
+        _pd_report_cache.update({
+            "serving_pd_ttft_p50_ms": rep["ttft_p50_ms"],
+            "serving_pd_tokens_per_s_per_replica":
+                rep["tokens_per_s_per_replica"],
+        })
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning("pd serving bench failed: %s",
+                                            e)
+        _pd_report_cache.update({
+            "serving_pd_ttft_p50_ms": 0.0,
+            "serving_pd_tokens_per_s_per_replica": 0.0})
+    finally:
+        if app is not None:
+            try:
+                app.shutdown()
+            except Exception:
+                pass
+    return _pd_report_cache
+
+
+def bench_pd_serving_ttft(min_time_s: float) -> float:
+    return _pd_serving_report(min_time_s)["serving_pd_ttft_p50_ms"]
+
+
+def bench_pd_serving_tokens_per_s(min_time_s: float) -> float:
+    return _pd_serving_report(min_time_s)[
+        "serving_pd_tokens_per_s_per_replica"]
+
+
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     from ray_tpu.util import placement_group, remove_placement_group
 
@@ -629,8 +815,18 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     # doesn't overlap the per-call measurements.
     "serving_ttft_p50_ms": bench_serving_ttft,
     "serving_tokens_per_s_per_replica": bench_serving_tokens_per_s,
+    # Compiled-DAG pipeline vs chained eager calls (same 3 actors, one
+    # run feeds both rows — the A/B that justifies compilation), and the
+    # compiled P/D serving numbers A/B'd against the colocated serving_*
+    # rows above.
+    "compiled_dag_steps_per_s": bench_compiled_dag_steps,
+    "chained_pipeline_steps_per_s": bench_chained_pipeline_steps,
+    "serving_pd_ttft_p50_ms": bench_pd_serving_ttft,
+    "serving_pd_tokens_per_s_per_replica": bench_pd_serving_tokens_per_s,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
+    "compiled_dag_cross_node_steps_per_s":
+        bench_compiled_dag_cross_node_steps,
     "internode_pull_gigabytes": bench_internode_pull_gigabytes,
     "weight_broadcast_gigabytes": bench_weight_broadcast_gigabytes,
 }
@@ -672,11 +868,28 @@ BASELINE = {
     # LOWER_IS_BETTER; the gate inverts its ratio).
     "serving_ttft_p50_ms": 8.5,
     "serving_tokens_per_s_per_replica": 67.0,
+    # Compiled-DAG anchors: no published reference — committed host-class
+    # numbers (3-stage pipeline, per-step execute+get); vs_ref reads as
+    # "vs the last recorded run".  The chained row is the A/B reference
+    # the compiled row must beat >=5x (asserted in tests, reported here).
+    "compiled_dag_steps_per_s": 1800.0,
+    "chained_pipeline_steps_per_s": 230.0,
+    "compiled_dag_cross_node_steps_per_s": 370.0,
+    "serving_pd_ttft_p50_ms": 10.5,
+    "serving_pd_tokens_per_s_per_replica": 67.0,
 }
 
 UNITS = {
     "serving_ttft_p50_ms": "ms p50 TTFT (open-loop, lower is better)",
     "serving_tokens_per_s_per_replica": "tok/s/replica (open-loop)",
+    "compiled_dag_steps_per_s": "steps/s (3-stage compiled pipeline)",
+    "chained_pipeline_steps_per_s": "steps/s (same chain, eager calls)",
+    "compiled_dag_cross_node_steps_per_s":
+        "steps/s (middle stage on a 2nd node, agent-bridged)",
+    "serving_pd_ttft_p50_ms":
+        "ms p50 TTFT (compiled P/D, lower is better)",
+    "serving_pd_tokens_per_s_per_replica":
+        "tok/s/replica (compiled P/D open-loop)",
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
@@ -738,11 +951,23 @@ DATA_PLANE_METRICS = (
 SERVING_METRICS = (
     "serving_ttft_p50_ms",
     "serving_tokens_per_s_per_replica",
+    "serving_pd_ttft_p50_ms",
+    "serving_pd_tokens_per_s_per_replica",
+)
+
+# Compiled-DAG pipeline metrics, gated with the DATA_PLANE downgrade
+# rules (0.0 / fingerprint-mismatch report-but-never-gate).  The
+# chained_pipeline row is deliberately NOT gated: it is the A/B
+# reference the compiled rows are read against, not a path we defend.
+DAG_METRICS = (
+    "compiled_dag_steps_per_s",
+    "compiled_dag_cross_node_steps_per_s",
 )
 
 # Metrics where SMALLER readings are better (latencies): the gate
 # inverts their ratio so "regression" always means "got worse".
-LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms"})
+LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms",
+                             "serving_pd_ttft_p50_ms"})
 
 
 def _latest_committed_bench(repo_root: str = "."):
@@ -851,7 +1076,7 @@ def check_against_committed(min_time_s: float = 2.0,
     host_mismatch = base_host is not None and \
         not _host_matches(base_host, this_host)
     gated = (CONTROL_PLANE_METRICS + AGGREGATE_METRICS
-             + DATA_PLANE_METRICS + SERVING_METRICS)
+             + DATA_PLANE_METRICS + SERVING_METRICS + DAG_METRICS)
     results = run_microbenchmarks(min_time_s=min_time_s,
                                   only=set(gated))
     failures = []
@@ -860,7 +1085,7 @@ def check_against_committed(min_time_s: float = 2.0,
             continue
         now, ref = results[name]["value"], committed[name]
         if name in DATA_PLANE_METRICS + SERVING_METRICS \
-                + AGGREGATE_METRICS and (not now or not ref):
+                + AGGREGATE_METRICS + DAG_METRICS and (not now or not ref):
             # 0.0 = the bench couldn't spawn its extra agents here (or
             # the baseline predates the metric): report, never gate.
             print(json.dumps({"metric": name, "now": now,

@@ -679,6 +679,28 @@ int rts_list_objects(int hidx, uint8_t* out, int max) {
   return n;
 }
 
+// Allocated-but-unsealed slots: [20-byte id][8-byte size] records. A
+// writer that dies between rts_create_object and rts_seal leaves a slot
+// no sealed-object listing can see; teardown sweeps these by id prefix
+// and rts_abort-s the orphans.
+int rts_list_unsealed(int hidx, uint8_t* out, int max) {
+  Handle& h = g_handles[hidx];
+  Guard g(h.hdr);
+  int n = 0;
+  const int rec = kIdSize + 8;
+  for (uint64_t i = 0; i < h.hdr->table_slots && n < max; i++) {
+    Slot* s = &h.table[i];
+    if (s->state == kAllocated) {
+      uint8_t* p = out + n * rec;
+      memcpy(p, s->key, kIdSize);
+      uint64_t sz = s->size;
+      memcpy(p + kIdSize, &sz, 8);
+      n++;
+    }
+  }
+  return n;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -699,6 +721,10 @@ namespace {
 constexpr uint32_t kChanMagic = 0x43484e4cu;  // "CHNL"
 constexpr int kMaxChanReaders = 8;
 
+// LAYOUT CONTRACT: shm_store.py Channel.stats()/peek_at() read these
+// fields by raw offset from Python (see the offset table there).  Any
+// field/alignment change here must update that mirror, or teardown's
+// spill-reclamation scan silently reads garbage.
 struct ChanHdr {
   uint32_t magic;
   uint32_t nslots;

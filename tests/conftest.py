@@ -77,6 +77,11 @@ def pytest_configure(config):
         "streaming, prefix cache, queue-driven autoscaling); the "
         "tier-1 open-loop load test stays under ~60s on a tiny "
         "TransformerConfig, CPU devices")
+    config.addinivalue_line(
+        "markers",
+        "dag: compiled actor pipelines (aDAG) over mutable shm "
+        "channels — same-node futex rings, agent-bridged cross-node "
+        "edges, channel-lowered collectives, typed failure semantics")
     # Build the native RPC framer ONCE at session start so worker/agent
     # processes spawned by cluster fixtures just dlopen the committed or
     # freshly-built .so instead of racing g++ builds.  Failure is fine:

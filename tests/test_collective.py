@@ -174,6 +174,9 @@ def test_xla_group_single_process(ray_start_regular):
     col.destroy_collective_group("xla1")
 
 
+# Needs a multi-process XLA world (CPU backend fails by
+# construction); ~11s.  Run with -m slow on TPU hosts.
+@pytest.mark.slow
 def test_xla_group_in_two_process_world(ray_start_regular):
     """XlaCollectiveGroup over a real 2-process jax.distributed world via
     JaxTrainer (the ICI-tier path; SURVEY.md §2.4)."""

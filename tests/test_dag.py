@@ -15,6 +15,8 @@ import pytest
 import ray_tpu
 from ray_tpu.dag import InputNode, MultiOutputNode
 
+pytestmark = pytest.mark.dag
+
 
 @ray_tpu.remote
 class Stage:
@@ -309,3 +311,179 @@ def test_dag_allreduce_error_keeps_lockstep(ray_start_regular):
         compiled.teardown()
         ray_tpu.kill(s1)
         ray_tpu.kill(s2)
+
+
+# ---------------------------------------------------------------------------
+# Zero-RPC steady state, backpressure, failure semantics, observability
+# ---------------------------------------------------------------------------
+
+def test_dag_zero_rpc_steady_state(ray_start_regular):
+    """Acceptance: steady-state compiled execution does ZERO per-step
+    GCS/owner RPCs — pinned by the driver's aggregate connection
+    counters.  300 steps add at most background-telemetry noise to
+    tx_frames (a per-step control path would add >=600)."""
+    from ray_tpu._private import rpc
+
+    a, b = Stage.remote(1), Stage.remote(1)
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.fwd.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        for i in range(10):                       # warm
+            assert compiled.execute(i).get(timeout=60) == i + 2
+        base = rpc.io_stats_snapshot()["tx_frames"]
+        n = 300
+        for i in range(n):
+            assert compiled.execute(i).get(timeout=60) == i + 2
+        delta = rpc.io_stats_snapshot()["tx_frames"] - base
+        assert delta < 30, (
+            f"steady-state execution sent {delta} RPC frames over {n} "
+            f"steps — the compiled path must not touch the control plane")
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_dag_ring_full_backpressure_blocks_execute(ray_start_regular, tmp_path):
+    """A full input ring BLOCKS execute() (the ring depth is the
+    _max_inflight_executions window) instead of dropping or erroring;
+    draining the pipeline unblocks it."""
+    import threading
+
+    gate = tmp_path / "gate"
+
+    @ray_tpu.remote
+    class Gated:
+        def fwd(self, x):
+            import os
+            import time as _t
+            while not os.path.exists(str(gate)):
+                _t.sleep(0.02)
+            return x
+
+    g = Gated.remote()
+    with InputNode() as inp:
+        dag = g.fwd.bind(inp)
+    compiled = dag.experimental_compile(_max_inflight_executions=2)
+    try:
+        assert compiled._channel_mode
+        refs = [compiled.execute(i) for i in range(3)]  # ring(2) + 1 in method
+        unblocked = threading.Event()
+        extra = []
+
+        def _push():
+            extra.append(compiled.execute(99))
+            unblocked.set()
+
+        th = threading.Thread(target=_push, daemon=True)
+        th.start()
+        assert not unblocked.wait(1.0), (
+            "execute() should block while the input ring is full")
+        gate.write_text("go")                     # release the stage
+        assert unblocked.wait(30), "execute() never unblocked after drain"
+        vals = [r.get(timeout=60) for r in refs] + \
+            [extra[0].get(timeout=60)]
+        assert vals == [0, 1, 2, 99]
+    finally:
+        gate.write_text("go")
+        compiled.teardown()
+        ray_tpu.kill(g)
+
+
+def test_dag_actor_sigkill_typed_error_and_ring_reclaim(ray_start_regular):
+    """Acceptance: SIGKILL of a stage actor mid-pipeline surfaces a typed
+    DAGBrokenError on outstanding get()s AND teardown reclaims every
+    ring + in-flight spilled message — arena usage returns to the
+    pre-compile baseline (pinned by store stats)."""
+    import os
+    import signal
+
+    import numpy as np
+
+    @ray_tpu.remote
+    class Spiller:
+        def fwd(self, x):
+            return x
+
+        def pid(self):
+            return os.getpid()
+
+    a, b = Spiller.remote(), Spiller.remote()
+    pid_a = ray_tpu.get(a.pid.remote(), timeout=30)
+    store = ray_tpu._core().store
+    base = store.stats()["bytes_in_use"]
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.fwd.bind(inp))
+    # Tiny slots force every payload through the spill path: the leak
+    # check covers in-flight spilled messages, not just ring buffers.
+    compiled = dag.experimental_compile(_channel_slot_bytes=8 * 1024)
+    try:
+        assert compiled._channel_mode
+        x = np.arange(1 << 17, dtype=np.float32)     # 512 KiB >> slot
+        assert compiled.execute(x).get(timeout=60).shape == x.shape
+        # Leave steps in flight, then kill stage A's worker.
+        pending = [compiled.execute(x) for i in range(4)]
+        os.kill(pid_a, signal.SIGKILL)
+        with pytest.raises(ray_tpu.exceptions.DAGBrokenError):
+            for r in pending:
+                r.get(timeout=60)
+        # Broken is sticky: new submissions fail typed too, never hang.
+        with pytest.raises(ray_tpu.exceptions.DAGBrokenError):
+            compiled.execute(x)
+        compiled.teardown()
+        # Every ring and every spilled in-flight message is reclaimed.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if store.stats()["bytes_in_use"] <= base:
+                break
+            time.sleep(0.2)
+        assert store.stats()["bytes_in_use"] <= base, (
+            f"leaked arena bytes: {store.stats()['bytes_in_use']} > "
+            f"baseline {base}")
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(b)
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+
+
+def test_dag_step_spans_and_ring_gauge_exported(ray_start_regular):
+    """Observability: dag:step spans (with channel-wait time) ride the
+    existing telemetry flush to the GCS sink, and the ring-occupancy
+    gauge lands in the unified metrics export."""
+    from ray_tpu.util import metrics as umetrics
+
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        dag = a.fwd.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(20):
+            assert compiled.execute(i).get(timeout=60) == i + 1
+        core = ray_tpu._core()
+        deadline = time.monotonic() + 30
+        spans, gauge = [], None
+        while time.monotonic() < deadline:
+            spans = [e for e in core.gcs_call("get_task_events",
+                                              {"limit": 100_000})
+                     if e.get("event") == "SPAN" and e.get("cat") == "dag"
+                     and e.get("name") == "dag:step"]
+            gauge = next((m for m in umetrics.get_metrics()
+                          if m["name"] == "ray_tpu_dag_ring_occupancy"),
+                         None)
+            if spans and gauge is not None:
+                break
+            time.sleep(0.5)
+        assert spans, "no dag:step spans reached the GCS sink"
+        args = (spans[0].get("args") or {})
+        assert args.get("method") == "fwd"
+        assert "wait_us" in args, "span must carry channel-wait time"
+        assert gauge is not None, \
+            "ring occupancy gauge missing from the unified export"
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)

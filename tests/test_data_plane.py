@@ -374,6 +374,9 @@ def test_pull_gone_everywhere_vs_transient_are_distinct():
     asyncio.run(main())
 
 
+# ~60s chaos soak (per-chunk drop/retry convergence); the quick drop
+# tests above keep the path covered in tier-1.
+@pytest.mark.slow
 def test_chaos_chunk_drops_recover(chunked_cluster):
     """End-to-end: rpc chaos drops fetch_chunk responses mid-broadcast;
     the pull retries within its budget and the object arrives intact

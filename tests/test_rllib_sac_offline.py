@@ -101,6 +101,8 @@ def test_ppo_with_framestack_connector_runs(ray_start_regular):
 # ----------------------------------------------------------------- SAC ----
 
 
+# ~10s learning-curve soak.
+@pytest.mark.slow
 def test_sac_cartpole_learns(ray_start_regular):
     """Off-policy soft-actor-critic gate (reference: tuned_examples/sac).
     Discrete SAC with auto-tuned temperature must clear a learning bar on

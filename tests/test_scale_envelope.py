@@ -11,6 +11,8 @@ than a slow nightly.
 
 import time
 
+import pytest
+
 import ray_tpu
 
 
@@ -19,6 +21,8 @@ def _noop(*args):
     return None
 
 
+# ~18s queue-depth soak.
+@pytest.mark.slow
 def test_many_queued_tasks(ray_start_regular):
     """50k tasks queued at once on one node drain without error
     (reference envelope: 1M tasks on a 64-core node in 186.8s)."""

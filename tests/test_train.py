@@ -158,6 +158,10 @@ def test_jax_trainer_transformer_end_to_end(ray_start_regular):
     assert os.path.isdir(os.path.join(result.checkpoint.path, "state"))
 
 
+# Multiprocess jax.distributed worlds need a real multi-chip backend
+# (CPU lacks cross-process collectives — fails there by construction)
+# and cost ~12s each; run with -m slow on TPU hosts.
+@pytest.mark.slow
 def test_jax_distributed_two_process_world(ray_start_regular):
     """_JaxBackend forms a real 2-process jax.distributed world: global
     device count = 2 and sharded compute spans both workers (reference:
@@ -186,6 +190,7 @@ def test_jax_distributed_two_process_world(ray_start_regular):
     assert result.metrics == {"procs": 2, "devices": 2, "sum": 4.0}
 
 
+@pytest.mark.slow
 def test_jax_distributed_four_process_world(ray_start_regular):
     """4 processes x 2 virtual CPU devices each = 8 global devices, with a
     psum spanning the whole world — the multi-host SPMD shape a v5e pod

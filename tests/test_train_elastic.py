@@ -85,6 +85,8 @@ def test_broken_callback_does_not_kill_run(ray_start_regular):
     assert result.metrics["ok"] == 1
 
 
+# ~19s end-to-end elastic resize soak.
+@pytest.mark.slow
 def test_elastic_downsize_after_node_loss():
     """Lose a node mid-run: the group must re-form at min_workers and
     finish from the latest checkpoint."""
