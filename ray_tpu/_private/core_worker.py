@@ -3072,13 +3072,16 @@ class CoreWorker:
         else:
             err = get_context().loads_code(reply["error"])
             if isinstance(err, (exc.DeadlineExceededError,
-                                exc.OverloadedError)):
+                                exc.OverloadedError,
+                                exc.StreamBrokenError,
+                                exc.KVGatherError)):
                 # Worker-side expiry (refused-before-execution, or a
-                # nested hop's budget ran out inside user code) and
-                # serving load-shed both surface TYPED — wrapped in
-                # RayTaskError they would slip past the
-                # `except DeadlineExceededError` / `except
-                # OverloadedError` contracts the docs promise.
+                # nested hop's budget ran out inside user code),
+                # serving load-shed, and mid-stream KV-plane breaks
+                # all surface TYPED — wrapped in RayTaskError they
+                # would slip past the `except DeadlineExceededError` /
+                # `except OverloadedError` / `except
+                # StreamBrokenError` contracts the docs promise.
                 self._store_task_exception(spec, err)
             else:
                 wrapped = exc.RayTaskError(

@@ -134,6 +134,22 @@ class StreamBrokenError(RayError):
         self.tokens_emitted = int(tokens_emitted)
 
 
+class KVGatherError(RayError):
+    """A bulk gather of remote KV pages failed mid-request.
+
+    Raised inside the LLM engine's streamed-attention path when a KV
+    part that lives in a remote node's arena (published through the
+    replica directory, pulled via the swarm plane) cannot be fetched —
+    the holding host died, the owner is gone, or the transfer failed
+    after source failover.  The underlying object-plane error rides
+    ``__cause__``.  NEVER surfaces as wrong tokens: the affected
+    request is retired typed (its pool pages return immediately) and
+    the serving layer re-raises it to the stream consumer as
+    :class:`StreamBrokenError` carrying ``tokens_emitted`` — the same
+    mid-stream contract as a replica death.  Other requests in the
+    same continuous batch are unaffected."""
+
+
 class DAGBrokenError(RayError):
     """A compiled DAG's pipeline broke and cannot deliver further steps.
 

@@ -12,7 +12,8 @@ structurally.
 from .batch import ProcessorConfig, build_llm_processor
 from .engine import LLMEngine, SamplingParams
 from .openai_api import (ByteTokenizer, OpenAIServer, build_openai_app)
-from .serve_patterns import (build_dp_deployment, build_llm_app,
+from .serve_patterns import (LongContextApp, build_dp_deployment,
+                             build_llm_app, run_long_context_app,
                              run_pd_app)
 from .serving import EngineReplica, run_open_loop
 
@@ -20,4 +21,4 @@ __all__ = ["LLMEngine", "SamplingParams", "ProcessorConfig",
            "ByteTokenizer", "OpenAIServer", "build_openai_app",
            "build_llm_processor", "build_dp_deployment",
            "build_llm_app", "run_pd_app", "EngineReplica",
-           "run_open_loop"]
+           "run_open_loop", "LongContextApp", "run_long_context_app"]

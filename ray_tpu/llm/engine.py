@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .._private import flight_recorder
+from ..exceptions import KVGatherError
 from ..models.transformer import (TransformerConfig, apply_rope, init_params,
                                   param_logical_axes, rms_norm, rope_angles)
 
@@ -74,6 +75,24 @@ class _Request:
     # instead of running prefill (add_external_request).
     kv_blob: Optional[dict] = None
     first_token: int = -1
+    # Chunked in-pool prefill: tokens already prefilled into the slot's
+    # pages (advances per tick so one huge prompt can't starve a tick).
+    prefilled: int = 0
+    # Paged cross-host KV (add_paged_request): the prompt's KV lives in
+    # external parts — local dicts or remote-arena refs — and only the
+    # decode tail occupies pool pages.  ext_written counts decode-tail
+    # tokens whose KV has been appended (the next write position is
+    # ext_len + ext_written).
+    kv_paged: bool = False
+    ext_parts: List[dict] = dataclasses.field(default_factory=list)
+    ext_len: int = 0
+    ext_written: int = 0
+    # Typed failure (e.g. KVGatherError on a remote part): the request
+    # retires with finish_reason "error" and NEVER emits a wrong token.
+    error: Optional[BaseException] = None
+    # SP accounting: shard i's stripe of the slot's pages (which pages a
+    # sequence-parallel prefill shard installed / would hand off).
+    sp_stripes: Optional[List[List[int]]] = None
 
 
 # --------------------------------------------------------------------------
@@ -305,8 +324,14 @@ class _PrefixCache:
     list only when the last holder lets go, so evicting an entry out
     from under an in-flight request is safe."""
 
-    def __init__(self, page: int):
+    def __init__(self, page: int, tag: bytes = b""):
         self.page = page
+        # Key namespace tag: sequence-parallel engines key their pages
+        # per SP layout (tag = b"sp<degree>") so pages cached under one
+        # shard→stripe mapping can never alias pages cached under
+        # another — the per-shard half of "prefix-cache keys become
+        # per-shard" (the other half is _Request.sp_stripes).
+        self.tag = tag
         # rolling-hash key -> page ids covering the whole prefix
         self._entries: "OrderedDict[bytes, List[int]]" = OrderedDict()
         self.hits = 0
@@ -317,6 +342,7 @@ class _PrefixCache:
     def _keys(self, prompt: Sequence[int], upto: int) -> List[bytes]:
         """Rolling hash at every page boundary 1..upto."""
         h = hashlib.blake2b(digest_size=16)
+        h.update(self.tag)
         out = []
         for k in range(1, upto + 1):
             h.update(np.asarray(prompt[(k - 1) * self.page: k * self.page],
@@ -372,6 +398,124 @@ class _PrefixCache:
         return True
 
 
+class _KVWindow:
+    """Bounded host-side prefetch window over external KV parts.
+
+    The streamed-attention path never materializes a paged request's
+    context in the device pool; what it does need is the CURRENT part's
+    bytes on host.  This window holds at most `capacity` parts (LRU),
+    fetched through the engine's `kv_fetch` callback (the serving layer
+    wires it to an object-plane get — a swarm-plane bulk pull when the
+    part lives in a remote arena) and optionally warmed ahead of the
+    attention step via `kv_prefetch` (async; gather overlaps compute).
+    A window smaller than the part count degrades to re-fetching —
+    counted, never silent (`refetches`)."""
+
+    def __init__(self, capacity: int, fetch, prefetch=None):
+        self.capacity = max(1, int(capacity))
+        self._fetch = fetch
+        self._prefetch = prefetch
+        self._data: "OrderedDict[str, dict]" = OrderedDict()
+        self._futures: Dict[str, Any] = {}
+        # Recently-seen keys for refetch detection, LRU-BOUNDED: a
+        # prefill shard streams thousands of one-shot context-part keys
+        # that no request ever drop()s — an unbounded set would be a
+        # slow leak in exactly the always-on serving process.
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_cap = max(64, 16 * self.capacity)
+        self.fetches = 0
+        self.refetches = 0
+        self.bytes_fetched = 0
+        self.wait_s = 0.0
+
+    def _mark_seen(self, key: str) -> None:
+        self._seen[key] = None
+        self._seen.move_to_end(key)
+        while len(self._seen) > self._seen_cap:
+            self._seen.popitem(last=False)
+
+    def _validate(self, key: str, data) -> dict:
+        if not isinstance(data, dict) or "k" not in data or "v" not in data:
+            raise KVGatherError(
+                f"KV part {key!r} resolved to {type(data).__name__}, "
+                f"expected a {{'k','v','len'}} dict")
+        return data
+
+    def _admit(self, key: str, data: dict) -> dict:
+        self._data[key] = data
+        self._data.move_to_end(key)
+        while len(self._data) > self.capacity:
+            self._data.popitem(last=False)
+        return data
+
+    def put(self, key: str, data: dict) -> None:
+        """Seed a locally-produced part (chunked prefill keeps its own
+        freshly published stripes hot for the next chunk)."""
+        self._mark_seen(key)
+        self._admit(key, data)
+
+    def prefetch(self, items) -> None:
+        """Kick async fetches for [(key, handle)] not already resident."""
+        if self._prefetch is None:
+            return
+        for key, handle in items:
+            if key in self._data or key in self._futures:
+                continue
+            try:
+                self._futures[key] = self._prefetch(handle)
+            except Exception:      # prefetch is best-effort; get() retries
+                self._futures.pop(key, None)
+
+    def get(self, key: str, handle) -> dict:
+        import time as _time
+        data = self._data.get(key)
+        if data is not None:
+            self._data.move_to_end(key)
+            return data
+        t0 = _time.perf_counter()
+        fut = self._futures.pop(key, None)
+        try:
+            if fut is not None:
+                data = fut.result()
+            else:
+                data = self._fetch(handle)
+        except KVGatherError:
+            raise
+        except Exception as e:
+            raise KVGatherError(
+                f"gather of KV part {key!r} failed: "
+                f"{type(e).__name__}: {e}") from e
+        self.wait_s += _time.perf_counter() - t0
+        data = self._validate(key, data)
+        self.fetches += 1
+        if key in self._seen:
+            self.refetches += 1
+        self._mark_seen(key)
+        self.bytes_fetched += (getattr(data["k"], "nbytes", 0)
+                               + getattr(data["v"], "nbytes", 0))
+        return self._admit(key, data)
+
+    def drop(self, keys) -> None:
+        for k in keys:
+            self._data.pop(k, None)
+            self._futures.pop(k, None)
+            self._seen.pop(k, None)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"fetches": self.fetches, "refetches": self.refetches,
+                "bytes": self.bytes_fetched, "wait_s": self.wait_s,
+                "resident": len(self._data), "capacity": self.capacity}
+
+
+def _default_kv_fetch(handle):
+    """Engine-standalone fetch: parts passed by value ARE their data."""
+    if isinstance(handle, dict):
+        return handle
+    raise KVGatherError(
+        f"remote KV handle {type(handle).__name__} needs a kv_fetch "
+        f"callback (the serving layer wires ray_tpu.get)")
+
+
 # --------------------------------------------------------------------------
 # Engine
 # --------------------------------------------------------------------------
@@ -385,18 +529,35 @@ class LLMEngine:
                  max_batch: int = 4, max_len: int = 256, seed: int = 0,
                  mesh=None, rules=None, page_size: int = 64,
                  kv_pages: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 sp_degree: Optional[int] = None,
+                 sp_strategy: str = "ring",
+                 prefill_chunk: Optional[int] = None,
+                 kv_gather_window: int = 4,
+                 kv_fetch=None, kv_prefetch=None):
         """kv_pages sizes the shared pool (default: enough for every slot
         at max_len — set it lower to oversubscribe: admission then queues
         until pages free up).  mesh: shard weights + KV over its tp axis.
         prefix_cache=True enables page-granular KV prefix reuse (shared
         full prompt pages skip prefill; LRU-evicted under pool
         pressure) — off by default: retired pages then linger in the
-        cache instead of returning to the free list immediately."""
+        cache instead of returning to the free list immediately.
+
+        sp_degree (default: cfg.sp_degree) > 1 runs prefill attention
+        sequence-parallel over an ``sp`` mesh axis (ring attention, or
+        Ulysses via sp_strategy="ulysses") — a local sp mesh is built
+        when no mesh is passed.  prefill_chunk (tokens, rounded to a
+        page multiple) bounds the per-tick prefill compute: a longer
+        prompt advances one chunk per step() so a huge prompt neither
+        compiles one giant XLA bucket nor starves the continuous-
+        batching tick.  kv_gather_window / kv_fetch / kv_prefetch
+        configure the streamed cross-host KV path (add_paged_request):
+        at most `window` external parts are host-resident at once,
+        fetched via kv_fetch (blocking) and warmed via kv_prefetch
+        (async) so the gather overlaps decode compute."""
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
-        self.mesh = mesh
         self.page = max(8, min(page_size, max_len))
         self.pages_per_slot = math.ceil(max_len / self.page)
         # page 0 is scratch (inactive-slot writes land there); never handed out
@@ -404,9 +565,53 @@ class LLMEngine:
                             else max_batch * self.pages_per_slot)
         L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
 
+        from . import sequence_parallel as _sp
+        deg = sp_degree if sp_degree is not None \
+            else getattr(cfg, "sp_degree", 1)
+        if sp_degree is None and deg == 1 and mesh is not None \
+                and mesh.shape.get("sp", 1) > 1:
+            # No caller-requested degree: adopt the mesh's sp axis.  An
+            # EXPLICIT sp_degree (or cfg default > 1) is never silently
+            # overridden — a mismatch hits the ValueError below.
+            deg = mesh.shape["sp"]
+        self.sp_degree = max(1, int(deg))
+        self.sp_strategy = sp_strategy
+        sp_built = False
+        if self.sp_degree > 1:
+            if self.sp_degree & (self.sp_degree - 1):
+                raise ValueError(
+                    f"sp_degree={self.sp_degree} must be a power of two "
+                    f"(pow-2 prefill buckets shard evenly)")
+            if max_len % self.sp_degree:
+                # _bucket clamps to max_len, so a non-divisible max_len
+                # would reach shard_map as an unsplittable sequence axis
+                # on the first long prompt — fail at construction instead.
+                raise ValueError(
+                    f"max_len={max_len} must be divisible by "
+                    f"sp_degree={self.sp_degree} (prefill buckets clamp "
+                    f"to max_len)")
+            _sp.validate_sp(cfg, self.sp_degree, sp_strategy)
+            if mesh is None:
+                mesh = _sp.sp_mesh(self.sp_degree)
+                sp_built = True
+            elif mesh.shape.get("sp", 1) != self.sp_degree:
+                raise ValueError(
+                    f"sp_degree={self.sp_degree} but the given mesh's sp "
+                    f"axis is {mesh.shape.get('sp', 1)} — build the mesh "
+                    f"with MeshSpec(sp={self.sp_degree})")
+        self.mesh = mesh
+        self._sp = _sp
+
         self._kv_shd = None
         param_shd = None
-        if mesh is not None:
+        if sp_built:
+            # Engine-built sp-only mesh: weights + pool REPLICATE over
+            # the sp devices (only the prefill sequence axis is
+            # sharded); decode/install run identically on every shard.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            param_shd = NamedSharding(mesh, P())
+            self._kv_shd = NamedSharding(mesh, P())
+        elif mesh is not None:
             from ..parallel.sharding import LogicalAxisRules, tree_shardings
             from jax.sharding import NamedSharding, PartitionSpec as P
             # Megatron layout minus vocab-parallel: replicating the (small)
@@ -437,7 +642,9 @@ class LLMEngine:
         # page -> holder count (requests + cache entries); a page leaves
         # _free_pages with count 1 and returns when the count hits 0.
         self._page_refs: Dict[int, int] = {}
-        self._cache = _PrefixCache(self.page) if prefix_cache else None
+        cache_tag = (b"sp%d" % self.sp_degree) if self.sp_degree > 1 else b""
+        self._cache = _PrefixCache(self.page, cache_tag) \
+            if prefix_cache else None
         self._tables = np.zeros((max_batch, self.pages_per_slot), np.int32)
         self._slots: Dict[int, _Request] = {}
         self._waiting: List[_Request] = []
@@ -460,8 +667,45 @@ class LLMEngine:
                 pk, pv, ks, vs, pages, page, kv_shd),
             donate_argnums=(0, 1))
 
+        # Chunked in-pool prefill: chunk size is a page multiple so every
+        # chunk boundary is a page boundary (the suffix path requires a
+        # page-aligned resident prefix).
+        if prefill_chunk:
+            c = max(self.page, int(prefill_chunk))
+            self.prefill_chunk: Optional[int] = c - (c % self.page)
+        else:
+            self.prefill_chunk = None
+        self._prefilling: Dict[int, _Request] = {}
+
+        # Streamed cross-host KV (paged requests + pool-free prefill).
+        from .sequence_parallel import StreamAttn
+        self._stream_attn = StreamAttn(cfg)
+        self._kv_window = _KVWindow(kv_gather_window,
+                                    kv_fetch or _default_kv_fetch,
+                                    kv_prefetch)
+        self._part_seq = 0
+
+        def _tail_gather(pk, pv, li, pages):
+            tk = pk[li][pages].reshape(-1, kvh, d)
+            tv = pv[li][pages].reshape(-1, kvh, d)
+            return tk, tv
+        self._tail_gather_jit = jax.jit(_tail_gather)
+
+        def _append_tail(pk, pv, ks, vs, page_id, off):
+            pk = pk.at[:, page_id, off].set(ks)
+            pv = pv.at[:, page_id, off].set(vs)
+            if kv_shd is not None:
+                pk = jax.lax.with_sharding_constraint(pk, kv_shd)
+                pv = jax.lax.with_sharding_constraint(pv, kv_shd)
+            return pk, pv
+        self._append_tail_jit = jax.jit(_append_tail,
+                                        donate_argnums=(0, 1))
+
     # ------------------------------------------------------------ requests --
     def _pages_needed(self, req: _Request) -> int:
+        if req.kv_paged:
+            # External context: only the decode tail lives in the pool.
+            return math.ceil((req.params.max_tokens + 1) / self.page)
         budget = len(req.prompt) + req.params.max_tokens + 1
         return math.ceil(min(budget, self.max_len) / self.page)
 
@@ -518,6 +762,66 @@ class LLMEngine:
         self._waiting.append(req)
         return req.req_id
 
+    def _norm_parts(self, parts, length: int, tag: str) -> List[dict]:
+        """Validate + key a part list: contiguous spans covering
+        [0, length), each entry {"span": (s, e), "handle": ...}."""
+        pos = 0
+        norm = []
+        for i, part in enumerate(parts):
+            s, e = part["span"]
+            if s != pos or e <= s:
+                raise ValueError(
+                    f"KV parts must tile the context contiguously: part "
+                    f"{i} spans [{s}, {e}) but {pos} tokens are covered")
+            pos = e
+            handle = part["handle"]
+            key = part.get("key")
+            if key is None:
+                hx = getattr(handle, "hex", None)
+                key = hx() if callable(hx) else f"{tag}:{i}"
+            norm.append({"span": (int(s), int(e)), "handle": handle,
+                         "key": key})
+        if pos != length:
+            raise ValueError(
+                f"KV parts cover {pos} tokens, context is {length}")
+        return norm
+
+    def add_paged_request(self, parts, length: int, first_token: int,
+                          params: Optional[SamplingParams] = None, *,
+                          prompt_tokens: Optional[Sequence[int]] = None
+                          ) -> int:
+        """Queue a request whose prompt KV lives in external PARTS —
+        (L, span, KV, D) stripes resident in arbitrary arenas (local
+        dicts, or refs into REMOTE nodes' arenas published through the
+        replica directory) — instead of this engine's pool.  This is the
+        page-table location tier: only the decode tail occupies local
+        pages, so the servable context length is bounded by the parts,
+        not by max_len or this node's pool (the point of cross-host KV).
+        Decode streams attention over the parts through the bounded
+        gather window; a part whose host is lost mid-decode fails THIS
+        request typed (KVGatherError → StreamBrokenError upstream),
+        never emitting a wrong token."""
+        params = params or SamplingParams()
+        S = int(length)
+        req = _Request(self._next_id,
+                       list(prompt_tokens) if prompt_tokens else [],
+                       params)
+        req.kv_paged = True
+        req.no_cache = True
+        req.ext_len = S
+        req.first_token = int(first_token)
+        req.ext_parts = self._norm_parts(parts, S, f"req{req.req_id}")
+        need = self._pages_needed(req)
+        if need > min(self.pages_per_slot, self.n_pages - 1):
+            raise ValueError(
+                f"decode tail needs {need} KV pages but a slot holds "
+                f"{self.pages_per_slot} and the pool {self.n_pages - 1} "
+                f"— lower max_tokens or raise kv_pages/max_len")
+        self._next_id += 1
+        self._requests[req.req_id] = req
+        self._waiting.append(req)
+        return req.req_id
+
     def cancel_request(self, req_id: int) -> bool:
         """Retire a request mid-flight (client disconnect, deadline
         expiry): its pages return to the pool IMMEDIATELY — mid-decode,
@@ -529,6 +833,9 @@ class LLMEngine:
         req.finish_reason = req.finish_reason or "cancelled"
         if req.slot >= 0 and self._slots.get(req.slot) is req:
             self._retire(req.slot)
+        elif req.slot >= 0 and self._prefilling.get(req.slot) is req:
+            del self._prefilling[req.slot]
+            self._free_slot(req)
         else:
             try:
                 self._waiting.remove(req)
@@ -547,7 +854,7 @@ class LLMEngine:
         return ev
 
     def has_unfinished(self) -> bool:
-        return bool(self._waiting or self._slots)
+        return bool(self._waiting or self._slots or self._prefilling)
 
     def kv_pages_free(self) -> int:
         return len(self._free_pages)
@@ -565,7 +872,14 @@ class LLMEngine:
 
     @property
     def active_requests(self) -> int:
-        return len(self._slots)
+        return len(self._slots) + len(self._prefilling)
+
+    def kv_gather_stats(self) -> Dict[str, Any]:
+        """Remote-part gather counters (bytes, fetches, refetches,
+        blocking wait) — exported as node-labeled gauges by the serving
+        layer; `refetches` > 0 means the gather window is smaller than a
+        live request's part count (counted, never silent)."""
+        return self._kv_window.stats()
 
     def prefix_cache_stats(self) -> Dict[str, Any]:
         if self._cache is None:
@@ -579,23 +893,34 @@ class LLMEngine:
 
     # ---------------------------------------------------------------- step --
     def _bucket(self, n: int) -> int:
-        b = 8
+        # Floor at sp_degree (both pow-2): a short prompt's bucket must
+        # still split over every sequence-parallel shard.
+        b = max(8, self.sp_degree)
         while b < n:
             b *= 2
         return min(b, self.max_len)
 
     def _run_prefill(self, prompt: Sequence[int]):
         """Bucketed, jit-cached prefill shared by admission and the P/D
-        prefill half; returns (last_logits, ks, vs)."""
+        prefill half; returns (last_logits, ks, vs).  With sp_degree > 1
+        dispatches to the sequence-parallel path (ring/Ulysses over the
+        mesh's sp axis) — exact parity with the single-device kernel."""
         S = len(prompt)
         Sb = self._bucket(S)
-        if Sb not in self._prefill_jit:
+        key = ("sp", Sb) if self.sp_degree > 1 else Sb
+        if key not in self._prefill_jit:
             cfg = self.cfg
-            self._prefill_jit[Sb] = jax.jit(
-                lambda p, t, n: _prefill_fn(p, t, n, cfg))
+            if self.sp_degree > 1:
+                mesh, strat = self.mesh, self.sp_strategy
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, t, n: self._sp.sp_prefill_fn(
+                        p, t, n, cfg, mesh, strat))
+            else:
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, t, n: _prefill_fn(p, t, n, cfg))
         toks = np.zeros((1, Sb), np.int32)
         toks[0, :S] = prompt
-        return self._prefill_jit[Sb](self.params, jnp.asarray(toks), S)
+        return self._prefill_jit[key](self.params, jnp.asarray(toks), S)
 
     # ------------------------------------------------------ page refcounts --
     def _alloc_page(self) -> int:
@@ -668,17 +993,29 @@ class LLMEngine:
         self._install_pages(req.pages, ks, vs)
 
     def _run_suffix(self, prompt: Sequence[int], prefix_len: int,
-                    pages_row):
-        """Jit-cached suffix prefill against resident prefix pages."""
-        suf = prompt[prefix_len:]
+                    pages_row, upto: Optional[int] = None):
+        """Jit-cached suffix prefill against resident prefix pages.
+        `upto` bounds the suffix (chunked prefill: one chunk per call).
+        With sp_degree > 1 the suffix attention runs sequence-parallel
+        (ring over the suffix KV, accumulator seeded by the resident
+        prefix) so prefix-cache hits keep their compute skip under SP."""
+        suf = prompt[prefix_len:upto]
         S = len(suf)
         Sb = self._bucket(S)
-        key = ("suffix", Sb)
+        sp = self.sp_degree > 1
+        key = ("sp-suffix", Sb) if sp else ("suffix", Sb)
         if key not in self._prefill_jit:
             cfg, page = self.cfg, self.page
-            self._prefill_jit[key] = jax.jit(
-                lambda p, pk, pv, pg, t, pl, n: _suffix_prefill_fn(
-                    p, pk, pv, pg, t, pl, n, cfg, page))
+            if sp:
+                mesh = self.mesh
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, pk, pv, pg, t, pl, n:
+                    self._sp.sp_suffix_prefill_fn(
+                        p, pk, pv, pg, t, pl, n, cfg, page, mesh))
+            else:
+                self._prefill_jit[key] = jax.jit(
+                    lambda p, pk, pv, pg, t, pl, n: _suffix_prefill_fn(
+                        p, pk, pv, pg, t, pl, n, cfg, page))
         toks = np.zeros((1, Sb), np.int32)
         toks[0, :S] = suf
         return self._prefill_jit[key](
@@ -690,7 +1027,25 @@ class LLMEngine:
         admitted = []
         while self._waiting and self._reserve(self._waiting[0]):
             req = self._waiting.pop(0)
+            if req.kv_paged:
+                # External paged context: nothing to prefill — the
+                # parts stay wherever they live (possibly remote); the
+                # reserved pages are the decode tail.
+                self._lengths[req.slot] = 0
+                self._temps[req.slot] = req.params.temperature
+                self._slots[req.slot] = req
+                self._last[req.slot] = req.first_token
+                self._emit(req, int(req.first_token))
+                continue
             S = len(req.prompt)
+            if self.prefill_chunk and req.kv_blob is None \
+                    and S - req.prefix_len > self.prefill_chunk:
+                # Chunked prefill: advance per tick (in step()), so one
+                # huge prompt neither compiles a giant bucket nor
+                # starves the continuous-batching tick.
+                req.prefilled = req.prefix_len
+                self._prefilling[req.slot] = req
+                continue
             active_before = len(self._slots)
             t0 = rec.begin()
             if req.kv_blob is not None:
@@ -708,6 +1063,21 @@ class LLMEngine:
             if self._cache is not None and not req.no_cache:
                 self._cache.insert(req.prompt, self._tables[req.slot],
                                    self._incref)
+            if self.sp_degree > 1:
+                # Which pages each sequence-parallel shard installed —
+                # the stripe accounting the cross-host handoff consumes.
+                # Shard boundaries follow the kernel's PADDED bucket; a
+                # prefix-cache hit stripes only the suffix's new pages
+                # (the shared prefix was not computed by any shard).
+                if req.prefix_len:
+                    suf = S - req.prefix_len
+                    req.sp_stripes = self._sp.sp_stripe_pages(
+                        req.pages, suf, self.sp_degree, self.page,
+                        padded=self._bucket(suf))
+                else:
+                    req.sp_stripes = self._sp.sp_stripe_pages(
+                        self._tables[req.slot], S, self.sp_degree,
+                        self.page, padded=self._bucket(S))
             self._lengths[req.slot] = S
             self._temps[req.slot] = req.params.temperature
             self._slots[req.slot] = req
@@ -764,12 +1134,28 @@ class LLMEngine:
     def _sample_host(self, logits, params: SamplingParams) -> int:
         return self._sample_batch([logits], [params])[0]
 
+    def sample_first(self, logits, params: Optional[SamplingParams] = None
+                     ) -> int:
+        """Sample a first token from prefill logits — the final step of a
+        distributed paged prefill, where the LAST shard's chunk holds the
+        prompt's real last-token logits (serve_patterns.LongContextApp)."""
+        return self._sample_host(logits, params or SamplingParams())
+
     def _emit(self, req: _Request, token: int):
         req.out.append(token)
         p = req.params
         if p.eos_id is not None and token == p.eos_id:
             req.finished = True
             req.finish_reason = req.finish_reason or "stop"
+        elif req.kv_paged:
+            # Paged context: length is bounded by max_tokens and the
+            # reserved decode-tail pages, never by max_len (the context
+            # itself lives in external parts).
+            tail_cap = len(req.pages) * self.page
+            if len(req.out) >= p.max_tokens \
+                    or req.ext_written + 1 >= tail_cap:
+                req.finished = True
+                req.finish_reason = req.finish_reason or "length"
         elif len(req.out) >= p.max_tokens \
                 or len(req.prompt) + len(req.out) >= self.max_len - 1:
             req.finished = True
@@ -777,11 +1163,14 @@ class LLMEngine:
         self._tick_events.append((req.req_id, token, req.finished))
 
     def step(self) -> List[_Request]:
-        """Admit waiting requests, run ONE decode step for all active
-        slots, retire finished requests.  Returns requests finished in
-        this step (vllm engine.step parity)."""
+        """Admit waiting requests, advance chunked prefills by one chunk,
+        run ONE decode step for all active slots (paged-context slots
+        stream their attention over external parts), retire finished
+        requests.  Returns requests finished in this step (vllm
+        engine.step parity)."""
         self._tick_events = []
         self._admit()
+        self._advance_prefilling()
         done: List[_Request] = []
         # Retire requests that finished at admission (eos on first token).
         for slot, req in list(self._slots.items()):
@@ -789,10 +1178,31 @@ class LLMEngine:
                 done.append(self._retire(slot))
         if not self._slots:
             return done
-        active = np.zeros(self.max_batch, bool)
-        for slot in self._slots:
-            active[slot] = True
         rec = flight_recorder.recorder()
+        # Paged-context slots: one streamed-attention token each (their
+        # KV spans external — possibly remote — parts; the compiled
+        # batch step below cannot gather those).
+        for slot, req in list(self._slots.items()):
+            if not req.kv_paged or req.finished:
+                continue
+            try:
+                tok = self._ext_decode_step(req)
+            except KVGatherError as e:
+                req.error = e
+                req.finished = True
+                req.finish_reason = "error"
+                done.append(self._retire(slot))
+                continue
+            self._last[slot] = tok
+            self._emit(req, tok)
+            if req.finished:
+                done.append(self._retire(slot))
+        batch = {s for s, r in self._slots.items() if not r.kv_paged}
+        if not batch:
+            return done
+        active = np.zeros(self.max_batch, bool)
+        for slot in batch:
+            active[slot] = True
         t0 = rec.begin()
         self._rng, key = jax.random.split(self._rng)
         self._pk, self._pv, nxt = self._decode_jit(
@@ -800,8 +1210,10 @@ class LLMEngine:
             jnp.asarray(self._last), jnp.asarray(self._lengths),
             jnp.asarray(active), jnp.asarray(self._temps), key)
         nxt = np.asarray(nxt)
-        rec.end("request", "decode", t0, batch=len(self._slots))
+        rec.end("request", "decode", t0, batch=len(batch))
         for slot, req in list(self._slots.items()):
+            if slot not in batch:
+                continue
             self._lengths[slot] += 1          # the token we just attended
             tok = int(nxt[slot])
             self._last[slot] = tok
@@ -810,8 +1222,61 @@ class LLMEngine:
                 done.append(self._retire(slot))
         return done
 
+    def _advance_prefilling(self) -> None:
+        """Advance chunked prefills by AT MOST one chunk per tick: the
+        decode tick's latency is bounded by one chunk's compile-stable
+        compute, so a million-token prompt cannot starve the continuous
+        batch.  The final chunk samples the first token and activates
+        the slot for decode."""
+        if not self._prefilling:
+            return
+        rec = flight_recorder.recorder()
+        for slot, req in sorted(self._prefilling.items()):
+            S = len(req.prompt)
+            nxt = min(req.prefilled + self.prefill_chunk, S)
+            row = self._tables[slot]
+            t0 = rec.begin()
+            if req.prefilled == 0:
+                logits, ks, vs = self._run_prefill(req.prompt[:nxt])
+                self._install_pages(
+                    row[:math.ceil(nxt / self.page)], ks, vs)
+            else:
+                logits, ks, vs = self._run_suffix(
+                    req.prompt, req.prefilled, row, upto=nxt)
+                self._install_pages(
+                    row[req.prefilled // self.page:
+                        math.ceil(nxt / self.page)], ks, vs)
+            rec.end("request", "prefill", t0,
+                    id=req.req_id.to_bytes(8, "little"), tokens=nxt,
+                    cached_tokens=req.prefilled, chunked=True,
+                    active=len(self._slots))
+            req.prefilled = nxt
+            if nxt >= S:
+                del self._prefilling[slot]
+                if self._cache is not None and not req.no_cache:
+                    self._cache.insert(req.prompt, row, self._incref)
+                # No sp_stripes for chunked prefills: every chunk was
+                # its own SP pass with its own bucket, so a single
+                # whole-prompt stripe attribution would lie; chunked
+                # cross-host handoffs carry exact spans via the paged
+                # parts path instead.
+                self._lengths[slot] = S
+                self._temps[slot] = req.params.temperature
+                self._slots[slot] = req
+                first = self._sample_batch([logits], [req.params])[0]
+                self._last[slot] = first
+                self._emit(req, int(first))
+            break                       # one chunk per tick, total
+
     def _retire(self, slot: int) -> _Request:
         req = self._slots.pop(slot)
+        self._free_slot(req)
+        return req
+
+    def _free_slot(self, req: _Request) -> None:
+        """Return a reserved slot's pages + slot to the pool (shared by
+        retirement and mid-prefill cancellation)."""
+        slot = req.slot
         self._free_slots.append(slot)
         for p in req.pages:
             self._decref(p)
@@ -819,10 +1284,189 @@ class LLMEngine:
             self._decref(p)
         req.pages = []
         req.shared_pages = []
+        if req.ext_parts:
+            self._kv_window.drop([p["key"] for p in req.ext_parts])
         self._tables[slot] = 0
         self._lengths[slot] = 0
         self._requests.pop(req.req_id, None)
-        return req
+
+    # ------------------------------------------- streamed cross-host KV ----
+    def _part_layer(self, part: dict, li: int):
+        """One layer's (k, v, valid_len) of an external part, through the
+        gather window (a remote part's first touch this step blocks on
+        the object-plane pull; prefetch usually got there first).
+
+        The whole part uploads to device ONCE per window residency and
+        is layer-sliced there — re-uploading per (token, layer) would
+        re-transfer the entire resident window every decoded token.
+        Device working set stays bounded by the same knob as host
+        memory: O(kv_gather_window parts)."""
+        data = self._kv_window.get(part["key"], part["handle"])
+        kj = data.get("_kj")
+        if kj is None:
+            kj = data["_kj"] = jnp.asarray(data["k"], self.cfg.dtype)
+            data["_vj"] = jnp.asarray(data["v"], self.cfg.dtype)
+        valid = int(data.get("len", data["k"].shape[1]))
+        return kj[li], data["_vj"][li], valid
+
+    def _window_prefetch(self, parts) -> None:
+        self._kv_window.prefetch(
+            [(p["key"], p["handle"]) for p in parts])
+
+    def _ext_decode_step(self, req: _Request) -> int:
+        """One decode token for a paged-context slot: streamed online-
+        softmax attention over the external parts (layers outer, parts
+        inner — the device never holds more than one part), the pool-
+        resident decode tail, and the incoming token itself; the new
+        token's KV appends to the tail pages in one donated update.
+        Raises KVGatherError if a part's bytes cannot be gathered."""
+        sa = self._stream_attn
+        S, t = req.ext_len, req.ext_written
+        pos = S + t                       # absolute write/query position
+        rec = flight_recorder.recorder()
+        win = self._kv_window
+        b0, w0, f0 = win.bytes_fetched, win.wait_s, win.fetches
+        t0 = rec.begin()
+        self._window_prefetch(req.ext_parts)
+        x = sa.embed(self.params,
+                     np.asarray([[self._last[req.slot]]], np.int32))
+        pages_row = jnp.asarray(np.asarray(req.pages, np.int32))
+        ks_new, vs_new = [], []
+        for li in range(self.cfg.num_layers):
+            q, k, v = sa.qkv(self.params["layers"], li, x, pos)
+            m, l, acc = sa.init(1)
+            for part in req.ext_parts:
+                pk, pv, valid = self._part_layer(part, li)
+                m, l, acc = sa.block(q, pk, pv, valid, pos,
+                                     part["span"][0], m, l, acc)
+            if t > 0:
+                tk, tv = self._tail_gather_jit(self._pk, self._pv,
+                                               jnp.int32(li), pages_row)
+                m, l, acc = sa.block(q, tk, tv, t, pos, S, m, l, acc)
+            m, l, acc = sa.block(q, k, v, 1, pos, pos, m, l, acc)
+            x = sa.finish(self.params["layers"], li, x, l, acc)
+            ks_new.append(k)
+            vs_new.append(v)
+        logits = sa.logits(self.params, x, 0)
+        # The span covers prefetch-kick → last layer; gather_wait_us is
+        # the BLOCKING portion (prefetch that got there first shows up
+        # as bytes with ~zero wait — the gather/compute overlap signal).
+        rec.end("request", "sp:gather", t0,
+                id=req.req_id.to_bytes(8, "little"),
+                parts=len(req.ext_parts),
+                gather_bytes=win.bytes_fetched - b0,
+                gather_wait_us=int((win.wait_s - w0) * 1e6),
+                fetches=win.fetches - f0)
+        page_id = req.pages[t // self.page]
+        self._pk, self._pv = self._append_tail_jit(
+            self._pk, self._pv, jnp.stack(ks_new)[:, 0],
+            jnp.stack(vs_new)[:, 0], jnp.int32(page_id),
+            jnp.int32(t % self.page))
+        req.ext_written = t + 1
+        return int(self._sample_batch([logits], [req.params])[0])
+
+    def prefill_paged_chunk(self, chunk_tokens: Sequence[int], pos0: int,
+                            ctx_parts, *, span: int, is_last: bool):
+        """One streamed prefill chunk that NEVER touches the page pool:
+        the chunk's queries attend to previously published context parts
+        (gathered through the window — cross-host when a part lives in a
+        peer's arena) plus the chunk itself causally, and the chunk's
+        own KV comes back as a new part, padded to `span` with its real
+        length in "len".  Returns (part, last_token_logits-or-None).
+
+        This is the unit the serving layer round-robins across N
+        sequence-parallel prefill shards: each shard computes its
+        stripe and publishes it into ITS OWN node's arena, so no single
+        node's pool (or arena) ever holds the whole context."""
+        sa = self._stream_attn
+        Sc = len(chunk_tokens)
+        if not (0 < Sc <= span):
+            raise ValueError(f"chunk of {Sc} tokens vs span {span}")
+        ctx = self._norm_parts(
+            ctx_parts, pos0, f"pf{self._part_seq}") if ctx_parts else []
+        self._part_seq += 1
+        rec = flight_recorder.recorder()
+        win = self._kv_window
+        b0, w0, f0 = win.bytes_fetched, win.wait_s, win.fetches
+        t0 = rec.begin()
+        self._window_prefetch(ctx)
+        toks = np.zeros((1, span), np.int32)
+        toks[0, :Sc] = chunk_tokens
+        x = sa.embed(self.params, toks)
+        ks_out, vs_out = [], []
+        for li in range(self.cfg.num_layers):
+            q, k, v = sa.qkv(self.params["layers"], li, x, pos0)
+            m, l, acc = sa.init(span)
+            for part in ctx:
+                pk, pv, valid = self._part_layer(part, li)
+                m, l, acc = sa.block(q, pk, pv, valid, pos0,
+                                     part["span"][0], m, l, acc)
+            m, l, acc = sa.block(q, k, v, Sc, pos0, pos0, m, l, acc)
+            x = sa.finish(self.params["layers"], li, x, l, acc)
+            ks_out.append(k)
+            vs_out.append(v)
+        rec.end("request", "sp:gather", t0, parts=len(ctx),
+                gather_bytes=win.bytes_fetched - b0,
+                gather_wait_us=int((win.wait_s - w0) * 1e6),
+                fetches=win.fetches - f0, prefill_chunk=True)
+        part = {"k": np.asarray(jnp.stack(ks_out)),
+                "v": np.asarray(jnp.stack(vs_out)), "len": Sc}
+        logits = sa.logits(self.params, x, Sc - 1) if is_last else None
+        return part, logits
+
+    def prefill_paged(self, prompt_tokens: Sequence[int],
+                      params: Optional[SamplingParams] = None, *,
+                      span: int = 64, publish=None) -> dict:
+        """Streamed chunked prefill of an arbitrarily long context with a
+        bounded device working set: chunk c attends to the c already-
+        published parts, then becomes part c itself.  `publish(part) ->
+        handle` puts each stripe wherever it should live (the serving
+        layer puts into the local arena — the handle is a 20-byte ref);
+        without it parts travel by value (engine-standalone use).
+        Returns the handoff ``{"parts": [{"span", "handle"}], "len",
+        "first"}`` that add_paged_request / decode_paged consume."""
+        params = params or SamplingParams()
+        prompt = list(prompt_tokens)
+        S = len(prompt)
+        span = max(8, int(span))
+        parts_meta: List[dict] = []
+        n_chunks = math.ceil(S / span)
+        logits = None
+        for c in range(n_chunks):
+            s0 = c * span
+            chunk = prompt[s0:s0 + span]
+            part, logits = self.prefill_paged_chunk(
+                chunk, s0, parts_meta, span=span,
+                is_last=(c == n_chunks - 1))
+            handle = publish(part) if publish is not None else part
+            key = f"pp{id(self) & 0xffff}:{self._part_seq}"
+            self._part_seq += 1
+            # Keep our own freshly produced stripe hot for chunk c+1.
+            self._kv_window.put(key, part)
+            parts_meta.append({"span": (s0, s0 + len(chunk)),
+                               "handle": handle, "key": key})
+        first = self._sample_batch([logits], [params])[0]
+        return {"parts": [{"span": m["span"], "handle": m["handle"]}
+                          for m in parts_meta],
+                "len": S, "first": int(first)}
+
+    def decode_paged(self, handoff: dict,
+                     params: Optional[SamplingParams] = None) -> List[int]:
+        """Closed-loop convenience over add_paged_request (the serving
+        layer streams the same admission instead): decode a paged
+        handoff to completion; re-raises the typed gather error if a
+        part's host was lost mid-decode."""
+        rid = self.add_paged_request(handoff["parts"], handoff["len"],
+                                     handoff["first"], params,
+                                     prompt_tokens=handoff.get("prompt"))
+        while self.has_unfinished():
+            for done in self.step():
+                if done.req_id == rid:
+                    if done.error is not None:
+                        raise done.error
+                    return done.out
+        raise RuntimeError(
+            f"paged request {rid} was dropped without finishing")
 
     # ------------------------------------------------------------ generate --
     def generate(self, prompts: Sequence[Sequence[int]],

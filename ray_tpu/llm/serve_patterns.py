@@ -280,3 +280,150 @@ class CompiledPDApp:
 def run_pd_compiled(preset: str = "tiny", **kwargs) -> CompiledPDApp:
     """Build the compiled P/D deployment (see :class:`CompiledPDApp`)."""
     return CompiledPDApp(preset, **kwargs)
+
+
+class LongContextApp:
+    """Long-context serving: N sequence-parallel prefill shards +
+    cross-host paged KV decode — the million-token-context deployment
+    shape (the capability the reference Ray does not have, SURVEY.md
+    §5.7: it only orchestrates SPMD programs that implement SP
+    themselves).
+
+    Prefill: the prompt is cut into ``span``-token chunks and
+    round-robined across N shard replicas.  Chunk c's queries attend to
+    the c already-published parts (ring order is the causal order, so
+    the online-softmax accumulation is exact — Liu et al. 2023) pulled
+    through each shard's bounded gather window, and its own KV stripe
+    is published into THAT shard's node arena; only 20-byte refs flow
+    back.  Each shard can additionally run its intra-chunk attention
+    sequence-parallel (``sp_degree`` > 1, ring/Ulysses over its local
+    devices).  The handoff is the union of every shard's stripes — N
+    prefill shards hand off to one decode replica without the proxy or
+    owner ever touching KV bytes.
+
+    Decode: :meth:`~ray_tpu.llm.serving.EngineReplica.admit_paged` — the
+    context stays in the shard arenas (the page-table location tier);
+    the decode replica streams attention over the parts through its
+    prefetch window (gather overlaps compute) and only the decode tail
+    occupies its local pool.  A context larger than ANY single node's
+    page pool — or arena — still serves.
+
+    Failure: losing a shard (or its node) mid-decode fails the affected
+    streams typed (`StreamBrokenError` carrying ``tokens_emitted``,
+    cause-chained `KVGatherError`); pages and window state reclaim
+    immediately and other requests keep decoding."""
+
+    def __init__(self, preset: str = "tiny", *, prefill_shards: int = 2,
+                 decode_replicas: int = 1, span: int = 64,
+                 max_batch: int = 2, max_len: int = 128,
+                 page_size: int = 16, kv_pages: Optional[int] = None,
+                 kv_gather_window: int = 4,
+                 sp_degree: Optional[int] = None,
+                 sp_strategy: str = "ring", max_tokens: int = 16,
+                 seed: int = 0, prefill_options: Optional[dict] = None,
+                 decode_options: Optional[dict] = None):
+        import threading
+
+        import ray_tpu
+        Rep = ray_tpu.remote(EngineReplica)
+        self.span = int(span)
+        # Shards never admit decode requests — their pool only backs the
+        # prefix cache / scratch, so kv_pages can be tiny.
+        self.shards = [
+            Rep.options(**(prefill_options or {})).remote(
+                preset, max_batch=1, max_len=max_len,
+                page_size=page_size, kv_pages=kv_pages,
+                prefix_cache=False, sp_degree=sp_degree,
+                sp_strategy=sp_strategy, paged_span=span,
+                kv_gather_window=kv_gather_window, seed=seed)
+            for _ in range(prefill_shards)]
+        self.decodes = [
+            Rep.options(**(decode_options or {})).remote(
+                preset, max_batch=max_batch, max_len=max_len,
+                page_size=page_size, kv_pages=kv_pages,
+                prefix_cache=False, max_tokens=max_tokens,
+                kv_gather_window=kv_gather_window, seed=seed)
+            for _ in range(decode_replicas)]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self.num_replicas = decode_replicas
+
+    def _next_decode(self):
+        with self._rr_lock:
+            d = self.decodes[self._rr % len(self.decodes)]
+            self._rr += 1
+        return d
+
+    def prefill(self, prompt_tokens: Sequence[int],
+                opts: Optional[dict] = None,
+                timeout: float = 120.0) -> dict:
+        """Run the sharded paged prefill; returns the decode handoff
+        ``{"parts": [{"span", "handle"}], "len", "first", "opts"}``.
+        Chunks are sequential by causality (chunk c attends to parts
+        0..c-1) but stripe STORAGE is spread across every shard's node —
+        the property the cluster test pins."""
+        import ray_tpu
+        prompt = list(prompt_tokens)
+        S = len(prompt)
+        n = max(1, -(-S // self.span))
+        parts: List[dict] = []
+        first = None
+        for c in range(n):
+            shard = self.shards[c % len(self.shards)]
+            res = ray_tpu.get(shard.prefill_paged_chunk.remote({
+                "chunk": prompt[c * self.span:(c + 1) * self.span],
+                "pos0": c * self.span, "parts": parts,
+                "span": self.span, "is_last": c == n - 1,
+                "opts": opts or {}}), timeout=timeout)
+            parts.append({"span": res["span"], "handle": res["handle"]})
+            first = res.get("first", first)
+        return {"parts": parts, "len": S, "first": int(first),
+                "opts": opts or {}}
+
+    def generate(self, prompt_tokens: Sequence[int],
+                 opts: Optional[dict] = None,
+                 timeout: float = 120.0) -> dict:
+        """Blocking completion: {"tokens": [...], "finish_reason": ...}."""
+        import ray_tpu
+        handoff = self.prefill(prompt_tokens, opts, timeout)
+        dec = self._next_decode()
+        return ray_tpu.get(dec.decode_paged.remote(handoff),
+                           timeout=timeout)
+
+    def stream(self, prompt_tokens: Sequence[int],
+               opts: Optional[dict] = None, timeout: float = 120.0):
+        """Generator of int tokens then one terminal dict — the
+        run_open_loop submit contract.  Mid-decode KV loss raises
+        StreamBrokenError out of the iteration, typed."""
+        import ray_tpu
+        handoff = self.prefill(prompt_tokens, opts, timeout)
+        dec = self._next_decode()
+        rid = ray_tpu.get(dec.admit_paged.remote(handoff),
+                          timeout=timeout)
+        gen = dec.collect_stream.options(
+            num_returns="streaming").remote(rid)
+        for item_ref in gen:
+            yield ray_tpu.get(item_ref, timeout=timeout)
+
+    def debug_stats(self, timeout: float = 30.0) -> dict:
+        import ray_tpu
+        return {"shards": ray_tpu.get(
+                    [s.debug_stats.remote() for s in self.shards],
+                    timeout=timeout),
+                "decodes": ray_tpu.get(
+                    [d.debug_stats.remote() for d in self.decodes],
+                    timeout=timeout)}
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for h in self.shards + self.decodes:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+
+
+def run_long_context_app(preset: str = "tiny", **kwargs) -> LongContextApp:
+    """Build the sharded long-context deployment (see
+    :class:`LongContextApp`)."""
+    return LongContextApp(preset, **kwargs)

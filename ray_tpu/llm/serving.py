@@ -97,13 +97,34 @@ class EngineReplica:
                  kv_pages: Optional[int] = None, prefix_cache: bool = True,
                  max_queue: int = 64, max_tokens: int = 16,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
-                 seed: int = 0, mesh=None):
+                 seed: int = 0, mesh=None, sp_degree: Optional[int] = None,
+                 sp_strategy: str = "ring",
+                 prefill_chunk: Optional[int] = None,
+                 kv_gather_window: int = 4, paged_span: int = 64):
+        import concurrent.futures
+
         from ..models import PRESETS
         cfg = PRESETS[preset] if isinstance(preset, str) else preset
+        # Cross-host KV gather plumbing: part handles are object-plane
+        # refs into OTHER replicas' arenas (published through the
+        # replica directory); the blocking fetch and the async prefetch
+        # both resolve via ray_tpu.get — a swarm-plane bulk pull when
+        # the holder is remote.  The prefetch pool is what overlaps the
+        # gather with decode compute (the engine kicks it before the
+        # attention loop touches the parts).
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="kv-gather")
         self.engine = LLMEngine(cfg, max_batch=max_batch, max_len=max_len,
                                 seed=seed, mesh=mesh, page_size=page_size,
                                 kv_pages=kv_pages,
-                                prefix_cache=prefix_cache)
+                                prefix_cache=prefix_cache,
+                                sp_degree=sp_degree,
+                                sp_strategy=sp_strategy,
+                                prefill_chunk=prefill_chunk,
+                                kv_gather_window=kv_gather_window,
+                                kv_fetch=self._kv_fetch,
+                                kv_prefetch=self._kv_prefetch)
+        self.paged_span = int(paged_span)
         self.defaults = SamplingParams(max_tokens=max_tokens,
                                        temperature=temperature,
                                        eos_id=eos_id)
@@ -123,8 +144,77 @@ class EngineReplica:
         self._expired = 0
         self._completed = 0
         self._tokens_out = 0
+        self._kv_broken = 0
+        self._gauges = None
+        self._last_gauge_flush = 0.0
 
     # ------------------------------------------------------------ helpers --
+    def _kv_fetch(self, handle):
+        """Blocking KV-part resolve (engine gather window, executor
+        thread): by-value dicts pass through; refs pull from the holding
+        arena — remote pulls ride the swarm plane via the owner's
+        replica directory location hints."""
+        if isinstance(handle, dict):
+            return handle
+        import ray_tpu
+        return ray_tpu.get(handle, timeout=60.0)
+
+    def _kv_prefetch(self, handle):
+        """Async KV-part warm (returns a Future with .result()): runs on
+        the gather pool so the pull overlaps decode compute."""
+        import concurrent.futures
+        if isinstance(handle, dict):
+            f: concurrent.futures.Future = concurrent.futures.Future()
+            f.set_result(handle)
+            return f
+        import ray_tpu
+        return self._fetch_pool.submit(ray_tpu.get, handle, timeout=60.0)
+
+    def _flush_gauges(self) -> None:
+        """Node-labeled KV/cache/gather gauges into the unified metrics
+        export (the core worker's telemetry flush ships
+        util.metrics.registry_snapshot()); throttled to ~1 Hz so the
+        decode tick never pays metric overhead."""
+        now = time.monotonic()
+        if now - self._last_gauge_flush < 1.0:
+            return
+        self._last_gauge_flush = now
+        try:
+            if self._gauges is None:
+                import ray_tpu
+                from ..util.metrics import Gauge
+                try:
+                    nid = ray_tpu.get_runtime_context().node_id
+                    node = nid.hex() if isinstance(nid, bytes) else str(nid)
+                except Exception:
+                    node = "driver"
+                tags = {"node_id": node}
+                self._gauges = {
+                    "occ": Gauge("ray_tpu_llm_kv_page_occupancy",
+                                 "KV page-pool occupancy (0..1)",
+                                 ("node_id",)).set_default_tags(tags),
+                    "hit": Gauge("ray_tpu_llm_prefix_cache_hit_rate",
+                                 "prefix-cache hit rate (0..1)",
+                                 ("node_id",)).set_default_tags(tags),
+                    "gbytes": Gauge("ray_tpu_llm_kv_gather_bytes",
+                                    "remote KV part bytes gathered",
+                                    ("node_id",)).set_default_tags(tags),
+                    "gwait": Gauge("ray_tpu_llm_kv_gather_wait_s",
+                                   "blocking remote-KV gather wait (s)",
+                                   ("node_id",)).set_default_tags(tags),
+                }
+            e = self.engine
+            self._gauges["occ"].set(e.kv_page_occupancy())
+            cs = e.prefix_cache_stats()
+            if cs.get("enabled"):
+                total = cs["hits"] + cs["misses"]
+                self._gauges["hit"].set(cs["hits"] / total if total else 0.0)
+            gs = e.kv_gather_stats()
+            self._gauges["gbytes"].set(gs["bytes"])
+            self._gauges["gwait"].set(gs["wait_s"])
+        except Exception:       # metrics must never sink the decode loop
+            pass
+
     def _params(self, opts: Optional[dict]) -> SamplingParams:
         o = opts or {}
         d = self.defaults
@@ -193,6 +283,7 @@ class EngineReplica:
                                                self.engine.active_requests
                                                + len(done))
                         self._fan_out(self.engine.take_tick_events(), done)
+                        self._flush_gauges()
                 if not self.engine.has_unfinished():
                     self._wake.clear()
                     await self._wake.wait()
@@ -248,6 +339,25 @@ class EngineReplica:
             meta = self._meta.get(rid)
             if meta is not None and not meta.get("finished"):
                 meta["finished"] = True
+                q = self._waiters.get(rid)
+                if req.finish_reason == "error" and req.error is not None:
+                    # Mid-decode loss of a KV-holding host: the engine
+                    # retired the request typed (KVGatherError, pages
+                    # already back in the pool) and never emitted a
+                    # wrong token.  Surface the SAME mid-stream contract
+                    # as a replica death: StreamBrokenError carrying
+                    # tokens_emitted, cause chained for diagnosis.
+                    self._kv_broken += 1
+                    rec.instant("request", "request:kv_broken",
+                                id=rid.to_bytes(8, "little"),
+                                tokens=len(req.out))
+                    if q is not None:
+                        err = StreamBrokenError(
+                            f"remote KV lost mid-decode: {req.error}",
+                            tokens_emitted=len(req.out))
+                        err.__cause__ = req.error
+                        q.put_nowait(err)
+                    continue
                 self._completed += 1
                 self._tokens_out += len(req.out)
                 # SERVICE time (admission -> finish), not enqueue ->
@@ -256,7 +366,6 @@ class EngineReplica:
                 dur = time.monotonic() - meta.get("t_adm",
                                                   meta["t_mono"])
                 self._req_s_ema += 0.2 * (dur - self._req_s_ema)
-                q = self._waiters.get(rid)
                 if q is not None:
                     q.put_nowait(_StreamEnd(req.finish_reason,
                                             len(req.out)))
@@ -516,6 +625,104 @@ class EngineReplica:
                 out.append(item)
         return {"tokens": out, "finish_reason": reason}
 
+    # ------------------------------------------ cross-host paged KV (SP) ---
+    async def prefill_paged_chunk(self, req: dict) -> dict:
+        """ONE sequence-parallel prefill shard's unit of work: compute a
+        chunk's KV stripe against the already-published context parts
+        (pulled through the gather window — cross-host when a part lives
+        in a peer shard's arena), publish the stripe into THIS replica's
+        arena, and return only its 20-byte ref.  ``req = {"chunk",
+        "pos0", "parts", "span", "is_last", "opts"}``; the returned part
+        dict drops straight into the next shard's ``parts`` list and
+        into the decode handoff.  The LAST chunk also samples the
+        prompt's first output token (its queries end at the prompt's
+        real last token).  serve_patterns.LongContextApp round-robins
+        these across N shard replicas so no single node's arena (or
+        pool) ever holds the whole context."""
+        import ray_tpu
+        chunk = list(req["chunk"])
+        pos0 = int(req["pos0"])
+        span = int(req.get("span") or self.paged_span)
+        parts = list(req.get("parts") or [])
+        is_last = bool(req.get("is_last"))
+        if deadlines.expired():
+            raise DeadlineExceededError(
+                "deadline exceeded before prefill chunk started")
+        loop = asyncio.get_running_loop()
+        first = None
+        async with self._lock:
+            part, logits = await loop.run_in_executor(
+                None, lambda: self.engine.prefill_paged_chunk(
+                    chunk, pos0, parts, span=span, is_last=is_last))
+            if is_last and logits is not None:
+                # Inside the lock: sampling advances the engine RNG and
+                # blocks on a device->host pull — both must not race the
+                # decode loop's ticks (the one-FIFO-lock invariant).
+                params = self._params(req.get("opts"))
+                first = await loop.run_in_executor(
+                    None, lambda: self.engine.sample_first(logits, params))
+        out = {"span": (pos0, pos0 + len(chunk)),
+               "handle": ray_tpu.put(part)}
+        if first is not None:
+            out["first"] = int(first)
+        return out
+
+    async def prefill_paged_handoff(self, req: dict) -> dict:
+        """Whole-prompt streamed chunked prefill on this one replica —
+        the single-shard form of the paged path: every stripe is
+        published into this replica's arena and the handoff carries only
+        refs, so the decode side pulls arena-to-arena and the proxy
+        never touches KV bytes.  ``req = {"prompt", "opts", "span"?}``;
+        returns ``{"parts", "len", "first", "opts"}`` for
+        :meth:`decode_paged` / :meth:`admit_paged`."""
+        import ray_tpu
+        prompt = list(req["prompt"])
+        opts = req.get("opts") or {}
+        span = int(req.get("span") or self.paged_span)
+        params = self._params(opts)
+        if deadlines.expired():
+            raise DeadlineExceededError(
+                "deadline exceeded before prefill started")
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            handoff = await loop.run_in_executor(
+                None, lambda: self.engine.prefill_paged(
+                    prompt, params, span=span,
+                    publish=lambda part: ray_tpu.put(part)))
+        handoff["opts"] = opts
+        return handoff
+
+    async def admit_paged(self, handoff: dict) -> int:
+        """Admit a paged handoff (context KV in external parts — local
+        or REMOTE arenas) into the continuous batch through the SAME
+        deadline-aware, shed-bounded queue as every other request;
+        returns the request id for :meth:`collect` /
+        :meth:`collect_stream`.  Only the decode tail occupies this
+        node's pool pages."""
+        params = self._params(handoff.get("opts"))
+        deadline = deadlines.get()
+        rec = flight_recorder.recorder()
+        async with self._lock:
+            self._maybe_shed(deadline)
+            rid = self.engine.add_paged_request(
+                handoff["parts"], handoff["len"], handoff["first"],
+                params, prompt_tokens=handoff.get("prompt"))
+            q: asyncio.Queue = asyncio.Queue()
+            self._waiters[rid] = q
+            self._meta[rid] = {"deadline": deadline, "t0": rec.begin(),
+                               "t_mono": time.monotonic(),
+                               "admitted": False, "finished": False}
+        self._ensure_loop()
+        self._wake.set()
+        return rid
+
+    async def decode_paged(self, handoff: dict) -> Dict[str, Any]:
+        """Decode a paged handoff to completion.  A KV part whose host
+        is lost mid-decode raises :class:`StreamBrokenError` (carrying
+        ``tokens_emitted``) out of this call — never a wrong token."""
+        rid = await self.admit_paged(handoff)
+        return await self.collect(rid)
+
     # ------------------------------------------------------------- introspect
     async def debug_stats(self) -> Dict[str, Any]:
         e = self.engine
@@ -523,12 +730,14 @@ class EngineReplica:
                 "shed": self._shed, "cancelled": self._cancelled,
                 "expired": self._expired, "completed": self._completed,
                 "tokens_out": self._tokens_out,
+                "kv_broken": self._kv_broken,
                 "queue_depth": e.queue_depth,
                 "active": e.active_requests,
                 "kv_pages_free": e.kv_pages_free(),
                 "kv_pages_total": e.kv_pages_total,
                 "load": self.__serve_load__(),
-                "prefix_cache": e.prefix_cache_stats()}
+                "prefix_cache": e.prefix_cache_stats(),
+                "kv_gather": e.kv_gather_stats()}
 
     async def pid(self) -> int:
         import os

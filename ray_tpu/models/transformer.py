@@ -50,6 +50,11 @@ class TransformerConfig:
     # "flash" = Pallas TPU kernel (ops/flash_attention.py);
     # "ring" = ring attention over the sp axis (ops/ring_attention.py).
     attention_impl: str = "xla"
+    # Sequence-parallel degree for the LLM engine's prefill attention
+    # (llm/sequence_parallel.py): >1 shards prefill over an `sp` mesh
+    # axis (ring attention / Ulysses).  Must be a power of two; the
+    # engine builds a local sp mesh when none is passed.  1 = off.
+    sp_degree: int = 1
 
     @property
     def head_dim_(self) -> int:

@@ -772,6 +772,67 @@ def bench_pd_serving_tokens_per_s(min_time_s: float) -> float:
         "serving_pd_tokens_per_s_per_replica"]
 
 
+# Long-context benches: sequence-parallel prefill tokens/s (degree 1 vs
+# N A/B) and paged cross-host TTFT.  Run in a SUBPROCESS with forced
+# host devices (`python -m ray_tpu.llm.sequence_parallel --bench`): the
+# sp mesh needs >=4 devices and XLA_FLAGS must be set before jax
+# initializes, which this process cannot guarantee (it may already hold
+# a 1-device backend).  No cluster involvement — treated like framer_
+# benches in run_microbenchmarks.
+_long_context_cache: Dict[str, float] = {}
+
+
+def _long_context_report(min_time_s: float) -> Dict[str, float]:
+    if _long_context_cache:
+        return _long_context_cache
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.llm.sequence_parallel",
+             "--bench", "--degree", "4", "--tokens", "512",
+             "--iters", str(max(2, int(min_time_s)))],
+            env=env, capture_output=True, text=True, timeout=240,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        _long_context_cache.update({
+            "sp_prefill_tokens_per_s": row["sp_prefill_tokens_per_s"],
+            "sp_prefill_tokens_per_s_base":
+                row["sp_prefill_tokens_per_s_base"],
+            "sp_speedup": row["sp_speedup"],
+            "long_context_ttft_ms": row["long_context_ttft_ms"]})
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging
+        logging.getLogger(__name__).warning(
+            "long-context bench failed: %s", e)
+        _long_context_cache.update({
+            "sp_prefill_tokens_per_s": 0.0,
+            "sp_prefill_tokens_per_s_base": 0.0,
+            "sp_speedup": 0.0,
+            "long_context_ttft_ms": 0.0})
+    return _long_context_cache
+
+
+def bench_sp_prefill_tokens_per_s(min_time_s: float) -> float:
+    return _long_context_report(min_time_s)["sp_prefill_tokens_per_s"]
+
+
+def bench_long_context_ttft(min_time_s: float) -> float:
+    return _long_context_report(min_time_s)["long_context_ttft_ms"]
+
+
+def bench_sp_prefill_base(min_time_s: float) -> float:
+    """Ungated A/B reference row: the SAME prompt through the
+    single-device _prefill_fn (sp_degree=1) in the same subprocess."""
+    return _long_context_report(min_time_s)[
+        "sp_prefill_tokens_per_s_base"]
+
+
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     from ray_tpu.util import placement_group, remove_placement_group
 
@@ -823,6 +884,11 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "chained_pipeline_steps_per_s": bench_chained_pipeline_steps,
     "serving_pd_ttft_p50_ms": bench_pd_serving_ttft,
     "serving_pd_tokens_per_s_per_replica": bench_pd_serving_tokens_per_s,
+    # Long-context subprocess benches (forced-host-device SP A/B + paged
+    # cross-host TTFT): no cluster involvement, skip the quiesce dance.
+    "sp_prefill_tokens_per_s": bench_sp_prefill_tokens_per_s,
+    "sp_prefill_tokens_per_s_base": bench_sp_prefill_base,
+    "long_context_ttft_ms": bench_long_context_ttft,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
     "compiled_dag_cross_node_steps_per_s":
@@ -877,6 +943,12 @@ BASELINE = {
     "compiled_dag_cross_node_steps_per_s": 370.0,
     "serving_pd_ttft_p50_ms": 10.5,
     "serving_pd_tokens_per_s_per_replica": 67.0,
+    # Long-context anchors: committed host-class numbers (tiny model, 4
+    # forced host devices; the SP row's in-run A/B base and speedup ride
+    # the bench tail).  TTFT is LOWER-is-better.
+    "sp_prefill_tokens_per_s": 34700.0,
+    "sp_prefill_tokens_per_s_base": 13500.0,
+    "long_context_ttft_ms": 51.0,
 }
 
 UNITS = {
@@ -890,6 +962,12 @@ UNITS = {
         "ms p50 TTFT (compiled P/D, lower is better)",
     "serving_pd_tokens_per_s_per_replica":
         "tok/s/replica (compiled P/D open-loop)",
+    "sp_prefill_tokens_per_s":
+        "tok/s (ring-attention prefill, sp_degree=4, forced host devs)",
+    "sp_prefill_tokens_per_s_base":
+        "tok/s (same prompt, sp_degree=1 — the A/B base, ungated)",
+    "long_context_ttft_ms":
+        "ms TTFT (paged cross-host KV path, lower is better)",
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
@@ -964,10 +1042,21 @@ DAG_METRICS = (
     "compiled_dag_cross_node_steps_per_s",
 )
 
+# Long-context metrics (sequence-parallel prefill + paged cross-host
+# KV), gated with the DATA_PLANE downgrade rules: the subprocess bench
+# needs 4 forced host devices — a 0.0 reading means it couldn't run
+# here and is reported, never gated on; host-fingerprint mismatch
+# downgrades to informational like every absolute gate.
+LONG_CONTEXT_METRICS = (
+    "sp_prefill_tokens_per_s",
+    "long_context_ttft_ms",
+)
+
 # Metrics where SMALLER readings are better (latencies): the gate
 # inverts their ratio so "regression" always means "got worse".
 LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms",
-                             "serving_pd_ttft_p50_ms"})
+                             "serving_pd_ttft_p50_ms",
+                             "long_context_ttft_ms"})
 
 
 def _latest_committed_bench(repo_root: str = "."):
@@ -1076,7 +1165,8 @@ def check_against_committed(min_time_s: float = 2.0,
     host_mismatch = base_host is not None and \
         not _host_matches(base_host, this_host)
     gated = (CONTROL_PLANE_METRICS + AGGREGATE_METRICS
-             + DATA_PLANE_METRICS + SERVING_METRICS + DAG_METRICS)
+             + DATA_PLANE_METRICS + SERVING_METRICS + DAG_METRICS
+             + LONG_CONTEXT_METRICS)
     results = run_microbenchmarks(min_time_s=min_time_s,
                                   only=set(gated))
     failures = []
@@ -1085,7 +1175,8 @@ def check_against_committed(min_time_s: float = 2.0,
             continue
         now, ref = results[name]["value"], committed[name]
         if name in DATA_PLANE_METRICS + SERVING_METRICS \
-                + AGGREGATE_METRICS + DAG_METRICS and (not now or not ref):
+                + AGGREGATE_METRICS + DAG_METRICS \
+                + LONG_CONTEXT_METRICS and (not now or not ref):
             # 0.0 = the bench couldn't spawn its extra agents here (or
             # the baseline predates the metric): report, never gate.
             print(json.dumps({"metric": name, "now": now,
@@ -1219,14 +1310,19 @@ def run_microbenchmarks(min_time_s: float = 1.0,
     for name, fn in BENCHES.items():
         if only and name not in only:
             continue
-        if name.startswith("framer_"):
-            # Loopback-only micro bench: no cluster involvement, so the
-            # quiesce/warmup dance below would be pure dead time.
+        if name.startswith("framer_") or name in LONG_CONTEXT_METRICS \
+                or name == "sp_prefill_tokens_per_s_base":
+            # Loopback-only / subprocess micro bench: no cluster
+            # involvement, so the quiesce/warmup dance below would be
+            # pure dead time.
             rate = fn(min_time_s)
+            vs_ref = (BASELINE[name] / rate
+                      if name in LOWER_IS_BETTER and rate
+                      else rate / BASELINE[name])
             results[name] = {
                 "value": round(rate, 2),
                 "unit": UNITS.get(name, "ops/s"),
-                "vs_ref": round(rate / BASELINE[name], 3),
+                "vs_ref": round(vs_ref, 3),
             }
             continue
         # Quiesce: let the previous bench's lease returns / worker

@@ -82,6 +82,13 @@ def pytest_configure(config):
         "dag: compiled actor pipelines (aDAG) over mutable shm "
         "channels — same-node futex rings, agent-bridged cross-node "
         "edges, channel-lowered collectives, typed failure semantics")
+    config.addinivalue_line(
+        "markers",
+        "sp: long-context engine — sequence-parallel prefill attention "
+        "(ring/Ulysses over the forced-host-device mesh) + cross-host "
+        "paged KV; the multi-actor pool-exceeding serve test and the "
+        "KV-host-loss chaos test are additionally marked slow so "
+        "tier-1 keeps completing inside its budget")
     # Build the native RPC framer ONCE at session start so worker/agent
     # processes spawned by cluster fixtures just dlopen the committed or
     # freshly-built .so instead of racing g++ builds.  Failure is fine:
