@@ -31,7 +31,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from . import clocks, loopmon, protocol, rpc
+from . import clocks, diagnosis, loopmon, protocol, rpc
 from . import flight_recorder as frec
 from .config import Config, get_config, set_config
 from .ids import NodeID, WorkerID
@@ -160,6 +160,10 @@ class WorkerHandle:
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
         self.spawned_at = time.monotonic()
+        # Diagnosis plane: grant stamp for the lease-stall detector
+        # (granted-but-never-RUNNING); flagged-once latch per grant.
+        self.lease_granted_at: Optional[float] = None
+        self.lease_stall_flagged = False
         # Blocked-get CPU release (reference: NodeManager::
         # HandleNotifyDirectCallTaskBlocked, node_manager.cc — a worker
         # blocked in ray.get releases its CPU so queued work can run).
@@ -295,6 +299,12 @@ class NodeAgent:
                 # and must never diverge between modes.
                 "ping": self._h_ping,
                 "fetch_chunk": self._sh_fetch_chunk,
+                # CHAOS (diagnosis_chaos_enabled only): wedge the loop
+                # that serves this conn on purpose — the loop-wedge
+                # detector's fault-injection hook.  Registered on the
+                # shard plane so a sharded agent stalls a SHARD thread.
+                **({"debug_stall_loop": self._sh_debug_stall}
+                   if cfg.diagnosis_chaos_enabled else {}),
             })
         self.gcs: Optional[rpc.Connection] = None
         self._spawn_lock = asyncio.Lock()
@@ -370,6 +380,12 @@ class NodeAgent:
             "worker_blocked": self.h_worker_blocked,
             "worker_unblocked": self.h_worker_unblocked,
             "profile_worker": self.h_profile_worker,
+            "node_profile": self.h_node_profile,
+            # Agent's OWN stacks/cpu_profile (diagnosis plane): the
+            # cluster_profile fan-out reaches daemons through these.
+            **diagnosis.profile_handlers("agent"),
+            **({"debug_stall_loop": self._sh_debug_stall}
+               if get_config().diagnosis_chaos_enabled else {}),
             "list_logs": self.h_list_logs,
             "read_log": self.h_read_log,
             "shutdown": self.h_shutdown,
@@ -380,6 +396,14 @@ class NodeAgent:
     def _h_ping(conn, p):
         return {"pong": True, "t1": clocks.wall(), "t2": clocks.wall()}
 
+    def _sh_debug_stall(self, conn, p):
+        """CHAOS (diagnosis_chaos_enabled): block THIS handler's loop
+        thread with a synchronous sleep — a real wedge, not a
+        simulation: the loopmon probe stops ticking, the stale gauge
+        grows, and the watchdog must catch it from its sibling thread."""
+        time.sleep(min(float(p.get("seconds", 2.0)), 30.0))
+        return True
+
     # ------------------------------------------------------------ lifecycle --
     async def start(self) -> tuple:
         addr = await self._server.start_tcp(self.host, 0)
@@ -388,6 +412,15 @@ class NodeAgent:
         # their own under shard<i>): exported per node so single-core
         # daemon saturation is a gauge, not an inference.
         loopmon.install("main")
+        cfg = get_config()
+        if cfg.diagnosis_enabled:
+            self._loop = asyncio.get_running_loop()
+            self._watchdog = diagnosis.Watchdog(
+                daemon_name="agent", node_id=self.node_id.hex(),
+                detectors=[diagnosis.loop_wedge_detector()],
+                notify=self._anomaly_from_thread,
+                poll_s=cfg.diagnosis_poll_ms / 1000.0)
+            self._watchdog.start()
 
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, name="agent->gcs",
@@ -617,12 +650,21 @@ class NodeAgent:
                 self._bytes_pulled, "counter"),
         ]
         # Per-loop busy fractions: main + every I/O shard, node-labeled
-        # (the gcs exports its own under daemon="gcs").
-        for label, ratio in loopmon.snapshot().items():
-            out.append(row("ray_tpu_daemon_loop_busy_ratio", ratio,
+        # (the gcs exports its own under daemon="gcs").  Stale entries
+        # stay in the export with their probe age alongside — a wedged
+        # loop must ALARM in the gauges, not vanish from them.
+        for label, info in loopmon.snapshot_full().items():
+            out.append(row("ray_tpu_daemon_loop_busy_ratio", info["ratio"],
                            labels={**lab, "loop": label},
                            help_="CPU-seconds per wall-second burned by "
                                  "the thread running this event loop"))
+            out.append(row("ray_tpu_daemon_loop_stale_seconds",
+                           info["stale_s"],
+                           labels={**lab, "loop": label},
+                           help_="age of this loop's last busy probe "
+                                 "tick; grows past the ~0.5s period "
+                                 "when the loop stops servicing "
+                                 "callbacks (wedged or stopped)"))
         sst = self._server.shard_stats()
         if sst["shards"]:
             out.append(row("ray_tpu_daemon_io_shard_hops_total",
@@ -671,6 +713,49 @@ class NodeAgent:
                         # loop means no death detection node-wide.
                         logger.exception("lease sweep failed for %s",
                                          lease_id.hex()[:8])
+            try:
+                await self._check_lease_stalls()
+            except Exception:
+                logger.exception("lease-stall detector pass failed")
+
+    async def _check_lease_stalls(self):
+        """Diagnosis-plane detector: a lease granted long ago whose
+        worker has started ZERO tasks since the grant (and runs none
+        now) — the owner wedged before pushing, or the push vanished.
+        Probed via the worker's exec_stats (AGES, not timestamps:
+        monotonic clocks don't compare across processes); flagged once
+        per grant."""
+        cfg = get_config()
+        if not cfg.diagnosis_enabled:
+            return
+        stall_s = cfg.diagnosis_lease_stall_s
+        now = time.monotonic()
+        for lease_id, wh in list(self.leases.items()):
+            if (wh.lease_granted_at is None or wh.lease_stall_flagged
+                    or wh.lease_id is None):
+                continue
+            age = now - wh.lease_granted_at
+            if age < stall_s:
+                continue
+            if wh.conn is None or wh.conn.closed \
+                    or wh.proc.poll() is not None:
+                continue        # dead-worker path handles these
+            try:
+                st = await wh.conn.call("exec_stats", {}, timeout=5)
+            except rpc.RpcError:
+                continue
+            if wh.lease_id is None or wh.lease_stall_flagged:
+                continue        # released/flagged while we awaited
+            started_age = st.get("last_task_started_age_s")
+            never_ran = started_age is None or started_age > age
+            if st.get("running") or not never_ran:
+                continue
+            wh.lease_stall_flagged = True
+            diagnosis.record_anomaly(
+                "lease_stalled", daemon="agent",
+                node_id=self.node_id.hex(), notify=self._send_anomaly,
+                lease_id=lease_id.hex(), worker_id=wh.worker_id.hex(),
+                lease_age_s=round(age, 3))
 
     async def _memory_monitor_loop(self):
         """Kill-by-policy when node memory crosses the threshold
@@ -877,6 +962,16 @@ class NodeAgent:
             # clock, so an injected skew must reach the workers too or
             # the node's own telemetry would disagree with itself.
             env.setdefault("RAY_TPU_clock_skew_s", str(skew))
+        # The task-hung watchdog runs IN the worker: its thresholds must
+        # reach worker processes too (their config builds from env;
+        # _system_config stops at the daemons' argv).
+        dcfg = get_config()
+        for _k in ("diagnosis_enabled", "diagnosis_poll_ms",
+                   "diagnosis_task_hang_multiple",
+                   "diagnosis_task_hang_min_s",
+                   "diagnosis_task_hang_default_s",
+                   "diagnosis_serving_silence_s"):
+            env.setdefault(f"RAY_TPU_{_k}", str(getattr(dcfg, _k)))
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_AGENT_ADDR"] = json.dumps(list(self.address))
         env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs_address))
@@ -1206,6 +1301,8 @@ class NodeAgent:
         wh.lease_resources = resources
         wh.lease_bundle = bundle_key
         wh.lease_owner_conn = conn
+        wh.lease_granted_at = time.monotonic()
+        wh.lease_stall_flagged = False
         self.leases[lease_id] = wh
         if p.get("prefetch"):
             # Arg prefetch: start pulling the lease's missing large
@@ -1415,6 +1512,80 @@ class NodeAgent:
                 {"error": str(res)} if isinstance(res, BaseException)
                 else res)
         return out
+
+    async def h_node_profile(self, conn, p):
+        """Whole-node live profile for the GCS cluster_profile fan-out:
+        the agent's own stacks/CPU profile + every live worker's,
+        sampled CONCURRENTLY so the node is one coherent time window.
+        A worker dying mid-profile yields a typed per-worker error
+        entry, never a failed fan-out."""
+        kind = p.get("kind", "stacks")
+        if kind not in ("stacks", "cpu_profile"):
+            raise rpc.RpcError(f"unknown profile kind {kind!r}")
+        pid = p.get("pid")
+        payload = {"duration_s": p.get("duration_s", 2.0),
+                   "interval_s": p.get("interval_s", 0.01)}
+
+        async def _self_profile():
+            try:
+                if kind == "stacks":
+                    r = diagnosis.dump_stacks()
+                else:
+                    r = await diagnosis.cpu_profile(payload["duration_s"],
+                                                    payload["interval_s"])
+                r["daemon"] = "agent"
+                return r
+            except Exception as e:  # noqa: BLE001 — typed entry, not a crash
+                return {"error": str(e)}
+
+        async def _one_worker(wid, wh):
+            try:
+                return wid, await wh.conn.call(
+                    kind, payload,
+                    timeout=float(payload["duration_s"]) + 30)
+            except Exception as e:  # noqa: BLE001
+                return wid, {"error": str(e)}
+
+        targets = []
+        for wid, wh in self.workers.items():
+            if wh.conn is None or wh.conn.closed \
+                    or wh.proc.poll() is not None:
+                continue
+            if pid is not None and wh.proc.pid != int(pid):
+                continue
+            targets.append((wid, wh))
+        include_agent = pid is None or int(pid) == os.getpid()
+        coros = [_one_worker(wid, wh) for wid, wh in targets]
+        if include_agent:
+            coros.append(_self_profile())
+        results = await asyncio.gather(*coros)
+        out = {"node_id": self.node_id.hex(), "workers": {}}
+        if include_agent:
+            out["agent"] = results.pop()
+        for wid, res in results:
+            out["workers"][wid.hex()] = res
+        return out
+
+    # ------------------------------------------------------- diagnosis ---
+    def _send_anomaly(self, info: dict) -> None:
+        """Best-effort forward to the GCS anomaly sink (triggers the
+        black-box capture); the counter + recorder event were already
+        emitted process-locally by record_anomaly."""
+        if self.gcs is None or self.gcs.closed:
+            return
+        try:
+            self.gcs.notify("report_anomaly", info)
+        except rpc.RpcError:
+            pass
+
+    def _anomaly_from_thread(self, info: dict) -> None:
+        loop = getattr(self, "_loop", None)
+        if loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(self._send_anomaly, info)
+        except RuntimeError:
+            pass
 
     def _recycle_worker(self, wh: WorkerHandle):
         """Return a no-longer-leased worker to its idle pool, or

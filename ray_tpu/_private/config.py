@@ -265,7 +265,8 @@ _define("flight_recorder_capacity", 4096,
         "ray_tpu_flight_recorder_dropped_total)")
 _define("flight_recorder_categories", "",
         "comma-separated category gate for the flight recorder "
-        "(lease,transfer,sched); empty = all categories on")
+        "(lease,transfer,sched,request,anomaly); empty = all "
+        "categories on")
 _define("flight_recorder_sample_n", 1,
         "record 1 of every N instant events per category (spans are "
         "never sampled away); 1 = record everything")
@@ -285,6 +286,55 @@ _define("metrics_export_enabled", True,
         "recorder drops) to the GCS on its heartbeat/telemetry tick; "
         "the dashboard /metrics exposition then carries node_id-labeled "
         "series for every node")
+
+# ---- observability: diagnosis plane (watchdogs + black-box capture) ---------
+_define("diagnosis_enabled", True,
+        "run the per-daemon hung-work watchdogs (wedged event loops, "
+        "tasks RUNNING past their historical p95, leases "
+        "granted-but-never-RUNNING, serving requests "
+        "admitted-but-token-silent); each detector emits a typed "
+        "`anomaly` flight-recorder event and a ray_tpu_anomaly_total "
+        "counter (reference spirit: Google-Wide Profiling / Tail at "
+        "Scale always-on anomaly capture)")
+_define("diagnosis_poll_ms", 500,
+        "watchdog thread poll period; detectors are O(tracked work) "
+        "dict scans, so this bounds detection latency, not overhead")
+_define("diagnosis_loop_wedge_s", 5.0,
+        "a loopmon entry stale at least this long while its thread is "
+        "still alive is a WEDGED loop (not a stopped one) -> dump its "
+        "stack via sys._current_frames from the watchdog thread")
+_define("diagnosis_task_hang_multiple", 20.0,
+        "a task RUNNING longer than this multiple of its function's "
+        "historical p95 (EMA over completed runs) is flagged hung")
+_define("diagnosis_task_hang_min_s", 10.0,
+        "floor on the per-function hang threshold so short functions "
+        "with microsecond p95s don't flap")
+_define("diagnosis_task_hang_default_s", 120.0,
+        "hang threshold for functions with no completion history yet")
+_define("diagnosis_lease_stall_s", 15.0,
+        "a lease granted this long ago whose worker has started zero "
+        "tasks since the grant (and runs none now) is a stalled lease "
+        "-> the owner likely wedged or the push never arrived")
+_define("diagnosis_serving_silence_s", 15.0,
+        "a serving request admitted into a decode batch but token-silent "
+        "this long is flagged (decode loop wedged or request starved)")
+_define("anomaly_capture_enabled", True,
+        "when a detector fires, the GCS snapshots the implicated nodes "
+        "(recorder drain, stacks, CPU profile, metrics, node views) "
+        "into a diag-<kind>-<ts>/ black-box bundle")
+_define("diagnosis_capture_dir", "",
+        "bundle output directory; empty = <session_dir>/diagnosis")
+_define("diagnosis_capture_min_interval_s", 60.0,
+        "per-anomaly-kind rate limit on bundle capture: a flapping "
+        "detector keeps counting but cannot DoS the cluster with "
+        "bundle I/O inside this window")
+_define("diagnosis_capture_profile_s", 2.0,
+        "CPU-profile sampling window captured into each bundle")
+_define("diagnosis_capture_max_bundles", 20,
+        "oldest bundles are pruned beyond this many (disk bound)")
+_define("diagnosis_chaos_enabled", False,
+        "CHAOS: expose debug handlers that wedge daemon loops on "
+        "purpose (tests only; never enable in production)")
 
 # ---- TPU specifics ----------------------------------------------------------
 _define("tpu_chips_per_host_default", 4)

@@ -249,6 +249,9 @@ class CoreWorker:
         self.executor = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="ray_tpu_exec")
         self._shutdown = False
+        # Hung-task tracker (diagnosis plane); armed by the worker main
+        # when diagnosis_enabled — record_task_event feeds it.
+        self._diag_tracker = None
         cfg = get_config()
         self._inline_limit = cfg.max_direct_call_object_size
         self._max_inflight = max(PIPELINE_DEPTH,
@@ -670,6 +673,8 @@ class CoreWorker:
             self._task_events_dropped += 1
         self._task_events.append(
             (task_id, name, event, clocks.wall(), extra or None))
+        if self._diag_tracker is not None:
+            self._diag_tracker.note(task_id, name, event)
 
     async def _telemetry_flush_loop(self):
         """Periodic push of buffered task events + metric deltas to the

@@ -25,6 +25,9 @@ Categories ("plane" granularity, gated via config
     request    LLM serving lifecycle (llm/serving.py + llm/engine.py):
                request:admit, prefill (w/ cached_tokens), decode
                (per tick, w/ batch), sample_sync, request:cancelled
+    anomaly    diagnosis-plane detector firings (_private/diagnosis.py):
+               loop_wedged, task_hung, lease_stalled, serving_silent —
+               rendered as global instant marks on the timeline
 
 Overflow drops the OLDEST record and counts it (`dropped`) — the
 counter is exported as a metric and stamped into every flush, so a

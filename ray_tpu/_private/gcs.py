@@ -24,8 +24,9 @@ import struct
 import time
 from typing import Any, Dict, List, Optional
 
-from . import clocks, loopmon, protocol, rpc
+from . import clocks, diagnosis, loopmon, protocol, rpc
 from . import scheduling_policy as policy
+from .config import get_config
 
 logger = logging.getLogger("ray_tpu.gcs")
 
@@ -288,6 +289,15 @@ class GcsServer:
             # single-loop mode must answer ping identically.
             shard_handlers={"ping": _h_ping})
         self._health_task: Optional[asyncio.Task] = None
+        # Diagnosis plane: anomaly sink (detector firings reported by
+        # every daemon/worker + the GCS's own watchdog), GCS-origin
+        # anomaly counts for _self_metrics, and the black-box capture
+        # manager (armed in start(); rate-limited per kind).
+        self._anomalies: _deque = _deque(maxlen=256)
+        self._anomaly_counts: Dict[str, int] = {}
+        self._capture_mgr = None
+        self._watchdog = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def _handlers(self):
         return {
@@ -320,6 +330,15 @@ class GcsServer:
             "get_cluster_info": self.h_get_cluster_info,
             "report_demand": self.h_report_demand,
             "get_demand": self.h_get_demand,
+            # Diagnosis plane: cluster-wide live introspection + anomaly
+            # sink + black-box capture (docs/observability.md §5).
+            "cluster_profile": self.h_cluster_profile,
+            "report_anomaly": self.h_report_anomaly,
+            "get_anomalies": self.h_get_anomalies,
+            "capture": self.h_capture,
+            # The GCS's OWN stacks/cpu_profile, same handler names every
+            # other process serves (diagnosis.profile_handlers).
+            **diagnosis.profile_handlers("gcs"),
         }
 
     def _mark_view_dirty(self, node: NodeInfo) -> None:
@@ -503,8 +522,9 @@ class GcsServer:
             "value": float(self._events_dropped_total())}]
         # Per-loop busy fractions (loopmon): single-core saturation of
         # the GCS main loop — or of any I/O shard — is a gauge, not an
-        # inference from host CPU.
-        for label, ratio in loopmon.snapshot().items():
+        # inference from host CPU.  Stale entries stay visible with
+        # their probe age: a wedged loop alarms instead of vanishing.
+        for label, info in loopmon.snapshot_full().items():
             out.append({
                 "name": "ray_tpu_daemon_loop_busy_ratio",
                 "labels": {"daemon": "gcs", "loop": label},
@@ -512,7 +532,25 @@ class GcsServer:
                 "help": "CPU-seconds per wall-second burned by the "
                         "thread running this event loop (1.0 = one "
                         "core saturated)",
-                "value": ratio})
+                "value": info["ratio"]})
+            out.append({
+                "name": "ray_tpu_daemon_loop_stale_seconds",
+                "labels": {"daemon": "gcs", "loop": label},
+                "type": "gauge",
+                "help": "age of this loop's last busy probe tick; "
+                        "grows past the ~0.5s period when the loop "
+                        "stops servicing callbacks",
+                "value": info["stale_s"]})
+        # GCS-origin detector firings (its own watchdog); every other
+        # process exports its ray_tpu_anomaly_total through its own
+        # registry snapshot, so totals never double-count.
+        for kind, count in self._anomaly_counts.items():
+            out.append({
+                "name": "ray_tpu_anomaly_total",
+                "labels": {"daemon": "gcs", "kind": kind, "node_id": ""},
+                "type": "counter",
+                "help": "hung-work detector firings by kind",
+                "value": float(count)})
         st = self._server.shard_stats()
         if st["shards"]:
             out.append({
@@ -550,6 +588,191 @@ class GcsServer:
                 "value": node.suspicion})
         return out
 
+    # ------------------------------------------------------- diagnosis --
+    # (docs/observability.md §5: cluster-wide live introspection, the
+    # anomaly sink, and anomaly-triggered black-box capture bundles.)
+
+    async def h_cluster_profile(self, conn, p):
+        """Cluster-wide stacks/CPU profile: fans out through every
+        agent's node_profile (agent + its workers, concurrently) plus
+        the GCS's own process, and stamps each node's clock offset so
+        renderers can align cross-node samples.  Selectors: node_id
+        (hex prefix), pid, job_id (hex prefix -> the nodes that job's
+        tasks touched)."""
+        kind = p.get("kind", "stacks")
+        if kind not in ("stacks", "cpu_profile"):
+            raise rpc.RpcError(f"unknown profile kind {kind!r}")
+        return await self._cluster_profile(kind, p)
+
+    async def _cluster_profile(self, kind: str, p: dict) -> dict:
+        duration = float(p.get("duration_s", 2.0))
+        interval = p.get("interval_s", 0.01)
+        sel_node = p.get("node_id")
+        sel_pid = p.get("pid")
+        node_filter = None
+        if p.get("job_id"):
+            # Job selection is node-granular: workers are pooled across
+            # jobs, so profile every node the job's task events touched.
+            node_filter = {
+                e["node_id"].hex()
+                for e in self._expanded_task_events()
+                if e.get("node_id")
+                and e.get("job_id")
+                and e["job_id"].hex().startswith(p["job_id"])}
+        targets = []
+        for node in self.nodes.values():
+            if not node.alive or node.conn is None or node.conn.closed:
+                continue
+            hexid = node.node_id.hex()
+            if sel_node and not hexid.startswith(str(sel_node)):
+                continue
+            if node_filter is not None and hexid not in node_filter:
+                continue
+            targets.append(node)
+        payload = {"kind": kind, "duration_s": duration,
+                   "interval_s": interval}
+        if sel_pid is not None:
+            payload["pid"] = int(sel_pid)
+
+        async def _gcs_self():
+            try:
+                if kind == "stacks":
+                    r = diagnosis.dump_stacks()
+                else:
+                    r = await diagnosis.cpu_profile(duration, interval)
+                r["daemon"] = "gcs"
+                return r
+            except Exception as e:  # noqa: BLE001 — typed, not fatal
+                return {"error": str(e)}
+
+        async def _one_node(node):
+            try:
+                return node, await node.conn.call(
+                    "node_profile", payload, timeout=duration + 30)
+            except Exception as e:  # noqa: BLE001 — per-node error entry
+                return node, {"error": str(e)}
+
+        # The GCS isn't a node: include it unless a selector narrows
+        # the sweep.  Everything samples CONCURRENTLY — one coherent
+        # cluster-wide time window.
+        include_gcs = not (sel_node or sel_pid or node_filter is not None)
+        coros = [_one_node(n) for n in targets]
+        if include_gcs:
+            gcs_task = asyncio.ensure_future(_gcs_self())
+        results = await asyncio.gather(*coros)
+        out = {"kind": kind, "duration_s": duration,
+               "ts": clocks.wall(), "nodes": {}}
+        if include_gcs:
+            out["gcs"] = await gcs_task
+        for node, res in results:
+            res = dict(res) if isinstance(res, dict) else {"error": str(res)}
+            res["clock_offset_s"] = node.clock.offset
+            res["clock_err_bound_s"] = node.clock.error_bound()
+            out["nodes"][node.node_id.hex()] = res
+        return out
+
+    async def h_report_anomaly(self, conn, p):
+        self._ingest_anomaly(dict(p))
+        return True
+
+    async def h_get_anomalies(self, conn, p):
+        out = list(self._anomalies)
+        if p.get("kind"):
+            out = [a for a in out if a.get("kind") == p["kind"]]
+        return out[-int(p.get("limit", 256)):]
+
+    async def h_capture(self, conn, p):
+        """Manual black-box capture (`ray_tpu capture`): same bundle as
+        an anomaly trigger, force bypasses the per-kind rate limit."""
+        kind = p.get("kind", "manual")
+        path = await self._capture_bundle(
+            kind, {"kind": kind, "daemon": "manual", "trigger": "rpc"},
+            force=bool(p.get("force", True)))
+        return {"captured": path is not None, "path": path,
+                "suppressed": dict(self._capture_mgr.suppressed)
+                if self._capture_mgr else {}}
+
+    def _ingest_anomaly(self, info: dict) -> None:
+        """Anomaly sink: every detector firing cluster-wide lands here
+        (workers/agents via report_anomaly notifies, the GCS's own
+        watchdog via the thread-safe callback).  Counted, published,
+        overlaid on the timeline, and — rate-limited — captured."""
+        info.setdefault("ts", time.time())
+        self._anomalies.append(info)
+        kind = info.get("kind", "unknown")
+        if info.get("daemon") == "gcs":
+            # Reporters export their own ray_tpu_anomaly_total through
+            # their registry snapshots; the GCS has no registry export,
+            # so its firings are counted here (see _self_metrics) —
+            # counting reported ones too would double them.
+            self._anomaly_counts[kind] = \
+                self._anomaly_counts.get(kind, 0) + 1
+            # ... and its recorder ring is never drained, so feed the
+            # timeline sink directly (reported anomalies arrive as
+            # recorder instants in the normal telemetry drains).
+            wall = clocks.wall()
+            self.task_events.append({
+                "task_id": b"", "name": f"anomaly:{kind}",
+                "event": "SPAN", "cat": "anomaly", "ts": wall,
+                "start_us": int(wall * 1e6), "dur_us": 0,
+                "worker_id": b"", "node_id": b"", "job_id": b"",
+                "args": {k: v for k, v in info.items()
+                         if k not in ("stack",) and
+                         isinstance(v, (str, int, float, bool))}})
+        self._publish("anomaly", {
+            k: v for k, v in info.items() if k != "stack"})
+        if get_config().anomaly_capture_enabled \
+                and self._capture_mgr is not None:
+            rpc.spawn(self._capture_bundle(kind, info))
+
+    def _anomaly_from_thread(self, info: dict) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(self._ingest_anomaly, info)
+        except RuntimeError:
+            pass
+
+    async def _capture_bundle(self, kind: str, info: dict,
+                              force: bool = False) -> Optional[str]:
+        """One `diag-<kind>-<ts>/` bundle: stacks + short CPU profile of
+        the implicated nodes (all nodes if the anomaly names none),
+        merged metrics, node views (suspicion/clock state included),
+        the task-event/recorder ring, recent anomalies, and a manifest.
+        Returns the bundle path, or None when rate-limited."""
+        mgr = self._capture_mgr
+        if mgr is None or not mgr.should_capture(kind, force=force):
+            return None
+        cfg = get_config()
+        sel = {}
+        if info.get("node_id"):
+            sel["node_id"] = info["node_id"]
+        try:
+            stacks = await self._cluster_profile("stacks", dict(sel))
+            prof = await self._cluster_profile(
+                "cpu_profile",
+                {**sel, "duration_s": cfg.diagnosis_capture_profile_s})
+        except Exception as e:  # noqa: BLE001 — partial bundle > none
+            stacks = prof = {"error": str(e)}
+        parts = {
+            "stacks": stacks,
+            "cpu_profile": prof,
+            "metrics": await self.h_get_metrics(None, {}),
+            "nodes": [n.view() for n in self.nodes.values()],
+            "recorder": list(self._expanded_task_events())[-2000:],
+            "anomalies": list(self._anomalies),
+        }
+        try:
+            path = mgr.write_bundle(kind, parts, manifest_extra=info)
+        except OSError as e:
+            logger.warning("diagnosis bundle write failed: %s", e)
+            return None
+        logger.warning("diagnosis: captured black-box bundle %s "
+                       "(anomaly kind=%s)", path, kind)
+        self._publish("anomaly", {"kind": kind, "capture_path": path})
+        return path
+
     async def start(self):
         if self.journal_path:
             self._replay(Journal.read(self.journal_path))
@@ -559,6 +782,31 @@ class GcsServer:
         # Busy-fraction probe for the main loop (shards install their
         # own): saturation of the state-mutating loop becomes a gauge.
         loopmon.install("main")
+        cfg = get_config()
+        if cfg.diagnosis_enabled:
+            self._loop = asyncio.get_running_loop()
+            if cfg.anomaly_capture_enabled:
+                root = cfg.diagnosis_capture_dir
+                if not root:
+                    import tempfile
+                    base = (os.path.dirname(self.journal_path)
+                            if self.journal_path else
+                            os.path.join(tempfile.gettempdir(), "ray_tpu"))
+                    root = os.path.join(base, "diagnosis")
+                try:
+                    os.makedirs(root, exist_ok=True)
+                    self._capture_mgr = diagnosis.CaptureManager(
+                        root,
+                        min_interval_s=cfg.diagnosis_capture_min_interval_s,
+                        max_bundles=cfg.diagnosis_capture_max_bundles)
+                except OSError as e:
+                    logger.warning("diagnosis capture disabled: %s", e)
+            self._watchdog = diagnosis.Watchdog(
+                daemon_name="gcs",
+                detectors=[diagnosis.loop_wedge_detector()],
+                notify=self._anomaly_from_thread,
+                poll_s=cfg.diagnosis_poll_ms / 1000.0)
+            self._watchdog.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
         # Re-kick interrupted placement/scheduling loops (their coroutines
         # died with the previous process; agents re-register shortly).

@@ -13,17 +13,25 @@ inferred from host CPU.
 
 Thread model: each probe writes only its own label's slot; `snapshot()`
 reads the dict from any thread (GIL-consistent; values are immutable
-tuples).  Stale entries (a stopped shard) age out of snapshots.
+tuples).  A stale entry is NOT dropped: a loop whose probe stopped
+ticking while its thread is still alive is a WEDGED loop — exactly the
+condition the diagnosis watchdogs alarm on — so `snapshot()` keeps the
+label (frozen ratio) and `snapshot_full()` reports how stale it is
+(`stale_s`, exported as `ray_tpu_daemon_loop_stale_seconds`) plus the
+loop thread's ident so a sibling thread can dump its stack.  Entries
+only vanish when the loop CLOSES (clean shutdown).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Optional
 
 DEFAULT_PERIOD_S = 0.5
 
-# label -> (busy_ratio in [0,1], monotonic stamp of the sample)
+# label -> (busy_ratio in [0,1], monotonic stamp of the sample,
+#           ident of the thread running the loop)
 _RATIOS: Dict[str, tuple] = {}
 
 
@@ -46,20 +54,45 @@ def install(label: str, loop=None, period: float = DEFAULT_PERIOD_S) -> None:
             dw = wall - state["wall"]
             if dw > 0:
                 _RATIOS[label] = (min(1.0, max(0.0, (cpu - state["cpu"])
-                                               / dw)), wall)
+                                               / dw)), wall,
+                                  threading.get_ident())
         state["cpu"], state["wall"] = cpu, wall
         loop.call_later(period, _tick)
 
     loop.call_soon(_tick)
 
 
-def snapshot(max_age_s: float = 10.0) -> Dict[str, float]:
-    """Fresh busy ratios by label.  Entries older than `max_age_s`
-    (stopped loop, wedged thread) are dropped from the view — a frozen
-    reading must not masquerade as a live gauge."""
+def snapshot() -> Dict[str, float]:
+    """Last-known busy ratios by label.  Stale entries are KEPT (frozen
+    at their last reading) — a wedged-but-alive loop must stay visible in
+    the gauges; pair with `snapshot_full()` / the stale-seconds gauge to
+    tell frozen from fresh."""
+    return {label: entry[0] for label, entry in list(_RATIOS.items())}
+
+
+def snapshot_full() -> Dict[str, dict]:
+    """Per-label probe state for the diagnosis plane:
+
+    ``{"ratio", "stale_s", "thread_ident", "alive"}``
+
+    `stale_s` is the age of the last probe tick — at most one probe
+    period (~0.5s) for a healthy loop, growing unboundedly once the loop
+    stops servicing callbacks.  `alive` is whether the loop's thread
+    still exists: stale+alive = wedged, stale+dead = stopped without
+    closing its loop."""
     now = time.monotonic()
-    return {label: ratio for label, (ratio, ts) in list(_RATIOS.items())
-            if now - ts <= max_age_s}
+    live = {t.ident for t in threading.enumerate()}
+    out: Dict[str, dict] = {}
+    for label, entry in list(_RATIOS.items()):
+        ratio, ts = entry[0], entry[1]
+        ident = entry[2] if len(entry) > 2 else None
+        out[label] = {
+            "ratio": ratio,
+            "stale_s": now - ts,
+            "thread_ident": ident,
+            "alive": ident in live if ident is not None else None,
+        }
+    return out
 
 
 def busy(label: str) -> Optional[float]:

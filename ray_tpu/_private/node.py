@@ -21,15 +21,22 @@ from typing import Dict, Optional, Tuple
 from .ids import NodeID
 
 
-def install_daemon_profiler(tag: str) -> None:
-    """Debug hook: cProfile the whole process, dumped on SIGTERM/exit when
-    RAY_TPU_PROFILE_WORKER_DIR is set (reference: dashboard reporter's
-    py-spy profiling fills this role for live processes). Shared by the
-    worker, GCS and agent mains — lives here so daemons don't have to
-    import each other's stacks for a 15-line debug helper."""
+def install_daemon_profiler(tag: str):
+    """Live + post-mortem profiling for a daemon process.
+
+    Always returns the live-introspection RPC handlers
+    (``{"stacks", "cpu_profile"}`` from `diagnosis.profile_handlers`) so
+    the caller can register them on its existing server conns — this is
+    how `cluster_profile` reaches daemons, not just workers (reference:
+    dashboard reporter's py-spy profiling fills this role for live
+    processes).  Additionally, when RAY_TPU_PROFILE_WORKER_DIR is set,
+    arms the whole-process cProfile dumped on SIGTERM/exit.  Shared by
+    the worker, GCS and agent mains."""
+    from . import diagnosis
+    handlers = diagnosis.profile_handlers(tag)
     prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER_DIR")
     if not prof_dir:
-        return
+        return handlers
     import atexit
     import cProfile
     import signal
@@ -48,6 +55,7 @@ def install_daemon_profiler(tag: str) -> None:
     dump_profile = _dump
     atexit.register(_dump)
     signal.signal(signal.SIGTERM, lambda *a: (_dump(), os._exit(0)))
+    return handlers
 
 
 def dump_profile(*_a) -> None:

@@ -97,6 +97,18 @@ def chrome_trace_events(raw: List[dict],
             # Both ride the same pipeline and render without external
             # collectors.
             cat = e.get("cat") or "trace"
+            if cat == "anomaly":
+                # Diagnosis-plane detector firings overlay the trace as
+                # GLOBAL instant marks (full-height lines in Perfetto):
+                # the hang/wedge is visible against the work around it.
+                events.append({
+                    "name": e.get("name") or "anomaly",
+                    "cat": "anomaly", "ph": "i", "s": "g",
+                    "ts": e.get("start_us", e["ts"] * 1e6),
+                    "pid": pid, "tid": wid,
+                    "args": dict(e.get("args") or {}),
+                })
+                continue
             args = {"trace_id": e.get("trace_id"),
                     "span_id": e.get("span_id"),
                     "parent_span_id": e.get("parent_span_id")} \

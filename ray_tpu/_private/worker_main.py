@@ -27,7 +27,7 @@ import traceback
 from collections import deque
 from typing import Any, Dict, Optional
 
-from . import protocol, rpc
+from . import diagnosis, protocol, rpc
 from .config import get_config
 from .core_worker import CoreWorker
 from .ids import ObjectID, TaskID
@@ -1146,41 +1146,27 @@ class Executor:
     # a pure-Python stack dump and a sampling CPU profiler.)
 
     async def h_stacks(self, conn, p):
-        """All threads' current stacks (the py-spy `dump` equivalent)."""
-        frames = sys._current_frames()
-        names = {t.ident: t.name for t in threading.enumerate()}
-        out = {}
-        for tid, frame in frames.items():
-            out[f"{names.get(tid, '?')}-{tid}"] = "".join(
-                traceback.format_stack(frame))
-        return {"pid": os.getpid(), "actor": bool(self.actor_id),
-                "stacks": out}
+        """All threads' current stacks (the py-spy `dump` equivalent;
+        shared implementation in diagnosis.dump_stacks, which also
+        carries folded stacks for flamegraph merging)."""
+        out = diagnosis.dump_stacks()
+        out["actor"] = bool(self.actor_id)
+        return out
 
     async def h_cpu_profile(self, conn, p):
         """Sampling CPU profile: poll every thread's frames at ~100Hz for
         `duration_s`, aggregate identical stacks (the py-spy `record`
-        equivalent, pure Python)."""
-        duration = min(float(p.get("duration_s", 5.0)), 60.0)
-        interval = max(float(p.get("interval_s", 0.01)), 0.001)
-        counts: Dict[str, int] = {}
-        samples = 0
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + duration
-        while loop.time() < deadline:
-            for frame in sys._current_frames().values():
-                stack = []
-                f = frame
-                while f is not None:
-                    stack.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
-                                 f":{f.f_lineno}:{f.f_code.co_name}")
-                    f = f.f_back
-                key = ";".join(reversed(stack))
-                counts[key] = counts.get(key, 0) + 1
-            samples += 1
-            await asyncio.sleep(interval)
-        top = sorted(counts.items(), key=lambda kv: -kv[1])[:50]
-        return {"pid": os.getpid(), "samples": samples,
-                "stacks": [{"stack": k, "count": v} for k, v in top]}
+        equivalent, pure Python; shared implementation in
+        diagnosis.cpu_profile)."""
+        return await diagnosis.cpu_profile(p.get("duration_s", 5.0),
+                                           p.get("interval_s", 0.01))
+
+    async def h_exec_stats(self, conn, p):
+        """Cheap executor-activity probe for the agent's lease-stall
+        detector: tasks started / currently running, as AGES (monotonic
+        clocks don't compare across processes)."""
+        tr = diagnosis.task_tracker()
+        return tr.stats() if tr is not None else {"running": None}
 
     def _resolve_queued_cancel(self, task_id: bytes) -> bool:
         """Pull a still-queued task out of the chunked-drain queues and
@@ -1305,6 +1291,7 @@ async def amain():
         "kill": executor.h_kill,
         "stacks": executor.h_stacks,
         "cpu_profile": executor.h_cpu_profile,
+        "exec_stats": executor.h_exec_stats,
     }
     core._server.handlers.update(exec_handlers)
     fast_handlers = {
@@ -1329,6 +1316,36 @@ async def amain():
 
     import ray_tpu
     ray_tpu._set_runtime_for_worker(core)
+
+    cfg = get_config()
+    if cfg.diagnosis_enabled:
+        tracker = diagnosis.init_task_tracker(
+            multiple=cfg.diagnosis_task_hang_multiple,
+            min_s=cfg.diagnosis_task_hang_min_s,
+            default_s=cfg.diagnosis_task_hang_default_s,
+            thread_lookup=lambda tid: executor._running_threads.get(tid))
+        core._diag_tracker = tracker
+
+        def _forward_anomaly(info):
+            # Watchdog thread -> loop thread -> best-effort GCS notify
+            # (triggers the black-box capture; counter+recorder already
+            # recorded process-locally by record_anomaly).
+            def _send():
+                try:
+                    if core.gcs is not None and not core.gcs.closed:
+                        core.gcs.notify("report_anomaly", info)
+                except Exception:
+                    pass
+            try:
+                core.loop.call_soon_threadsafe(_send)
+            except RuntimeError:
+                pass
+
+        watchdog = diagnosis.Watchdog(
+            daemon_name="worker", node_id=node_id.hex(),
+            detectors=[tracker.detector()], notify=_forward_anomaly,
+            poll_s=cfg.diagnosis_poll_ms / 1000.0)
+        watchdog.start()
 
     # Die with the agent (reference: a core worker exits when its raylet
     # IPC socket closes — node death must take its workers down, or dead

@@ -18,6 +18,8 @@ Endpoints:
     GET /api/tasks    recent task events
     GET /api/demand   autoscaler demand view
     GET /api/timeline chrome://tracing JSON
+    GET /api/profile  cluster-wide stacks / CPU flamegraph (diagnosis)
+    GET /api/anomalies  recent diagnosis-plane detector firings
     GET /metrics      Prometheus text exposition
     GET /healthz      200 once connected to the GCS
 """
@@ -246,22 +248,42 @@ class DashboardHead:
         if path in ("/", "/index.html"):
             return 200, "text/html", _INDEX.encode()
         if path == "/api/profile":
-            # Live profiling (reference: dashboard reporter module's
-            # py-spy/memray endpoints): /api/profile?node=<hex>&
-            # kind=stacks|cpu_profile&duration=5[&worker=<hex>]
-            agent = await self._node_agent(query)
-            if agent is None:
-                return 404, "text/plain", b"no such live node"
-            try:
-                wid = query.get("worker", [None])[0]
-                res = await agent.call("profile_worker", {
-                    "kind": query.get("kind", ["stacks"])[0],
-                    "duration_s": float(
-                        query.get("duration", ["5"])[0]),
-                    "worker_id": bytes.fromhex(wid) if wid else None,
-                }, timeout=90)
-            finally:
-                await agent.close()
+            # Cluster-wide live profiling (reference: dashboard reporter
+            # module's py-spy/memray endpoints, scaled out through the
+            # GCS diagnosis plane): /api/profile?kind=stacks|cpu_profile
+            # &duration=5[&node=<hex>][&pid=N][&job=<hex>]
+            # [&format=raw|folded|speedscope].  `raw` is the full result
+            # tree; the others render a merged flamegraph.
+            from .._private import diagnosis
+            gcs = await self._gcs()
+            dur = float(query.get("duration", ["5"])[0])
+            payload = {"kind": query.get("kind", ["stacks"])[0],
+                       "duration_s": dur}
+            for qk, pk in (("node", "node_id"), ("job", "job_id")):
+                if query.get(qk, [None])[0]:
+                    payload[pk] = query[qk][0]
+            if query.get("pid", [None])[0]:
+                payload["pid"] = int(query["pid"][0])
+            res = await gcs.call("cluster_profile", payload,
+                                 timeout=dur + 60)
+            fmt = query.get("format", ["raw"])[0]
+            if fmt == "speedscope":
+                body = json.dumps(diagnosis.speedscope_json(
+                    diagnosis.merge_cluster_profile(res)))
+            elif fmt == "folded":
+                return (200, "text/plain", diagnosis.folded_text(
+                    diagnosis.merge_cluster_profile(res)).encode())
+            else:
+                body = json.dumps(_hexify(res))
+            return 200, "application/json", body.encode()
+        if path == "/api/anomalies":
+            # Diagnosis-plane detector firings (ring of the last 256):
+            # /api/anomalies[?kind=loop_wedged][&limit=N]
+            gcs = await self._gcs()
+            res = await gcs.call("get_anomalies", {
+                "kind": query.get("kind", [None])[0],
+                "limit": int(query.get("limit", ["256"])[0]),
+            })
             return (200, "application/json",
                     json.dumps(_hexify(res)).encode())
         if path == "/healthz":

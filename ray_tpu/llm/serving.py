@@ -62,7 +62,8 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .._private import deadlines, flight_recorder
+from .._private import deadlines, diagnosis, flight_recorder
+from .._private.config import get_config
 from ..exceptions import (DeadlineExceededError, OverloadedError,
                           StreamBrokenError)
 from .engine import LLMEngine, SamplingParams
@@ -147,6 +148,7 @@ class EngineReplica:
         self._kv_broken = 0
         self._gauges = None
         self._last_gauge_flush = 0.0
+        self._silence_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------ helpers --
     def _kv_fetch(self, handle):
@@ -263,6 +265,34 @@ class EngineReplica:
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.ensure_future(self._decode_loop())
+        cfg = get_config()
+        if cfg.diagnosis_enabled and (self._silence_task is None
+                                      or self._silence_task.done()):
+            self._silence_task = asyncio.ensure_future(
+                self._silence_watch(cfg.diagnosis_serving_silence_s))
+
+    async def _silence_watch(self, silence_s: float) -> None:
+        """Diagnosis-plane detector: a request that was ADMITTED (holds a
+        decode slot) but has emitted no token for `silence_s` is a silent
+        hang — the engine thread is wedged or the stream consumer stopped
+        being fed.  Flagged once per request (`serving_silent` anomaly);
+        the decode loop keeps running, this only observes."""
+        poll = max(0.5, silence_s / 4.0)
+        while True:
+            await asyncio.sleep(poll)
+            now = time.monotonic()
+            for rid, meta in list(self._meta.items()):
+                if (not meta.get("admitted") or meta.get("finished")
+                        or meta.get("_silent")):
+                    continue
+                last = max(meta.get("t_adm", now),
+                           meta.get("t_last_tok", 0.0))
+                if now - last > silence_s:
+                    meta["_silent"] = True
+                    diagnosis.record_anomaly(
+                        "serving_silent", daemon="serving",
+                        request_id=int(rid), silent_s=now - last,
+                        active=self.engine.active_requests)
 
     # --------------------------------------------------------- decode loop --
     async def _decode_loop(self):
@@ -332,6 +362,7 @@ class EngineReplica:
                         queued=self.engine.queue_depth,
                         decoding=max(0, self.engine.active_requests - 1
                                      + len(done_by_id)))
+            meta["t_last_tok"] = time.monotonic()
             q = self._waiters.get(rid)
             if q is not None:
                 q.put_nowait(int(tok))

@@ -107,7 +107,9 @@ def cmd_stop(args) -> int:
 
 def _connect(args):
     import ray_tpu
-    ray_tpu.init(address=args.address or "auto", log_level="ERROR")
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=getattr(args, "address", None) or "auto",
+                     log_level="ERROR")
     return ray_tpu
 
 
@@ -236,6 +238,111 @@ def cmd_timeline(args) -> int:
     print(f"wrote {len(events)} events to {out}"
           + (f" (job {job_id.hex()[:8]})" if job_id else ""))
     return 0
+
+
+def _cluster_profile_call(ray_tpu, args, kind: str, seconds: float):
+    """One GCS `cluster_profile` round-trip with the CLI selectors."""
+    payload = {"kind": kind, "duration_s": seconds}
+    if getattr(args, "node", None):
+        payload["node_id"] = args.node
+    if getattr(args, "pid", None) is not None:
+        payload["pid"] = int(args.pid)
+    if getattr(args, "job", None):
+        payload["job_id"] = args.job
+    return ray_tpu._core().gcs_call("cluster_profile", payload)
+
+
+def _iter_procs(merged):
+    """(proc_label, result) pairs over a cluster_profile tree."""
+    if merged.get("gcs"):
+        yield "gcs", merged["gcs"]
+    for node_hex, node in sorted((merged.get("nodes") or {}).items()):
+        if not isinstance(node, dict):
+            continue
+        if node.get("error"):
+            yield f"node-{node_hex[:8]}", node
+            continue
+        if node.get("agent"):
+            yield f"node-{node_hex[:8]}/agent", node["agent"]
+        for wid, res in sorted((node.get("workers") or {}).items()):
+            yield f"node-{node_hex[:8]}/worker-{wid[:8]}", res
+
+
+def _render_profile(merged, fmt: str) -> str:
+    """Render a cluster_profile result: `text` (per-process raw thread
+    stacks), `folded` (collapsed-stack lines), or `speedscope` JSON."""
+    from ray_tpu._private import diagnosis
+    if fmt == "speedscope":
+        return json.dumps(diagnosis.speedscope_json(
+            diagnosis.merge_cluster_profile(merged)), indent=1)
+    if fmt == "folded":
+        return diagnosis.folded_text(
+            diagnosis.merge_cluster_profile(merged))
+    out = []
+    for label, res in _iter_procs(merged):
+        if not isinstance(res, dict) or res.get("error"):
+            err = res.get("error") if isinstance(res, dict) else res
+            out.append(f"==== {label}: ERROR {err}\n")
+            continue
+        out.append(f"==== {label} (pid {res.get('pid')}) ====")
+        if merged.get("kind") == "cpu_profile":
+            out.append(f"  {res.get('samples', 0)} samples")
+            for s in res.get("stacks") or []:
+                out.append(f"  {s['count']:>6}  {s['stack']}")
+        else:
+            for tlabel, text in sorted((res.get("stacks") or {}).items()):
+                out.append(f"-- thread {tlabel} --")
+                out.append(text.rstrip("\n"))
+        out.append("")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _emit(text: str, output) -> None:
+    if output:
+        os.makedirs(os.path.dirname(os.path.abspath(output)), exist_ok=True)
+        with open(output, "w") as f:
+            f.write(text)
+        print(f"wrote {output}")
+    else:
+        sys.stdout.write(text)
+
+
+def cmd_stacks(args) -> int:
+    """Cluster-wide live stack dump: every daemon (GCS, agents) and
+    worker, merged at the GCS (reference: `ray stack`, which is
+    single-node — this fans out through the agent conns)."""
+    ray_tpu = _connect(args)
+    merged = _cluster_profile_call(ray_tpu, args, "stacks", 2.0)
+    _emit(_render_profile(merged, args.format), args.output)
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Cluster-wide sampling CPU profile -> merged flamegraph
+    (speedscope JSON by default; open at https://speedscope.app)."""
+    ray_tpu = _connect(args)
+    merged = _cluster_profile_call(ray_tpu, args, "cpu_profile",
+                                   args.seconds)
+    out = args.output
+    if out is None and args.format == "speedscope":
+        os.makedirs("/tmp/ray_tpu", exist_ok=True)
+        out = f"/tmp/ray_tpu/profile-{int(time.time())}.speedscope.json"
+    _emit(_render_profile(merged, args.format), out)
+    return 0
+
+
+def cmd_capture(args) -> int:
+    """Force a black-box diagnosis bundle (stacks + profile + metrics +
+    recorder rings + node views) into the GCS capture dir."""
+    ray_tpu = _connect(args)
+    res = ray_tpu._core().gcs_call(
+        "capture", {"kind": args.kind, "force": not args.no_force})
+    if res.get("captured"):
+        print(f"bundle written: {res.get('path')}")
+        return 0
+    print(f"not captured (rate-limited; suppressed="
+          f"{res.get('suppressed')})")
+    return 1
 
 
 def cmd_summary(args) -> int:
@@ -379,6 +486,38 @@ def main(argv=None) -> int:
     p.add_argument("--no-align", action="store_true",
                    help="keep raw per-host clocks (debug the estimator)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("stacks", help="live stacks from every daemon and "
+                                      "worker cluster-wide")
+    p.add_argument("--node", default=None, help="node id hex prefix")
+    p.add_argument("--pid", type=int, default=None,
+                   help="one process on the selected node(s)")
+    p.add_argument("--job", default=None, help="job id hex prefix")
+    p.add_argument("--format", choices=["text", "folded", "speedscope"],
+                   default="text")
+    p.add_argument("--output", "-o", default=None,
+                   help="write to a file instead of stdout")
+    p.set_defaults(fn=cmd_stacks)
+
+    p = sub.add_parser("profile", help="cluster-wide CPU profile -> "
+                                       "merged flamegraph")
+    p.add_argument("--seconds", type=float, default=2.0)
+    p.add_argument("--node", default=None, help="node id hex prefix")
+    p.add_argument("--pid", type=int, default=None,
+                   help="one process on the selected node(s)")
+    p.add_argument("--job", default=None, help="job id hex prefix")
+    p.add_argument("--format", choices=["speedscope", "folded", "text"],
+                   default="speedscope")
+    p.add_argument("--output", "-o", default=None,
+                   help="output file (default /tmp/ray_tpu/profile-*.json)")
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("capture", help="force a diagnosis bundle now")
+    p.add_argument("--kind", default="manual",
+                   help="anomaly kind label for the bundle dir")
+    p.add_argument("--no-force", action="store_true",
+                   help="respect the per-kind capture rate limit")
+    p.set_defaults(fn=cmd_capture)
 
     p = sub.add_parser("summary", help="task-state counts + per-node "
                                        "transfer/skew/queue table")

@@ -1297,6 +1297,91 @@ def check_recorder_overhead(min_time_s: float = 2.0,
     return 0
 
 
+# The diagnosis-plane A/B gate covers the same per-call paths: the
+# watchdogs poll off-loop (a sibling thread per daemon) and the task
+# tracker adds one dict update per task event, so the per-call budget is
+# tighter than the recorder's (<=2%).
+DIAGNOSIS_AB_METRICS = RECORDER_AB_METRICS
+
+
+def check_diagnosis_overhead(min_time_s: float = 2.0,
+                             threshold: float = 0.02,
+                             rounds: int = 3,
+                             informational: bool = False) -> int:
+    """Same-host A/B of the diagnosis plane (hung-work watchdogs + task
+    hang tracker): run the per-call benches with detectors ON vs OFF
+    (alternating rounds, best-of per mode — the same co-tenant-noise
+    discipline as check_recorder_overhead) and gate detectors-on within
+    `threshold` of detectors-off.  The toggle travels via
+    RAY_TPU_diagnosis_enabled, which child_env hands to every
+    daemon/worker the re-init spawns, so both sides cover the whole
+    cluster (GCS + agent loop-wedge watchdogs, worker task tracker).
+
+    `informational=True` (host-fingerprint mismatch vs the committed
+    baseline — same rule as the absolute gates) reports but exits 0."""
+    import os as _os
+
+    from ray_tpu._private import config as config_mod
+
+    results = {"on": {m: [] for m in DIAGNOSIS_AB_METRICS},
+               "off": {m: [] for m in DIAGNOSIS_AB_METRICS}}
+    prev = _os.environ.get("RAY_TPU_diagnosis_enabled")
+
+    def _cluster(mode: str):
+        _os.environ["RAY_TPU_diagnosis_enabled"] = \
+            "1" if mode == "on" else "0"
+        # The driver's own config singleton predates the env flip —
+        # rebuild it so the driver side of the A/B toggles too.
+        config_mod.set_config(config_mod.Config())
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        import multiprocessing
+        ray_tpu.init(num_cpus=max(8, multiprocessing.cpu_count()))
+        warmup_cluster(60)
+
+    try:
+        for _ in range(max(1, rounds)):
+            # Interleaved A/B pairs: co-tenant drift hits both modes.
+            for mode in ("on", "off"):
+                _cluster(mode)
+                for m in DIAGNOSIS_AB_METRICS:
+                    results[mode][m].append(BENCHES[m](min_time_s))
+                ray_tpu.shutdown()
+    finally:
+        if prev is None:
+            _os.environ.pop("RAY_TPU_diagnosis_enabled", None)
+        else:
+            _os.environ["RAY_TPU_diagnosis_enabled"] = prev
+        config_mod.set_config(config_mod.Config())
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+
+    failures = []
+    for m in DIAGNOSIS_AB_METRICS:
+        on = max(results["on"][m])
+        off = max(results["off"][m])
+        ratio = on / off if off else 1.0
+        row = {"metric": m, "diagnosis_on": round(on, 2),
+               "diagnosis_off": round(off, 2), "ratio": round(ratio, 3)}
+        if ratio < 1.0 - threshold:
+            row["DIAGNOSIS_OVERHEAD"] = True
+            failures.append(m)
+        print(json.dumps(row))
+    if failures:
+        if informational:
+            print(json.dumps({
+                "diagnosis_check": "host-mismatch-informational",
+                "would_have_failed": failures,
+                "threshold": threshold}))
+            return 0
+        print(json.dumps({"diagnosis_check": "FAIL",
+                          "over_threshold": failures,
+                          "threshold": threshold}))
+        return 1
+    print(json.dumps({"diagnosis_check": "ok", "threshold": threshold}))
+    return 0
+
+
 def warmup_cluster(n: int = 200) -> None:
     """Spawn/prestart the worker pool and export the bench functions so
     measurements see steady state, not process-spawn latency."""
@@ -1378,6 +1463,13 @@ def main(argv=None):
                          "1_1_actor_calls_async)")
     ap.add_argument("--recorder-threshold", type=float, default=0.03)
     ap.add_argument("--recorder-rounds", type=int, default=3)
+    ap.add_argument("--no-check-diagnosis", action="store_true",
+                    help="skip the diagnosis-plane overhead A/B gate "
+                         "(detectors-on must stay within 2%% of "
+                         "detectors-off on tasks_sync and "
+                         "1_1_actor_calls_async)")
+    ap.add_argument("--diagnosis-threshold", type=float, default=0.02)
+    ap.add_argument("--diagnosis-rounds", type=int, default=3)
     args = ap.parse_args(argv)
     owns = not ray_tpu.is_initialized()
     if owns:
@@ -1401,6 +1493,16 @@ def main(argv=None):
                     min_time_s=args.min_time_s,
                     threshold=args.recorder_threshold,
                     rounds=args.recorder_rounds,
+                    informational=(committed_host_mismatch()
+                                   and not args.check_force))
+            if not args.no_check_diagnosis:
+                # Diagnosis-plane (watchdogs + task tracker) overhead
+                # A/B — same alternating-rounds / fingerprint-downgrade
+                # discipline, tighter bound.
+                rc = rc or check_diagnosis_overhead(
+                    min_time_s=args.min_time_s,
+                    threshold=args.diagnosis_threshold,
+                    rounds=args.diagnosis_rounds,
                     informational=(committed_host_mismatch()
                                    and not args.check_force))
             raise SystemExit(rc)

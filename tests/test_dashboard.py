@@ -130,9 +130,10 @@ def test_prometheus_text_histogram_rendering():
 
 
 def test_profile_endpoint_with_query_params(dashboard):
-    """/api/profile?kind=...&duration=... reaches the agent's live
-    profiler with its query parameters intact (reference: reporter
-    module's profiling endpoints)."""
+    """/api/profile?kind=...&duration=... reaches the GCS
+    cluster_profile fan-out with its query parameters intact and
+    returns the whole-cluster tree (reference: reporter module's
+    profiling endpoints, scaled out through the diagnosis plane)."""
     import json as _json
 
     @ray_tpu.remote
@@ -153,14 +154,23 @@ def test_profile_endpoint_with_query_params(dashboard):
                         "/api/profile?kind=cpu_profile&duration=1")
     assert st == 200, body
     res = _json.loads(body)
-    assert res, "no workers profiled"
-    joined = " ".join(s["stack"] for w in res.values()
-                      if isinstance(w, dict) and "stacks" in w
+    assert res["kind"] == "cpu_profile" and res["nodes"]
+    procs = [res["gcs"]] + [
+        p for node in res["nodes"].values() if isinstance(node, dict)
+        for p in [node.get("agent"), *node.get("workers", {}).values()]
+        if isinstance(p, dict)]
+    joined = " ".join(s["stack"] for w in procs if "stacks" in w
                       for s in w["stacks"])
     assert "churn" in joined, "cpu samples missed the busy method"
     # samples field proves the cpu_profile kind (stacks has none).
-    assert any("samples" in w for w in res.values()
-               if isinstance(w, dict))
+    assert any("samples" in w for w in procs)
+    # The merged-flamegraph render: ?format=speedscope over the
+    # default stacks kind.
+    st2, _ct2, body2 = _get(dashboard, "/api/profile?format=speedscope")
+    assert st2 == 200, body2
+    ss = _json.loads(body2)
+    assert ss["$schema"].endswith("file-format-schema.json")
+    assert ss["profiles"][0]["samples"]
     assert ray_tpu.get(ref, timeout=60) > 0
     ray_tpu.kill(b)
 

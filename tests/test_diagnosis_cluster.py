@@ -1,0 +1,325 @@
+"""Diagnosis plane, cluster level: agent profile fan-out semantics,
+GCS `cluster_profile` coverage over a multi-node cluster + the
+`ray_tpu stacks` / `ray_tpu profile` CLI, and the chaos e2e — wedge a
+worker and stall a daemon loop, prove the watchdogs fire, the counter
+ticks, and the auto-captured black-box bundle contains the wedged
+frame while the rate limiter suppresses the flap."""
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import diagnosis
+from ray_tpu._private import rpc as rpc_mod
+from ray_tpu.cluster_utils import Cluster
+
+
+def _wait_for(pred, timeout=15, msg="condition not met"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.25)
+    raise AssertionError(msg)
+
+
+def _agent_call(method, payload, timeout=60):
+    core = ray_tpu._core()
+
+    async def _go():
+        agent = await rpc_mod.connect(core.agent_address,
+                                      name="test->agent")
+        try:
+            return await agent.call(method, payload, timeout=timeout)
+        finally:
+            await agent.close()
+
+    return asyncio.run(_go())
+
+
+# ---------------------------------------------------------------------------
+# agent fan-out semantics (profile_worker / node_profile)
+# ---------------------------------------------------------------------------
+
+def test_profile_worker_rejects_unknown_kind(ray_start_regular):
+    with pytest.raises(rpc_mod.RpcError, match="unknown profile kind"):
+        _agent_call("profile_worker", {"kind": "flamegraph"})
+    with pytest.raises(rpc_mod.RpcError, match="unknown profile kind"):
+        ray_tpu._core().gcs_call("cluster_profile", {"kind": "flamegraph"})
+
+
+def test_profile_worker_fans_out_and_survives_worker_death(
+        ray_start_isolated):
+    """worker_id=None hits EVERY live worker; a worker dying mid-profile
+    becomes a typed per-worker error entry, not a failed fan-out."""
+
+    @ray_tpu.remote
+    class Steady:
+        def ping(self):
+            return os.getpid()
+
+    @ray_tpu.remote
+    class Doomed:
+        def ping(self):
+            return os.getpid()
+
+        def die_soon(self, delay):
+            import threading
+
+            def _boom():
+                time.sleep(delay)
+                os._exit(1)
+
+            threading.Thread(target=_boom, daemon=True).start()
+            return True
+
+    steady = [Steady.remote() for _ in range(2)]
+    doomed = Doomed.remote()
+    steady_pids = ray_tpu.get([a.ping.remote() for a in steady], timeout=30)
+    doomed_pid = ray_tpu.get(doomed.ping.remote(), timeout=30)
+    assert ray_tpu.get(doomed.die_soon.remote(0.5), timeout=30)
+
+    res = _agent_call("profile_worker",
+                      {"kind": "cpu_profile", "duration_s": 2.5},
+                      timeout=60)
+    # All-live semantics: every registered worker got an entry.
+    assert len(res) >= 3
+    ok = [r for r in res.values() if "error" not in r]
+    errs = [r for r in res.values() if "error" in r]
+    assert errs, "dying worker should surface as a typed error entry"
+    assert all(isinstance(r["error"], str) for r in errs)
+    got_pids = {r["pid"] for r in ok}
+    assert set(steady_pids) <= got_pids
+    assert doomed_pid not in got_pids
+    for a in steady:
+        ray_tpu.kill(a)
+
+
+# ---------------------------------------------------------------------------
+# multi-node cluster_profile + CLI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def _coverage(merged, want_nodes):
+    """Assert a cluster_profile tree covers gcs + agent + >=1 worker on
+    every node, and return it rendered as speedscope for validation."""
+    assert merged.get("gcs") and merged["gcs"].get("daemon") == "gcs"
+    nodes = merged["nodes"]
+    assert len(nodes) == want_nodes
+    for hexid, node in nodes.items():
+        assert "error" not in node, f"node {hexid[:8]}: {node}"
+        assert node["agent"].get("daemon") == "agent"
+        workers = {w: r for w, r in node["workers"].items()
+                   if "error" not in r}
+        assert workers, f"node {hexid[:8]} has no live profiled worker"
+        assert isinstance(node["clock_offset_s"], float)
+        assert node["clock_err_bound_s"] >= 0.0
+    folded = diagnosis.merge_cluster_profile(merged)
+    ss = diagnosis.speedscope_json(folded)
+    prof = ss["profiles"][0]
+    assert prof["samples"] and len(prof["samples"]) == len(prof["weights"])
+    nframes = len(ss["shared"]["frames"])
+    assert all(0 <= i < nframes for s in prof["samples"] for i in s)
+    roots = {f["name"].split(";")[0]
+             for f in (ss["shared"]["frames"][s[0]]
+                       for s in prof["samples"])}
+    assert "gcs" in roots
+    for hexid in nodes:
+        assert f"node-{hexid[:8]}/agent" in roots
+        assert any(r.startswith(f"node-{hexid[:8]}/worker-")
+                   for r in roots)
+    return ss
+
+
+def test_cluster_profile_multinode_and_cli(cluster, tmp_path):
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    core = ray_tpu._core()
+
+    @ray_tpu.remote(num_cpus=2)
+    class Spinner:
+        def node(self):
+            return ray_tpu._core().node_id.hex()
+
+    # One 2-cpu actor per 2-cpu node: every node hosts a live worker.
+    spinners = [Spinner.remote() for _ in range(2)]
+    homes = ray_tpu.get([s.node.remote() for s in spinners], timeout=60)
+    assert len(set(homes)) == 2, f"spinners did not spread: {homes}"
+
+    stacks = core.gcs_call("cluster_profile", {"kind": "stacks"})
+    assert stacks["kind"] == "stacks"
+    _coverage(stacks, want_nodes=2)
+
+    prof = core.gcs_call(
+        "cluster_profile", {"kind": "cpu_profile", "duration_s": 2.0},
+        timeout=90)
+    assert prof["kind"] == "cpu_profile" and prof["duration_s"] == 2.0
+    _coverage(prof, want_nodes=2)
+
+    # Selectors: node_id prefix narrows to that node and drops the GCS.
+    target = sorted(stacks["nodes"])[0]
+    one = core.gcs_call("cluster_profile",
+                        {"kind": "stacks", "node_id": target[:12]})
+    assert "gcs" not in one and list(one["nodes"]) == [target]
+
+    # CLI: `ray_tpu stacks` / `ray_tpu profile --seconds 2` — merged
+    # speedscope/folded output files against the live cluster.
+    from ray_tpu.scripts import cli
+    ns = lambda **kw: argparse.Namespace(  # noqa: E731
+        address=cluster.address, node=None, pid=None, job=None, **kw)
+    stacks_out = str(tmp_path / "stacks.folded")
+    assert cli.cmd_stacks(ns(format="folded", output=stacks_out)) == 0
+    lines = open(stacks_out).read().splitlines()
+    assert lines and all(l.rsplit(" ", 1)[1].isdigit() for l in lines)
+
+    prof_out = str(tmp_path / "profile.speedscope.json")
+    assert cli.cmd_profile(ns(format="speedscope", seconds=2.0,
+                              output=prof_out)) == 0
+    ss = json.load(open(prof_out))
+    assert ss["$schema"].endswith("file-format-schema.json")
+    assert ss["profiles"][0]["samples"]
+    text_out = str(tmp_path / "stacks.txt")
+    assert cli.cmd_stacks(ns(format="text", output=text_out)) == 0
+    assert "==== gcs" in open(text_out).read()
+
+    for s in spinners:
+        ray_tpu.kill(s)
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: wedge a worker + stall a daemon loop -> detectors, counter,
+# rate-limited black-box bundles with the wedged frame inside
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_detectors_fire_and_capture_bundles(tmp_path):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cap = tmp_path / "diag"
+    ray_tpu.init(num_cpus=4, _system_config={
+        "diagnosis_poll_ms": 100,
+        "diagnosis_loop_wedge_s": 1.0,
+        "diagnosis_task_hang_default_s": 1.0,
+        "diagnosis_task_hang_min_s": 1.0,
+        # Quiesce the detectors this test does NOT exercise.
+        "diagnosis_lease_stall_s": 3600.0,
+        "diagnosis_serving_silence_s": 3600.0,
+        "diagnosis_capture_min_interval_s": 300.0,
+        "diagnosis_capture_dir": str(cap),
+        "diagnosis_chaos_enabled": True,
+    })
+    try:
+        core = ray_tpu._core()
+
+        @ray_tpu.remote
+        def wedged_marker_function(s):
+            time.sleep(s)
+            return 1
+
+        # Two tasks wedge past the 1s no-history threshold: two
+        # task_hung firings, ONE bundle (second flap is rate-limited).
+        refs = [wedged_marker_function.remote(30.0) for _ in range(2)]
+
+        hung = _wait_for(
+            lambda: (lambda a: a if len(a) >= 2 else None)(
+                core.gcs_call("get_anomalies", {"kind": "task_hung"})),
+            timeout=30, msg="task_hung anomalies never reached the GCS")
+        assert {a["daemon"] for a in hung} == {"worker"}
+        assert all(a["node_id"] for a in hung)
+        assert {a["name"] for a in hung} == {"wedged_marker_function"}
+        assert all(a["running_s"] >= a["threshold_s"] for a in hung)
+        # The detector dumped the executing thread from a sibling:
+        assert any("wedged_marker_function" in a.get("stack", "")
+                   for a in hung)
+
+        def _bundles(kind):
+            if not cap.is_dir():
+                return []
+            return sorted(d for d in os.listdir(cap)
+                          if d.startswith(f"diag-{kind}-"))
+
+        _wait_for(lambda: _bundles("task_hung"),
+                  timeout=30, msg="no task_hung bundle captured")
+        assert len(_bundles("task_hung")) == 1, \
+            "rate limiter must suppress the second flap's bundle"
+        bundle = cap / _bundles("task_hung")[0]
+        man = json.load(open(bundle / "manifest.json"))
+        assert man["anomaly_kind"] == "task_hung"
+        assert {"stacks.json", "cpu_profile.json", "metrics.json",
+                "nodes.json", "recorder.json", "anomalies.json",
+                }.issubset(set(os.listdir(bundle)))
+        # String-provable: the black box caught the wedged frame.
+        assert "wedged_marker_function" in (bundle / "stacks.json") \
+            .read_text()
+
+        # --- stall an agent event loop (chaos handler = a REAL wedge:
+        # synchronous sleep on the loop thread) -------------------------
+        asyncio.run(_stall_agent(core.agent_address, 3.5))
+
+        wedged = _wait_for(
+            lambda: core.gcs_call("get_anomalies",
+                                  {"kind": "loop_wedged"}) or None,
+            timeout=30, msg="loop_wedged anomaly never reached the GCS")
+        assert all(a["daemon"] == "agent" for a in wedged)
+        assert any("_sh_debug_stall" in a.get("stack", "")
+                   for a in wedged)
+        _wait_for(lambda: _bundles("loop_wedged"),
+                  timeout=30, msg="no loop_wedged bundle captured")
+        assert len(_bundles("loop_wedged")) == 1
+
+        # The counter rode the ordinary telemetry export to the GCS.
+        from ray_tpu.util import metrics as umetrics
+
+        def _counts():
+            rows = {}
+            for m in umetrics.get_metrics():
+                if m["name"] == "ray_tpu_anomaly_total":
+                    k = m["labels"].get("kind")
+                    rows[k] = rows.get(k, 0) + m["value"]
+            return rows if rows.get("task_hung", 0) >= 2 \
+                and rows.get("loop_wedged", 0) >= 1 else None
+
+        counts = _wait_for(_counts, timeout=30,
+                           msg="ray_tpu_anomaly_total never exported")
+        assert counts["task_hung"] >= 2 and counts["loop_wedged"] >= 1
+
+        # Anomaly instants land on the cluster timeline as global marks
+        # (they ride the ordinary recorder drain -> GCS sink path).
+        def _timeline_marks():
+            marks = [e for e in ray_tpu.timeline()
+                     if e.get("cat") == "anomaly"
+                     and e["name"] == "anomaly:task_hung"]
+            return marks or None
+
+        marks = _wait_for(_timeline_marks, timeout=20,
+                          msg="anomaly instants never hit the timeline")
+        assert all(e["ph"] == "i" and e["s"] == "g" for e in marks)
+
+        for r in refs:
+            ray_tpu.cancel(r, force=True)
+    finally:
+        ray_tpu.shutdown()
+
+
+async def _stall_agent(agent_address, seconds):
+    agent = await rpc_mod.connect(agent_address, name="test->agent")
+    try:
+        agent.notify("debug_stall_loop", {"seconds": seconds})
+        await asyncio.sleep(0.2)    # flush the notify before closing
+    finally:
+        await agent.close()

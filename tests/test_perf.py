@@ -34,6 +34,20 @@ def test_recorder_overhead_ab_gate():
     assert not ray_tpu.is_initialized()   # leaves no cluster behind
 
 
+@pytest.mark.slow
+def test_diagnosis_overhead_ab_gate():
+    """`perf --check`'s diagnosis-plane A/B: toggles the watchdogs +
+    trackers across full cluster re-inits and gates detectors-on within
+    2% of detectors-off.  Informational here, same as the recorder
+    gate."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    rc = perf.check_diagnosis_overhead(min_time_s=0.4, rounds=1,
+                                       informational=True)
+    assert rc == 0
+    assert not ray_tpu.is_initialized()   # leaves no cluster behind
+
+
 def test_committed_host_fingerprint_probe():
     """The shared informational rule: the fingerprint probe runs and
     returns a bool (the A/B gate consumes it for its informational
