@@ -492,6 +492,10 @@ class CoreWorker:
             flat = arr.reshape(-1).view(np.uint8)
             total = flat.nbytes
             chunk = bytes(flat[offset:offset + self._DEVICE_CHUNK])
+            # Copy audit: count the staged bytes actually shipped (per
+            # chunk, so the cumulative series equals bytes transferred).
+            from . import device_plane
+            device_plane.record_d2h(len(chunk))
             return {"data": chunk, "total": total, "offset": offset,
                     "dtype": str(arr.dtype), "shape": list(arr.shape)}
 
@@ -832,6 +836,23 @@ class CoreWorker:
         else:
             self._notify_owner(remote, "escape_pin", ref.binary())
 
+    def _note_device_resident(self, oid: bytes, owner) -> None:
+        """A get() on this worker just re-uploaded the object's arrays
+        onto OUR accelerators: register this node in the owner's
+        DEVICE-TIER replica directory so locality scheduling scores
+        future consumers of the ref toward this slice (above any peer
+        whose copy is host-arena bytes)."""
+        from . import device_plane
+        n, _b = device_plane.take_rebuilt_notice()
+        if not n:
+            return
+        if owner is None or tuple(owner) == self.address:
+            self.memory_store.add_location(
+                oid, self.agent_address, device=True)
+        else:
+            self._notify_owner(tuple(owner), "object_location_add", oid,
+                               addr=list(self.agent_address), dev=True)
+
     def _notify_owner(self, owner: tuple, method: str, object_id: bytes,
                       **extra):
         """Fire-and-forget refcount message to an object's owner; safe from
@@ -932,12 +953,18 @@ class CoreWorker:
         create/write-in-place/seal discipline — the Cython put path
         likewise copies on the caller).  Only the arena-full fallback
         (spill backpressure) routes through the loop."""
+        from . import device_plane
         ctx = get_context()
         ctx.capture = captured = []
+        device_plane.take_staged_notice()       # drain stale counts
         try:
             parts = ctx.serialize(value)
         finally:
             ctx.capture = None
+        # A value containing device arrays registers this node in the
+        # ref's DEVICE-TIER directory: the arrays stay resident in this
+        # process, so consumers scheduled here skip the re-upload.
+        staged_dev = device_plane.take_staged_notice()
         size = ctx.total_size(parts)
         cfg = get_config()
         if not captured and size <= self._inline_limit \
@@ -945,6 +972,9 @@ class CoreWorker:
             oid = self._next_put_id()
             self.reference_counter.add_owned(oid)
             self.memory_store.put_inline(oid, protocol.concat_parts(parts))
+            if staged_dev:
+                self.memory_store.add_location(
+                    oid, self.agent_address, device=True)
             return ObjectRef(oid, self.address, worker=self)
         if size > self._inline_limit and not self._on_loop_thread():
             # Zero-copy sync plasma path (containment bookkeeping is
@@ -959,12 +989,21 @@ class CoreWorker:
             if self._put_store_sync(oid, parts):
                 self.memory_store.put_plasma_location(
                     oid, list(self.agent_address), size=size)
+                if staged_dev:
+                    self.memory_store.add_location(
+                        oid, self.agent_address, device=True)
                 return ObjectRef(oid, self.address, worker=self)
             # Arena full: loop-side backpressure/spill.  _run blocks this
             # thread until stored, so the caller may mutate its buffers
             # (which `parts` still views) only after the copy completes.
-            return self._run(self._put_plasma_prepinned(oid, parts))
-        return self._run(self._put_serialized_async(parts, captured, size))
+            ref = self._run(self._put_plasma_prepinned(oid, parts))
+        else:
+            ref = self._run(
+                self._put_serialized_async(parts, captured, size))
+        if staged_dev:
+            self.memory_store.add_location(
+                ref.binary(), self.agent_address, device=True)
+        return ref
 
     def _put_store_sync(self, oid: bytes, parts) -> bool:
         """One native create+iov-copy+seal into shm on the CALLING thread,
@@ -1207,9 +1246,12 @@ class CoreWorker:
             entry = ms.get(oid)
         if entry is None or entry.data is None:
             return False, None           # plasma-resident: loop IO path
+        from . import device_plane
+        device_plane.take_rebuilt_notice()      # drain stale counts
         value = get_context().deserialize(memoryview(entry.data))
         if isinstance(value, exc.RayError):
             raise value
+        self._note_device_resident(oid, None)   # owner-local fast path
         return True, value
 
     def _maybe_release_cpu(self, refs) -> bool:
@@ -1323,10 +1365,13 @@ class CoreWorker:
         return out
 
     async def _get_one(self, ref: ObjectRef, deadline):
+        from . import device_plane
         data = await self._fetch_serialized(ref, deadline)
+        device_plane.take_rebuilt_notice()      # drain stale counts
         value = get_context().deserialize(data)
         if isinstance(value, exc.RayError):
             raise value
+        self._note_device_resident(ref.binary(), ref.owner_address)
         return value
 
     async def _fetch_serialized(self, ref: ObjectRef, deadline) -> memoryview:
@@ -1805,11 +1850,15 @@ class CoreWorker:
         """An agent holds (or is mid-pull of) a copy: record it.  With
         primary=True the primary record repoints — the drain path's
         adopt_primary uses this so owners learn the new pinned home
-        without waiting for a recovery probe."""
+        without waiting for a recovery probe.  dev=True registers a
+        DEVICE-TIER holder instead (a getter re-uploaded the object's
+        arrays onto its accelerators): a locality-scheduling signal,
+        never a pull source."""
         from .config import get_config
         return self.memory_store.add_location(
             p["object_id"], tuple(p["addr"]),
             primary=bool(p.get("primary")),
+            device=bool(p.get("dev")),
             max_secondaries=get_config().replica_directory_max_secondaries)
 
     async def h_object_location_remove(self, conn, p):
@@ -3442,16 +3491,29 @@ class CoreWorker:
             if isinstance(a, ObjectRef):
                 oid = a.binary()
                 owner = list(a.owner_address or self.address)
-                hint, sz = None, None
+                hint, sz, dev = None, None, None
                 if tuple(owner) == self.address:
                     entry_ms = self.memory_store.get(oid)
-                    if entry_ms is not None and entry_ms.plasma_node:
-                        # Full replica set (primary first, suspects
-                        # last): the scheduler scores bytes-already-
-                        # local against EVERY holder and the executing
-                        # node's prefetch stripes across them.
-                        hint = self._ordered_locations(entry_ms)
-                        sz = entry_ms.size
+                    if entry_ms is not None:
+                        if entry_ms.plasma_node:
+                            # Full replica set (primary first, suspects
+                            # last): the scheduler scores bytes-already-
+                            # local against EVERY holder and the
+                            # executing node's prefetch stripes across
+                            # them.
+                            hint = self._ordered_locations(entry_ms)
+                            sz = entry_ms.size
+                        if entry_ms.device_nodes:
+                            # Device-tier holders ride a SEPARATE hint
+                            # key: arg_locality scores them local-or-
+                            # better, but they never join the pull
+                            # sources in ref[2] (device bytes aren't in
+                            # any arena).
+                            dev = [list(x) for x in entry_ms.device_nodes]
+                            if sz is None:
+                                sz = entry_ms.size or (
+                                    len(entry_ms.data)
+                                    if entry_ms.data is not None else None)
                 # Pin EVERY by-ref arg while in flight — for borrowed refs
                 # the submitted pin keeps the local borrow registered (and
                 # thus the owner's borrower entry) until the reply.
@@ -3460,6 +3522,8 @@ class CoreWorker:
                 entry = {"ref": [oid, owner, hint]}
                 if sz:
                     entry["sz"] = sz
+                if dev:
+                    entry["dev"] = dev
             else:
                 ctx.capture = captured = []
                 try:

@@ -134,7 +134,7 @@ class DagChannelManager:
         try:
             await loop.run_in_executor(
                 self._write_pool,
-                lambda: _transport.send(self.store, ch, bytes(body),
+                lambda: _transport.send(self.store, ch, body,
                                         nreaders, slot_bytes, mint,
                                         timeout_ms=600_000))
         except ChannelClosed:
@@ -267,8 +267,13 @@ class _Bridge(threading.Thread):
         try:
             while not self._stop.is_set():
                 try:
-                    body = _transport.recv(store, ch, self._reader,
-                                           timeout_ms=1000)
+                    # View mode: a spilled body is forwarded straight out
+                    # of the pinned arena region (the framer's writev
+                    # consumes the view) — no host materialization on the
+                    # bridge hop, for device payloads and big host blobs
+                    # alike.  Released once the forward call returns.
+                    body, release = _transport.recv_view(
+                        store, ch, self._reader, timeout_ms=1000)
                 except TimeoutError:
                     continue
                 except ChannelClosed:
@@ -283,6 +288,8 @@ class _Bridge(threading.Thread):
                     logger.warning("bridge %s: forward failed: %s",
                                    self._chan[:4].hex(), e)
                     res = None
+                finally:
+                    release()
                 if res is not True:
                     # Destination unreachable or mirror closed: break the
                     # pipeline LOUDLY by closing the home ring — every

@@ -1,0 +1,479 @@
+"""Device-direct data plane: first-class device-array payloads.
+
+Reference surface: Ray's RDT/GPU-object transport (`tensor_transport`
+actor option + the transport manager keyed on object refs) and aDAG's
+pluggable accelerator channels (`TorchTensorAcceleratorChannel` /
+`AcceleratorContext`).  TPU-native design: a `DeviceArraySpec` payload
+type negotiated at DAG compile time plus a transport ladder —
+
+  rung 0 (same process / same slice): the producer registers the live
+      jax.Arrays in an in-process table and ships only an 8-byte token +
+      spec over the ring slot ("ring slots carry specs, not blobs");
+      the consumer takes the very same arrays.  ZERO host bytes.
+  rung 1 (cross-process): the serializer stages each array exactly once
+      — a dlpack/`__array_interface__` host view travels as a pickle-5
+      out-of-band buffer straight into the arena `create_buffer` view
+      (no intermediate `np.asarray` materialization, no pickle of the
+      payload bytes), ships over the native framer, and is re-uploaded
+      with `jax.device_put` on the far side.  ONE host copy per
+      direction, test-pinned by the copy audit below.
+
+Copy audit: `device_to_host_bytes` / `host_to_device_bytes` are stamped
+at every transfer seam and exported through the unified metrics registry
+(`ray_tpu_device_to_host_bytes_total` / `ray_tpu_host_to_device_bytes_total`)
+so "zero host-staging bytes on same-slice edges" is a pinned invariant,
+not a claim.  `device_fallback_bytes` counts arrays that could not
+export a zero-copy host view (non-contiguous shardings, real
+accelerators without a host-addressable buffer) and paid the extra
+materialization — report-only, never an error.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+# -- copy audit ---------------------------------------------------------------
+
+_audit_lock = threading.Lock()
+_audit = {
+    "device_to_host_bytes": 0,   # staging copies: device buffer -> host view
+    "host_to_device_bytes": 0,   # uploads: host view -> device buffer
+    "device_fallback_bytes": 0,  # subset of d2h that paid an EXTRA copy
+    "device_arrays_staged": 0,
+    "device_arrays_local": 0,    # rung-0 handoffs (no bytes moved)
+}
+
+
+def _record(key: str, nbytes: int, count_key: Optional[str] = None):
+    with _audit_lock:
+        _audit[key] += nbytes
+        if count_key:
+            _audit[count_key] += 1
+    try:
+        from ray_tpu.util.metrics import Counter
+        if key == "device_to_host_bytes":
+            Counter("ray_tpu_device_to_host_bytes_total",
+                    "device->host staging bytes (copy audit)").inc(nbytes)
+        elif key == "host_to_device_bytes":
+            Counter("ray_tpu_host_to_device_bytes_total",
+                    "host->device upload bytes (copy audit)").inc(nbytes)
+        elif key == "device_fallback_bytes":
+            Counter("ray_tpu_device_staging_fallback_bytes_total",
+                    "device staging bytes that paid an extra "
+                    "materialization (non-contiguous / unaddressable)"
+                    ).inc(nbytes)
+    except Exception:
+        pass  # metrics registry must never break the data path
+
+
+def device_copy_stats() -> dict:
+    """Snapshot of the device copy-audit counters for this process."""
+    with _audit_lock:
+        return dict(_audit)
+
+
+def record_d2h(nbytes: int) -> None:
+    """Audit a device->host copy made OUTSIDE the serializer (explicit
+    host-staging downgrades, e.g. the engine's host-staged KV A/B path):
+    every transfer seam counts, not just the automatic ones."""
+    _record("device_to_host_bytes", int(nbytes))
+
+
+def record_h2d(nbytes: int) -> None:
+    """Audit a host->device upload made outside the serializer."""
+    _record("host_to_device_bytes", int(nbytes))
+
+
+def _reset_copy_stats():
+    """Test helper: zero the audit so deltas can be asserted exactly."""
+    with _audit_lock:
+        for k in _audit:
+            _audit[k] = 0
+
+
+# -- jax detection ------------------------------------------------------------
+
+def _jax():
+    """The jax module IF the process already imported it (a value can only
+    contain jax.Arrays if jax is loaded; never import it ourselves)."""
+    return sys.modules.get("jax")
+
+
+def is_device_array(x) -> bool:
+    jax = _jax()
+    if jax is None:
+        return False
+    try:
+        return isinstance(x, jax.Array) and not isinstance(
+            x, jax.core.Tracer)
+    except Exception:
+        return False
+
+
+# -- specs --------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DeviceArraySpec:
+    """Compile-time-negotiable description of a device-array payload:
+    what crosses a DAG edge is this spec; the bytes ride the ladder."""
+    dtype: str
+    shape: Tuple[int, ...]
+    nbytes: int
+    sharding: str  # fingerprint: platform + participating-device count
+
+    @classmethod
+    def of(cls, arr) -> "DeviceArraySpec":
+        try:
+            devs = arr.sharding.device_set
+            plat = next(iter(devs)).platform if devs else "?"
+            fp = f"{plat}:{len(devs)}"
+        except Exception:
+            fp = "host:1"
+        return cls(dtype=str(arr.dtype), shape=tuple(arr.shape),
+                   nbytes=int(arr.nbytes), sharding=fp)
+
+    def compatible(self, other: "DeviceArraySpec") -> bool:
+        return (self.dtype == other.dtype and self.shape == other.shape)
+
+
+def spec_of(x) -> Optional[DeviceArraySpec]:
+    return DeviceArraySpec.of(x) if is_device_array(x) else None
+
+
+def validate_against_spec(value, spec: dict, where: str = "?"):
+    """Step-time guard for a stage's DECLARED output spec: every device
+    leaf of `value` must match the promised shape/dtype.  (Declaration
+    disagreements between stages fail earlier, at compile time.)"""
+    from ray_tpu import exceptions as exc
+    want_shape = tuple(spec["shape"])
+    want_dtype = spec["dtype"]
+
+    def check(arr):
+        if tuple(arr.shape) != want_shape or str(arr.dtype) != want_dtype:
+            raise exc.DeviceSpecMismatchError(
+                f"stage {where!r} produced a device array of "
+                f"shape={tuple(arr.shape)} dtype={arr.dtype}, but its "
+                f"declared payload spec is shape={want_shape} "
+                f"dtype={want_dtype}")
+        return arr
+
+    _map_device_leaves(value, check)
+
+
+# -- host staging (rung 1) ----------------------------------------------------
+
+def _host_view(arr) -> Tuple[Any, bool]:
+    """A host-memory ndarray exposing `arr`'s bytes.  Returns
+    (view, zero_copy): zero_copy=True means the view ALIASES the device
+    buffer (CPU backend / host-addressable memory — the arena memcpy is
+    then the only copy); False means we had to materialize (counted as
+    `device_fallback_bytes`)."""
+    import numpy as np
+    try:
+        if len(arr.sharding.device_set) == 1 and arr.is_fully_addressable:
+            v = np.from_dlpack(arr)
+            if v.flags["C_CONTIGUOUS"]:
+                return v, True
+    except Exception:
+        pass
+    return np.asarray(arr), False
+
+
+class _DeviceLeaf:
+    """Serialize-side wrapper substituted for a jax.Array leaf: pickles
+    as (spec, PickleBuffer over a host view) so the payload bytes travel
+    out-of-band and land in the arena with exactly one memcpy."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __reduce_ex__(self, protocol):
+        arr = self.arr
+        view, zero_copy = _host_view(arr)
+        nbytes = int(view.nbytes)
+        _record("device_to_host_bytes", nbytes, "device_arrays_staged")
+        if not zero_copy:
+            _record("device_fallback_bytes", nbytes)
+        spec = DeviceArraySpec.of(arr)
+        return (_rebuild_device_array,
+                (spec, pickle.PickleBuffer(view)))
+
+
+def _rebuild_device_array(spec: DeviceArraySpec, buf):
+    """Deserialize-side reconstructor: one `jax.device_put` upload from
+    the (possibly arena-backed) buffer; no intermediate host copy."""
+    import numpy as np
+    jax = sys.modules.get("jax")
+    arr = np.frombuffer(buf, dtype=np.dtype(spec.dtype)).reshape(spec.shape)
+    _record("host_to_device_bytes", int(arr.nbytes))
+    _notice_rebuilt(int(arr.nbytes))
+    if jax is None:
+        import jax  # the consumer needs a device to land on
+    return jax.device_put(arr)
+
+
+# -- container walking --------------------------------------------------------
+
+_MAX_DEPTH = 8
+
+
+def _map_device_leaves(value, fn: Callable, depth: int = _MAX_DEPTH):
+    """Rebuild `value` with every jax.Array leaf replaced by fn(leaf).
+    Containers (list/tuple/dict) are walked to a bounded depth; other
+    objects pass through untouched (a custom object hiding a device
+    array falls back to jax's own pickle path).  Returns (new, hits)."""
+    if is_device_array(value):
+        return fn(value), 1
+    if depth <= 0:
+        return value, 0
+    if type(value) is list:
+        hits, out = 0, []
+        for v in value:
+            nv, h = _map_device_leaves(v, fn, depth - 1)
+            out.append(nv)
+            hits += h
+        return (out if hits else value), hits
+    if type(value) is tuple:
+        hits, out = 0, []
+        for v in value:
+            nv, h = _map_device_leaves(v, fn, depth - 1)
+            out.append(nv)
+            hits += h
+        return (tuple(out) if hits else value), hits
+    if type(value) is dict:
+        hits, out = 0, {}
+        for k, v in value.items():
+            nv, h = _map_device_leaves(v, fn, depth - 1)
+            out[k] = nv
+            hits += h
+        return (out if hits else value), hits
+    return value, 0
+
+
+def has_device_leaves(value) -> bool:
+    if _jax() is None:
+        return False
+    _, hits = _map_device_leaves(value, lambda a: a)
+    return hits > 0
+
+
+def swap_device_leaves(value) -> Tuple[Any, int]:
+    """Serializer pre-pass: substitute `_DeviceLeaf` wrappers so device
+    bytes travel out-of-band (one copy).  Returns (value', n_leaves)."""
+    if _jax() is None:
+        return value, 0
+    return _map_device_leaves(value, _DeviceLeaf)
+
+
+def split_device_leaves(value):
+    """Rung-0 encode: extract the live arrays.  Returns
+    (skeleton, leaves, specs) where skeleton has `_LeafRef(i)` markers."""
+    leaves: List[Any] = []
+    specs: List[DeviceArraySpec] = []
+
+    def grab(arr):
+        leaves.append(arr)
+        specs.append(DeviceArraySpec.of(arr))
+        return _LeafRef(len(leaves) - 1)
+
+    skeleton, _ = _map_device_leaves(value, grab)
+    return skeleton, leaves, specs
+
+
+@dataclass(frozen=True)
+class _LeafRef:
+    """Placeholder for a device leaf travelling out of band (rung 0)."""
+    index: int
+
+
+def join_device_leaves(skeleton, leaves):
+    def back(v, depth=_MAX_DEPTH):
+        if isinstance(v, _LeafRef):
+            return leaves[v.index]
+        if depth <= 0:
+            return v
+        if type(v) is list:
+            return [back(x, depth - 1) for x in v]
+        if type(v) is tuple:
+            return tuple(back(x, depth - 1) for x in v)
+        if type(v) is dict:
+            return {k: back(x, depth - 1) for k, x in v.items()}
+        return v
+    return back(skeleton)
+
+
+# -- deserialize-from-view safety --------------------------------------------
+
+def detach_host_leaves(value, source: memoryview):
+    """After deserializing DIRECTLY from an arena view (so device leaves
+    upload straight from the arena), any host ndarray/bytes leaves still
+    alias the view; copy them out so the arena pin can be released.
+    Device payload skeletons carry only small metadata, so this is
+    cheap — the device bytes themselves never touch it."""
+    import numpy as np
+    base = np.frombuffer(source, np.uint8)
+    lo = base.ctypes.data
+    hi = lo + base.nbytes
+
+    def aliases(v) -> bool:
+        b = v
+        while b.base is not None and isinstance(b.base, np.ndarray):
+            b = b.base
+        try:
+            ptr = b.__array_interface__["data"][0]
+        except Exception:
+            return False
+        return lo <= ptr < hi
+
+    def walk(v, depth=_MAX_DEPTH):
+        if isinstance(v, np.ndarray):
+            return v.copy() if aliases(v) else v
+        if depth <= 0:
+            return v
+        if type(v) is list:
+            return [walk(x, depth - 1) for x in v]
+        if type(v) is tuple:
+            return tuple(walk(x, depth - 1) for x in v)
+        if type(v) is dict:
+            return {k: walk(x, depth - 1) for k, x in v.items()}
+        return v
+    return walk(value)
+
+
+# -- serialize/deserialize notices (TLS) -------------------------------------
+
+_tls = threading.local()
+
+
+def _notice_rebuilt(nbytes: int):
+    _tls.rebuilt_bytes = getattr(_tls, "rebuilt_bytes", 0) + nbytes
+    _tls.rebuilt_n = getattr(_tls, "rebuilt_n", 0) + 1
+
+
+def take_rebuilt_notice() -> Tuple[int, int]:
+    """(n_leaves, bytes) of device arrays rebuilt by THIS thread since
+    the last call — lets get()/recv seams register device-tier locations
+    without re-walking the value."""
+    n = getattr(_tls, "rebuilt_n", 0)
+    b = getattr(_tls, "rebuilt_bytes", 0)
+    _tls.rebuilt_n = 0
+    _tls.rebuilt_bytes = 0
+    return n, b
+
+
+def note_staged_leaves(n: int):
+    _tls.staged_n = getattr(_tls, "staged_n", 0) + n
+
+
+def take_staged_notice() -> int:
+    n = getattr(_tls, "staged_n", 0)
+    _tls.staged_n = 0
+    return n
+
+
+# -- rung-0 in-process registry ----------------------------------------------
+
+MAGIC_LOCAL = b"\xffRTDVL\x00\x01"   # 8B: local-token device message
+MAGIC_STAGED = b"\xffRTDVS\x00\x01"  # 8B: staged payload, in-place decode ok
+
+_local_lock = threading.Lock()
+_local: dict = {}        # token -> [leaves, remaining_takes]
+_local_seq = [0]
+
+
+def register_local(leaves: List[Any], nreaders: int) -> bytes:
+    """Park live device arrays for same-process consumers; the ring
+    carries only the returned 8-byte token.  Refcounted by reader."""
+    import os
+    with _local_lock:
+        _local_seq[0] += 1
+        token = struct.pack("<II", os.getpid() & 0xFFFFFFFF,
+                            _local_seq[0] & 0xFFFFFFFF)
+        _local[token] = [leaves, max(1, int(nreaders))]
+    with _audit_lock:
+        _audit["device_arrays_local"] += len(leaves)
+    return token
+
+
+def take_local(token: bytes) -> List[Any]:
+    with _local_lock:
+        ent = _local.get(token)
+        if ent is None:
+            raise KeyError(f"device-local token {token!r} not registered "
+                           "(producer restarted or token already drained)")
+        ent[1] -= 1
+        if ent[1] <= 0:
+            del _local[token]
+        return ent[0]
+
+
+def local_registry_size() -> int:
+    with _local_lock:
+        return len(_local)
+
+
+def drop_local(token: bytes):
+    """Unconditionally forget a token (producer-side cleanup on serve
+    loop exit; missing tokens — already drained — are a no-op)."""
+    with _local_lock:
+        _local.pop(token, None)
+
+
+def local_is_registered(token: bytes) -> bool:
+    with _local_lock:
+        return token in _local
+
+
+# -- DAG body encode/decode ---------------------------------------------------
+
+def dag_encode_body(ctx, status: bytes, value, local_ok: bool,
+                    nreaders: int):
+    """Build a DAG message body as a parts list ([status, ...]).
+
+    rung 0 (local_ok, device leaves present): the ring carries
+    MAGIC_LOCAL + (token, skeleton, specs) — the arrays never leave the
+    device.  Returns (parts, token) so the producer can reclaim the
+    registry entry if the pipeline tears down before consumers drain it.
+
+    rung 1 (device leaves crossing processes): MAGIC_STAGED marks the
+    payload as safe to decode IN PLACE from the arena view (device
+    leaves upload straight from it; host leaves are detached).
+
+    Plain host payloads keep the unmarked wire form."""
+    if local_ok and has_device_leaves(value):
+        skeleton, leaves, specs = split_device_leaves(value)
+        token = register_local(leaves, nreaders)
+        ser = ctx.serialize((token, skeleton,
+                             [s.__dict__ for s in specs]))
+        return [status, MAGIC_LOCAL, *ser], token
+    take_staged_notice()                    # drain stale notices
+    ser = ctx.serialize(value)
+    if take_staged_notice():
+        return [status, MAGIC_STAGED, *ser], None
+    return [status, *ser], None
+
+
+def dag_decode_body(ctx, body):
+    """Decode a DAG message body (status byte stripped by the caller's
+    slicing here).  `body` may be bytes (inline) or a pinned arena view
+    (spilled, via recv_view) — the caller releases it AFTER this
+    returns; no reference into the view survives."""
+    payload = memoryview(body)[1:]
+    if payload[:8] == MAGIC_LOCAL:
+        token, skeleton, _specs = ctx.deserialize(payload[8:])
+        return join_device_leaves(skeleton, take_local(token))
+    if payload[:8] == MAGIC_STAGED:
+        v = ctx.deserialize(payload[8:])
+        return detach_host_leaves(v, payload)
+    if not isinstance(body, (bytes, bytearray)):
+        # Unmarked spilled payload: preserve the copy-out discipline —
+        # host ndarray leaves may alias the view as pickle-5 buffers.
+        payload = memoryview(bytes(payload))
+    return ctx.deserialize(payload)

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 class _Entry:
     __slots__ = ("data", "is_exception", "plasma_node", "size",
-                 "secondaries")
+                 "secondaries", "device_nodes")
 
     def __init__(self, data, is_exception: bool = False,
                  plasma_node=None, size=None):
@@ -43,9 +43,18 @@ class _Entry:
         # primary.  None until the first registration (cheap common
         # case: most objects are never pulled anywhere).
         self.secondaries = None
+        # DEVICE-TIER directory (device-direct data plane): nodes whose
+        # workers hold this object's device arrays RESIDENT on their
+        # accelerators (put/get of a value containing jax.Arrays
+        # registers here).  Strictly a SCHEDULING hint — device bytes
+        # live in process memory, not in any arena, so these addresses
+        # are never valid pull sources and stay out of locations().
+        self.device_nodes = None
 
     def locations(self):
-        """All known holders, primary first.  List of address tuples."""
+        """All known holders, primary first.  List of address tuples.
+        Device-tier holders are deliberately excluded — they are
+        scheduling hints, not pullable replicas (see device_locations)."""
         out = []
         if self.plasma_node is not None:
             out.append(tuple(self.plasma_node))
@@ -87,14 +96,29 @@ class MemoryStore:
     # ----------------------------------------------- replica directory ---
     def add_location(self, object_id: bytes, addr, *,
                      primary: bool = False,
+                     device: bool = False,
                      max_secondaries: int = 8) -> bool:
         """Register `addr` as a holder of a plasma object.  primary=True
         repoints the primary record (drain adoption); otherwise the addr
         joins the secondary set (bounded, oldest registration dropped —
         secondaries are evictable caches, so dropping a directory entry
-        only costs a source, never correctness)."""
+        only costs a source, never correctness).  device=True records a
+        DEVICE-TIER holder instead: a node whose workers keep the
+        object's arrays resident on accelerators — a locality-scheduling
+        signal, never a pull source."""
         entry = self._objects.get(object_id)
-        if entry is None or (entry.data is not None and not primary):
+        if entry is None:
+            return False
+        if device:
+            addr = tuple(addr)
+            if entry.device_nodes is None:
+                entry.device_nodes = []
+            if addr not in entry.device_nodes:
+                entry.device_nodes.append(addr)
+                while len(entry.device_nodes) > max_secondaries:
+                    entry.device_nodes.pop(0)
+            return True
+        if entry.data is not None and not primary:
             return False
         addr = tuple(addr)
         if primary:
@@ -127,6 +151,14 @@ class MemoryStore:
         absent/inline entries)."""
         entry = self._objects.get(object_id)
         return entry.locations() if entry is not None else []
+
+    def device_locations(self, object_id: bytes):
+        """Device-tier holders (nodes with the arrays accelerator-
+        resident): scheduling hints only, never pull sources."""
+        entry = self._objects.get(object_id)
+        if entry is None or not entry.device_nodes:
+            return []
+        return list(entry.device_nodes)
 
     def _wake(self, object_id: bytes):
         for ev in self._waiters.pop(object_id, []):

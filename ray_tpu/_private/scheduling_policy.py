@@ -107,26 +107,38 @@ def hybrid_pick(candidates: Sequence[Tuple[object, Dict[str, float],
     return min(scored, key=lambda ku: ku[1])[0]
 
 
+# Device-tier holders score ABOVE arena holders for the same bytes: an
+# accelerator-resident arg skips the arena read AND the host->device
+# upload, so a device copy is worth strictly more than a same-size
+# plasma replica when the scheduler breaks locality ties.
+DEVICE_TIER_WEIGHT = 2
+
+
 def arg_locality(args) -> Dict[Tuple, int]:
     """Bytes-already-local map of a task spec's by-reference args:
     holder address -> total hinted bytes resident there.  Fed by the
     owner's replica directory (every holder counts, not just the
     primary) via the spec's location hints; inline args and refs
-    without a size hint contribute nothing."""
+    without a size hint contribute nothing.  Device-tier holders (the
+    spec's `dev` hint: nodes with the arrays accelerator-resident)
+    count the same bytes at DEVICE_TIER_WEIGHT, so "already on this
+    slice" outranks "in a peer's arena"."""
     out: Dict[Tuple, int] = {}
     for e in args or ():
         sz = int(e.get("sz") or 0) if isinstance(e, dict) else 0
         if sz <= 0 or "ref" not in e:
             continue
         locs = e["ref"][2] if len(e["ref"]) > 2 else None
-        if not locs:
-            continue
-        first = locs[0]
-        if not isinstance(first, (list, tuple)):   # legacy single addr
-            locs = [locs]
-        for a in locs:
+        if locs:
+            first = locs[0]
+            if not isinstance(first, (list, tuple)):  # legacy single addr
+                locs = [locs]
+            for a in locs:
+                key = tuple(a)
+                out[key] = out.get(key, 0) + sz
+        for a in e.get("dev") or ():
             key = tuple(a)
-            out[key] = out.get(key, 0) + sz
+            out[key] = out.get(key, 0) + sz * DEVICE_TIER_WEIGHT
     return out
 
 

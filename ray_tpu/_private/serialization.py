@@ -11,9 +11,15 @@ borrowed references crossing process boundaries.
 Wire layout of a serialized object:
   [8B header_len][pickled bytes][8B nbufs][(8B len, payload) * nbufs]
 
-jax.Arrays on device are serialized by staging to host memory (np.asarray);
-device-to-device movement never goes through this path — in-graph transfers
-are XLA's job (see parallel/collectives.py).
+jax.Arrays on device are staged to host exactly ONCE: a serialize-side
+pre-pass (`device_plane.swap_device_leaves`) substitutes each device leaf
+with a wrapper whose reduce emits a dlpack/`__array_interface__` host view
+as a pickle-5 out-of-band buffer, so the bytes land in the destination
+arena via the same single `write_parts_into` memcpy as any ndarray — no
+intermediate `np.asarray` materialization (the old double copy), no pickle
+of the payload.  Deserialize re-uploads with `jax.device_put`.  Both seams
+stamp the device copy audit (see _private/device_plane.py).  In-graph
+device-to-device movement is still XLA's job (see parallel/collectives.py).
 """
 
 from __future__ import annotations
@@ -50,6 +56,10 @@ class SerializationContext:
 
     # -- data path -----------------------------------------------------------
     def serialize(self, value: Any) -> List[memoryview | bytes]:
+        from ray_tpu._private import device_plane
+        value, n_dev = device_plane.swap_device_leaves(value)
+        if n_dev:
+            device_plane.note_staged_leaves(n_dev)
         buffers: List[pickle.PickleBuffer] = []
         header = cloudpickle.dumps(
             value, protocol=5, buffer_callback=buffers.append

@@ -718,7 +718,7 @@ class Executor:
         the existing telemetry flush, nothing new per step on the wire."""
         import pickle
         from ..dag import _transport
-        from . import flight_recorder
+        from . import device_plane, flight_recorder
         from .shm_store import Channel, ChannelClosed
         from ..util.metrics import Gauge
         store = self.core.store
@@ -752,6 +752,13 @@ class Executor:
             coll_out = Channel.attach(store, coll["out_chan"])
             coll_ins = [(Channel.attach(store, s["chan"]), s["reader"])
                         for s in coll["in"]]
+        # Device transport ladder (see _private/device_plane.py):
+        # local_ok (compile-time: every consumer shares this process) →
+        # rung 0, the ring carries a registry token, zero host bytes.
+        dev = stage.get("device") or {}
+        dev_local_ok = bool(dev.get("local_ok"))
+        dev_spec = dev.get("spec")
+        live_tokens: list = []
         span_id = bytes(stage.get("out_chan") or stage["in"][0]["chan"])[:8]
         occ_gauge = Gauge(
             "ray_tpu_dag_ring_occupancy",
@@ -763,21 +770,34 @@ class Executor:
         try:
             while True:
                 t_wait = rec.begin()
+                recvd: list = []
                 try:
-                    bodies = [_transport.recv(store, ch, r)
-                              for ch, r in ins]
+                    for ch, r in ins:
+                        recvd.append(_transport.recv_view(store, ch, r))
                 except ChannelClosed:
+                    for _b, _rel in recvd:
+                        _rel()
                     break
                 t0 = rec.begin()
                 wait_us = max(0, (t0 - t_wait) // 1000)
                 err_body = next(
-                    (b for b in bodies if b[:1] == _transport.ERR), None)
+                    (bytes(b) for b, _rel in recvd
+                     if b[:1] == _transport.ERR), None)
                 result = None
+                vals = None
                 if err_body is None:
                     try:
-                        vals = [ctx.deserialize(memoryview(b)[1:])
-                                for b in bodies]
-
+                        # Decode straight from the pinned arena views:
+                        # device leaves upload from the arena (one h2d),
+                        # host payloads keep the copy-out discipline.
+                        vals = [device_plane.dag_decode_body(ctx, b)
+                                for b, _rel in recvd]
+                    except BaseException as e:  # noqa: BLE001
+                        err_body = self._dag_err_body(ctx, e)
+                for _b, _rel in recvd:
+                    _rel()
+                if err_body is None:
+                    try:
                         def _arg(p):
                             kind, v = p
                             if kind == "ch":
@@ -796,6 +816,11 @@ class Executor:
                             # loop lives on an executor thread).
                             result = asyncio.run_coroutine_threadsafe(
                                 result, self.core.loop).result()
+                        if dev_spec is not None:
+                            # Declared output contract: violations are
+                            # typed per-step errors, not silent drift.
+                            device_plane.validate_against_spec(
+                                result, dev_spec, method_name)
                     except BaseException as e:  # noqa: BLE001
                         err_body = self._dag_err_body(ctx, e)
                 if coll:
@@ -849,14 +874,26 @@ class Executor:
                     if err_body is not None:
                         body = err_body
                     else:
-                        body = b"".join([_transport.OK,
-                                         *ctx.serialize(result)])
+                        body, tok = device_plane.dag_encode_body(
+                            ctx, _transport.OK, result,
+                            local_ok=dev_local_ok, nreaders=nreaders)
+                        if tok is not None:
+                            live_tokens.append(tok)
+                            if len(live_tokens) >= 512:
+                                live_tokens = [
+                                    t for t in live_tokens
+                                    if device_plane.local_is_registered(t)]
                     _transport.send(store, out, body, nreaders, slot_bytes,
                                     mint)
                     occ_gauge.set(out.stats()["occupancy"], tags=occ_tags)
                 rec.end("dag", "dag:step", t0, id=span_id,
                         method=method_name, wait_us=wait_us)
         finally:
+            # Reclaim rung-0 registry entries consumers never drained
+            # (teardown mid-pipeline): the arrays are freed with the
+            # tokens; drained tokens are no-ops.
+            for t in live_tokens:
+                device_plane.drop_local(t)
             if out is not None:
                 out.close()   # cascade EOF downstream
             if coll_out is not None:

@@ -85,6 +85,44 @@ class ClassMethodNode(DAGNode):
         self.args = args
         self.kwargs = kwargs
         self.collective: Optional[dict] = None   # set by allreduce_bind
+        self.device_spec = None        # declared output DeviceArraySpec
+        self.device_arg_specs: Optional[dict] = None  # arg idx/kw -> spec
+
+    def with_device_payload(self, spec=None, arg_specs: Optional[dict] = None
+                            ) -> "ClassMethodNode":
+        """Declare device-array payload specs for compile-time
+        negotiation (reference: aDAG `with_tensor_transport` /
+        `TorchTensorType` annotations).  `spec` describes this node's
+        output array; `arg_specs` maps a positional index or kwarg name
+        to the spec this node EXPECTS from the producer bound there.
+        Specs are `DeviceArraySpec` instances or `(shape, dtype)`
+        shorthand.  Mismatched declarations across an edge raise
+        :class:`~ray_tpu.exceptions.DeviceSpecMismatchError` at
+        `experimental_compile` time, not on the first step."""
+        if spec is not None:
+            self.device_spec = _norm_spec(spec)
+        if arg_specs:
+            self.device_arg_specs = {k: _norm_spec(v)
+                                     for k, v in arg_specs.items()}
+        return self
+
+
+def _norm_spec(s):
+    from .._private.device_plane import DeviceArraySpec
+    if isinstance(s, DeviceArraySpec):
+        return s
+    if isinstance(s, tuple) and len(s) == 2:
+        import numpy as np
+        shape, dtype = s
+        dt = np.dtype(dtype)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return DeviceArraySpec(dtype=str(dt), shape=tuple(shape),
+                               nbytes=n * dt.itemsize, sharding="any")
+    raise TypeError(
+        "device payload spec must be a DeviceArraySpec or a "
+        f"(shape, dtype) tuple, got {type(s).__name__}")
 
 
 class CollectiveOutNode(DAGNode):
@@ -196,6 +234,11 @@ class CompiledDAG:
             _walk(out)
         if not self._plan:
             raise ValueError("empty DAG: nothing was bound")
+        # Device-payload spec negotiation happens HERE — before channel
+        # compilation and OUTSIDE its fallback try: a declaration
+        # mismatch is a typed authoring error, never a reason to fall
+        # back to task chaining.
+        self._negotiate_device_specs()
 
         self._channel_mode = False
         self._broken: Optional[BaseException] = None
@@ -225,6 +268,36 @@ class CompiledDAG:
                     f"setup failed: {e}"
                 ) from e
             logger.info("compiled DAG falling back to task chaining: %s", e)
+
+    # ------------------------------------------------------- device specs ---
+    def _negotiate_device_specs(self) -> None:
+        """Cross-check every consumer's declared device-arg spec against
+        the producer's declared output spec.  Runs at compile time so a
+        shape/dtype disagreement surfaces as a typed
+        DeviceSpecMismatchError before any channel ring is allocated."""
+        from .. import exceptions as exc
+        for node in self._plan:
+            expects = node.device_arg_specs
+            if not expects:
+                continue
+            bound = {i: a for i, a in enumerate(node.args)}
+            bound.update(node.kwargs)
+            for where, want in expects.items():
+                a = bound.get(where)
+                if not isinstance(a, (ClassMethodNode, CollectiveOutNode)):
+                    continue   # InputNode/const: nothing declared upstream
+                have = self._producer(a).device_spec
+                if have is None:
+                    continue   # producer made no promise to check against
+                if not want.compatible(have):
+                    raise exc.DeviceSpecMismatchError(
+                        f"device payload spec mismatch on edge into "
+                        f"{node.actor_method._method_name!r} arg "
+                        f"{where!r}: producer "
+                        f"{self._producer(a).actor_method._method_name!r} "
+                        f"declares shape={have.shape} dtype={have.dtype}, "
+                        f"consumer expects shape={want.shape} "
+                        f"dtype={want.dtype}")
 
     # ---------------------------------------------------------- channels ----
     @staticmethod
@@ -415,6 +488,12 @@ class CompiledDAG:
                 (_driver_ring(cid), reader_of[(key, "driver")]))
 
         # ---- stage specs + serve loops -----------------------------------
+        # Device transport ladder, rung 0: an output edge whose consumers
+        # ALL live in the producer's own worker process (methods of the
+        # same actor) moves device arrays via the in-process registry —
+        # the ring carries an 8-byte token + specs, never the bytes.
+        aid_by_stage = {id(n): n.actor_method._handle._actor_id
+                        for n in self._plan}
         self._serve_refs = []
         for node in self._plan:
             my = node_of_stage(node)
@@ -455,6 +534,10 @@ class CompiledDAG:
                            for peer in coll["_group"]["nodes"]
                            if peer is not node],
                 }
+            my_aid = node.actor_method._handle._actor_id
+            local_ok = has_out and all(
+                aid_by_stage.get(c) == my_aid
+                for c in consumers.get(out_key, []))
             stage = {
                 "method": node.actor_method._method_name,
                 "in": in_specs,
@@ -466,6 +549,11 @@ class CompiledDAG:
                 "slot_bytes": self._slot_bytes,
                 "spill_prefix": self._spill_prefix,
                 "collective": coll_spec,
+                "device": {
+                    "local_ok": local_ok,
+                    "spec": (node.device_spec.__dict__
+                             if node.device_spec is not None else None),
+                },
             }
             serve = ActorMethod(node.actor_method._handle,
                                 "__ray_dag_serve__")
@@ -549,8 +637,13 @@ class CompiledDAG:
         from . import _transport
         from .._private.shm_store import ChannelClosed
         from .._private.serialization import get_context
+        from .._private import device_plane
         ctx = get_context()
-        body = b"".join([_transport.OK, *ctx.serialize(inp)])
+        # Parts form: a spilled input scatters straight into the arena
+        # via write_parts_into (device leaves staged exactly once, no
+        # b"".join materialization of large host payloads either).
+        body, _tok = device_plane.dag_encode_body(
+            ctx, _transport.OK, inp, local_ok=False, nreaders=1)
         with self._send_lock:
             idx = self._exec_idx
             sent = 0
@@ -588,6 +681,7 @@ class CompiledDAG:
         from . import _transport
         from .._private.shm_store import ChannelClosed
         from .._private.serialization import get_context
+        from .._private import device_plane
         from .. import exceptions as exc
         import time as _time
         deadline = (None if timeout is None
@@ -613,12 +707,15 @@ class CompiledDAG:
                         tmo = max(0, int((deadline - _time.monotonic())
                                          * 1000))
                     try:
-                        body = _transport.recv(self._core.store, ch, ridx,
-                                               timeout_ms=tmo)
+                        body, release = _transport.recv_view(
+                            self._core.store, ch, ridx, timeout_ms=tmo)
                     except ChannelClosed:
                         self._raise_broken()
-                    status, payload = body[:1], body[1:]
-                    v = ctx.deserialize(memoryview(payload))
+                    try:
+                        status = bytes(body[:1])
+                        v = device_plane.dag_decode_body(ctx, body)
+                    finally:
+                        release()
                     self._partial.append(
                         _Err(v) if status == _transport.ERR else v)
                 self._results[self._next_read] = self._partial

@@ -27,15 +27,34 @@ OK = b"\x00"
 ERR = b"\x01"
 
 
-def send(store: ShmStore, chan: Channel, body: bytes, nreaders: int,
+def send(store: ShmStore, chan: Channel, body, nreaders: int,
          slot_bytes: int, mint_id, timeout_ms: int = -1) -> None:
-    """body = status byte + serialized value."""
-    if 1 + len(body) <= slot_bytes:
-        chan.write(_INLINE + body, timeout_ms=timeout_ms)
+    """body = status byte + serialized value: either pre-joined bytes or
+    a parts list ([status, *serialized_parts]).  Parts spill via
+    write_parts_into — each part memcpys straight into the arena view
+    (the single host copy of the staged-device discipline; also spares
+    every large host payload the b"".join materialization)."""
+    parts = None
+    if not isinstance(body, (bytes, bytearray, memoryview)):
+        parts = body
+        total = sum(
+            len(p) if isinstance(p, (bytes, bytearray)) else p.nbytes
+            for p in parts)
+        if 1 + total <= slot_bytes:
+            body = b"".join(parts)      # inline: small by definition
+        else:
+            body = None
+    if body is not None and 1 + len(body) <= slot_bytes:
+        chan.write(_INLINE + bytes(body), timeout_ms=timeout_ms)
         return
     oid = mint_id()
-    buf = store.create_buffer(oid, len(body))   # created pinned (refcount 1)
-    buf[:len(body)] = body
+    if parts is not None and body is None:
+        buf = store.create_buffer(oid, total)   # pinned (refcount 1)
+        from .._private.serialization import write_parts_into
+        write_parts_into(parts, buf)
+    else:
+        buf = store.create_buffer(oid, len(body))
+        buf[:len(body)] = body
     buf.release()
     store.seal(oid)
     for _ in range(nreaders - 1):               # one pin per reader total
@@ -145,3 +164,40 @@ def recv(store: ShmStore, chan: Channel, reader: int,
     # the last reader's drop deletes the object.
     store.release_n_and_delete_if(oid, 2)
     return body
+
+
+def recv_view(store: ShmStore, chan: Channel, reader: int,
+              timeout_ms: int = -1):
+    """Like recv but, for spilled messages, returns the pinned arena view
+    itself plus a release callable instead of copying the body out.
+    Device-payload decode uploads straight from the view (one host copy
+    total per direction) and bridges forward it without materializing;
+    the caller MUST invoke release() exactly once when done with the
+    view (after which the memory may be reused).  Inline messages return
+    (bytes, no-op)."""
+    msg = chan.read(reader, timeout_ms=timeout_ms)
+    if msg[:1] == _INLINE:
+        return msg[1:], _noop
+    oid = bytes(msg[1:21])
+    view = store.get(oid, timeout_ms=10_000)
+    if view is None:
+        raise RuntimeError(f"spilled DAG message {oid.hex()} vanished")
+    done = [False]
+
+    def release():
+        if done[0]:
+            return
+        done[0] = True
+        try:
+            view.release()
+        except BufferError:
+            # A straggler export (decoder bug) keeps the mapping alive;
+            # still drop the pins — the object outlives via the mapping.
+            pass
+        store.release_n_and_delete_if(oid, 2)
+
+    return view, release
+
+
+def _noop():
+    pass

@@ -166,6 +166,20 @@ class DAGBrokenError(RayError):
     pass
 
 
+class DeviceSpecMismatchError(RayError):
+    """Declared device-array payload specs disagree across a compiled-DAG
+    edge (or a stage produced an array violating its declared spec).
+
+    The shape/dtype contract of `with_device_payload` is negotiated at
+    COMPILE time: a producer declaring one spec feeding a consumer
+    expecting another raises this during `experimental_compile` — the
+    pipeline never launches — instead of failing on the first step
+    (reference: aDAG `TorchTensorType` shape/dtype declarations checked
+    when the accelerator channel is allocated)."""
+
+    pass
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

@@ -161,6 +161,8 @@ def device_get(ref: DeviceRef, *, timeout: Optional[float] = 60.0):
                          dtype=np.dtype(res["dtype"]))
     out = jnp.asarray(host.reshape(res["shape"]))
     _record_staged(host.nbytes, time.perf_counter() - t0)
+    from .._private import device_plane
+    device_plane.record_h2d(host.nbytes)   # unified copy audit
     return out
 
 

@@ -1304,8 +1304,15 @@ class LLMEngine:
         data = self._kv_window.get(part["key"], part["handle"])
         kj = data.get("_kj")
         if kj is None:
-            kj = data["_kj"] = jnp.asarray(data["k"], self.cfg.dtype)
-            data["_vj"] = jnp.asarray(data["v"], self.cfg.dtype)
+            k_raw, v_raw = data["k"], data["v"]
+            kj = data["_kj"] = jnp.asarray(k_raw, self.cfg.dtype)
+            data["_vj"] = jnp.asarray(v_raw, self.cfg.dtype)
+            if isinstance(k_raw, np.ndarray):
+                # Host-resident part (legacy blob / cross-host pull that
+                # landed as numpy): this upload is a transfer seam —
+                # device-resident parts skip it entirely.
+                from .._private import device_plane
+                device_plane.record_h2d(kj.nbytes + data["_vj"].nbytes)
         valid = int(data.get("len", data["k"].shape[1]))
         return kj[li], data["_vj"][li], valid
 
@@ -1409,14 +1416,21 @@ class LLMEngine:
                 gather_bytes=win.bytes_fetched - b0,
                 gather_wait_us=int((win.wait_s - w0) * 1e6),
                 fetches=win.fetches - f0, prefill_chunk=True)
-        part = {"k": np.asarray(jnp.stack(ks_out)),
-                "v": np.asarray(jnp.stack(vs_out)), "len": Sc}
+        # The stripe stays DEVICE-RESIDENT: a same-process consumer
+        # (chunk c+1 via the window, or a co-located decode engine)
+        # attends to it with zero host copies, and publishing it stages
+        # exactly once through the serializer's device plane — the old
+        # np.asarray here paid a device->host sync per chunk even when
+        # nothing ever left the process.
+        part = {"k": jnp.stack(ks_out), "v": jnp.stack(vs_out), "len": Sc}
         logits = sa.logits(self.params, x, Sc - 1) if is_last else None
         return part, logits
 
     def prefill_paged(self, prompt_tokens: Sequence[int],
                       params: Optional[SamplingParams] = None, *,
-                      span: int = 64, publish=None) -> dict:
+                      span: int = 64, publish=None,
+                      pipeline: bool = True,
+                      host_staged: bool = False) -> dict:
         """Streamed chunked prefill of an arbitrarily long context with a
         bounded device working set: chunk c attends to the c already-
         published parts, then becomes part c itself.  `publish(part) ->
@@ -1424,7 +1438,17 @@ class LLMEngine:
         layer puts into the local arena — the handle is a 20-byte ref);
         without it parts travel by value (engine-standalone use).
         Returns the handoff ``{"parts": [{"span", "handle"}], "len",
-        "first"}`` that add_paged_request / decode_paged consume."""
+        "first"}`` that add_paged_request / decode_paged consume.
+
+        pipeline=True (default) overlaps chunk c's publish with chunk
+        c+1's shard compute: publishes run on a background thread and
+        the handles resolve only when the handoff is assembled — safe
+        because chunk c+1 reads part c through the gather window (seeded
+        locally), never through its handle.  host_staged=True forces the
+        legacy downgrade — every stripe is materialized to host numpy
+        before it travels — and exists for the device-vs-staged A/B
+        (perf gate `long_context_ttft_ms` vs the informational
+        `long_context_ttft_staged_ms`)."""
         params = params or SamplingParams()
         prompt = list(prompt_tokens)
         S = len(prompt)
@@ -1432,20 +1456,47 @@ class LLMEngine:
         parts_meta: List[dict] = []
         n_chunks = math.ceil(S / span)
         logits = None
-        for c in range(n_chunks):
-            s0 = c * span
-            chunk = prompt[s0:s0 + span]
-            part, logits = self.prefill_paged_chunk(
-                chunk, s0, parts_meta, span=span,
-                is_last=(c == n_chunks - 1))
-            handle = publish(part) if publish is not None else part
-            key = f"pp{id(self) & 0xffff}:{self._part_seq}"
-            self._part_seq += 1
-            # Keep our own freshly produced stripe hot for chunk c+1.
-            self._kv_window.put(key, part)
-            parts_meta.append({"span": (s0, s0 + len(chunk)),
-                               "handle": handle, "key": key})
-        first = self._sample_batch([logits], [params])[0]
+        pub_pool = None
+        try:
+            for c in range(n_chunks):
+                s0 = c * span
+                chunk = prompt[s0:s0 + span]
+                part, logits = self.prefill_paged_chunk(
+                    chunk, s0, parts_meta, span=span,
+                    is_last=(c == n_chunks - 1))
+                if host_staged:
+                    from .._private import device_plane
+                    hk = np.asarray(part["k"])
+                    hv = np.asarray(part["v"])
+                    device_plane.record_d2h(hk.nbytes + hv.nbytes)
+                    part = {"k": hk, "v": hv, "len": part["len"]}
+                key = f"pp{id(self) & 0xffff}:{self._part_seq}"
+                self._part_seq += 1
+                # Keep our own freshly produced stripe hot for chunk c+1.
+                self._kv_window.put(key, part)
+                if publish is None:
+                    handle = part
+                elif pipeline:
+                    if pub_pool is None:
+                        import concurrent.futures as _cf
+                        pub_pool = _cf.ThreadPoolExecutor(
+                            1, thread_name_prefix="kvpublish")
+                    handle = pub_pool.submit(publish, part)
+                else:
+                    handle = publish(part)
+                parts_meta.append({"span": (s0, s0 + len(chunk)),
+                                   "handle": handle, "key": key})
+            first = self._sample_batch([logits], [params])[0]
+            if pub_pool is not None:
+                # Resolve pipelined publishes (any failure surfaces here,
+                # before the handoff can reference a phantom part).
+                for m in parts_meta:
+                    import concurrent.futures as _cf
+                    if isinstance(m["handle"], _cf.Future):
+                        m["handle"] = m["handle"].result()
+        finally:
+            if pub_pool is not None:
+                pub_pool.shutdown(wait=True)
         return {"parts": [{"span": m["span"], "handle": m["handle"]}
                           for m in parts_meta],
                 "len": S, "first": int(first)}
@@ -1486,10 +1537,13 @@ class LLMEngine:
         """Prefill-node half of P/D disaggregation (reference pattern:
         llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py):
         returns (kv_blob, first_token) to ship to a decode node via the
-        object store.  With a sharded engine this is the KV-transfer path:
-        np.asarray gathers the tp-sharded cache to host for the wire.
-        With the prefix cache on, a hit computes only the suffix and
-        gathers the shared span straight out of the resident pages."""
+        object store.  The blob's k/v stay DEVICE-RESIDENT jax arrays: a
+        same-process decode engine installs them with no host round-trip,
+        and shipping the blob stages it exactly once through the
+        serializer's device plane (a multi-device tp-sharded cache falls
+        back to a host gather there, counted as fallback bytes).  With
+        the prefix cache on, a hit computes only the suffix and gathers
+        the shared span straight out of the resident pages."""
         params = params or SamplingParams()
         S = len(prompt_tokens)
         if S >= self.max_len:
@@ -1504,16 +1558,16 @@ class LLMEngine:
             row = np.zeros(self.pages_per_slot, np.int32)
             row[:len(shared)] = shared
             logits, ks, vs = self._run_suffix(prompt, c, row)
-            ck = np.asarray(self._pk[:, np.asarray(shared)]).reshape(
+            ck = self._pk[:, jnp.asarray(np.asarray(shared))].reshape(
                 self.cfg.num_layers, c, self.cfg.num_kv_heads, -1)
-            cv = np.asarray(self._pv[:, np.asarray(shared)]).reshape(
+            cv = self._pv[:, jnp.asarray(np.asarray(shared))].reshape(
                 self.cfg.num_layers, c, self.cfg.num_kv_heads, -1)
-            k_full = np.concatenate([ck, np.asarray(ks[:, :S - c])], 1)
-            v_full = np.concatenate([cv, np.asarray(vs[:, :S - c])], 1)
+            k_full = jnp.concatenate([ck, ks[:, :S - c]], 1)
+            v_full = jnp.concatenate([cv, vs[:, :S - c]], 1)
         else:
             logits, ks, vs = self._run_prefill(prompt)
-            k_full = np.asarray(ks[:, :S])
-            v_full = np.asarray(vs[:, :S])
+            k_full = ks[:, :S]
+            v_full = vs[:, :S]
         # Populate the cache from this prefill: a prefill-only engine
         # (the P/D prefill half) runs no admission, so this is its only
         # insertion point.  The full prompt pages beyond the cached

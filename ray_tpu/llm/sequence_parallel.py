@@ -453,11 +453,15 @@ def _bench_sp_prefill(degree: int, tokens: int, strategy: str,
     return tokens / dt
 
 
-def _bench_long_context_ttft(context: int, span: int) -> float:
+def _bench_long_context_ttft(context: int, span: int,
+                             host_staged: bool = False) -> float:
     """TTFT (ms) for a context served through the paged cross-host KV
     path: streamed chunked prefill (pool-free) + paged admission — the
     pool of BOTH engines is sized well below the context to prove the
-    page-location tier carries it."""
+    page-location tier carries it.  host_staged=True forces the legacy
+    downgrade (every KV stripe round-trips through host numpy, publish
+    pipelining off) — the informational A/B base for the device-direct
+    data plane."""
     import time
 
     from ..models import PRESETS
@@ -470,22 +474,28 @@ def _bench_long_context_ttft(context: int, span: int) -> float:
     prompt = list(np.random.default_rng(1).integers(
         1, cfg.vocab_size, context))
     sp = SamplingParams(max_tokens=4)
+    kw = dict(span=span, host_staged=host_staged,
+              pipeline=not host_staged)
     # Warm the compile caches so TTFT measures the serve path, not XLA.
-    h = pre.prefill_paged(prompt, sp, span=span)
+    h = pre.prefill_paged(prompt, sp, **kw)
     dec.decode_paged(h, sp)
-    t0 = time.perf_counter()
-    handoff = pre.prefill_paged(prompt, sp, span=span)
-    rid = dec.add_paged_request(handoff["parts"], handoff["len"],
-                                handoff["first"], sp)
-    first_seen = None
-    while dec.has_unfinished() and first_seen is None:
-        dec.step()
-        for ev_rid, _tok, _fin in dec.take_tick_events():
-            if ev_rid == rid:
-                first_seen = time.perf_counter()
-                break
-    dec.cancel_request(rid)
-    return ((first_seen or time.perf_counter()) - t0) * 1e3
+    best = None
+    for _ in range(3):         # best-of: single-shot TTFT is co-tenant
+        t0 = time.perf_counter()   # noise on a shared host
+        handoff = pre.prefill_paged(prompt, sp, **kw)
+        rid = dec.add_paged_request(handoff["parts"], handoff["len"],
+                                    handoff["first"], sp)
+        first_seen = None
+        while dec.has_unfinished() and first_seen is None:
+            dec.step()
+            for ev_rid, _tok, _fin in dec.take_tick_events():
+                if ev_rid == rid:
+                    first_seen = time.perf_counter()
+                    break
+        dec.cancel_request(rid)
+        ms = ((first_seen or time.perf_counter()) - t0) * 1e3
+        best = ms if best is None else min(best, ms)
+    return best
 
 
 def _bench_main(argv=None) -> int:
@@ -508,12 +518,17 @@ def _bench_main(argv=None) -> int:
     spn = _bench_sp_prefill(args.degree, args.tokens, args.strategy,
                             args.iters)
     ttft = _bench_long_context_ttft(args.context, args.span)
+    # Informational A/B base: same serve path with the legacy host-
+    # staged KV downgrade (reported, never gated — see perf.py).
+    ttft_staged = _bench_long_context_ttft(args.context, args.span,
+                                           host_staged=True)
     print(json.dumps({
         "sp_prefill_tokens_per_s": round(spn, 1),
         "sp_prefill_tokens_per_s_base": round(base, 1),
         "sp_degree": args.degree,
         "sp_speedup": round(spn / base, 3) if base else 0.0,
         "long_context_ttft_ms": round(ttft, 2),
+        "long_context_ttft_staged_ms": round(ttft_staged, 2),
     }))
     return 0
 

@@ -805,7 +805,9 @@ def _long_context_report(min_time_s: float) -> Dict[str, float]:
             "sp_prefill_tokens_per_s_base":
                 row["sp_prefill_tokens_per_s_base"],
             "sp_speedup": row["sp_speedup"],
-            "long_context_ttft_ms": row["long_context_ttft_ms"]})
+            "long_context_ttft_ms": row["long_context_ttft_ms"],
+            "long_context_ttft_staged_ms":
+                row.get("long_context_ttft_staged_ms", 0.0)})
     except Exception as e:  # pragma: no cover — a bench must never sink
         import logging
         logging.getLogger(__name__).warning(
@@ -814,7 +816,8 @@ def _long_context_report(min_time_s: float) -> Dict[str, float]:
             "sp_prefill_tokens_per_s": 0.0,
             "sp_prefill_tokens_per_s_base": 0.0,
             "sp_speedup": 0.0,
-            "long_context_ttft_ms": 0.0})
+            "long_context_ttft_ms": 0.0,
+            "long_context_ttft_staged_ms": 0.0})
     return _long_context_cache
 
 
@@ -831,6 +834,121 @@ def bench_sp_prefill_base(min_time_s: float) -> float:
     single-device _prefill_fn (sp_degree=1) in the same subprocess."""
     return _long_context_report(min_time_s)[
         "sp_prefill_tokens_per_s_base"]
+
+
+def bench_long_context_ttft_staged(min_time_s: float) -> float:
+    """Ungated A/B reference row: the SAME paged-KV serve path with the
+    legacy host-staged downgrade (every stripe round-trips through host
+    numpy, publish pipelining off) — what long_context_ttft_ms is read
+    against to see the device-direct data plane's win."""
+    return _long_context_report(min_time_s).get(
+        "long_context_ttft_staged_ms", 0.0)
+
+
+# Device-channel bench: a compiled same-actor edge carrying a DEVICE
+# array payload (rung 0 of the transport ladder — the ring moves an
+# 8-byte token, the array never leaves the accelerator) A/B'd against
+# the IDENTICAL pipeline carrying a same-size host numpy payload through
+# arena staging.  One run feeds the gated row and its ungated base.
+_device_channel_cache: Dict[str, float] = {}
+
+_DEV_PAYLOAD_ELEMS = 1 << 20            # 4 MiB float32 per step
+
+
+@ray_tpu.remote
+class _DevChanStage:  # noqa: D401 — bench fixture actor
+    def __init__(self, n):
+        import jax.numpy as jnp
+        self._dev = jnp.arange(n, dtype=jnp.float32)
+        self._host = np.arange(n, dtype=np.float32)
+
+    def dev(self, i):
+        return self._dev
+
+    def host(self, i):
+        return self._host
+
+    def tail(self, a):
+        return int(a.shape[0])
+
+
+def _device_channel_report(min_time_s: float) -> Dict[str, float]:
+    if _device_channel_cache:
+        return _device_channel_cache
+    try:
+        from ray_tpu.dag import InputNode
+        a = _DevChanStage.remote(_DEV_PAYLOAD_ELEMS)
+        ray_tpu.get(a.tail.remote(np.zeros(1)), timeout=120)  # warm jax
+        for kind, row in (("dev", "device_channel_steps_per_s"),
+                          ("host", "device_channel_steps_per_s_host")):
+            with InputNode() as inp:
+                dag = a.tail.bind(getattr(a, kind).bind(inp))
+            compiled = dag.experimental_compile()
+            try:
+                assert compiled._channel_mode, "compile fell back"
+                compiled.execute(0).get(timeout=60)
+
+                def run():
+                    n = 30
+                    for i in range(n):
+                        compiled.execute(i).get(timeout=60)
+                    return n
+
+                _device_channel_cache[row] = _timeit(
+                    run, min_time_s, windows=2)
+            finally:
+                compiled.teardown()
+        ray_tpu.kill(a)
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning(
+            "device channel bench failed: %s", e)
+        _device_channel_cache.setdefault("device_channel_steps_per_s", 0.0)
+        _device_channel_cache.setdefault(
+            "device_channel_steps_per_s_host", 0.0)
+    return _device_channel_cache
+
+
+def bench_device_channel_steps(min_time_s: float) -> float:
+    return _device_channel_report(min_time_s)["device_channel_steps_per_s"]
+
+
+def bench_device_channel_steps_host(min_time_s: float) -> float:
+    """Ungated A/B base: the same compiled edge, payload staged through
+    the arena as host numpy (what every edge paid before the device
+    plane)."""
+    return _device_channel_report(min_time_s)[
+        "device_channel_steps_per_s_host"]
+
+
+def bench_kv_handoff_gibs(min_time_s: float, chunk_mb: int = 64) -> float:
+    """GiB/s of a device-resident KV blob through the object plane —
+    the P/D prefill→decode handoff seam: put stages the jax arrays
+    exactly once into the arena (device-plane pickle-5 out-of-band
+    buffers, no intermediate np.asarray), get re-uploads straight from
+    the pinned arena view.  0.0 when jax is unavailable (reported,
+    never gated)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:  # pragma: no cover
+        return 0.0
+    half = (chunk_mb << 20) // 8           # elements per array, 2 arrays
+    blob = {"k": jnp.arange(half, dtype=jnp.float32),
+            "v": jnp.arange(half, dtype=jnp.float32), "len": half}
+    jax.block_until_ready(blob["k"])
+
+    def run():
+        n = 3
+        for _ in range(n):
+            ref = ray_tpu.put(blob)
+            out = ray_tpu.get(ref)
+            jax.block_until_ready(out["k"])
+            del ref, out
+        return n
+    run()                                  # extra warm: first-touch arena
+    chunks_per_s = _timeit(run, min_time_s, windows=2)
+    return chunks_per_s * chunk_mb / 1024.0
 
 
 def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
@@ -889,6 +1007,13 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "sp_prefill_tokens_per_s": bench_sp_prefill_tokens_per_s,
     "sp_prefill_tokens_per_s_base": bench_sp_prefill_base,
     "long_context_ttft_ms": bench_long_context_ttft,
+    "long_context_ttft_staged_ms": bench_long_context_ttft_staged,
+    # Device-direct data plane: rung-0 compiled-channel steps (device
+    # payload vs its host-staged A/B base) and the device KV blob
+    # put/get throughput (the P/D handoff seam).
+    "device_channel_steps_per_s": bench_device_channel_steps,
+    "device_channel_steps_per_s_host": bench_device_channel_steps_host,
+    "kv_handoff_gibs": bench_kv_handoff_gibs,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
     "compiled_dag_cross_node_steps_per_s":
@@ -949,6 +1074,13 @@ BASELINE = {
     "sp_prefill_tokens_per_s": 34700.0,
     "sp_prefill_tokens_per_s_base": 13500.0,
     "long_context_ttft_ms": 51.0,
+    # Device-plane anchors: committed host-class numbers (4 MiB payload
+    # on a compiled same-actor edge; 64 MiB device KV blob through
+    # put/get).  The *_host and *_staged rows are ungated A/B bases.
+    "long_context_ttft_staged_ms": 55.0,
+    "device_channel_steps_per_s": 3900.0,
+    "device_channel_steps_per_s_host": 850.0,
+    "kv_handoff_gibs": 0.17,
 }
 
 UNITS = {
@@ -968,6 +1100,18 @@ UNITS = {
         "tok/s (same prompt, sp_degree=1 — the A/B base, ungated)",
     "long_context_ttft_ms":
         "ms TTFT (paged cross-host KV path, lower is better)",
+    "long_context_ttft_staged_ms":
+        "ms TTFT (same path, host-staged KV downgrade — the A/B base, "
+        "ungated)",
+    "device_channel_steps_per_s":
+        "steps/s (compiled same-actor edge, 4 MiB DEVICE payload — "
+        "rung 0, zero host bytes)",
+    "device_channel_steps_per_s_host":
+        "steps/s (same edge, 4 MiB host payload via arena staging — "
+        "the A/B base, ungated)",
+    "kv_handoff_gibs":
+        "GiB/s (device KV blob put+get — single-copy staging + "
+        "device_put re-upload)",
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
@@ -1052,11 +1196,24 @@ LONG_CONTEXT_METRICS = (
     "long_context_ttft_ms",
 )
 
+# Device-direct data-plane metrics (first-class device-array channels +
+# KV handoff), gated with the DATA_PLANE downgrade rules: 0.0 means the
+# bench couldn't run here (jax unavailable, compile fell back) and is
+# reported, never gated on; host-fingerprint mismatch downgrades to
+# informational like every absolute gate.  The *_host and *_staged A/B
+# bases are deliberately NOT gated — they are the reference the device
+# rows are read against, not a path we defend.
+DEVICE_PLANE_METRICS = (
+    "device_channel_steps_per_s",
+    "kv_handoff_gibs",
+)
+
 # Metrics where SMALLER readings are better (latencies): the gate
 # inverts their ratio so "regression" always means "got worse".
 LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms",
                              "serving_pd_ttft_p50_ms",
-                             "long_context_ttft_ms"})
+                             "long_context_ttft_ms",
+                             "long_context_ttft_staged_ms"})
 
 
 def _latest_committed_bench(repo_root: str = "."):
@@ -1166,7 +1323,7 @@ def check_against_committed(min_time_s: float = 2.0,
         not _host_matches(base_host, this_host)
     gated = (CONTROL_PLANE_METRICS + AGGREGATE_METRICS
              + DATA_PLANE_METRICS + SERVING_METRICS + DAG_METRICS
-             + LONG_CONTEXT_METRICS)
+             + LONG_CONTEXT_METRICS + DEVICE_PLANE_METRICS)
     results = run_microbenchmarks(min_time_s=min_time_s,
                                   only=set(gated))
     failures = []
@@ -1176,7 +1333,8 @@ def check_against_committed(min_time_s: float = 2.0,
         now, ref = results[name]["value"], committed[name]
         if name in DATA_PLANE_METRICS + SERVING_METRICS \
                 + AGGREGATE_METRICS + DAG_METRICS \
-                + LONG_CONTEXT_METRICS and (not now or not ref):
+                + LONG_CONTEXT_METRICS + DEVICE_PLANE_METRICS \
+                and (not now or not ref):
             # 0.0 = the bench couldn't spawn its extra agents here (or
             # the baseline predates the metric): report, never gate.
             print(json.dumps({"metric": name, "now": now,
@@ -1396,7 +1554,8 @@ def run_microbenchmarks(min_time_s: float = 1.0,
         if only and name not in only:
             continue
         if name.startswith("framer_") or name in LONG_CONTEXT_METRICS \
-                or name == "sp_prefill_tokens_per_s_base":
+                or name in ("sp_prefill_tokens_per_s_base",
+                            "long_context_ttft_staged_ms"):
             # Loopback-only / subprocess micro bench: no cluster
             # involvement, so the quiesce/warmup dance below would be
             # pure dead time.

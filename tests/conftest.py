@@ -84,6 +84,13 @@ def pytest_configure(config):
         "edges, channel-lowered collectives, typed failure semantics")
     config.addinivalue_line(
         "markers",
+        "device_channel: device-direct data plane — DeviceArraySpec "
+        "payloads over compiled-DAG edges (rung-0 same-process token "
+        "handoff / rung-1 single-copy staging), the copy audit, "
+        "device-tier replica-directory locations; CPU-safe on the "
+        "forced-host-device mesh")
+    config.addinivalue_line(
+        "markers",
         "sp: long-context engine — sequence-parallel prefill attention "
         "(ring/Ulysses over the forced-host-device mesh) + cross-host "
         "paged KV; the multi-actor pool-exceeding serve test and the "

@@ -1,0 +1,353 @@
+"""Device-direct data plane: device-array channels + copy audit.
+
+Reference model: Ray's RDT/GPU-object transport and aDAG accelerator
+channels (`with_tensor_transport` / `TorchTensorType`).  Pins the PR's
+acceptance invariants on the forced-host-device mesh (conftest sets
+XLA_FLAGS=--xla_force_host_platform_device_count=8, so every test is
+CPU-safe while exercising the real jax.Array paths):
+
+- spec negotiation: shape/dtype disagreements across a DAG edge raise a
+  typed DeviceSpecMismatchError at experimental_compile time, never on
+  the first step; a stage violating its OWN declared output spec fails
+  typed per-step.
+- rung 0 (same-process edge): ring slots carry an 8-byte token + spec,
+  the copy audit pins ZERO device->host staging bytes.
+- rung 1 (cross-process edge): exactly ONE host copy per direction —
+  producer d2h == payload bytes == consumer h2d, per step.
+- serializer single-copy: device payload bytes ride as pickle-5
+  out-of-band views (`copied_part_bytes` == 0), never materialized.
+- object plane: put/get of device arrays registers a device-tier
+  location (scheduling hint, excluded from pullable `locations()`), and
+  `arg_locality` scores device-tier holders above same-size arena
+  replicas.
+- SIGKILL mid-transfer: staged device messages spilled to the arena are
+  reclaimed by teardown (extends the unsealed-object sweep).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import device_plane
+from ray_tpu._private.device_plane import DeviceArraySpec
+from ray_tpu.dag import InputNode
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = [pytest.mark.dag, pytest.mark.device_channel]
+
+
+# ---------------------------------------------------------------- units ------
+
+def test_spec_of_and_compatibility():
+    a = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    s = DeviceArraySpec.of(a)
+    assert s.shape == (3, 4) and s.dtype == "float32"
+    assert s.nbytes == 48
+    assert s.compatible(DeviceArraySpec.of(jnp.zeros((3, 4), jnp.float32)))
+    assert not s.compatible(DeviceArraySpec.of(jnp.zeros((4, 3),
+                                                         jnp.float32)))
+    assert not s.compatible(DeviceArraySpec.of(jnp.zeros((3, 4),
+                                                         jnp.int32)))
+
+
+def test_serializer_single_copy_for_device_values():
+    """Satellite pin: a device-array payload serializes with its bytes
+    travelling as out-of-band views — `copied_part_bytes` stays 0 (the
+    regression that used to double-copy via an intermediate bytes())."""
+    from ray_tpu._private.serialization import copied_part_bytes, get_context
+    ctx = get_context()
+    arr = jnp.arange(1 << 16, dtype=jnp.float32)        # 256 KiB
+    before = device_plane.device_copy_stats()
+    parts = ctx.serialize({"kv": arr, "meta": 7})
+    assert copied_part_bytes(parts) == 0
+    after = device_plane.device_copy_stats()
+    # Exactly one staging copy of exactly the payload bytes.
+    assert (after["device_to_host_bytes"] -
+            before["device_to_host_bytes"]) == arr.nbytes
+    assert (after["device_arrays_staged"] -
+            before["device_arrays_staged"]) == 1
+    # Round-trip: one upload, value intact.
+    val = ctx.deserialize(b"".join(bytes(p) for p in parts))
+    final = device_plane.device_copy_stats()
+    assert (final["host_to_device_bytes"] -
+            after["host_to_device_bytes"]) == arr.nbytes
+    assert isinstance(val["kv"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(val["kv"]), np.asarray(arr))
+
+
+def test_arg_locality_scores_device_tier_above_arena():
+    from ray_tpu._private import scheduling_policy as sp
+    args = [{"ref": [b"o" * 16, ["n1", 1], [["n1", 1]]], "sz": 100,
+             "dev": [["n2", 2]]}]
+    loc = sp.arg_locality(args)
+    # Arena holder counts sz once; device-tier holder counts it double.
+    assert loc[("n1", 1)] == 100
+    assert loc[("n2", 2)] == 100 * sp.DEVICE_TIER_WEIGHT
+    pick = sp.pick_by_locality(
+        [("a", ("n1", 1), {"CPU": 4}, {"CPU": 4}),
+         ("b", ("n2", 2), {"CPU": 4}, {"CPU": 4})],
+        {"CPU": 1}, loc)
+    assert pick == "b"
+
+
+def test_local_registry_refcounts_and_drops():
+    a = jnp.ones(8)
+    tok = device_plane.register_local([a], nreaders=2)
+    assert device_plane.local_is_registered(tok)
+    assert device_plane.take_local(tok)[0] is a
+    assert device_plane.local_is_registered(tok)   # one reader left
+    assert device_plane.take_local(tok)[0] is a
+    assert not device_plane.local_is_registered(tok)
+    with pytest.raises(KeyError):
+        device_plane.take_local(tok)
+    tok2 = device_plane.register_local([a], nreaders=4)
+    device_plane.drop_local(tok2)                  # producer-side cleanup
+    assert not device_plane.local_is_registered(tok2)
+
+
+# ------------------------------------------------- compile-time contract -----
+
+@ray_tpu.remote
+class DevStage:
+    """DAG stage producing/consuming device arrays, with an audit tap so
+    tests can pin per-process copy-audit deltas from the outside."""
+
+    def make(self, i):
+        return jnp.full((64, 256), float(i), jnp.float32)   # 64 KiB
+
+    def make_slow(self, i):
+        time.sleep(0.25)
+        return jnp.full((64, 256), float(i), jnp.float32)
+
+    def consume(self, arr):
+        assert isinstance(arr, jax.Array), type(arr)
+        return float(arr[0, 0])
+
+    def wrong_shape(self, i):
+        return jnp.zeros((2, 2), jnp.float32)
+
+    def audit(self):
+        return device_plane.device_copy_stats()
+
+    def pid(self):
+        return os.getpid()
+
+
+def test_spec_mismatch_is_a_compile_time_error(ray_start_regular):
+    """Disagreeing edge declarations fail at experimental_compile —
+    before any channel ring is allocated, not on the first step."""
+    a, b = DevStage.remote(), DevStage.remote()
+    try:
+        with InputNode() as inp:
+            mid = a.make.bind(inp).with_device_payload(
+                spec=((64, 256), "float32"))
+            dag = b.consume.bind(mid).with_device_payload(
+                arg_specs={0: ((128, 128), "float32")})
+        with pytest.raises(ray_tpu.exceptions.DeviceSpecMismatchError,
+                           match="shape"):
+            dag.experimental_compile()
+        # dtype disagreement is equally a compile-time authoring error.
+        with InputNode() as inp:
+            mid = a.make.bind(inp).with_device_payload(
+                spec=((64, 256), "float32"))
+            dag = b.consume.bind(mid).with_device_payload(
+                arg_specs={0: ((64, 256), "int32")})
+        with pytest.raises(ray_tpu.exceptions.DeviceSpecMismatchError):
+            dag.experimental_compile()
+    finally:
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_matching_specs_compile_and_run(ray_start_regular):
+    a, b = DevStage.remote(), DevStage.remote()
+    with InputNode() as inp:
+        mid = a.make.bind(inp).with_device_payload(
+            spec=((64, 256), "float32"))
+        dag = b.consume.bind(mid).with_device_payload(
+            arg_specs={0: ((64, 256), "float32")})
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        assert compiled.execute(3).get(timeout=60) == 3.0
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_output_spec_violation_is_typed_at_step_time(ray_start_regular):
+    """A stage breaking its OWN declared output contract fails that step
+    with a typed DeviceSpecMismatchError (wrapped as the task error),
+    not silent shape drift downstream."""
+    a, b = DevStage.remote(), DevStage.remote()
+    with InputNode() as inp:
+        mid = a.wrong_shape.bind(inp).with_device_payload(
+            spec=((64, 256), "float32"))
+        dag = b.consume.bind(mid)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        with pytest.raises(ray_tpu.exceptions.RayError) as ei:
+            compiled.execute(0).get(timeout=60)
+        assert isinstance(ei.value.__cause__,
+                          ray_tpu.exceptions.DeviceSpecMismatchError)
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+# ------------------------------------------------------ transport ladder -----
+
+def test_same_process_edge_moves_zero_host_bytes(ray_start_regular):
+    """Rung 0: when producer and consumer stages share one actor
+    process, the ring carries only a token + spec — the copy audit pins
+    d2h staging bytes at EXACTLY zero across many steps."""
+    a = DevStage.remote()
+    base = ray_tpu.get(a.audit.remote(), timeout=30)
+    with InputNode() as inp:
+        dag = a.consume.bind(a.make.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        for i in range(8):
+            assert compiled.execute(i).get(timeout=60) == float(i)
+        now = ray_tpu.get(a.audit.remote(), timeout=30)
+        assert now["device_to_host_bytes"] == base["device_to_host_bytes"], (
+            "same-process DAG edge staged device bytes through the host")
+        assert now["host_to_device_bytes"] == base["host_to_device_bytes"]
+        assert (now["device_arrays_local"] -
+                base["device_arrays_local"]) == 8
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+
+
+def test_cross_process_edge_pays_exactly_one_copy_each_way(
+        ray_start_regular):
+    """Rung 1: a device payload crossing processes costs exactly ONE
+    device->host staging copy on the producer and ONE host->device
+    upload on the consumer — payload bytes each, per step, no pickle of
+    the array body (fallback counter stays 0 on the host backend)."""
+    a, b = DevStage.remote(), DevStage.remote()
+    nbytes = 64 * 256 * 4
+    steps = 5
+    base_a = ray_tpu.get(a.audit.remote(), timeout=30)
+    base_b = ray_tpu.get(b.audit.remote(), timeout=30)
+    with InputNode() as inp:
+        dag = b.consume.bind(a.make.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        for i in range(steps):
+            assert compiled.execute(i).get(timeout=60) == float(i)
+        now_a = ray_tpu.get(a.audit.remote(), timeout=30)
+        now_b = ray_tpu.get(b.audit.remote(), timeout=30)
+        assert (now_a["device_to_host_bytes"] -
+                base_a["device_to_host_bytes"]) == steps * nbytes
+        assert (now_a["device_arrays_staged"] -
+                base_a["device_arrays_staged"]) == steps
+        assert (now_b["host_to_device_bytes"] -
+                base_b["host_to_device_bytes"]) == steps * nbytes
+        # Consumer never staged anything back (its output is a host
+        # float), and the zero-copy host view never fell back.
+        assert (now_b["device_to_host_bytes"] ==
+                base_b["device_to_host_bytes"])
+        assert (now_a["device_fallback_bytes"] ==
+                base_a["device_fallback_bytes"])
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_device_payload_sigkill_reclaims_staging_pins(ray_start_regular):
+    """SIGKILL of the producer mid-transfer: outstanding get()s fail
+    typed, and teardown's unsealed-object sweep reclaims every spilled
+    staged device message — arena usage returns to baseline."""
+    a, b = DevStage.remote(), DevStage.remote()
+    pid_a = ray_tpu.get(a.pid.remote(), timeout=30)
+    store = ray_tpu._core().store
+    base = store.stats()["bytes_in_use"]
+    with InputNode() as inp:
+        dag = b.consume.bind(a.make_slow.bind(inp))
+    # Tiny slots force every 64 KiB staged device payload through the
+    # arena spill path, so the leak check covers staging pins.
+    compiled = dag.experimental_compile(_channel_slot_bytes=8 * 1024)
+    try:
+        assert compiled._channel_mode
+        assert compiled.execute(1).get(timeout=60) == 1.0
+        # The slow producer keeps these genuinely in flight (staged
+        # messages mid-ring) when the SIGKILL lands.
+        pending = [compiled.execute(i) for i in range(4)]
+        os.kill(pid_a, signal.SIGKILL)
+        with pytest.raises(ray_tpu.exceptions.DAGBrokenError):
+            for r in pending:
+                r.get(timeout=60)
+        compiled.teardown()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if store.stats()["bytes_in_use"] <= base:
+                break
+            time.sleep(0.2)
+        assert store.stats()["bytes_in_use"] <= base, (
+            f"leaked staged device bytes: "
+            f"{store.stats()['bytes_in_use']} > baseline {base}")
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(b)
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------- object plane ----
+
+def test_put_registers_device_tier_location(ray_start_regular):
+    """put() of a device array records a device-tier entry in the
+    owner's replica directory — a scheduling hint, never a pull source
+    (excluded from locations()).  Host values register nothing."""
+    core = ray_tpu._core()
+    ref = ray_tpu.put(jnp.arange(4096, dtype=jnp.float32))
+    devs = core.memory_store.device_locations(ref.binary())
+    assert devs, "device put registered no device-tier location"
+    # Device-tier holders are recorded by NODE (agent address): the
+    # accelerators belong to the slice, not to one worker process.
+    assert tuple(core.agent_address) in [tuple(d) for d in devs]
+    # The entry's pullable locations come only from the plasma replica
+    # set — device_nodes never leak into them.
+    entry = core.memory_store.get(ref.binary())
+    assert set(entry.locations()) == (
+        {tuple(entry.plasma_node)} if entry.plasma_node else set()
+    ) | {tuple(s) for s in (entry.secondaries or [])}
+    # get() returns a live device array, value intact.
+    got = ray_tpu.get(ref, timeout=30)
+    assert isinstance(got, jax.Array)
+    assert float(got[17]) == 17.0
+
+    host_ref = ray_tpu.put(np.arange(4096, dtype=np.float32))
+    assert core.memory_store.device_locations(host_ref.binary()) == []
+
+
+def test_task_arg_spec_carries_device_hint(ray_start_regular):
+    """The owner's task specs ship device-tier holders under the
+    separate `dev` hint key so arg_locality can score them — without
+    ever joining the pullable location hints in ref[2]."""
+    from ray_tpu._private import scheduling_policy as sp
+    core = ray_tpu._core()
+    ref = ray_tpu.put(jnp.ones((512, 512), jnp.float32))   # 1 MiB
+    entries, _refs, _borrowed, _big = core._build_arg_entries_sync(
+        [ref], {})
+    e = entries[0]
+    assert e.get("dev"), f"no device hint in arg entry: {e}"
+    loc = sp.arg_locality(entries)
+    assert loc.get(tuple(core.agent_address), 0) >= \
+        (512 * 512 * 4) * sp.DEVICE_TIER_WEIGHT
