@@ -164,6 +164,8 @@ class WorkerHandle:
         # (granted-but-never-RUNNING); flagged-once latch per grant.
         self.lease_granted_at: Optional[float] = None
         self.lease_stall_flagged = False
+        # Cluster epoch this lease was granted under (GCS HA fencing).
+        self.granted_epoch = 0
         # Blocked-get CPU release (reference: NodeManager::
         # HandleNotifyDirectCallTaskBlocked, node_manager.cc — a worker
         # blocked in ray.get releases its CPU so queued work can run).
@@ -183,6 +185,12 @@ class NodeAgent:
                  store_capacity: int, host: str = "127.0.0.1"):
         self.gcs_address = tuple(gcs_address)
         self.session_dir = session_dir
+        # Cluster epoch (GCS HA fencing token, docs/control_plane.md §8):
+        # learned from registration + every heartbeat reply, monotonic.
+        # Stamped into every lease grant; a lease request presenting an
+        # OLDER epoch is rejected typed (REJECT_STALE_EPOCH) so owners
+        # refresh and resubmit through the normal retry path.
+        self.cluster_epoch = protocol.EPOCH_NONE
         from .runtime_env import UriCache
         self.uri_cache = UriCache(
             os.path.join(session_dir, "runtime_resources"))
@@ -425,7 +433,12 @@ class NodeAgent:
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, name="agent->gcs",
             handlers={"pubsub": self._on_pubsub},
-            on_reconnect=self._register_gcs)
+            on_reconnect=self._register_gcs,
+            # Every reconnect attempt re-reads the advertised address:
+            # after a GCS failover the promoted standby serves on a new
+            # port, and re-homing rides this same jittered dial loop.
+            resolver=lambda: protocol.resolve_gcs_address(
+                self.session_dir, fallback=self.gcs_address))
         await self.gcs.ensure()
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._parked_lease_loop()))
@@ -460,7 +473,7 @@ class NodeAgent:
         this node back (reference: raylet re-registration after
         RayletNotifyGCSRestart, core_worker.proto:467).  Reads self.node_id
         at call time so a fresh-id rejoin reuses it unchanged."""
-        await conn.call("register_node", {
+        reply = await conn.call("register_node", {
             "node_id": self.node_id,
             "address": list(self.address),
             "resources": self.resources_total,
@@ -472,6 +485,22 @@ class NodeAgent:
             # the GCS instead of O(N^2) view-building.
             "view": False,
         })
+        if isinstance(reply, dict):
+            self._learn_epoch(reply.get(protocol.EPOCH_KEY))
+
+    def _learn_epoch(self, epoch):
+        """Adopt a (monotonically higher) cluster epoch from a GCS reply.
+        A bump mid-flight means a standby took over; grants this agent
+        mints from now on carry the new epoch, and requests still
+        presenting the old one get the typed stale-epoch rejection."""
+        if not isinstance(epoch, int) or epoch <= self.cluster_epoch:
+            return
+        if self.cluster_epoch != protocol.EPOCH_NONE:
+            logger.warning(
+                "cluster epoch bumped %d -> %d (GCS failover observed); "
+                "new lease grants are fenced to the new epoch",
+                self.cluster_epoch, epoch)
+        self.cluster_epoch = epoch
 
     async def _rejoin_with_fresh_id(self):
         """The GCS rejected our heartbeat: this node was marked dead while
@@ -528,6 +557,8 @@ class NodeAgent:
                     # one notify per heartbeat when there is anything
                     # to ship.
                     self._flush_telemetry()
+                    if isinstance(ok, dict):
+                        self._learn_epoch(ok.get(protocol.EPOCH_KEY))
                     if ok is False and not self._shutdown \
                             and self._draining is None:
                         # Rejected = we're listed dead.  (Never during a
@@ -1188,6 +1219,22 @@ class NodeAgent:
         client to poll)."""
         rec = frec.recorder()
         t0 = rec.begin()
+        req_epoch = p.get(protocol.EPOCH_KEY)
+        if isinstance(req_epoch, int):
+            if req_epoch > self.cluster_epoch:
+                # The requester heard about a failover before this agent's
+                # next heartbeat did — adopt its epoch rather than reject
+                # a perfectly current owner.
+                self._learn_epoch(req_epoch)
+            elif (req_epoch != protocol.EPOCH_NONE
+                  and req_epoch < self.cluster_epoch):
+                # Fencing: the owner is still living in a pre-failover
+                # epoch.  A typed rejection (not a plain refusal) tells it
+                # to refresh its epoch and resubmit — retried work stays
+                # exactly-once because nothing was granted here.
+                return {"granted": False,
+                        "reject": protocol.REJECT_STALE_EPOCH,
+                        protocol.EPOCH_KEY: self.cluster_epoch}
         if not (self._parked_leases and not p.get("placement_group")):
             # Fast path only while nobody is parked: a fresh request must
             # not jump the FIFO, or a stream of small shapes starves a
@@ -1303,6 +1350,7 @@ class NodeAgent:
         wh.lease_owner_conn = conn
         wh.lease_granted_at = time.monotonic()
         wh.lease_stall_flagged = False
+        wh.granted_epoch = self.cluster_epoch
         self.leases[lease_id] = wh
         if p.get("prefetch"):
             # Arg prefetch: start pulling the lease's missing large
@@ -1315,7 +1363,8 @@ class NodeAgent:
             rpc.spawn(self._prefetch_lease_args(p["prefetch"]))
         return {"granted": True, "lease_id": lease_id,
                 "worker_addr": list(wh.address),
-                "worker_id": wh.worker_id}
+                "worker_id": wh.worker_id,
+                protocol.EPOCH_KEY: self.cluster_epoch}
 
     async def _prefetch_lease_args(self, entries) -> None:
         cfg = get_config()
@@ -1628,6 +1677,13 @@ class NodeAgent:
         self._recycle_worker(wh)
 
     async def h_return_lease(self, conn, p):
+        # Returns are accepted under ANY epoch: refusing a release from a
+        # pre-failover owner would leak the worker forever, and handing
+        # resources back is safe regardless of who asks.  (Grants are the
+        # fenced direction — see h_request_lease.)
+        e = p.get(protocol.EPOCH_KEY)
+        if isinstance(e, int):
+            self._learn_epoch(e)
         wh = self.leases.get(p["lease_id"])
         if wh is None:
             return False

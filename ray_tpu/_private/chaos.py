@@ -32,7 +32,12 @@ An ``agent`` kill takes the whole node down the way a preemption does:
 the agent plus its zygote and workers, found via the ppid chain.  A
 killed GCS can be respawned through a ``restart`` callback (same port,
 same journal) so the cluster exercises journal-replay recovery — see
-``cluster_utils.Cluster.restart_gcs``.
+``cluster_utils.Cluster.restart_gcs``.  When a warm standby is armed
+(``Cluster(gcs_standby=True)``), the ``gcs`` class still targets only
+the PRIMARY — the standby logs to ``gcs_standby.err``, which the
+``gcs.`` basename prefix deliberately excludes — and the restart
+callback waits for the standby's epoch-fenced promotion instead of
+respawning (``Cluster._gcs_failover_restart``).
 """
 
 from __future__ import annotations

@@ -80,6 +80,27 @@ _define("actor_scheduling_timeout_s", 90.0,
 _define("health_check_period_ms", 1000,
         "reference: gcs_health_check_manager.h health_check_period_ms")
 _define("health_check_failure_threshold", 5)
+_define("gcs_lease_ttl_s", 3.0,
+        "primary GCS advertised-address lease TTL: the primary renews "
+        "the on-disk lease every ttl/3 while it holds agent-heartbeat "
+        "majority; a warm standby takes over (bumping the cluster "
+        "epoch) only after the lease has been stale for a full TTL")
+_define("gcs_standby_poll_ms", 100,
+        "warm-standby journal-tail and lease-check poll interval")
+_define("gcs_lease_heartbeat_fresh_s", 0.0,
+        "heartbeat freshness window for the lease-renewal majority "
+        "condition; 0 = auto (4x resource_report_period_ms, min 2s). "
+        "A primary that cannot see fresh heartbeats from a majority of "
+        "alive agents stops renewing — so a primary partitioned from "
+        "the cluster yields, while a standby partitioned from a "
+        "healthy primary never steals the lease (split-brain guard)")
+_define("journal_snapshot_every_bytes", 64 * 1024 * 1024,
+        "GCS journal compaction threshold: when the journal file "
+        "exceeds this many bytes, the primary writes a full-table "
+        "snapshot record to a fresh file and atomically replaces the "
+        "journal (replay = snapshot + suffix); 0 disables compaction. "
+        "Standby tailers detect the replacement by inode change and "
+        "re-read from the snapshot")
 _define("resource_report_period_ms", 250,
         "ray_syncer-equivalent periodic resource view broadcast")
 _define("lineage_max_entries", 100_000,

@@ -85,9 +85,10 @@ class _PendingTask:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_addr", "worker_id", "conn", "inflight",
-                 "agent_conn", "idle_since")
+                 "agent_conn", "idle_since", "epoch")
 
-    def __init__(self, lease_id, worker_addr, worker_id, conn, agent_conn):
+    def __init__(self, lease_id, worker_addr, worker_id, conn, agent_conn,
+                 epoch=0):
         self.lease_id = lease_id
         self.worker_addr = worker_addr
         self.worker_id = worker_id
@@ -95,6 +96,9 @@ class _Lease:
         self.agent_conn = agent_conn
         self.inflight = 0
         self.idle_since = time.monotonic()
+        # Cluster epoch the grant was minted under (GCS HA fencing):
+        # idle leases from an older epoch are dropped on epoch bump.
+        self.epoch = epoch
 
 
 class _KeyState:
@@ -168,6 +172,12 @@ class CoreWorker:
         self.agent_address = tuple(agent_address)
         self.node_id = node_id
         self.session_dir = session_dir
+        # Cluster epoch (GCS HA fencing, docs/control_plane.md §8):
+        # learned from grants/rejections, stamped into every lease
+        # request so a fenced-off owner is told to refresh instead of
+        # silently acting on a pre-failover view.
+        self.cluster_epoch = protocol.EPOCH_NONE
+        self.stale_epoch_rejections = 0
         self.worker_id = worker_id or WorkerID.from_random().binary()
         self.job_id = job_id
         self.store = ShmStore.attach(store_path)
@@ -320,7 +330,12 @@ class CoreWorker:
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, name="cw->gcs",
             handlers={"pubsub": self.h_pubsub},
-            on_reconnect=self._resubscribe)
+            on_reconnect=self._resubscribe,
+            # GCS failover re-homing: every dial re-reads the session's
+            # advertised-address file, so a promoted standby (new port)
+            # is found through the same jittered reconnect backoff.
+            resolver=lambda: protocol.resolve_gcs_address(
+                self.session_dir, fallback=self.gcs_address))
         await self.gcs.ensure()
         self.agent = await rpc.connect(self.agent_address, name="cw->agent")
         self._spawn(self._telemetry_flush_loop())
@@ -2435,6 +2450,10 @@ class CoreWorker:
                 # IMMEDIATELY (fetch overlaps worker dispatch/queueing)
                 # and its spillback choice scores bytes-already-local.
                 "prefetch": self._lease_prefetch_entries(state),
+                # Fencing token: an agent that has seen a NEWER cluster
+                # epoch (GCS failover) rejects this typed so we refresh
+                # and resubmit instead of acting on a stale grant.
+                protocol.EPOCH_KEY: self.cluster_epoch,
             }, timeout=130)
         except (rpc.RpcError, asyncio.TimeoutError):
             state.pending_lease_requests -= 1
@@ -2443,6 +2462,20 @@ class CoreWorker:
                 self._pump(key, state)
             return
         if not res.get("granted"):
+            if res.get("reject") == protocol.REJECT_STALE_EPOCH:
+                # Fenced: this owner's epoch predates a GCS failover the
+                # agent already lives in.  Adopt the agent's epoch and
+                # resubmit through the normal pump — the queued tasks were
+                # never granted, so the retry is exactly-once by
+                # construction (reference: Raft clients retry with the
+                # new term; StaleEpochError is the user-facing type when
+                # a caller surfaces this instead of retrying).
+                self.stale_epoch_rejections += 1
+                self._learn_epoch(res.get(protocol.EPOCH_KEY))
+                state.pending_lease_requests -= 1
+                if state.queue:
+                    self._pump(key, state)
+                return
             reason = res.get("reason") or ""
             if "runtime env setup failed" in reason:
                 # A broken env spec (bad package, dead find_links) can
@@ -2502,12 +2535,43 @@ class CoreWorker:
                 "return_lease", {"lease_id": res["lease_id"]}))
             return
         worker_addr = tuple(res["worker_addr"])
+        grant_epoch = res.get(protocol.EPOCH_KEY)
+        if isinstance(grant_epoch, int):
+            self._learn_epoch(grant_epoch)
         conn = await self._worker_conn(worker_addr)
         lease = _Lease(res["lease_id"], worker_addr, res["worker_id"], conn,
-                       agent_conn)
+                       agent_conn,
+                       epoch=(grant_epoch if isinstance(grant_epoch, int)
+                              else self.cluster_epoch))
         state.leases.append(lease)
         self._pump(key, state)
         self._spawn(self._lease_reaper(key, state, lease))
+
+    def _learn_epoch(self, epoch):
+        """Adopt a higher cluster epoch (GCS failover observed).  Cached
+        idle leases minted under the old epoch are handed back — their
+        grants are formally fenced, and the replacement request returns
+        a fresh same-worker lease stamped with the new epoch.  Leases
+        with work in flight finish it first (the executing worker and
+        its agent are both still alive; only the grant token aged)."""
+        if not isinstance(epoch, int) or epoch <= self.cluster_epoch:
+            return
+        prev = self.cluster_epoch
+        self.cluster_epoch = epoch
+        if prev == protocol.EPOCH_NONE:
+            return
+        logger.warning("cluster epoch bumped %d -> %d (GCS failover)",
+                       prev, epoch)
+        for key, state in self._keys.items():
+            stale = [ls for ls in state.leases
+                     if ls.epoch < epoch and not ls.inflight]
+            for ls in stale:
+                state.leases.remove(ls)
+                self._spawn(ls.agent_conn.call(
+                    "return_lease", {"lease_id": ls.lease_id,
+                                     protocol.EPOCH_KEY: epoch}))
+            if stale and state.queue:
+                self._pump(key, state)
 
     async def _cluster_nodes(self, force: bool = False):
         """GCS node view, cached briefly (strategy routing must not add
@@ -2784,9 +2848,12 @@ class CoreWorker:
         # provides reuse).
         while True:
             await asyncio.sleep(0.05)
+            if lease not in state.leases:
+                # Already handed back elsewhere (e.g. fenced as stale on
+                # an epoch bump) — nothing left to reap.
+                return
             if lease.conn.closed:
-                if lease in state.leases:
-                    state.leases.remove(lease)
+                state.leases.remove(lease)
                 return
             if lease.inflight == 0 and not state.queue:
                 if time.monotonic() - lease.idle_since > 0.1:
@@ -3469,9 +3536,40 @@ class CoreWorker:
                 self._notify_owner(nowner, "escape_release", noid)
 
     async def _register_actor_spec(self, spec):
-        res = await self.gcs.call("register_actor", {"spec": spec},
-                                  timeout=180)
+        # Epoch-stamped mutation: a fenced ex-primary (or a primary that
+        # failed over past us) rejects this typed instead of recording a
+        # placement nobody will honor.  A lagging-but-legitimate owner
+        # (we just hadn't heard about the failover yet) refreshes its
+        # epoch and resubmits ONCE — registration is an id-keyed upsert,
+        # so the retry is exactly-once.
+        res = await self._gcs_mutate("register_actor", {"spec": spec},
+                                     timeout=180)
         return res["actor"]
+
+    async def _gcs_mutate(self, method, payload, timeout=None):
+        """Issue an epoch-stamped GCS mutation; on a stale-epoch
+        rejection, learn the current epoch and retry once.  Raises
+        StaleEpochError if the refreshed epoch is STILL refused — that
+        means this owner is genuinely fenced off, not merely behind."""
+        payload = dict(payload)
+        for attempt in range(2):
+            payload[protocol.EPOCH_KEY] = self.cluster_epoch
+            try:
+                return await self.gcs.call(method, payload, timeout=timeout)
+            except rpc.RpcError as e:
+                if "stale_epoch" not in str(e) or attempt:
+                    if "stale_epoch" in str(e):
+                        self.stale_epoch_rejections += 1
+                        raise exc.StaleEpochError(
+                            f"GCS refused {method}: {e}",
+                            stale_epoch=self.cluster_epoch) from e
+                    raise
+                self.stale_epoch_rejections += 1
+                try:
+                    info = await self.gcs.call("get_cluster_info", {})
+                    self._learn_epoch(info.get(protocol.EPOCH_KEY))
+                except rpc.RpcError:
+                    pass
 
     def _build_arg_entries_sync(self, args, kwargs):
         """Serialize args on the CALLING thread (so post-call mutation is

@@ -46,11 +46,20 @@ class Journal:
     def __init__(self, path: str):
         self.path = path
         self._f = open(path, "ab")
+        try:
+            self.size = os.path.getsize(path)
+        except OSError:
+            self.size = 0
 
     def append(self, kind: str, payload) -> None:
         data = rpc._pack([kind, payload])
         self._f.write(_JLEN.pack(len(data)) + data)
         self._f.flush()
+        self.size += 4 + len(data)
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
 
     def close(self) -> None:
         self._f.close()
@@ -72,6 +81,86 @@ class Journal:
         except FileNotFoundError:
             pass
         return out
+
+
+class JournalTailer:
+    """Follow-mode reader of a live (possibly compacting) journal — the
+    warm standby's replication stream.  Shared-path equivalent of a
+    `journal_tail` streaming RPC: the primary's append+flush discipline
+    makes every complete record visible to a same-host reader, and the
+    length-prefix framing makes a half-flushed tail detectable (we
+    simply retry it next poll, the same torn-tail tolerance
+    Journal.read has).
+
+    Compaction safety: the primary compacts by writing snapshot+suffix
+    to a NEW file and atomically replacing the journal path.  A tailer
+    mid-tail detects the replacement by inode change (or the file
+    shrinking under its offset), reopens, and reports reset=True so the
+    caller rebuilds its replica from the new file's start."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._ino = None
+        self.offset = 0
+
+    def _open(self) -> bool:
+        try:
+            f = open(self.path, "rb")
+        except FileNotFoundError:
+            return False
+        if self._f is not None:
+            self._f.close()
+        self._f = f
+        self._ino = os.fstat(f.fileno()).st_ino
+        self.offset = 0
+        return True
+
+    def lag_bytes(self) -> int:
+        """Bytes the primary has journaled that we have not yet applied."""
+        try:
+            return max(0, os.path.getsize(self.path) - self.offset)
+        except OSError:
+            return 0
+
+    def poll(self):
+        """-> (records, reset).  `records` are the complete records
+        appended since the last poll; reset=True means the journal was
+        replaced (compaction) and `records` restart from the NEW file's
+        beginning — the caller must drop its replica tables first."""
+        reset = False
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return [], False
+        if self._f is None:
+            if not self._open():
+                return [], False
+        elif st.st_ino != self._ino or st.st_size < self.offset:
+            if not self._open():
+                return [], False
+            reset = True
+        out = []
+        while True:
+            self._f.seek(self.offset)
+            hdr = self._f.read(4)
+            if len(hdr) < 4:
+                break
+            (n,) = _JLEN.unpack(hdr)
+            body = self._f.read(n)
+            if len(body) < n:
+                break               # torn tail: complete next poll
+            try:
+                out.append(rpc._unpack(body))
+            except Exception:
+                break               # half-flushed record: retry next poll
+            self.offset += 4 + n
+        return out, reset
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 class NodeInfo:
@@ -154,6 +243,11 @@ class NodeInfo:
             "resources_available": self.resources_available,
             "labels": self.labels,
             "store_path": self.store_path,
+            # Attaching drivers adopt the node's session dir — it is
+            # where resolve_gcs_address() finds the CURRENT advertised
+            # GCS address, so a driver that joined pre-failover can
+            # re-home instead of dialing the dead primary forever.
+            "session_dir": self.session_dir,
             "alive": self.alive,
             "state": (protocol.NODE_DEAD if not self.alive
                       else protocol.NODE_DRAINING if self.draining
@@ -220,11 +314,28 @@ def _h_ping(conn, p):
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 ha_dir: Optional[str] = None):
         self.host = host
         self.port = port
         self.journal_path = journal_path
         self.journal: Optional[Journal] = None
+        # High availability (docs/control_plane.md §8): `ha_dir` is the
+        # shared directory holding the advertised-address file and the
+        # primary lease; None (the default, and every in-process test's
+        # default) disables the lease machinery entirely.  The cluster
+        # epoch is the fencing token: journaled, bumped exactly once per
+        # failover by the promoted standby, stamped into registration
+        # and heartbeat replies (and by agents into lease grants).
+        self.ha_dir = ha_dir
+        self.epoch = 1
+        self._journal_epoch = 1     # epoch as last journaled/replayed
+        self._replayed = False      # standby pre-replays before start()
+        self._fenced = False
+        self.fenced_event = asyncio.Event()
+        self._failover_count = 0
+        self._lease_task: Optional[asyncio.Task] = None
+        self._last_snapshot_size = 0
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
@@ -520,6 +631,37 @@ class GcsServer:
             "help": "task events evicted by the GCS sink or dropped in "
                     "reporter buffers before reaching it",
             "value": float(self._events_dropped_total())}]
+        # GCS HA: the fencing epoch and failover count, plus — when a
+        # warm standby is tailing our journal — its replication lag
+        # (read from the progress file the standby refreshes each poll).
+        out.append({
+            "name": "ray_tpu_gcs_epoch", "labels": {}, "type": "gauge",
+            "help": "cluster epoch (fencing token): bumped exactly once "
+                    "per GCS failover, stamped into every grant",
+            "value": float(self.epoch)})
+        out.append({
+            "name": "ray_tpu_gcs_failover_total", "labels": {},
+            "type": "counter",
+            "help": "GCS failovers this instance participated in "
+                    "(takeovers it performed or fencings it suffered)",
+            "value": float(self._failover_count)})
+        if self.ha_dir:
+            sb = self._read_json(
+                os.path.join(self.ha_dir, protocol.GCS_STANDBY_FILE))
+            if sb and sb.get("ts"):
+                out.append({
+                    "name": "ray_tpu_gcs_standby_lag_bytes",
+                    "labels": {}, "type": "gauge",
+                    "help": "journal bytes the warm standby has not yet "
+                            "applied to its hot replica tables",
+                    "value": float(sb.get("lag_bytes") or 0)})
+                out.append({
+                    "name": "ray_tpu_gcs_standby_age_seconds",
+                    "labels": {}, "type": "gauge",
+                    "help": "seconds since the warm standby last "
+                            "reported tail progress; grows without "
+                            "bound when no standby is running",
+                    "value": max(0.0, time.time() - float(sb["ts"]))})
         # Per-loop busy fractions (loopmon): single-core saturation of
         # the GCS main loop — or of any I/O shard — is a gauge, not an
         # inference from host CPU.  Stale entries stay visible with
@@ -774,11 +916,32 @@ class GcsServer:
         return path
 
     async def start(self):
-        if self.journal_path:
+        if self.journal_path and not self._replayed:
             self._replay(Journal.read(self.journal_path))
+            self._replayed = True
+        if self.journal_path:
             self.journal = Journal(self.journal_path)
+            if self.epoch != self._journal_epoch:
+                # Promoted standby: the epoch bump hits the journal
+                # BEFORE the first request is served — a crash right
+                # after this line still replays into the new epoch.
+                self.journal.append("epoch", self.epoch)
+                self.journal.sync()
+                self._journal_epoch = self.epoch
+        if self.ha_dir:
+            os.makedirs(self.ha_dir, exist_ok=True)
+            self._claim_lease()
         addr = await self._server.start_tcp(self.host, self.port)
         self.address = addr
+        if self.ha_dir:
+            # Advertise AFTER the socket listens: a client that re-reads
+            # the address file must never be pointed at a closed port.
+            self._write_json_atomic(
+                os.path.join(self.ha_dir, protocol.GCS_ADDRESS_FILE),
+                {"address": list(addr),
+                 protocol.EPOCH_KEY: self.epoch,
+                 "pid": os.getpid()})
+            self._lease_task = asyncio.ensure_future(self._lease_loop())
         # Busy-fraction probe for the main loop (shards install their
         # own): saturation of the state-mutating loop becomes a gauge.
         loopmon.install("main")
@@ -824,6 +987,78 @@ class GcsServer:
     def _log(self, kind: str, payload) -> None:
         if self.journal is not None:
             self.journal.append(kind, payload)
+            limit = get_config().journal_snapshot_every_bytes
+            # Compact at the threshold, but only once the log has also
+            # doubled past the LAST snapshot: when live state alone
+            # exceeds the threshold, an absolute trigger would rewrite
+            # the full snapshot on every append.
+            if limit and self.journal.size > max(
+                    limit, 2 * self._last_snapshot_size):
+                try:
+                    self._compact_journal()
+                except OSError as e:
+                    logger.warning("journal compaction failed: %s", e)
+
+    def _compact_journal(self) -> None:
+        """Snapshot + truncate: serialize the journaled tables as the
+        minimal record sequence into a fresh file and atomically replace
+        the journal — replay afterwards is snapshot + suffix.  The
+        replace is what a mid-tail standby detects by inode change."""
+        old_size = self.journal.size
+        tmp = self.journal_path + ".compact"
+        try:
+            os.unlink(tmp)          # a crashed attempt must not append
+        except FileNotFoundError:
+            pass
+        snap = Journal(tmp)
+        snap.append("snapshot", self._snapshot_records())
+        snap.sync()
+        snap.close()
+        self.journal.close()
+        os.replace(tmp, self.journal_path)
+        self.journal = Journal(self.journal_path)
+        self._last_snapshot_size = self.journal.size
+        logger.info("journal compacted: %d -> %d bytes",
+                    old_size, self.journal.size)
+
+    def _snapshot_records(self) -> list:
+        """Current journaled state as the record sequence that rebuilds
+        it — `_replay` is the single decoder for both live journals and
+        snapshots, so the two can never drift apart."""
+        recs: list = [["epoch", self.epoch],
+                      ["job_counter", self._job_counter]]
+        for ns, d in self.kv.items():
+            if ns in _EPHEMERAL_NS:
+                continue
+            for k, v in d.items():
+                recs.append(["kv_put", {"ns": ns, "key": k, "value": v}])
+        for job in self.jobs.values():
+            recs.append(["job", job])
+        for node in self.nodes.values():
+            recs.append(["node", {
+                "node_id": node.node_id, "address": list(node.address),
+                "resources": node.resources_total, "labels": node.labels,
+                "store_path": node.store_path,
+                "session_dir": node.session_dir}])
+        for actor in self.actors.values():
+            recs.append(["actor_spec", {"actor_id": actor.actor_id,
+                                        "spec": actor.spec}])
+            recs.append(["actor_view", actor.view()])
+        for pg in self.placement_groups.values():
+            recs.append(["pg", pg])
+        return recs
+
+    def _reset_tables(self) -> None:
+        """Drop every journaled table (snapshot replay, standby reset
+        after a compaction landed mid-tail)."""
+        self.kv = {}
+        self.nodes = {}
+        self.actors = {}
+        self.named_actors = {}
+        self.jobs = {}
+        self.placement_groups = {}
+        self._job_counter = 0
+        self._addr_index = None
 
     def _log_actor(self, actor: ActorInfo, with_spec: bool = False) -> None:
         # Spec is immutable — journaled once at registration; transitions
@@ -881,6 +1116,15 @@ class GcsServer:
                 self.placement_groups[p["pg_id"]] = p
             elif kind == "pg_del":
                 self.placement_groups.pop(p, None)
+            elif kind == "epoch":
+                self.epoch = max(self.epoch, int(p))
+                self._journal_epoch = self.epoch
+            elif kind == "snapshot":
+                # Compaction record: the tables reset and rebuild from
+                # the embedded record sequence (then the journal suffix
+                # after this record replays on top as usual).
+                self._reset_tables()
+                self._replay(p)
 
     async def _reschedule_replayed(self, actor: ActorInfo):
         ok = await self._schedule_actor(actor)
@@ -894,14 +1138,158 @@ class GcsServer:
         self._closing = True
         if self._health_task:
             self._health_task.cancel()
+        if self._lease_task:
+            self._lease_task.cancel()
         await self._server.close()
         if self._io_shards is not None:
             # After the server: bridged connection closes need the
             # shard loops alive to run.
             self._io_shards.close()
 
+    # ------------------------------------------------- HA lease / fencing --
+    # (docs/control_plane.md §8.)  The primary holds a disk lease under
+    # ha_dir, renewed every ttl/3 — but ONLY while it can see fresh
+    # heartbeats from a majority of its alive agents.  A primary
+    # partitioned from the cluster therefore stops renewing and yields;
+    # a standby partitioned from a HEALTHY primary never sees the lease
+    # go stale (renewal rides the agents' votes, not the standby's view
+    # of the primary), so it cannot steal the cluster: the split-brain
+    # guard.  A fenced ex-primary (a higher epoch appears in the lease
+    # file while it was frozen) refuses every write and exits.
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _write_json_atomic(path: str, obj: dict) -> None:
+        # pid-suffixed tmp: the promoted standby and a not-yet-fenced
+        # ex-primary must never truncate each other's half-written tmp.
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+        return True
+
+    def _lease_path(self) -> str:
+        return os.path.join(self.ha_dir, protocol.GCS_LEASE_FILE)
+
+    def _claim_lease(self) -> None:
+        """Take (or re-take) the primary lease at startup.  Refuses to
+        start against a live holder: a fresh lease owned by a running
+        pid means another primary is serving — starting anyway would be
+        manufacturing the very split brain the lease exists to prevent."""
+        ttl = float(get_config().gcs_lease_ttl_s)
+        cur = self._read_json(self._lease_path())
+        if cur:
+            if int(cur.get("epoch", 0)) > self.epoch:
+                raise RuntimeError(
+                    f"GCS lease already held at epoch {cur.get('epoch')} "
+                    f"> ours {self.epoch}: a newer primary exists")
+            pid = int(cur.get("owner_pid") or 0)
+            age = time.time() - float(cur.get("renewed", 0.0))
+            if pid and pid != os.getpid() and self._pid_alive(pid) \
+                    and age <= float(cur.get("ttl_s", ttl)):
+                raise RuntimeError(
+                    f"GCS lease held by live pid {pid} "
+                    f"(age {age:.1f}s <= ttl): refusing to double-serve")
+        self._renew_lease(ttl)
+
+    def _renew_lease(self, ttl: float) -> None:
+        self._write_json_atomic(self._lease_path(), {
+            "epoch": self.epoch,
+            "renewed": time.time(),
+            "ttl_s": ttl,
+            "owner_pid": os.getpid(),
+            "address": list(getattr(self, "address",
+                                    (self.host, self.port)))})
+
+    def _heartbeat_majority_ok(self, fresh_window: float) -> bool:
+        """Lease renewal votes: a majority of ALIVE agents must have
+        heartbeated within the freshness window.  No agents (bootstrap,
+        benches) trivially passes — there is no cluster to lose."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return True
+        now = time.monotonic()
+        fresh = sum(1 for n in alive
+                    if now - n.last_heartbeat <= fresh_window)
+        return fresh * 2 > len(alive)
+
+    async def _lease_loop(self):
+        cfg = get_config()
+        ttl = float(cfg.gcs_lease_ttl_s)
+        fresh_window = float(cfg.gcs_lease_heartbeat_fresh_s) or max(
+            2.0, 4.0 * cfg.resource_report_period_ms / 1000.0)
+        while not self._closing:
+            try:
+                cur = self._read_json(self._lease_path())
+                if cur and int(cur.get("epoch", 0)) > self.epoch:
+                    # We were frozen/partitioned long enough for the
+                    # standby to take over: we are history.
+                    self._fence(int(cur["epoch"]))
+                    return
+                if self._heartbeat_majority_ok(fresh_window):
+                    self._renew_lease(ttl)
+                else:
+                    logger.warning(
+                        "withholding GCS lease renewal: no fresh "
+                        "heartbeat majority (partitioned from agents?)")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("lease renewal pass failed")
+            await asyncio.sleep(ttl / 3.0)
+
+    def _fence(self, successor_epoch: int) -> None:
+        """A successor bumped the epoch past ours: refuse every write
+        from now on and signal the hosting process to exit (the
+        subprocess main watches fenced_event; in-process tests assert on
+        it directly)."""
+        if self._fenced:
+            return
+        self._fenced = True
+        self._failover_count += 1
+        logger.error(
+            "GCS FENCED: successor epoch %d > ours %d — refusing all "
+            "writes and exiting", successor_epoch, self.epoch)
+        self._ingest_anomaly({
+            "kind": "gcs_fenced", "daemon": "gcs",
+            "epoch": self.epoch, "successor_epoch": successor_epoch})
+        self.fenced_event.set()
+
+    def _check_writable(self, p: Optional[dict] = None) -> None:
+        """Mutation fencing: a fenced ex-primary accepts no state
+        mutation at all, and ANY primary rejects a mutation stamped
+        with an epoch older than its own (a grant-holder acting on a
+        pre-failover decision)."""
+        if self._fenced:
+            raise rpc.RpcError(
+                f"stale_epoch: this GCS instance is fenced "
+                f"(epoch {self.epoch})")
+        if p:
+            e = p.get(protocol.EPOCH_KEY)
+            if e is not None and int(e) and int(e) < self.epoch:
+                raise rpc.RpcError(
+                    f"stale_epoch: mutation carries epoch {e} < "
+                    f"current {self.epoch}")
+
     # ------------------------------------------------------------------ KV --
     async def h_kv_put(self, conn, p):
+        self._check_writable(p)
         ns = self.kv.setdefault(p.get("ns", ""), {})
         key = p["key"]
         if not p.get("overwrite", True) and key in ns:
@@ -919,6 +1307,7 @@ class GcsServer:
         return p["key"] in self.kv.get(p.get("ns", ""), {})
 
     async def h_kv_del(self, conn, p):
+        self._check_writable(p)
         ns = self.kv.get(p.get("ns", ""), {})
         prefix = p.get("prefix", False)
         if p.get("ns", "") not in _EPHEMERAL_NS:
@@ -939,6 +1328,7 @@ class GcsServer:
 
     # ---------------------------------------------------------------- nodes --
     async def h_register_node(self, conn, p):
+        self._check_writable(p)
         node = NodeInfo(p["node_id"], p["address"], p["resources"],
                         p.get("labels", {}), p.get("store_path", ""),
                         p.get("session_dir", ""))
@@ -969,8 +1359,10 @@ class GcsServer:
             # registrations otherwise does O(N^2) view-building on this
             # loop, which is exactly the mass-(re)registration moment
             # the GCS can least afford it.
-            return {"node_id": node.node_id, "num_nodes": len(self.nodes)}
-        return {"cluster_nodes": [n.view() for n in self.nodes.values()]}
+            return {"node_id": node.node_id, "num_nodes": len(self.nodes),
+                    protocol.EPOCH_KEY: self.epoch}
+        return {"cluster_nodes": [n.view() for n in self.nodes.values()],
+                protocol.EPOCH_KEY: self.epoch}
 
     async def _connect_agent(self, node: NodeInfo):
         try:
@@ -1044,7 +1436,11 @@ class GcsServer:
                 rate = st.get("rate")
                 if rate is not None:
                     target.peer_rates[p["node_id"]] = (float(rate), ts)
-        return True
+        # Dict (truthy) keeps the legacy `ok is False` rejection check
+        # working while carrying the cluster epoch: the heartbeat is how
+        # every agent LEARNS a failover happened (and starts fencing
+        # grants minted under the old epoch).
+        return {"ok": True, protocol.EPOCH_KEY: self.epoch}
 
     async def h_drain_node(self, conn, p):
         """Two-phase graceful drain (reference: autoscaler.proto DrainNode;
@@ -1510,11 +1906,13 @@ class GcsServer:
 
     # ----------------------------------------------------------------- jobs --
     async def h_next_job_id(self, conn, p):
+        self._check_writable(p)
         self._job_counter += 1
         self._log("job_counter", self._job_counter)
         return self._job_counter
 
     async def h_register_job(self, conn, p):
+        self._check_writable(p)
         self.jobs[p["job_id"]] = {"job_id": p["job_id"],
                                   "driver_addr": p.get("driver_addr"),
                                   "start_time": time.time(), "alive": True}
@@ -1528,6 +1926,7 @@ class GcsServer:
     async def h_register_actor(self, conn, p):
         """Register + schedule an actor (reference: gcs_actor_manager.cc
         RegisterActor/CreateActor; scheduling in gcs_actor_scheduler.cc)."""
+        self._check_writable(p)
         spec = p["spec"]
         actor_id = spec["actor_id"]
         name = spec.get("name")
@@ -1784,6 +2183,7 @@ class GcsServer:
         return [a.view() for a in self.actors.values()]
 
     async def h_kill_actor(self, conn, p):
+        self._check_writable(p)
         actor = self.actors.get(p["actor_id"])
         if actor is None:
             # Client-minted handles can be killed before their background
@@ -1809,6 +2209,7 @@ class GcsServer:
         return True
 
     async def h_actor_failed(self, conn, p):
+        self._check_writable(p)
         actor = self.actors.get(p["actor_id"])
         if actor is None:
             return False
@@ -1852,6 +2253,7 @@ class GcsServer:
         gcs_placement_group_scheduler.cc prepare/commit;
         node_manager.proto:471-476).  Returns immediately; clients poll
         get_placement_group / wait on the CH_PG channel."""
+        self._check_writable(p)
         pg_id = p["pg_id"]
         if pg_id in self.placement_groups:
             # Retried create (reply lost across a GCS restart): keep the
@@ -2030,6 +2432,7 @@ class GcsServer:
         return chosen
 
     async def h_remove_placement_group(self, conn, p):
+        self._check_writable(p)
         pg = self.placement_groups.pop(p["pg_id"], None)
         if pg is None:
             return False
@@ -2088,7 +2491,101 @@ class GcsServer:
             "nodes": [n.view() for n in self.nodes.values()],
             "num_actors": len(self.actors),
             "num_jobs": len(self.jobs),
+            protocol.EPOCH_KEY: self.epoch,
+            "failovers": self._failover_count,
         }
+
+
+class GcsStandby:
+    """Warm-standby GCS: tails the primary's journal (shared-path follow
+    mode — the single-host equivalent of a `journal_tail` streaming RPC),
+    keeps hot replicas of every journaled table in an unstarted
+    GcsServer, and holds back from serving until the primary's lease has
+    gone a full TTL without renewal.  Takeover then: drain the un-tailed
+    journal suffix, bump the cluster epoch (journaled before a single
+    request is served), take over the advertised address, and start
+    serving — clients re-home through resolve_gcs_address() on their
+    next reconnect attempt (docs/control_plane.md §8)."""
+
+    def __init__(self, journal_path: str, ha_dir: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.journal_path = journal_path
+        self.ha_dir = ha_dir
+        self.server = GcsServer(host, port, journal_path, ha_dir=ha_dir)
+        self.tailer = JournalTailer(journal_path)
+        self.promoted = False
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _apply(self, records, reset: bool) -> None:
+        if reset:
+            # Compaction replaced the journal under us: the new file is
+            # self-contained (snapshot + suffix), so rebuild from zero.
+            self.server._reset_tables()
+        self.server._replay(records)
+
+    async def run_until_takeover(self) -> Optional[GcsServer]:
+        """Tail until the lease expires, then take over and return the
+        (started) server; returns None if stop() was called first."""
+        cfg = get_config()
+        poll = cfg.gcs_standby_poll_ms / 1000.0
+        lease_path = os.path.join(self.ha_dir, protocol.GCS_LEASE_FILE)
+        sb_path = os.path.join(self.ha_dir, protocol.GCS_STANDBY_FILE)
+        last_progress = 0.0
+        while not self._stop:
+            records, reset = self.tailer.poll()
+            if records or reset:
+                self._apply(records, reset)
+            now = time.time()
+            if now - last_progress >= 1.0:
+                # Tail-progress breadcrumb: the PRIMARY exports it as
+                # the standby-lag gauges (it owns the metrics endpoint).
+                GcsServer._write_json_atomic(sb_path, {
+                    "lag_bytes": self.tailer.lag_bytes(),
+                    "ts": now, "pid": os.getpid()})
+                last_progress = now
+            lease = GcsServer._read_json(lease_path)
+            if lease is not None:
+                age = now - float(lease.get("renewed", 0.0))
+                ttl = float(lease.get("ttl_s",
+                                      cfg.gcs_lease_ttl_s))
+                if age > ttl:
+                    await self._take_over(lease, age)
+                    return self.server
+            await asyncio.sleep(poll)
+        self.tailer.close()
+        return None
+
+    async def _take_over(self, stale_lease: dict, lease_age_s: float):
+        # Final drain: whatever the dead primary flushed before the
+        # lease lapsed must be in the replica before we bump the epoch.
+        for _ in range(8):
+            records, reset = self.tailer.poll()
+            if not records and not reset:
+                break
+            self._apply(records, reset)
+        self.tailer.close()
+        srv = self.server
+        prev_epoch = srv.epoch
+        srv.epoch = max(srv.epoch, int(stale_lease.get("epoch", 0))) + 1
+        srv._failover_count += 1
+        srv._replayed = True        # tables are hot; start() must not re-replay
+        logger.warning(
+            "GCS standby taking over: lease stale %.2fs, epoch %d -> %d",
+            lease_age_s, prev_epoch, srv.epoch)
+        addr = await srv.start()    # journals the epoch bump, claims the
+        self.promoted = True        # lease, rewrites the address file
+        # Failover is an anomaly by definition: capture a black-box
+        # bundle (diag-gcs_failover-*) with the takeover context.
+        srv._ingest_anomaly({
+            "kind": "gcs_failover", "daemon": "gcs",
+            "epoch": srv.epoch, "prev_epoch": prev_epoch,
+            "lease_age_s": round(lease_age_s, 3),
+            "ex_primary_pid": int(stale_lease.get("owner_pid") or 0),
+            "address": list(addr)})
+        return addr
 
 
 async def _amain(args):
@@ -2102,16 +2599,45 @@ async def _amain(args):
     rpc.enable_link_chaos(_gcfg().link_chaos)
     rpc.enable_native_framer(_gcfg().rpc_native_framer)
     rpc.set_default_call_timeout(_gcfg().control_call_timeout_s)
-    server = GcsServer(port=args.port,
-                       journal_path=args.journal or None)
-    addr = await server.start()
-    # Signal readiness to the parent via a file it watches.
-    if args.ready_file:
+
+    def _ready(payload: dict) -> None:
+        if not args.ready_file:
+            return
         tmp = args.ready_file + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"address": list(addr)}, f)
+            json.dump(payload, f)
         os.replace(tmp, args.ready_file)
-    await asyncio.Event().wait()
+
+    if args.standby:
+        if not args.journal:
+            raise SystemExit("--standby requires --journal")
+        ha_dir = args.ha_dir or os.path.dirname(args.journal)
+        standby = GcsStandby(args.journal, ha_dir, port=args.port)
+        # Readiness for a standby means "tailing", not "serving".
+        _ready({"standby": True, "pid": os.getpid()})
+        server = await standby.run_until_takeover()
+        if server is None:
+            return
+        _ready({"address": list(server.address), "promoted": True,
+                protocol.EPOCH_KEY: server.epoch, "pid": os.getpid()})
+    else:
+        server = GcsServer(port=args.port,
+                           journal_path=args.journal or None,
+                           ha_dir=args.ha_dir or None)
+        addr = await server.start()
+        # Signal readiness to the parent via a file it watches.
+        _ready({"address": list(addr), protocol.EPOCH_KEY: server.epoch,
+                "pid": os.getpid()})
+    # Serve until fenced: a successor epoch in the lease file means a
+    # standby took over while this process was frozen/partitioned — the
+    # only correct move left is to stop touching the world and exit.
+    await server.fenced_event.wait()
+    logger.error("exiting: fenced by a newer-epoch primary")
+    try:
+        await asyncio.wait_for(server.close(), 5)
+    except asyncio.TimeoutError:
+        pass
+    os._exit(3)
 
 
 def main():
@@ -2119,6 +2645,12 @@ def main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--ready-file", default="")
     parser.add_argument("--journal", default="")
+    parser.add_argument("--ha-dir", default="",
+                        help="shared dir for the HA lease + advertised-"
+                             "address files; empty disables the lease")
+    parser.add_argument("--standby", action="store_true",
+                        help="run as warm standby: tail the journal, "
+                             "serve only after lease-expiry takeover")
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--system-config", default="")
     args = parser.parse_args()

@@ -114,21 +114,51 @@ def _spawn(args, session_dir: str, tag: str) -> subprocess.Popen:
 
 
 def start_gcs(session_dir: str, port: int = 0,
-              system_config: Optional[dict] = None
+              system_config: Optional[dict] = None,
+              ha: bool = False
               ) -> Tuple[subprocess.Popen, tuple]:
     """Spawn the GCS with its journal in the session dir; restarting it
     with the same session_dir + port replays the journal (reference:
-    Redis-backed GCS restart, gcs_init_data.cc)."""
+    Redis-backed GCS restart, gcs_init_data.cc).
+
+    ``ha=True`` arms the high-availability plane (docs/control_plane.md
+    §8): the primary claims a disk lease under the session dir, renews
+    it while it holds agent-heartbeat majority, and advertises its
+    address through the session address file so a warm standby (see
+    `start_gcs_standby`) can take over after a crash."""
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}.json")
-    proc = _spawn(
-        [sys.executable, "-m", "ray_tpu._private.gcs",
-         "--port", str(port), "--ready-file", ready,
-         "--journal", os.path.join(session_dir, "gcs_journal.msgpack"),
-         "--system-config",
-         json.dumps(system_config) if system_config else ""],
-        session_dir, "gcs")
+    args = [sys.executable, "-m", "ray_tpu._private.gcs",
+            "--port", str(port), "--ready-file", ready,
+            "--journal", os.path.join(session_dir, "gcs_journal.msgpack"),
+            "--system-config",
+            json.dumps(system_config) if system_config else ""]
+    if ha:
+        args += ["--ha-dir", session_dir]
+    proc = _spawn(args, session_dir, "gcs")
     info = _wait_ready(ready, proc)
     return proc, tuple(info["address"])
+
+
+def start_gcs_standby(session_dir: str, port: int = 0,
+                      system_config: Optional[dict] = None
+                      ) -> subprocess.Popen:
+    """Spawn a warm-standby GCS: it tails the primary's journal from the
+    shared session dir, keeps hot table replicas, and promotes itself —
+    bumping the cluster epoch — once the primary's lease goes a full TTL
+    without renewal.  Returns as soon as the standby confirms it is
+    tailing (its promotion, if ever, is autonomous)."""
+    ready = os.path.join(session_dir,
+                         f"gcs_standby_ready_{uuid.uuid4().hex[:6]}.json")
+    proc = _spawn(
+        [sys.executable, "-m", "ray_tpu._private.gcs",
+         "--standby", "--port", str(port), "--ready-file", ready,
+         "--journal", os.path.join(session_dir, "gcs_journal.msgpack"),
+         "--ha-dir", session_dir,
+         "--system-config",
+         json.dumps(system_config) if system_config else ""],
+        session_dir, "gcs_standby")
+    _wait_ready(ready, proc)
+    return proc
 
 
 def start_agent(session_dir: str, gcs_address: tuple,

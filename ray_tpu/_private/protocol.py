@@ -230,3 +230,63 @@ CH_NODE = "node"
 CH_ERROR = "error"
 CH_LOG = "log"
 CH_PG = "placement_group"
+
+# Cluster epoch — the fencing token of GCS high availability
+# (reference: Raft terms, Ongaro & Ousterhout; leader leases with
+# monotonic epochs).  The epoch is a journaled monotonic integer bumped
+# exactly once per failover, BEFORE the new primary serves a single
+# request.  It is stamped into every lease grant, node registration and
+# actor-placement decision under the EPOCH_KEY field; agents and core
+# workers reject grants minted under an older epoch (StaleEpochError)
+# and the primary rejects mutations carrying a stale one.  EPOCH_NONE
+# marks a participant that has not yet learned any epoch (accepts the
+# first one it sees).
+EPOCH_KEY = "cluster_epoch"
+EPOCH_NONE = 0
+
+# Typed-rejection marker used on the wire when an agent refuses a
+# stale-epoch lease operation: replies carry {"granted": False,
+# "reject": REJECT_STALE_EPOCH, EPOCH_KEY: <current>} so owners can
+# distinguish fencing from plain resource exhaustion.
+REJECT_STALE_EPOCH = "stale_epoch"
+
+# GCS high-availability files, all under the session dir (the shared
+# path both the primary and the warm standby can reach):
+#   GCS_ADDRESS_FILE — the ADVERTISED address: {"address": [h, p],
+#     "cluster_epoch": e}.  Atomically replaced by whichever instance
+#     currently holds the lease; every client re-reads it through
+#     resolve_gcs_address() on every reconnect attempt, so failover
+#     re-homing rides the existing jittered dial backoff.
+#   GCS_LEASE_FILE — the primary's liveness lease: {"epoch",
+#     "renewed" (wall), "ttl_s", "owner_pid", "address"}.  Renewed
+#     every ttl/3 while the primary holds agent-heartbeat majority; a
+#     standby takes over only once the lease has gone a full TTL
+#     without renewal, and an ex-primary that observes a HIGHER epoch
+#     in this file is fenced (refuses writes and exits).
+#   GCS_STANDBY_FILE — the standby's tail progress: {"lag_bytes",
+#     "ts", "pid"}; the primary exports it as the standby-lag gauges.
+GCS_ADDRESS_FILE = "gcs_address.json"
+GCS_LEASE_FILE = "gcs_lease.json"
+GCS_STANDBY_FILE = "gcs_standby.json"
+
+
+def resolve_gcs_address(session_dir: Optional[str], fallback=None):
+    """Current advertised GCS address for a session, or `fallback`.
+
+    The ONE address-resolution helper every reconnect path routes
+    through (agents, core workers, drivers): reading the session's
+    address file at dial time — instead of trusting the address cached
+    from init()/argv forever — is what lets a failover (or a plain
+    address change) re-home clients without process restarts."""
+    if session_dir:
+        import json
+        import os
+        try:
+            with open(os.path.join(session_dir, GCS_ADDRESS_FILE)) as f:
+                info = json.load(f)
+            addr = info.get("address")
+            if addr and len(addr) >= 2:
+                return (addr[0], int(addr[1]))
+        except (OSError, ValueError, TypeError):
+            pass
+    return fallback

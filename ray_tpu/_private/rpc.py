@@ -2265,13 +2265,21 @@ class ReconnectingConnection:
     RayletNotifyGCSRestart, core_worker.proto:467).  A lost call is
     retried ONCE after reconnect; GCS mutations are id-keyed upserts, so
     the replay is idempotent.  `on_reconnect(conn)` runs after every
-    successful (re)dial — registration/subscription goes there."""
+    successful (re)dial — registration/subscription goes there.
+
+    `resolver` (optional, sync, returns an address or None) is consulted
+    before EVERY dial attempt, not just the first: after a GCS failover
+    the advertised address points at the promoted standby, and a client
+    pinned to the address it cached at init() would redial a corpse
+    forever.  Re-homing rides the same jittered backoff as a plain
+    restart — no separate failover code path on the client."""
 
     def __init__(self, address, handlers: Dict[str, Callable] | None = None,
                  name: str = "client",
                  on_reconnect: Callable | None = None,
                  dial_retries: int = 75, retry_delay: float = 0.2,
-                 auth_token=DEFAULT_TOKEN):
+                 auth_token=DEFAULT_TOKEN,
+                 resolver: Callable | None = None):
         self.address = address
         self.handlers = handlers
         self.name = name
@@ -2279,6 +2287,7 @@ class ReconnectingConnection:
         self.dial_retries = dial_retries
         self.retry_delay = retry_delay
         self.auth_token = auth_token
+        self.resolver = resolver
         self._conn: Connection | None = None
         self._lock = asyncio.Lock()
         self._closed = False
@@ -2300,15 +2309,39 @@ class ReconnectingConnection:
         async with self._lock:
             if self._conn is not None and not self._conn.closed:
                 return self._conn
-            self._conn = await connect(
-                self.address, self.handlers, retries=self.dial_retries,
-                retry_delay=self.retry_delay, name=self.name,
-                auth_token=self.auth_token)
+            self._conn = await self._dial()
             if self.on_reconnect is not None:
                 res = self.on_reconnect(self._conn)
                 if isinstance(res, Awaitable):
                     await res
             return self._conn
+
+    async def _dial(self) -> Connection:
+        # One single-shot connect per retry round so the resolver runs
+        # between rounds — connect()'s own retry loop would pin the
+        # whole budget to the address resolved once up front.
+        last_err: Exception | None = None
+        for attempt in range(self.dial_retries):
+            if self.resolver is not None:
+                try:
+                    addr = self.resolver()
+                except Exception:
+                    addr = None  # transient resolve failure: last known
+                if addr:
+                    self.address = (addr if isinstance(addr, str)
+                                    else (addr[0], addr[1]))
+            try:
+                return await connect(
+                    self.address, self.handlers, retries=1, retry_delay=0,
+                    name=self.name, auth_token=self.auth_token)
+            except ConnectionLost as e:
+                last_err = e
+                if attempt + 1 >= self.dial_retries:
+                    break
+                await asyncio.sleep(
+                    _backoff_delay(attempt, self.retry_delay))
+        raise ConnectionLost(
+            f"cannot connect to {self.address} ({self.name}): {last_err}")
 
     async def call(self, method: str, payload=None,
                    timeout: float | None = None,

@@ -44,10 +44,19 @@ class SimulatedNode:
 
     def __init__(self, gcs_address: tuple, index: int,
                  period_s: float = 0.25, metrics_rows: int = 8,
-                 telemetry_rows: int = 4):
+                 telemetry_rows: int = 4,
+                 session_dir: Optional[str] = None):
         from .ids import NodeID
         self.gcs_address = tuple(gcs_address)
         self.index = index
+        # With a session_dir the node runs in HA mode: a reconnecting
+        # GCS connection that re-resolves the advertised address on
+        # every dial and re-registers after each (re)connect — the same
+        # failover re-homing a real agent does.  Used by the GCS
+        # failover soak (tests/test_gcs_failover.py).
+        self.session_dir = session_dir
+        self.last_epoch = 0
+        self.reregistrations = 0
         self.node_id = NodeID.from_random().binary()
         self.period_s = period_s
         self.metrics_rows = metrics_rows
@@ -86,21 +95,36 @@ class SimulatedNode:
             "shutdown": lambda conn, p: True,
         }, name=f"sim-agent-{self.index}")
         self.address = await self.server.start_tcp("127.0.0.1", 0)
-        self.gcs = await rpc.connect(self.gcs_address,
-                                     name=f"sim{self.index}->gcs")
         t0 = time.monotonic()
-        reply = await self.gcs.call("register_node", {
+        if self.session_dir:
+            from . import protocol
+            self.gcs = rpc.ReconnectingConnection(
+                self.gcs_address, name=f"sim{self.index}->gcs",
+                on_reconnect=self._register,
+                resolver=lambda: protocol.resolve_gcs_address(
+                    self.session_dir, fallback=self.gcs_address))
+            await self.gcs.ensure()
+        else:
+            self.gcs = await rpc.connect(self.gcs_address,
+                                         name=f"sim{self.index}->gcs")
+            await self._register(self.gcs)
+        self.reg_latency_s = time.monotonic() - t0
+
+    async def _register(self, conn) -> None:
+        reply = await conn.call("register_node", {
             "node_id": self.node_id,
             "address": list(self.address),
             "resources": self.resources,
             "labels": {"sim": "1"},
             "store_path": "",
-            "session_dir": "",
+            "session_dir": self.session_dir or "",
             "view": False,          # the slim O(1) registration reply
         }, timeout=30)
-        self.reg_latency_s = time.monotonic() - t0
+        self.reregistrations += 1
         if not isinstance(reply, dict) or "num_nodes" not in reply:
             self.errors.append(f"unexpected register reply: {reply!r}")
+        elif isinstance(reply.get("cluster_epoch"), int):
+            self.last_epoch = max(self.last_epoch, reply["cluster_epoch"])
 
     async def _h_drain(self, conn, p):
         self.drain_requests += 1
@@ -133,6 +157,10 @@ class SimulatedNode:
                 self.heartbeats_sent += 1
                 if ok is False:
                     self.heartbeats_rejected += 1
+                elif isinstance(ok, dict) and \
+                        isinstance(ok.get("cluster_epoch"), int):
+                    self.last_epoch = max(self.last_epoch,
+                                          ok["cluster_epoch"])
                 self._flush_telemetry()
             except asyncio.CancelledError:
                 raise

@@ -41,7 +41,8 @@ class ClusterNode:
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[dict] = None):
+                 head_node_args: Optional[dict] = None,
+                 gcs_standby: bool = False):
         self.session_dir = node_mod.new_session_dir()
         # Same token story as a real head start: generate/export before
         # any daemon spawns so every agent requires it (the driver that
@@ -53,6 +54,8 @@ class Cluster:
         auth.ensure_cluster_token(self.session_dir, write_wellknown=False)
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_address: Optional[tuple] = None
+        self.gcs_standby_proc: Optional[subprocess.Popen] = None
+        self._gcs_ha = gcs_standby
         self.nodes: List[ClusterNode] = []
         self.head_node: Optional[ClusterNode] = None
         self.chaos = None
@@ -62,7 +65,15 @@ class Cluster:
             # The head's _system_config also parameterizes the GCS (e.g.
             # rpc_chaos must inject in EVERY process, GCS included).
             self.gcs_proc, self.gcs_address = node_mod.start_gcs(
-                self.session_dir, system_config=self._head_system_config)
+                self.session_dir, system_config=self._head_system_config,
+                ha=gcs_standby)
+            if gcs_standby:
+                # Warm standby: tails the primary's journal and promotes
+                # itself with a bumped cluster epoch once the primary's
+                # disk lease lapses (docs/control_plane.md §8).
+                self.gcs_standby_proc = node_mod.start_gcs_standby(
+                    self.session_dir,
+                    system_config=self._head_system_config)
             self.head_node = self.add_node(**(head_node_args or {}))
             # Process-kill chaos harness (config `process_chaos` or env
             # RAY_TPU_process_chaos): SIGKILLs worker/agent/GCS processes
@@ -74,9 +85,15 @@ class Cluster:
                     or os.environ.get("RAY_TPU_process_chaos", ""))
             if spec:
                 from ._private.chaos import ProcessChaos
+                # With a warm standby armed, a chaos GCS kill is handled
+                # by FAILOVER (wait for the standby's promotion, then
+                # re-arm a fresh standby) instead of a same-port respawn
+                # — the harness exercises the epoch-fenced takeover path.
+                gcs_cb = (self._gcs_failover_restart if gcs_standby
+                          else self.restart_gcs)
                 self.chaos = ProcessChaos(
                     spec, self.session_dir,
-                    restart={"gcs": self.restart_gcs},
+                    restart={"gcs": gcs_cb},
                     protect_pids={os.getpid(),
                                   self.head_node.proc.pid}).start()
 
@@ -121,6 +138,59 @@ class Cluster:
         self.gcs_proc, self.gcs_address = node_mod.start_gcs(
             self.session_dir, port=self.gcs_address[1],
             system_config=self._head_system_config)
+
+    # ------------------------------------------------------- GCS failover --
+    def kill_gcs_primary(self, rearm: bool = True,
+                         timeout: float = 30.0) -> tuple:
+        """SIGKILL the GCS primary and wait for the warm standby to take
+        over (lease lapse -> epoch bump -> new advertised address).
+        With ``rearm`` a fresh standby is spawned behind the promoted
+        primary, so the cluster tolerates the NEXT kill too.  Returns
+        the new primary's address."""
+        if self.gcs_standby_proc is None:
+            raise RuntimeError("no warm standby armed "
+                               "(Cluster(gcs_standby=True))")
+        old_addr = self.gcs_address
+        self.gcs_proc.kill()
+        self.gcs_proc.wait()
+        self.gcs_address = self.wait_for_gcs_failover(old_addr, timeout)
+        # The promoted standby IS the primary now.
+        self.gcs_proc, self.gcs_standby_proc = self.gcs_standby_proc, None
+        if rearm:
+            self.gcs_standby_proc = node_mod.start_gcs_standby(
+                self.session_dir, system_config=self._head_system_config)
+        return self.gcs_address
+
+    def wait_for_gcs_failover(self, old_address: tuple,
+                              timeout: float = 30.0) -> tuple:
+        """Block until the session's advertised GCS address moves off
+        `old_address` (the standby promoted itself and rewrote the
+        address file)."""
+        from ._private import protocol
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            addr = protocol.resolve_gcs_address(self.session_dir)
+            if addr is not None and tuple(addr) != tuple(old_address):
+                return tuple(addr)
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"GCS standby did not take over within {timeout}s "
+            f"(logs in {os.path.join(self.session_dir, 'logs')})")
+
+    def _gcs_failover_restart(self) -> None:
+        """Chaos-harness callback for a GCS kill when a standby is armed:
+        reap the dead primary, wait for the promotion, re-arm."""
+        old = self.gcs_proc
+        if old is not None:
+            try:
+                old.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                old.kill()
+                old.wait()
+        self.gcs_address = self.wait_for_gcs_failover(self.gcs_address)
+        self.gcs_proc, self.gcs_standby_proc = self.gcs_standby_proc, None
+        self.gcs_standby_proc = node_mod.start_gcs_standby(
+            self.session_dir, system_config=self._head_system_config)
 
     def remove_node(self, node: ClusterNode,
                     allow_graceful: bool = False) -> None:
@@ -177,13 +247,16 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 node.proc.kill()
                 node.proc.wait()  # reap; also a barrier before the unlink below
-        if self.gcs_proc is not None:
-            self.gcs_proc.terminate()
+        for proc in (self.gcs_proc, self.gcs_standby_proc):
+            if proc is None:
+                continue
+            proc.terminate()
             try:
-                self.gcs_proc.wait(timeout=3)
+                proc.wait(timeout=3)
             except subprocess.TimeoutExpired:
-                self.gcs_proc.kill()
-                self.gcs_proc.wait()
+                proc.kill()
+                proc.wait()
+        self.gcs_proc = self.gcs_standby_proc = None
         # /dev/shm arenas are unlinked by the agents on SIGTERM; hard-killed
         # agents leave theirs behind until reboot — remove defensively.
         for node in nodes:

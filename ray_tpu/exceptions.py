@@ -199,3 +199,24 @@ class NodePreemptedError(NodeDiedError):
     the ActorDiedError raised to callers, preserving isinstance
     compatibility); match on the cause text to distinguish preemption
     from a crash."""
+
+
+class StaleEpochError(RayError):
+    """A control-plane grant or mutation carried a cluster epoch older
+    than the current one.
+
+    Every GCS failover bumps the journaled cluster epoch; the epoch is
+    stamped into lease grants, node registrations, and actor-placement
+    decisions.  An agent asked to honour a lease minted under an older
+    epoch (a grant that outlived a failover), or a fenced ex-primary
+    trying to mutate state it no longer owns, gets this typed rejection
+    instead of silent acceptance — the Raft-style fencing-token
+    discipline applied to the primary/standby GCS pair.  Owners treat it
+    like a lost lease: drop the cached grant and resubmit through the
+    normal retry path (task-id dedup keeps execution exactly-once)."""
+
+    def __init__(self, message: str = "stale cluster epoch",
+                 stale_epoch: int = 0, current_epoch: int = 0):
+        super().__init__(message)
+        self.stale_epoch = int(stale_epoch)
+        self.current_epoch = int(current_epoch)

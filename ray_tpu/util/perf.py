@@ -963,6 +963,93 @@ def bench_pg_create_removal(min_time_s: float, batch: int = 5) -> float:
     return _timeit(run, min_time_s)
 
 
+def _gcs_failover_round() -> float:
+    """One failover measurement: spin an isolated HA pair (primary +
+    journal-tailing standby, short lease so the round stays quick),
+    SIGKILL the primary, and return ms until a client dialing through
+    `resolve_gcs_address` completes a `kv_get` against the promoted
+    standby.  No ambient-cluster involvement."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from ray_tpu._private import auth, node, protocol, rpc
+
+    session_dir = tempfile.mkdtemp(prefix="ray_tpu_ha_bench_")
+    cfg = {"gcs_lease_ttl_s": 1.0, "gcs_standby_poll_ms": 25}
+    procs = []
+    try:
+        auth.ensure_cluster_token(session_dir, write_wellknown=False)
+        proc, addr = node.start_gcs(session_dir, system_config=cfg,
+                                    ha=True)
+        procs.append(proc)
+        procs.append(node.start_gcs_standby(session_dir,
+                                            system_config=cfg))
+
+        async def run() -> float:
+            conn = rpc.ReconnectingConnection(
+                addr, name="bench->gcs", dial_retries=200,
+                resolver=lambda: protocol.resolve_gcs_address(
+                    session_dir, fallback=addr))
+            await conn.call("kv_put", {"ns": "bench", "key": "k",
+                                       "value": b"v"})
+            # Let the standby's tail and lease view go quiescent, then
+            # blackout: kill -9 the primary and clock the first
+            # successful read through the re-resolved address.
+            await asyncio.sleep(1.0)
+            proc.kill()
+            proc.wait()
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    got = await conn.call("kv_get",
+                                          {"ns": "bench", "key": "k"},
+                                          timeout=5)
+                    if got == b"v":
+                        break
+                except rpc.RpcError:
+                    pass
+                await asyncio.sleep(0.02)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            await conn.close()
+            return dt_ms
+
+        return asyncio.run(run())
+    finally:
+        for p in procs:
+            try:
+                p.terminate()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        shutil.rmtree(session_dir, ignore_errors=True)
+
+
+def bench_gcs_failover_downtime_ms(min_time_s: float,
+                                   rounds: int = 0) -> float:
+    """Control-plane blackout of a warm-standby GCS failover
+    (docs/control_plane.md §8).  Median of `rounds` independent
+    failovers: where the SIGKILL lands inside the lease-renewal period
+    (ttl/3) moves a single reading by several hundred ms, so one
+    sample is too noisy to gate on (the 0.05 s harness smoke keeps a
+    single round).  Lower is better; 0.0 when the pair can't spawn
+    here (reported, never gated)."""
+    if rounds <= 0:
+        rounds = 3 if min_time_s >= 1.0 else 1
+    samples = []
+    try:
+        for _ in range(rounds):
+            samples.append(_gcs_failover_round())
+    except Exception as e:  # pragma: no cover — a bench must never sink
+        import logging                       # the rest of the suite
+        logging.getLogger(__name__).warning(
+            "gcs failover bench failed: %s", e)
+        if not samples:
+            return 0.0
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
 BENCHES: Dict[str, Callable[[float], float]] = {
     # name -> bench fn; units live in UNITS, reference values in BASELINE.
     # Ordering is deliberate on small hosts: the multi-client benches run
@@ -1014,6 +1101,10 @@ BENCHES: Dict[str, Callable[[float], float]] = {
     "device_channel_steps_per_s": bench_device_channel_steps,
     "device_channel_steps_per_s_host": bench_device_channel_steps_host,
     "kv_handoff_gibs": bench_kv_handoff_gibs,
+    # GCS HA failover blackout (isolated subprocess pair — no ambient
+    # cluster): ms from primary SIGKILL to the first read served by the
+    # promoted standby through the re-resolved advertised address.
+    "gcs_failover_downtime_ms": bench_gcs_failover_downtime_ms,
     # Last: these spawn/kill extra node agents; their churn must not
     # overlap another measurement.
     "compiled_dag_cross_node_steps_per_s":
@@ -1081,6 +1172,11 @@ BASELINE = {
     "device_channel_steps_per_s": 3900.0,
     "device_channel_steps_per_s_host": 850.0,
     "kv_handoff_gibs": 0.17,
+    # GCS HA anchor: committed host-class number (1 s bench lease TTL,
+    # 25 ms standby poll — detection dominates: ~TTL + drain + promote;
+    # median of 3 rounds).  LOWER-is-better; production defaults (3 s
+    # TTL) scale it ~3x.
+    "gcs_failover_downtime_ms": 1150.0,
 }
 
 UNITS = {
@@ -1112,6 +1208,9 @@ UNITS = {
     "kv_handoff_gibs":
         "GiB/s (device KV blob put+get — single-copy staging + "
         "device_put re-upload)",
+    "gcs_failover_downtime_ms":
+        "ms control-plane blackout (primary SIGKILL -> first read off "
+        "the promoted standby; 1 s bench lease TTL, lower is better)",
     "single_client_put_gigabytes": "GiB/s",
     "multi_client_put_gigabytes": "GiB/s",
     "framer_bulk_gibs_native": "GiB/s (loopback raw pull)",
@@ -1208,12 +1307,21 @@ DEVICE_PLANE_METRICS = (
     "kv_handoff_gibs",
 )
 
+# GCS HA failover blackout, gated with the DATA_PLANE downgrade rules:
+# 0.0 means the isolated GCS pair couldn't spawn here and is reported,
+# never gated on; host-fingerprint mismatch downgrades to informational
+# like every absolute gate.  Lower is better (see LOWER_IS_BETTER).
+GCS_HA_METRICS = (
+    "gcs_failover_downtime_ms",
+)
+
 # Metrics where SMALLER readings are better (latencies): the gate
 # inverts their ratio so "regression" always means "got worse".
 LOWER_IS_BETTER = frozenset({"serving_ttft_p50_ms",
                              "serving_pd_ttft_p50_ms",
                              "long_context_ttft_ms",
-                             "long_context_ttft_staged_ms"})
+                             "long_context_ttft_staged_ms",
+                             "gcs_failover_downtime_ms"})
 
 
 def _latest_committed_bench(repo_root: str = "."):
@@ -1323,7 +1431,8 @@ def check_against_committed(min_time_s: float = 2.0,
         not _host_matches(base_host, this_host)
     gated = (CONTROL_PLANE_METRICS + AGGREGATE_METRICS
              + DATA_PLANE_METRICS + SERVING_METRICS + DAG_METRICS
-             + LONG_CONTEXT_METRICS + DEVICE_PLANE_METRICS)
+             + LONG_CONTEXT_METRICS + DEVICE_PLANE_METRICS
+             + GCS_HA_METRICS)
     results = run_microbenchmarks(min_time_s=min_time_s,
                                   only=set(gated))
     failures = []
@@ -1334,6 +1443,7 @@ def check_against_committed(min_time_s: float = 2.0,
         if name in DATA_PLANE_METRICS + SERVING_METRICS \
                 + AGGREGATE_METRICS + DAG_METRICS \
                 + LONG_CONTEXT_METRICS + DEVICE_PLANE_METRICS \
+                + GCS_HA_METRICS \
                 and (not now or not ref):
             # 0.0 = the bench couldn't spawn its extra agents here (or
             # the baseline predates the metric): report, never gate.
@@ -1554,6 +1664,7 @@ def run_microbenchmarks(min_time_s: float = 1.0,
         if only and name not in only:
             continue
         if name.startswith("framer_") or name in LONG_CONTEXT_METRICS \
+                or name in GCS_HA_METRICS \
                 or name in ("sp_prefill_tokens_per_s_base",
                             "long_context_ttft_staged_ms"):
             # Loopback-only / subprocess micro bench: no cluster
