@@ -230,6 +230,35 @@ class NodeAgent:
         self._pull_waiters: List[Tuple[int, int, asyncio.Future]] = []  # heap
         self._pull_active = 0
         self._pull_seq = 0
+        # --- Tiered-memory admission (the CreateRequestQueue analogue;
+        # reference: plasma create_request_queue.h + SURVEY N15/N16's
+        # unified object manager).  Creates that cannot reserve arena
+        # headroom park in a bounded FIFO; _create_queue_loop retries the
+        # HEAD as eviction/spill frees room (FIFO = no small-object
+        # starvation of a big create), expiring entries typed.
+        # _reserved is the admission ledger: oid -> (nbytes, expiry) for
+        # creates granted headroom but not yet sealed — counted as
+        # in-use by every sweep/admission decision, so a racing put can
+        # never be granted the same headroom and a pressure sweep never
+        # treats an unsealed in-progress region as reclaimable.
+        from collections import deque as _cq
+        self._create_queue: _cq = _cq()
+        self._create_queue_depth_max = int(cfg.create_queue_depth)
+        self._create_event = asyncio.Event()
+        self._reserved: Dict[bytes, Tuple[int, float]] = {}
+        self._pinned_floor = int(cfg.eviction_pinned_bytes_floor)
+        # Spill/restore byte counters (observability catalog rows).
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
+        # Memory-pressure chaos (config mem_chaos): squeezes the
+        # EFFECTIVE arena budget the admission/spill policy sees.
+        # Consulted lazily via _capacity_scale() — no extra thread.
+        self._mem_chaos = None
+        if cfg.mem_chaos:
+            from .chaos import MemChaos
+            self._mem_chaos = MemChaos(cfg.mem_chaos)
+        self._shed_threshold = float(cfg.lease_shed_pressure_threshold)
+        self._leases_shed = 0
         # Replica-plane state (see docs/data_plane.md "replica directory"):
         # oid -> owner addr for SECONDARY copies this node registered with
         # an owner (pulled replicas; deregistered on eviction/free/drain so
@@ -371,6 +400,7 @@ class NodeAgent:
             "fetch_chunk": self.h_fetch_chunk,
             "pull_object": self.h_pull_object,
             "ensure_space": self.h_ensure_space,
+            "reserve_create": self.h_reserve_create,
             "spill_path": self.h_spill_path,
             "spill_register": self.h_spill_register,
             "restore_object": self.h_restore_object,
@@ -442,6 +472,7 @@ class NodeAgent:
         await self.gcs.ensure()
         self._tasks.append(asyncio.ensure_future(self._report_loop()))
         self._tasks.append(asyncio.ensure_future(self._parked_lease_loop()))
+        self._tasks.append(asyncio.ensure_future(self._create_queue_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         if get_config().worker_fork_server:
             # Warm the fork-server immediately: its one-time heavy import
@@ -603,12 +634,26 @@ class NodeAgent:
             st = {}
         lm = loopmon.snapshot()
         shard_busy = [v for k, v in lm.items() if k.startswith("shard")]
+        # Heartbeat tick doubles as the arena source of the shared
+        # pressure signal (memory_monitor.pressure_signal): lease
+        # shedding and KV demotion drain the same number the create
+        # queue backpressures on.
+        pressure = self._arena_pressure(st)
+        try:
+            from .memory_monitor import pressure_signal
+            pressure_signal().report("arena", pressure)
+            if self._mem_chaos is not None:
+                self._mem_chaos.report_pressure()
+        except Exception:
+            pass
         return {
             "lease_queue_depth": float(len(self._parked_leases)),
             "active_leases": float(len(self.leases)),
             "num_workers": float(len(self.workers)),
             "arena_used_bytes": float(st.get("bytes_in_use", 0)),
             "arena_capacity_bytes": float(st.get("capacity", 0)),
+            "arena_pressure": pressure,
+            "create_queue_depth": float(len(self._create_queue)),
             # Loop saturation for `ray_tpu summary`'s busy column:
             # main-loop busy fraction / max across I/O shards.
             "loop_busy": float(lm.get("main", 0.0)),
@@ -679,6 +724,19 @@ class NodeAgent:
                 self._bytes_served, "counter"),
             row("ray_tpu_transfer_pulled_bytes_total",
                 self._bytes_pulled, "counter"),
+            row("ray_tpu_arena_pressure", rt["arena_pressure"],
+                help_="arena occupancy incl. unsealed create "
+                      "reservations, over the EFFECTIVE capacity "
+                      "(mem_chaos squeezes shrink the denominator)"),
+            row("ray_tpu_create_queue_depth", rt["create_queue_depth"],
+                help_="creates parked in the FIFO admission queue "
+                      "waiting for eviction/spill headroom"),
+            row("ray_tpu_spilled_bytes_total",
+                self._spilled_bytes_total, "counter",
+                help_="bytes written to the NVMe/external spill tier"),
+            row("ray_tpu_restored_bytes_total",
+                self._restored_bytes_total, "counter",
+                help_="bytes restored from spill files into the arena"),
         ]
         # Per-loop busy fractions: main + every I/O shard, node-labeled
         # (the gcs exports its own under daemon="gcs").  Stale entries
@@ -794,18 +852,27 @@ class NodeAgent:
         node_manager.cc:229-230)."""
         from .config import get_config
         from .memory_monitor import (GroupByOwnerPolicy, kill_worker,
-                                     node_memory_usage)
+                                     node_memory_usage, pressure_signal)
         cfg = get_config()
         period = cfg.memory_monitor_refresh_ms / 1000.0
         threshold = cfg.memory_usage_threshold
         if period <= 0 or threshold >= 1.0:
             return
         policy = GroupByOwnerPolicy()
+        sig = pressure_signal()
         while not self._shutdown:
             await asyncio.sleep(period)
             try:
                 used, total = node_memory_usage()
                 frac = used / max(total, 1)
+                # Node RAM feeds the SAME pressure signal the create
+                # queue and lease shedding drain — but only past the OOM
+                # threshold: ordinary host occupancy (a busy dev box)
+                # must not flip the cluster into shed mode.
+                if frac > threshold:
+                    sig.report("node", frac)
+                else:
+                    sig.clear("node")
                 if frac <= threshold:
                     continue
                 victim = policy.pick(list(self.workers.values()))
@@ -1274,6 +1341,27 @@ class NodeAgent:
             return {"granted": False,
                     "reason": f"node draining ({self._draining})",
                     "spillback": spill, "retry_after_ms": 200}
+        if not p.get("placement_group"):
+            # Memory-pressure lease shedding: when the node's shared
+            # pressure signal (arena occupancy / node RAM past the OOM
+            # threshold / KV pool / chaos squeeze) is high, prefer a
+            # feasible peer over piling more working set onto a node
+            # already evicting.  Only when a spillback target EXISTS —
+            # a sole node always grants (degrading to refusal would
+            # deadlock single-node clusters, and the create queue
+            # already backpressures the data plane).
+            try:
+                from .memory_monitor import pressure_signal
+                level = pressure_signal().level()
+            except Exception:
+                level = 0.0
+            if level >= self._shed_threshold:
+                spill = await self._find_spillback(resources,
+                                                   p.get("prefetch"))
+                if spill is not None:
+                    self._leases_shed += 1
+                    return {"granted": False, "spillback": spill,
+                            "reason": "memory pressure shed"}
         pg = p.get("placement_group")
         bundle_key = None
         if pg:
@@ -2033,6 +2121,18 @@ class NodeAgent:
         if p.get("owner_addr"):
             self._pinned_owner[oid] = tuple(p["owner_addr"])
         self.pinned[oid] = self.pinned.get(oid, 0) + 1
+        # The create this pin finalizes is sealed: its admission
+        # reservation (if any) collapses into the store's real
+        # accounting.
+        self._release_reservation(oid)
+        if oid in self.spilled:
+            # Spilled before (or during) the ownership handoff — e.g. a
+            # worker's direct put-to-disk whose owner we only learn now:
+            # register the storage-tier directory location.
+            owner = self._pinned_owner.get(oid)
+            if owner is not None:
+                rpc.spawn(self._notify_owner_location(
+                    oid, owner, add=True, disk=True))
         await self._maybe_spill_to_threshold()
         return True
 
@@ -2050,6 +2150,7 @@ class NodeAgent:
 
     async def h_free_objects(self, conn, p):
         for oid in p["object_ids"]:
+            self._release_reservation(oid)
             for _ in range(self.pinned.pop(oid, 0)):
                 if oid not in self.spilled:
                     self.store.release(oid)
@@ -2126,7 +2227,16 @@ class NodeAgent:
             return 0
         self._spilling.add(oid)
         size = len(view)
-        path = self._spill_path(oid)
+        try:
+            path = self._spill_path(oid)
+        except OSError:
+            # Spill dir unusable (unwritable/clobbered): the object is
+            # simply not spillable right now — the sweep must degrade,
+            # not crash the admission loops that drive it.
+            self._spilling.discard(oid)
+            view.release()
+            self.store.release(oid)
+            return 0
         try:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, _write_file, path, view)
@@ -2137,6 +2247,20 @@ class NodeAgent:
         finally:
             self._spilling.discard(oid)
             view.release()
+        if self.pinned.get(oid, 0) != npins:
+            # The pin count moved while the write ran off-loop (a second
+            # put's pin_transfer, a fresh owner pin, or an unpin): the
+            # snapshot release_n_and_delete_if would commit is STALE —
+            # releasing n+1 here would either strip a pin someone still
+            # counts on or leave the arena copy undeletable with broken
+            # accounting.  Abort this sweep's attempt; the object is
+            # still resident and a later sweep re-snapshots.
+            self.store.release(oid)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return 0
         if not self.store.release_n_and_delete_if(oid, npins + 1):
             # A reader pinned the object mid-write: abort the spill.
             try:
@@ -2145,6 +2269,15 @@ class NodeAgent:
                 pass
             return 0
         self.spilled[oid] = (path, size)
+        self._spilled_bytes_total += size
+        # The spilled primary becomes a STORAGE-TIER directory location
+        # at its owner: pulls/recovery resolve through it (this agent
+        # serves the file via fetch_chunk / restores direct-to-arena),
+        # and locality scoring discounts it to DISK_TIER_WEIGHT.
+        owner = self._pinned_owner.get(oid)
+        if owner is not None:
+            rpc.spawn(self._notify_owner_location(oid, owner, add=True,
+                                                  disk=True))
         if self._ext is not None:
             # Synchronous: the object is not durably spilled until the
             # external copy exists (the reference's cloud spill IS the
@@ -2153,26 +2286,192 @@ class NodeAgent:
         return size
 
     async def _free_space(self, need: int) -> int:
-        """Spill oldest pinned primaries until `need` bytes could be freed.
-        Unpinned sealed objects are already LRU-evicted by the store itself."""
+        """Make `need` bytes of arena headroom, cheapest eviction first.
+
+        Ordering (the tiered-memory eviction policy, test-pinned):
+        1. DROP local secondaries — replicas this node pulled from an
+           owner elsewhere.  The swarm can re-fetch them from the
+           primary at any time, so deleting one costs a future pull,
+           never durability, and no disk write.
+        2. SPILL sole pinned primaries oldest-first — each costs an
+           NVMe write and makes THIS node the only restore source — but
+           never below `eviction_pinned_bytes_floor` of arena-resident
+           pinned bytes (a hot working set stays mapped even under
+           admission pressure).
+        Unpinned sealed objects are already LRU-evicted by the store
+        itself on allocation pressure."""
         freed = 0
+        try:
+            objs = {o: (sz, rc) for o, sz, rc in self.store.list_objects()}
+        except Exception:
+            objs = {}
+        for oid in list(self._replica_owner.keys()):
+            if freed >= need:
+                return freed
+            info = objs.get(oid)
+            if info is None or oid in self.pinned or oid in self.spilled:
+                continue
+            size, rc = info
+            if rc != 0:
+                continue            # a reader holds it right now
+            try:
+                self.store.delete(oid)
+            except Exception:
+                continue
+            self._drop_replica_registration(oid)
+            freed += size
+        if freed >= need:
+            return freed
+        floor = self._pinned_floor
+        resident = 0
+        if floor > 0:
+            resident = sum(objs.get(o, (0, 0))[0] for o in self.pinned
+                           if o not in self.spilled)
         for oid in list(self.pinned.keys()):
             if freed >= need:
                 break
-            freed += await self._spill_one(oid)
+            if floor > 0 and \
+                    resident - objs.get(oid, (0, 0))[0] < floor:
+                continue
+            got = await self._spill_one(oid)
+            freed += got
+            if floor > 0:
+                resident -= got
         return freed
+
+    def _capacity_scale(self) -> float:
+        """mem_chaos hook: fraction of real capacity the admission/spill
+        policy may use right now (1.0 = no squeeze)."""
+        return (self._mem_chaos.arena_frac()
+                if self._mem_chaos is not None else 1.0)
+
+    def _reserved_bytes(self) -> int:
+        """Unexpired admission reservations (granted creates not yet
+        sealed) — counted as in-use by every policy decision.  Expiry
+        (writer crashed between reserve and seal) is swept here."""
+        if not self._reserved:
+            return 0
+        now = time.monotonic()
+        for o in [o for o, (_, exp) in self._reserved.items()
+                  if exp < now]:
+            del self._reserved[o]
+        return sum(n for n, _ in self._reserved.values())
+
+    def _arena_pressure(self, st=None) -> float:
+        """Occupancy-with-reservations over EFFECTIVE capacity in
+        [0, 1] — the arena's contribution to the shared pressure
+        signal."""
+        if st is None:
+            try:
+                st = self.store.stats()
+            except Exception:
+                return 0.0
+        cap = max(1, int(st.get("capacity", 1) * self._capacity_scale()))
+        used = st.get("bytes_in_use", 0) + self._reserved_bytes()
+        return min(1.0, used / cap)
 
     async def _maybe_spill_to_threshold(self):
         st = self.store.stats()
-        cap = st["capacity"]
+        cap = int(st["capacity"] * self._capacity_scale())
         target = int(cap * self._spill_threshold)
-        if st["bytes_in_use"] > target:
-            await self._free_space(st["bytes_in_use"] - target)
+        usage = st["bytes_in_use"] + self._reserved_bytes()
+        if usage > target:
+            await self._free_space(usage - target)
 
     async def h_ensure_space(self, conn, p):
         """Create-queue backpressure: a writer that got ENOMEM asks us to
         spill; it retries its create afterwards."""
         return {"freed": await self._free_space(int(p["nbytes"]))}
+
+    # --- create admission (the CreateRequestQueue analogue; reference:
+    # plasma create_request_queue.h — creates QUEUE for headroom instead
+    # of failing, and fail TYPED past their deadline) --------------------
+    def _retry_after_s(self) -> float:
+        """Backoff hint for a refused create: scales with queue depth so
+        a deeper backlog spreads retries wider."""
+        return min(5.0, 0.1 * (1 + len(self._create_queue)))
+
+    def _admit_now(self, oid: bytes, nbytes: int) -> bool:
+        """Reserve `nbytes` of headroom for `oid` if it fits RIGHT NOW
+        under effective capacity minus in-use minus prior reservations.
+        The reservation makes admission atomic: a racing create cannot
+        be granted the same headroom, and pressure sweeps count it as
+        in-use so they never target the headroom an unsealed in-progress
+        region is about to occupy."""
+        try:
+            st = self.store.stats()
+        except Exception:
+            return False
+        cap = int(st["capacity"] * self._capacity_scale())
+        headroom = cap - st["bytes_in_use"] - self._reserved_bytes()
+        if nbytes > headroom:
+            return False
+        self._reserved[oid] = (nbytes, time.monotonic() + 60.0)
+        return True
+
+    def _release_reservation(self, oid: bytes) -> None:
+        if self._reserved.pop(oid, None) is not None and self._reserved:
+            self._create_event.set()
+
+    async def h_reserve_create(self, conn, p):
+        """Admission control for a put/return seal: reserve arena
+        headroom, parking FIFO (bounded) while eviction/spill makes
+        room.  Reply {"ok": True} = reserved, go store; {"ok": False,
+        "retry_after_s": ...} = refused typed — the caller surfaces
+        ObjectStoreFullError(retry_after_s), NEVER a raw arena error."""
+        oid = p["object_id"]
+        nbytes = int(p["nbytes"])
+        deadline = time.monotonic() + float(
+            p.get("timeout_s") or get_config().create_backpressure_timeout_s)
+        # Fast path only when nothing is parked: FIFO order is the
+        # anti-starvation guarantee (a stream of small puts must not
+        # starve the big create at the head of the queue).
+        if not self._create_queue and self._admit_now(oid, nbytes):
+            return {"ok": True}
+        if not self._create_queue:
+            await self._free_space(nbytes)
+            if self._admit_now(oid, nbytes):
+                return {"ok": True}
+        if len(self._create_queue) >= self._create_queue_depth_max:
+            return {"ok": False, "reason": "queue_full",
+                    "retry_after_s": self._retry_after_s()}
+        fut = asyncio.get_running_loop().create_future()
+        self._create_queue.append((oid, nbytes, deadline, fut))
+        self._create_event.set()
+        return await fut
+
+    async def _create_queue_loop(self):
+        """FIFO drainer for parked creates: retries the HEAD as
+        eviction/spill/frees make headroom, expires entries typed at
+        their deadline."""
+        while not self._shutdown:
+            if not self._create_queue:
+                self._create_event.clear()
+                await self._create_event.wait()
+                continue
+            oid, nbytes, deadline, fut = self._create_queue[0]
+            if fut.done():
+                self._create_queue.popleft()
+                continue
+            if time.monotonic() >= deadline:
+                self._create_queue.popleft()
+                fut.set_result({"ok": False, "reason": "deadline",
+                                "retry_after_s": self._retry_after_s()})
+                continue
+            if self._admit_now(oid, nbytes):
+                self._create_queue.popleft()
+                fut.set_result({"ok": True})
+                continue
+            try:
+                await self._free_space(nbytes)
+            except Exception:
+                logger.exception("create-queue eviction pass failed")
+            if self._admit_now(oid, nbytes):
+                self._create_queue.popleft()
+                fut.set_result({"ok": True})
+                continue
+            # No headroom yet: wait for a free/unpin/chaos-restore tick.
+            await asyncio.sleep(0.05)
 
     async def h_spill_path(self, conn, p):
         """Hand a worker the path for a direct put-to-disk (objects that can
@@ -2185,7 +2484,16 @@ class NodeAgent:
         path = self._spill_path(oid)
         if not os.path.exists(path):
             return False
-        self.spilled[oid] = (path, os.path.getsize(path))
+        size = os.path.getsize(path)
+        self.spilled[oid] = (path, size)
+        self._spilled_bytes_total += size
+        self._release_reservation(oid)
+        owner = (tuple(p["owner_addr"]) if p.get("owner_addr")
+                 else self._pinned_owner.get(oid))
+        if owner is not None:
+            self._pinned_owner.setdefault(oid, owner)
+            rpc.spawn(self._notify_owner_location(oid, owner, add=True,
+                                                  disk=True))
         if self._ext is not None:
             await self._ext_upload(oid, path)
         return True
@@ -2363,6 +2671,14 @@ class NodeAgent:
             return False
         self.spilled.pop(oid, None)
         self._disk_cached.pop(oid, None)
+        self._restored_bytes_total += size
+        # Back in the arena: retract the storage-tier directory marking
+        # (disk=True removes ONLY the tier record — this node's
+        # primary/secondary entry stands, now at full arena weight).
+        owner = self._pinned_owner.get(oid)
+        if owner is not None:
+            rpc.spawn(self._notify_owner_location(oid, owner, add=False,
+                                                  disk=True))
         try:
             os.unlink(path)
         except FileNotFoundError:
@@ -3027,13 +3343,14 @@ class NodeAgent:
 
     async def _notify_owner_location(self, oid: bytes, owner: tuple,
                                      add: bool,
-                                     primary: bool = False) -> None:
+                                     primary: bool = False,
+                                     disk: bool = False) -> None:
         try:
             conn = await self._owner_conn(tuple(owner))
             await conn.call(
                 "object_location_add" if add else "object_location_remove",
                 {"object_id": oid, "addr": list(self.address),
-                 "primary": primary}, timeout=10)
+                 "primary": primary, "disk": disk}, timeout=10)
         except Exception:
             # Best-effort: a stale directory entry only costs a puller
             # one failed probe (it fails over); a dead owner means the

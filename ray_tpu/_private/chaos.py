@@ -184,6 +184,106 @@ class LinkChaos:
                 delay += start - now
         return drop, delay
 
+# ---------------------------------------------------------------------------
+# Memory chaos (config `mem_chaos`): shrink the EFFECTIVE memory budget
+# under load, then restore it.  Unlike process/link chaos this injects no
+# failures directly — it squeezes the policy layer (arena admission/spill
+# thresholds, the KV page pool) so the tiered-memory machinery (eviction
+# ordering, create-queue backpressure, KV demotion) runs for real while
+# the workload is live.  Square-wave schedule: within each period the
+# first half runs at full budget, the second half at the squeezed
+# fraction — deterministic, so soak assertions can count squeeze windows.
+#
+#   spec: 'arena=frac:period_s[,pool=frac]'
+#
+#     arena frac   effective arena budget during a squeeze window, as a
+#                  fraction of real capacity (0 < frac <= 1)
+#     period_s     squeeze cycle length (half squeezed, half restored)
+#     pool frac    optional: KV page-pool fraction during the window
+#
+# e.g. 'arena=0.25:4,pool=0.5' — every 4s the arena policy budget drops
+# to 25% (driving spill/eviction/backpressure) and the engine parks half
+# its free KV pages, for 2s, then both restore.
+# ---------------------------------------------------------------------------
+
+
+def parse_mem_spec(spec: str) -> dict:
+    """'arena=frac:period_s[,pool=frac]' -> {arena, pool, period}."""
+    out = {"arena": None, "pool": None, "period": 5.0}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, rhs = part.partition("=")
+        if name not in ("arena", "pool"):
+            raise ValueError(
+                f"unknown mem_chaos key {name!r} (expected arena|pool)")
+        fields = rhs.split(":")
+        frac = float(fields[0])
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(
+                f"mem_chaos {name} fraction must be in (0, 1], got {frac}")
+        out[name] = frac
+        if len(fields) > 1:
+            period = float(fields[1])
+            if period <= 0:
+                raise ValueError("mem_chaos period_s must be > 0")
+            out["period"] = period
+    if out["arena"] is None and out["pool"] is None:
+        raise ValueError("mem_chaos spec squeezes neither arena nor pool")
+    return out
+
+
+class MemChaos:
+    """Deterministic memory-budget squeeze planner.
+
+        mc = MemChaos("arena=0.25:4,pool=0.5")
+        frac = mc.arena_frac()   # 1.0 restored / 0.25 during a squeeze
+
+    Consulted lazily on the hot paths it squeezes (agent admission/spill
+    checks, engine page allocation) — no thread of its own, so it
+    composes with ProcessChaos/LinkChaos without another tick loop.  The
+    schedule starts RESTORED (first half-period at full budget) so
+    cluster bring-up never races a squeeze.  Squeeze windows are
+    reported into the shared :func:`memory_monitor.pressure_signal` so
+    lease shedding and KV demotion see chaos pressure like any other."""
+
+    def __init__(self, spec: str, seed: int = 0xC0FFEE):
+        rule = parse_mem_spec(spec)
+        self.arena = rule["arena"]
+        self.pool = rule["pool"]
+        self.period = rule["period"]
+        self._rng = random.Random(seed)   # reserved for jittered schedules
+        self._t0 = time.monotonic()
+        self.squeezes = 0                 # completed squeeze windows seen
+
+    def squeezing(self, now: Optional[float] = None) -> bool:
+        t = (time.monotonic() if now is None else now) - self._t0
+        cycle, phase = divmod(t, self.period)
+        on = phase >= self.period / 2.0
+        if on:
+            self.squeezes = max(self.squeezes, int(cycle) + 1)
+        return on
+
+    def arena_frac(self, now: Optional[float] = None) -> float:
+        if self.arena is None or not self.squeezing(now):
+            return 1.0
+        return self.arena
+
+    def pool_frac(self, now: Optional[float] = None) -> float:
+        if self.pool is None or not self.squeezing(now):
+            return 1.0
+        return self.pool
+
+    def report_pressure(self) -> None:
+        """Publish the current squeeze into the shared pressure signal
+        (cleared when restored, so shed mode tracks the square wave)."""
+        from . import memory_monitor
+        sig = memory_monitor.pressure_signal()
+        if self.squeezing():
+            sig.report("chaos", 1.0 - min(self.arena_frac(),
+                                          self.pool_frac()))
+        else:
+            sig.clear("chaos")
+
+
 # log-file basename prefix -> process class
 _LOG_CLASS = (("worker-", "worker"), ("agent_", "agent"),
               ("gcs.", "gcs"), ("zygote", "zygote"))

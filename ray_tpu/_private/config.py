@@ -135,6 +135,41 @@ _define("task_arg_fetch_timeout_s", 600.0,
 _define("create_backpressure_timeout_s", 30.0,
         "how long a plasma put waits for spill/eviction to make room before "
         "failing (reference: plasma create_request_queue semantics)")
+_define("create_queue_depth", 32,
+        "bound on the agent's FIFO create-admission queue (the "
+        "CreateRequestQueue analogue): puts/seals that cannot reserve "
+        "arena headroom park here while eviction/spill makes room; a "
+        "full queue or an expired deadline fails the create TYPED as "
+        "ObjectStoreFullError(retry_after_s) — never a raw arena "
+        "exception (reference: plasma create_request_queue.h)")
+_define("eviction_pinned_bytes_floor", 0,
+        "pressure sweeps never spill arena-resident pinned primaries "
+        "below this many bytes (0 = no floor): keeps a hot working set "
+        "resident even under admission pressure; re-fetchable "
+        "secondaries are always dropped first regardless of the floor")
+_define("lease_shed_pressure_threshold", 0.95,
+        "when the node's shared memory-pressure signal (max of arena "
+        "occupancy, node RAM, KV pool, chaos squeeze) is at or above "
+        "this fraction, lease granting prefers spilling tasks back to a "
+        "feasible peer over granting locally — memory_monitor feeds the "
+        "same signal the create queue drains.  Only sheds when a "
+        "spillback target exists; a sole node always grants")
+_define("kv_cache_demotion_enabled", True,
+        "LRU-evicted prefix-cache pages demote into host/NVMe KV parts "
+        "(the external-KV part format) instead of being freed; a later "
+        "prefix hit promotes them back via direct re-install + "
+        "device_put, so cache hit rate survives page-pool pressure")
+_define("kv_demoted_bytes_limit", 256 * 1024 * 1024,
+        "byte bound on the demoted prefix-cache tier; the host window "
+        "holds the hot tail and overflows to NVMe files under the spill "
+        "dir, oldest demoted entries are dropped past the bound")
+_define("mem_chaos", "",
+        "memory-pressure chaos: 'arena=frac:period_s[,pool=frac]' — "
+        "squeeze the EFFECTIVE arena budget to frac of capacity (and "
+        "optionally the KV page pool to pool-frac) during alternate "
+        "half-periods, then restore it; drives spill/eviction/"
+        "backpressure and KV demotion under load, composing with "
+        "process/link chaos (empty = off)")
 _define("rpc_connect_retries", 10)
 _define("rpc_connect_retry_delay_s", 0.2)
 _define("rpc_native_framer", True,

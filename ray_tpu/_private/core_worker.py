@@ -32,6 +32,7 @@ import os
 import threading
 import time
 import traceback
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -57,6 +58,18 @@ logger = logging.getLogger("ray_tpu.core_worker")
 # (reference: NotifyDirectCallTaskBlocked, core_worker.cc — deadlock
 # avoidance for tasks that block on results of tasks they submitted).
 task_exec_tls = threading.local()
+
+
+def _release_read_pin(store: ShmStore, oid: bytes) -> None:
+    """weakref.finalize target for _pinned views: runs from GC on any
+    thread, possibly at interpreter exit after the store detached — both
+    must be harmless (the C refcount ops are atomic; a freed object's
+    release is a no-op in the store)."""
+    try:
+        if store._h is not None:
+            store.release(oid)
+    except Exception:
+        pass
 
 # Floor of the ADAPTIVE in-flight window per leased worker.  A granted
 # lease still RUNS one task at a time (the worker's task lock serializes
@@ -1133,6 +1146,7 @@ class CoreWorker:
         cfg = get_config()
         deadline = time.monotonic() + cfg.create_backpressure_timeout_s
         stored = False
+        refusal = None        # typed refusal from the admission queue
 
         def _try_store() -> bool:
             try:
@@ -1142,6 +1156,10 @@ class CoreWorker:
                 return False
 
         loop = asyncio.get_running_loop()
+        try:
+            oversized = size >= self.store.stats()["capacity"] // 2
+        except Exception:
+            oversized = False
         while True:
             # Multi-MB copies run on an executor thread so this (worker /
             # driver) loop keeps serving RPC during the memcpy; small ones
@@ -1154,30 +1172,69 @@ class CoreWorker:
                 stored = True
                 self._send_pin_transfer(oid, owner_addr)
                 break
-            res = await self.agent.call("ensure_space", {"nbytes": size})
-            if res["freed"] == 0:
-                if size >= self.store.stats()["capacity"] // 2 or \
-                        time.monotonic() >= deadline:
-                    break  # fall through to the disk path
-                await asyncio.sleep(0.05)
-            if time.monotonic() >= deadline:
+            if oversized:
+                break  # can never (usefully) fit: straight to the disk tier
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 break
+            # Admission queue (the CreateRequestQueue analogue): the
+            # agent reserves headroom — parking us FIFO while its
+            # eviction/spill sweeps make room — or refuses TYPED with a
+            # retry_after_s hint.  The reservation is atomic under the
+            # queue, so a racing put can't steal the freed headroom and
+            # the sweep won't reclaim our in-progress region.
+            try:
+                res = await self.agent.call(
+                    "reserve_create",
+                    {"object_id": oid, "nbytes": size,
+                     "timeout_s": remaining},
+                    timeout=remaining + 30.0)
+            except rpc.RpcError:
+                res = None     # reconnect blip: legacy spill-and-retry
+            if isinstance(res, dict) and not res.get("ok"):
+                refusal = res
+                break          # deadline/queue-full: try the disk tier
+            if res is None:
+                try:
+                    freed = (await self.agent.call(
+                        "ensure_space", {"nbytes": size}))["freed"]
+                except rpc.RpcError:
+                    freed = 0
+                if freed == 0:
+                    if time.monotonic() >= deadline:
+                        break
+                    await asyncio.sleep(0.05)
         if not stored:
             # Worker and agent share the host: write the spill file here
             # (off-loop) and just register it — no copy crosses the RPC.
-            path = await self.agent.call("spill_path", {"object_id": oid})
+            retry_after = float((refusal or {}).get("retry_after_s", 1.0))
+            try:
+                path = await self.agent.call("spill_path",
+                                             {"object_id": oid})
 
-            def _write():
-                with open(path, "wb") as f:
-                    for p in parts:
-                        f.write(p)
+                def _write():
+                    with open(path, "wb") as f:
+                        for p in parts:
+                            f.write(p)
 
-            await asyncio.get_running_loop().run_in_executor(
-                self.executor, _write)
-            if not await self.agent.call("spill_register",
-                                         {"object_id": oid}, timeout=60):
+                await asyncio.get_running_loop().run_in_executor(
+                    self.executor, _write)
+                registered = await self.agent.call(
+                    "spill_register",
+                    {"object_id": oid,
+                     "owner_addr": list(owner_addr or self.address)},
+                    timeout=60)
+            except (rpc.RpcError, OSError) as e:
+                # NEVER a raw arena/IO exception out of a put: the typed
+                # error carries the backoff hint and keeps accounting
+                # intact (no reservation, no pin, no partial region).
                 raise exc.ObjectStoreFullError(
-                    f"object of size {size} does not fit and could not spill")
+                    f"object of size {size} does not fit and the spill "
+                    f"tier failed ({e})", retry_after_s=retry_after) from e
+            if not registered:
+                raise exc.ObjectStoreFullError(
+                    f"object of size {size} does not fit and could not "
+                    f"spill", retry_after_s=retry_after)
             # Disk-spilled primaries carry no shm refcount; the agent still
             # records the owner pin so free_objects accounting matches.
             self._send_pin_transfer(oid, owner_addr)
@@ -1389,6 +1446,25 @@ class CoreWorker:
         self._note_device_resident(ref.binary(), ref.owner_address)
         return value
 
+    def _pinned(self, oid: bytes, view: memoryview) -> memoryview:
+        """Tie a store.get read pin's lifetime to the VALUE built over it.
+
+        Deserialize is zero-copy: the user's arrays alias the arena
+        mapping, so the pin must outlive them — but it must not outlive
+        them FOREVER.  Re-exporting the view through a numpy base whose
+        collection releases the pin makes every downstream slice (pickle5
+        buffers, ndarray views) keep the base alive; when the last one
+        dies, the pin returns and the bytes become evictable/spillable
+        again.  Without this, each driver get and worker arg read leaked
+        one pin per object for the life of the process — under sustained
+        arena oversubscription the resident set only ever grew, and every
+        later put aged out its full admission deadline before reaching
+        the disk tier."""
+        import numpy as np
+        base = np.frombuffer(view, np.uint8)
+        weakref.finalize(base, _release_read_pin, self.store, oid)
+        return memoryview(base).toreadonly()
+
     async def _fetch_serialized(self, ref: ObjectRef, deadline) -> memoryview:
         oid = ref.binary()
         owner = ref.owner_address or self.address
@@ -1428,7 +1504,9 @@ class CoreWorker:
             # 2. Local shared memory.
             view = self.store.get(oid, timeout_ms=0)
             if view is not None:
-                return view  # zero-copy; pin retained for the view's lifetime
+                # Zero-copy; the read pin releases when the deserialized
+                # value is collected (_pinned).
+                return self._pinned(oid, view)
             # 3. Owner-mediated resolution.
             if tuple(owner) == self.address:
                 timeout = None if deadline is None else max(
@@ -1554,6 +1632,26 @@ class CoreWorker:
                 except (rpc.RpcError, asyncio.TimeoutError):
                     pass
                 self.memory_store.remove_location(oid, sec)
+            # Storage-tier fast path: a registered disk holder still has
+            # the bytes in its spill file even though its arena copy is
+            # gone.  Ask that agent to restore direct-to-arena
+            # (read_file_into), pin the restored copy, and repoint the
+            # primary there — no lineage re-execution.
+            for dsk in list(entry.disk_nodes or ()):
+                try:
+                    conn = await self._peer_owner(tuple(dsk))
+                    if await conn.call("restore_object",
+                                       {"object_id": oid}, timeout=60):
+                        await conn.call("pin_object", {
+                            "object_id": oid,
+                            "owner_addr": list(self.address)}, timeout=30)
+                        entry.plasma_node = list(dsk)
+                        self.memory_store.remove_location(oid, dsk,
+                                                          disk=True)
+                        return True
+                except (rpc.RpcError, asyncio.TimeoutError):
+                    pass
+                self.memory_store.remove_location(oid, dsk, disk=True)
         # Drain-migration fast path: a gracefully drained node republished
         # its sole primaries to a peer before exiting — repoint the
         # owner's location record and read from the new holder; no
@@ -1641,7 +1739,7 @@ class CoreWorker:
         source (receiver-becomes-source broadcast)."""
         view = self.store.get(oid, timeout_ms=0)
         if view is not None:
-            return view
+            return self._pinned(oid, view)
         if tuple(agent_addr) == self.agent_address:
             # Spilled primaries restore on demand (reference: raylet
             # RestoreSpilledObject on the get path).  Bounded retry: a
@@ -1655,7 +1753,7 @@ class CoreWorker:
                                          {"object_id": oid}, timeout=120):
                     view = self.store.get(oid, timeout_ms=0)
                     if view is not None:
-                        return view
+                        return self._pinned(oid, view)
                     continue
                 break
             # Restore failed — or succeeded 4x with the copy evicted (and
@@ -1669,7 +1767,7 @@ class CoreWorker:
             view = self.store.get(oid, timeout_ms=timeout_ms)
             if view is None:
                 raise exc.ObjectLostError(f"{oid.hex()} not in local store")
-            return view
+            return self._pinned(oid, view)
         # Wall-clock deadline for the pull: the tighter of the caller's
         # get() bound (monotonic) and the ambient task deadline — carried
         # in the RPC frame and inside the payload so the agent bounds its
@@ -1752,7 +1850,7 @@ class CoreWorker:
         view = self.store.get(oid, timeout_ms=5000)
         if view is None:
             raise exc.ObjectLostError(f"{oid.hex()} pulled but not sealed")
-        return view
+        return self._pinned(oid, view)
 
     async def _read_spilled(self, agent_conn, oid: bytes):
         """Chunked read of a spilled object that cannot re-enter the arena
@@ -1868,18 +1966,24 @@ class CoreWorker:
         without waiting for a recovery probe.  dev=True registers a
         DEVICE-TIER holder instead (a getter re-uploaded the object's
         arrays onto its accelerators): a locality-scheduling signal,
-        never a pull source."""
+        never a pull source.  disk=True registers a STORAGE-TIER holder
+        (the node spilled its copy to NVMe/external): a real restore
+        source ranked below arena holders."""
         from .config import get_config
         return self.memory_store.add_location(
             p["object_id"], tuple(p["addr"]),
             primary=bool(p.get("primary")),
             device=bool(p.get("dev")),
+            disk=bool(p.get("disk")),
             max_secondaries=get_config().replica_directory_max_secondaries)
 
     async def h_object_location_remove(self, conn, p):
         """A holder evicted/aborted its copy (or is draining): the
-        directory entry must not outlive the bytes."""
-        self.memory_store.remove_location(p["object_id"], tuple(p["addr"]))
+        directory entry must not outlive the bytes.  disk=True retracts
+        only the storage-tier marking (the holder restored its spill
+        file back into the arena — any arena record stands)."""
+        self.memory_store.remove_location(p["object_id"], tuple(p["addr"]),
+                                          disk=bool(p.get("disk")))
         return True
 
     def _ordered_locations(self, entry_or_oid) -> list:
@@ -3589,7 +3693,7 @@ class CoreWorker:
             if isinstance(a, ObjectRef):
                 oid = a.binary()
                 owner = list(a.owner_address or self.address)
-                hint, sz, dev = None, None, None
+                hint, sz, dev, dsk = None, None, None, None
                 if tuple(owner) == self.address:
                     entry_ms = self.memory_store.get(oid)
                     if entry_ms is not None:
@@ -3612,6 +3716,12 @@ class CoreWorker:
                                 sz = entry_ms.size or (
                                     len(entry_ms.data)
                                     if entry_ms.data is not None else None)
+                        if entry_ms.disk_nodes:
+                            # Storage-tier holders (spilled copy on local
+                            # NVMe): arg_locality scores them between
+                            # arena-local and remote — restoring from the
+                            # spill file beats a network pull.
+                            dsk = [list(x) for x in entry_ms.disk_nodes]
                 # Pin EVERY by-ref arg while in flight — for borrowed refs
                 # the submitted pin keeps the local borrow registered (and
                 # thus the owner's borrower entry) until the reply.
@@ -3622,6 +3732,8 @@ class CoreWorker:
                     entry["sz"] = sz
                 if dev:
                     entry["dev"] = dev
+                if dsk:
+                    entry["dsk"] = dsk
             else:
                 ctx.capture = captured = []
                 try:

@@ -16,8 +16,9 @@ OutOfMemoryError instead of a generic crash.
 from __future__ import annotations
 
 import os
+import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 def node_memory_usage() -> Tuple[int, int]:
@@ -41,6 +42,64 @@ def node_memory_usage() -> Tuple[int, int]:
         return total - avail, total
     except Exception:
         return 0, 1
+
+
+class PressureSignal:
+    """One process-wide memory-pressure signal shared by every consumer.
+
+    Sources report a pressure fraction in [0, 1] under a name ("arena"
+    from the agent's sweep/heartbeat, "node" from the memory-monitor
+    loop, "kv_pool" from the LLM engine's page pool, "chaos" from the
+    mem_chaos squeezer).  ``level()`` is the max over fresh reports —
+    the tiered-memory policy drains ONE signal: lease granting sheds to
+    peers, eviction sweeps run earlier, and the prefix cache demotes
+    harder, all off the same number.  Thread-safe (reports come from
+    the agent loop, the monitor thread, and engine step threads)."""
+
+    FRESH_S = 10.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Tuple[float, float]] = {}
+
+    def report(self, source: str, frac: float) -> None:
+        frac = min(1.0, max(0.0, float(frac)))
+        with self._lock:
+            self._sources[source] = (frac, time.monotonic())
+
+    def clear(self, source: str) -> None:
+        with self._lock:
+            self._sources.pop(source, None)
+
+    def level(self, fresh_s: Optional[float] = None) -> float:
+        """Max pressure over sources reported within `fresh_s` seconds
+        (stale sources — a dead reporter — decay to no-pressure instead
+        of wedging the cluster in shed mode forever)."""
+        horizon = self.FRESH_S if fresh_s is None else fresh_s
+        now = time.monotonic()
+        with self._lock:
+            fresh = [f for f, t in self._sources.values()
+                     if now - t <= horizon]
+        return max(fresh, default=0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            return {k: f for k, (f, t) in self._sources.items()
+                    if now - t <= self.FRESH_S}
+
+
+_signal: Optional[PressureSignal] = None
+_signal_lock = threading.Lock()
+
+
+def pressure_signal() -> PressureSignal:
+    """The process singleton (agent, engine and chaos all share it)."""
+    global _signal
+    with _signal_lock:
+        if _signal is None:
+            _signal = PressureSignal()
+        return _signal
 
 
 class GroupByOwnerPolicy:

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 class _Entry:
     __slots__ = ("data", "is_exception", "plasma_node", "size",
-                 "secondaries", "device_nodes")
+                 "secondaries", "device_nodes", "disk_nodes")
 
     def __init__(self, data, is_exception: bool = False,
                  plasma_node=None, size=None):
@@ -50,16 +50,29 @@ class _Entry:
         # live in process memory, not in any arena, so these addresses
         # are never valid pull sources and stay out of locations().
         self.device_nodes = None
+        # STORAGE-TIER directory (tiered cluster memory): nodes holding a
+        # SPILLED copy of this object (local NVMe spill file or an
+        # external URI they can re-materialize).  Unlike the device tier
+        # these ARE real restore sources — a holder's agent serves pulls
+        # straight from the spill file (fetch_chunk's pread path) and
+        # restores direct-to-arena via read_file_into — but they rank
+        # BELOW arena holders for locality (DISK_TIER_WEIGHT): reading
+        # NVMe beats a network pull, loses to bytes already mapped.
+        self.disk_nodes = None
 
     def locations(self):
         """All known holders, primary first.  List of address tuples.
         Device-tier holders are deliberately excluded — they are
-        scheduling hints, not pullable replicas (see device_locations)."""
+        scheduling hints, not pullable replicas (see device_locations).
+        Disk-tier holders ARE included (last): their agents serve pulls
+        from the spill file even when the arena copy is gone."""
         out = []
         if self.plasma_node is not None:
             out.append(tuple(self.plasma_node))
         if self.secondaries:
             out.extend(a for a in self.secondaries if a not in out)
+        if self.disk_nodes:
+            out.extend(a for a in self.disk_nodes if a not in out)
         return out
 
 
@@ -97,6 +110,7 @@ class MemoryStore:
     def add_location(self, object_id: bytes, addr, *,
                      primary: bool = False,
                      device: bool = False,
+                     disk: bool = False,
                      max_secondaries: int = 8) -> bool:
         """Register `addr` as a holder of a plasma object.  primary=True
         repoints the primary record (drain adoption); otherwise the addr
@@ -105,10 +119,23 @@ class MemoryStore:
         only costs a source, never correctness).  device=True records a
         DEVICE-TIER holder instead: a node whose workers keep the
         object's arrays resident on accelerators — a locality-scheduling
-        signal, never a pull source."""
+        signal, never a pull source.  disk=True records a STORAGE-TIER
+        holder: the node spilled its copy to NVMe/external, and its
+        agent can still serve pulls and restores from the file — a real
+        source scored between arena-local and peer-arena by the
+        locality scheduler."""
         entry = self._objects.get(object_id)
         if entry is None:
             return False
+        if disk:
+            addr = tuple(addr)
+            if entry.disk_nodes is None:
+                entry.disk_nodes = []
+            if addr not in entry.disk_nodes:
+                entry.disk_nodes.append(addr)
+                while len(entry.disk_nodes) > max_secondaries:
+                    entry.disk_nodes.pop(0)
+            return True
         if device:
             addr = tuple(addr)
             if entry.device_nodes is None:
@@ -138,12 +165,22 @@ class MemoryStore:
             entry.secondaries.pop(0)
         return True
 
-    def remove_location(self, object_id: bytes, addr) -> None:
+    def remove_location(self, object_id: bytes, addr, *,
+                        disk: bool = False) -> None:
+        """Deregister a holder.  disk=True retracts ONLY the storage-tier
+        marking (the node restored its spill file back into the arena —
+        its primary/secondary record, if any, stands); otherwise the
+        addr leaves both the secondary set and the disk tier (the holder
+        dropped the bytes entirely)."""
         entry = self._objects.get(object_id)
-        if entry is None or not entry.secondaries:
+        if entry is None:
             return
         addr = tuple(addr)
-        if addr in entry.secondaries:
+        if entry.disk_nodes and addr in entry.disk_nodes:
+            entry.disk_nodes.remove(addr)
+        if disk:
+            return
+        if entry.secondaries and addr in entry.secondaries:
             entry.secondaries.remove(addr)
 
     def locations(self, object_id: bytes):
@@ -159,6 +196,14 @@ class MemoryStore:
         if entry is None or not entry.device_nodes:
             return []
         return list(entry.device_nodes)
+
+    def disk_locations(self, object_id: bytes):
+        """Storage-tier holders (nodes whose copy lives in a spill file):
+        real restore sources, ranked below arena holders for locality."""
+        entry = self._objects.get(object_id)
+        if entry is None or not entry.disk_nodes:
+            return []
+        return list(entry.disk_nodes)
 
     def _wake(self, object_id: bytes):
         for ev in self._waiters.pop(object_id, []):

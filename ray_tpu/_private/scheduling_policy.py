@@ -113,6 +113,14 @@ def hybrid_pick(candidates: Sequence[Tuple[object, Dict[str, float],
 # plasma replica when the scheduler breaks locality ties.
 DEVICE_TIER_WEIGHT = 2
 
+# Storage-tier holders (spilled copy on local NVMe) score BELOW arena
+# holders but above nothing at all: restoring from the local spill file
+# (read_file_into, one pread into the arena) beats pulling the bytes
+# over the wire from a peer's arena, but loses to bytes already mapped.
+# The tier ladder the scheduler sees: device (2.0) > arena (1.0) >
+# local NVMe (0.5) > remote (0).
+DISK_TIER_WEIGHT = 0.5
+
 
 def arg_locality(args) -> Dict[Tuple, int]:
     """Bytes-already-local map of a task spec's by-reference args:
@@ -122,12 +130,17 @@ def arg_locality(args) -> Dict[Tuple, int]:
     without a size hint contribute nothing.  Device-tier holders (the
     spec's `dev` hint: nodes with the arrays accelerator-resident)
     count the same bytes at DEVICE_TIER_WEIGHT, so "already on this
-    slice" outranks "in a peer's arena"."""
+    slice" outranks "in a peer's arena".  Storage-tier holders (the
+    spec's `dsk` hint: nodes whose copy lives in a spill file) count at
+    DISK_TIER_WEIGHT — and a holder appearing in BOTH the location list
+    and the dsk hint (a spilled primary) counts ONCE at disk weight:
+    its arena copy is gone, so full arena credit would overstate it."""
     out: Dict[Tuple, int] = {}
     for e in args or ():
         sz = int(e.get("sz") or 0) if isinstance(e, dict) else 0
         if sz <= 0 or "ref" not in e:
             continue
+        disk = {tuple(a) for a in e.get("dsk") or ()}
         locs = e["ref"][2] if len(e["ref"]) > 2 else None
         if locs:
             first = locs[0]
@@ -135,7 +148,11 @@ def arg_locality(args) -> Dict[Tuple, int]:
                 locs = [locs]
             for a in locs:
                 key = tuple(a)
+                if key in disk:
+                    continue          # scored once below, at disk weight
                 out[key] = out.get(key, 0) + sz
+        for key in disk:
+            out[key] = out.get(key, 0) + int(sz * DISK_TIER_WEIGHT)
         for a in e.get("dev") or ():
             key = tuple(a)
             out[key] = out.get(key, 0) + sz * DEVICE_TIER_WEIGHT

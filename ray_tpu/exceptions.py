@@ -43,7 +43,23 @@ class OutOfMemoryError(WorkerCrashedError):
 
 
 class ObjectStoreFullError(RayError):
-    pass
+    """A put/seal could not reserve arena space before its deadline.
+
+    Raised TYPED by the admission path (never a raw arena exception,
+    never an OOM kill): the create entered the agent's bounded FIFO
+    create queue, eviction/spill could not make headroom within the
+    caller's backpressure budget, and the disk-spill fallback also could
+    not place the object.  Carries ``retry_after_s`` — the agent's
+    estimate of when headroom frees up (same contract as
+    :class:`OverloadedError` on the serving plane) — so callers back off
+    instead of hot-looping.  Object-store accounting is intact when this
+    raises: the failed create holds no reservation, no pin, and no
+    partially-written region."""
+
+    def __init__(self, message: str = "object store full",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 class ObjectLostError(RayError):

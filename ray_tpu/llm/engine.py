@@ -34,6 +34,8 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import math
+import os
+import tempfile
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -386,16 +388,110 @@ class _PrefixCache:
             for p in pages:
                 incref(p)
 
-    def evict_lru(self, decref) -> bool:
+    def evict_lru(self, decref, demote=None) -> bool:
         """Drop the least-recently-used entry; True if one was dropped.
-        Pages still held by active requests stay allocated (ref > 0)."""
+        Pages still held by active requests stay allocated (ref > 0).
+        `demote(key, pages)` — when given — runs BEFORE the refs drop,
+        so the hook can copy the page contents out of the pool while
+        they are still guaranteed unrecycled (after decref the pages
+        rejoin the free list and may be overwritten by any admission)."""
         if not self._entries:
             return False
-        _, pages = self._entries.popitem(last=False)
+        key, pages = self._entries.popitem(last=False)
         self.evictions += 1
+        if demote is not None:
+            demote(key, pages)
         for p in pages:
             decref(p)
         return True
+
+
+class _KVDemoteStore:
+    """Demoted prefix-cache pages: bounded host window + NVMe overflow.
+
+    LRU-evicted prefix-cache entries land here instead of being freed
+    outright: the evicted pages' contents move device -> host (a byte-
+    bounded LRU window) and overflow to NVMe part files under the spill
+    dir, in the external-KV part format ({"k", "v", "len"}).  A later
+    request sharing the prefix PROMOTES the entry back into the pool
+    (device_put + page re-alloc) instead of re-running prefill — the
+    same demote-then-restore policy shape as the object store's
+    arena -> NVMe spill tier, driven by the same pool-pressure signal.
+    Entries are caches, never truth: any demoted entry may be dropped
+    (e.g. on a disk write failure) at the cost of a re-prefill."""
+
+    def __init__(self, byte_limit: int, spill_dir: str):
+        self.byte_limit = max(0, int(byte_limit))
+        self.spill_dir = spill_dir
+        self._host: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._disk: Dict[bytes, str] = {}
+        self._host_bytes = 0
+        self._seq = 0
+        self.demoted_pages = 0
+        self.promoted_pages = 0
+        self.disk_spills = 0
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    def contains(self, key: bytes) -> bool:
+        return key in self._host or key in self._disk
+
+    def put(self, key: bytes, k_np, v_np, npages: int) -> None:
+        if self.contains(key):
+            return
+        self._host[key] = {"k": k_np, "v": v_np, "len": int(npages)}
+        self._host_bytes += k_np.nbytes + v_np.nbytes
+        self.demoted_pages += int(npages)
+        while self._host_bytes > self.byte_limit and self._host:
+            okey, part = self._host.popitem(last=False)
+            self._host_bytes -= part["k"].nbytes + part["v"].nbytes
+            self._spill(okey, part)
+
+    def _spill(self, key: bytes, part: dict) -> None:
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._seq += 1
+            path = os.path.join(
+                self.spill_dir,
+                "kvdemote-%d-%d.npz" % (os.getpid(), self._seq))
+            np.savez(path, k=part["k"], v=part["v"],
+                     len=np.int64(part["len"]))
+            self._disk[key] = path
+            self.disk_spills += 1
+        except OSError:
+            pass    # dropped: a demoted entry is a cache, never truth
+
+    def get(self, key: bytes) -> Optional[dict]:
+        """Pop an entry for promotion ({"k","v","len"}), or None."""
+        part = self._host.pop(key, None)
+        if part is not None:
+            self._host_bytes -= part["k"].nbytes + part["v"].nbytes
+            self.promoted_pages += part["len"]
+            return part
+        path = self._disk.pop(key, None)
+        if path is None:
+            return None
+        try:
+            with np.load(path) as z:
+                part = {"k": z["k"], "v": z["v"], "len": int(z["len"])}
+        except OSError:
+            return None
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self.promoted_pages += part["len"]
+        return part
+
+    def stats(self) -> Dict[str, Any]:
+        return {"demoted_pages": self.demoted_pages,
+                "promoted_pages": self.promoted_pages,
+                "demoted_entries": len(self),
+                "demoted_host_bytes": self._host_bytes,
+                "demoted_disk_entries": len(self._disk),
+                "demoted_disk_spills": self.disk_spills}
 
 
 class _KVWindow:
@@ -645,6 +741,28 @@ class LLMEngine:
         cache_tag = (b"sp%d" % self.sp_degree) if self.sp_degree > 1 else b""
         self._cache = _PrefixCache(self.page, cache_tag) \
             if prefix_cache else None
+        # KV offload tier: LRU-evicted prefix-cache pages demote into a
+        # bounded host window (NVMe overflow) instead of being freed;
+        # hits promote back via device_put.  Pool squeezes (mem_chaos)
+        # park free pages on the ballast list so admission sees a
+        # smaller pool and the eviction/demotion path actually drains.
+        self._demote: Optional[_KVDemoteStore] = None
+        self._ballast_pages: List[int] = []
+        if self._cache is not None:
+            try:
+                from .._private.config import get_config as _getcfg
+                _c = _getcfg()
+                _demo_on = bool(_c.kv_cache_demotion_enabled)
+                _demo_lim = int(_c.kv_demoted_bytes_limit)
+                _demo_dir = str(_c.object_spill_dir or "")
+            except Exception:
+                _demo_on, _demo_lim, _demo_dir = True, 256 << 20, ""
+            if not _demo_dir:
+                _demo_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    "ray_tpu_kv_demote_%d" % os.getpid())
+            if _demo_on:
+                self._demote = _KVDemoteStore(_demo_lim, _demo_dir)
         self._tables = np.zeros((max_batch, self.pages_per_slot), np.int32)
         self._slots: Dict[int, _Request] = {}
         self._waiting: List[_Request] = []
@@ -884,12 +1002,16 @@ class LLMEngine:
     def prefix_cache_stats(self) -> Dict[str, Any]:
         if self._cache is None:
             return {"enabled": False}
-        return {"enabled": True, "entries": len(self._cache._entries),
-                "hits": self._cache.hits, "misses": self._cache.misses,
-                "hit_pages": self._cache.hit_pages,
-                "evictions": self._cache.evictions,
-                "allocated_pages": len(self._page_refs),
-                "free_pages": len(self._free_pages)}
+        out = {"enabled": True, "entries": len(self._cache._entries),
+               "hits": self._cache.hits, "misses": self._cache.misses,
+               "hit_pages": self._cache.hit_pages,
+               "evictions": self._cache.evictions,
+               "allocated_pages": len(self._page_refs),
+               "free_pages": len(self._free_pages),
+               "ballast_pages": len(self._ballast_pages)}
+        if self._demote is not None:
+            out.update(self._demote.stats())
+        return out
 
     # ---------------------------------------------------------------- step --
     def _bucket(self, n: int) -> int:
@@ -939,6 +1061,96 @@ class LLMEngine:
             del self._page_refs[p]
             self._free_pages.append(p)
 
+    # ------------------------------------------------------- KV offload --
+    def _demote_entry(self, key: bytes, pages: Sequence[int]) -> None:
+        """Prefix-cache eviction hook: copy the evicted pages' contents
+        device -> host into the demote store BEFORE the refs drop (after
+        decref the pages rejoin the free list and any admission may
+        overwrite them)."""
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        kk = np.asarray(self._pk[:, idx])
+        vv = np.asarray(self._pv[:, idx])
+        self._demote.put(key, kk, vv, len(pages))
+
+    def _try_promote(self, req: _Request, c: int, shared: List[int],
+                     total: int) -> Tuple[int, List[int]]:
+        """Promote the longest demoted prefix usable by this prompt back
+        into the pool, superseding any (shorter) resident hit.  Only
+        fires when the pool can hold the promoted pages AND the
+        request's remainder (`total` pages all told) — promotion must
+        never starve the admission it serves.  Returns the possibly-
+        updated (prefix_tokens, shared_pages)."""
+        usable = (len(req.prompt) - 1) // self.page
+        have = len(shared)
+        if usable <= have:
+            return c, shared
+        keys = self._cache._keys(req.prompt, usable)
+        for k in range(usable, have, -1):
+            key = keys[k - 1]
+            if not self._demote.contains(key):
+                continue
+            if len(self._free_pages) < total:
+                break               # no headroom: admit on what we have
+            part = self._demote.get(key)
+            if part is None or int(part["len"]) != k:
+                continue
+            L, KV, D = (part["k"].shape[0], part["k"].shape[-2],
+                        part["k"].shape[-1])
+            kk = jnp.asarray(part["k"].reshape(L, k * self.page, KV, D),
+                             self.cfg.dtype)
+            vv = jnp.asarray(part["v"].reshape(L, k * self.page, KV, D),
+                             self.cfg.dtype)
+            new_pages = [self._alloc_page() for _ in range(k)]
+            self._install_pages(new_pages, kk, vv)
+            # Re-register under the same rolling-hash key: the alloc ref
+            # is the cache's membership hold; the request holds one more
+            # (exactly the lookup-hit refcount shape in _reserve).
+            self._cache._entries[key] = [int(p) for p in new_pages]
+            for p in new_pages:
+                self._incref(p)
+            for p in shared:
+                self._decref(p)     # superseded shorter-prefix hold
+            # The lookup above scored this admission a miss (or a
+            # shorter hit) before the demoted tier resolved it: reclass
+            # — the request's prefill IS skipped, same as a pool hit.
+            if have == 0:
+                self._cache.misses -= 1
+                self._cache.hits += 1
+            self._cache.hit_pages += k - have
+            return k * self.page, new_pages
+        return c, shared
+
+    def apply_pool_pressure(self, frac: float) -> None:
+        """Shrink (frac < 1) or restore (frac = 1) the usable page pool
+        by parking free pages on a ballast list — the mem_chaos pool
+        squeeze (and any external memory-pressure controller) drives
+        this.  Admission then sees a smaller free list, evicts the
+        prefix cache sooner, and the demotion path absorbs the evicted
+        pages instead of discarding them.  Pages already allocated are
+        never touched: the squeeze throttles NEW admissions only."""
+        frac = min(1.0, max(0.0, float(frac)))
+        parked_target = (self.n_pages - 1) - max(
+            0, int((self.n_pages - 1) * frac))
+        while len(self._ballast_pages) < parked_target and self._free_pages:
+            self._ballast_pages.append(self._free_pages.pop())
+        while len(self._ballast_pages) > parked_target:
+            self._free_pages.append(self._ballast_pages.pop())
+
+    def _report_pool_pressure(self) -> None:
+        """Feed the node-shared PressureSignal: the KV pool is under
+        pressure only when admission is actually blocked on pages (a
+        hot pool with an empty queue is healthy, not pressured)."""
+        try:
+            from .._private.memory_monitor import pressure_signal
+            sig = pressure_signal()
+            total = max(1, self.n_pages - 1)
+            if self._waiting and not self._free_pages:
+                sig.report("kv_pool", 1.0 - len(self._free_pages) / total)
+            else:
+                sig.clear("kv_pool")
+        except Exception:
+            pass
+
     def _reserve(self, req: _Request) -> bool:
         """Reserve slot + pages for a request; False = wait for capacity.
         With the prefix cache on, shared prefix pages are reused
@@ -954,13 +1166,18 @@ class LLMEngine:
         # Hold the shared pages before any eviction can touch them.
         for p in shared:
             self._incref(p)
+        demote = self._demote_entry if self._demote is not None else None
         while len(self._free_pages) < need and self._cache is not None \
-                and self._cache.evict_lru(self._decref):
+                and self._cache.evict_lru(self._decref, demote):
             pass
         if len(self._free_pages) < need:
             for p in shared:
                 self._decref(p)
             return False
+        if self._demote is not None and not req.no_cache \
+                and not req.kv_paged and len(self._demote):
+            c, shared = self._try_promote(req, c, shared, total)
+            need = total - len(shared)
         req.slot = self._free_slots.pop(0)
         req.pages = [self._alloc_page() for _ in range(need)]
         req.shared_pages = shared
@@ -1093,6 +1310,7 @@ class LLMEngine:
             for (req, _), first in zip(admitted, firsts):
                 self._last[req.slot] = first
                 self._emit(req, int(first))
+        self._report_pool_pressure()
 
     def _install_external(self, req: _Request):
         """Install a shipped KV blob; on a prefix-cache hit only the

@@ -204,6 +204,10 @@ class EngineReplica:
                     "gwait": Gauge("ray_tpu_llm_kv_gather_wait_s",
                                    "blocking remote-KV gather wait (s)",
                                    ("node_id",)).set_default_tags(tags),
+                    "demo": Gauge("ray_tpu_kv_demoted_pages",
+                                  "prefix-cache pages demoted to the "
+                                  "host/NVMe offload tier (cumulative)",
+                                  ("node_id",)).set_default_tags(tags),
                 }
             e = self.engine
             self._gauges["occ"].set(e.kv_page_occupancy())
@@ -211,6 +215,7 @@ class EngineReplica:
             if cs.get("enabled"):
                 total = cs["hits"] + cs["misses"]
                 self._gauges["hit"].set(cs["hits"] / total if total else 0.0)
+                self._gauges["demo"].set(cs.get("demoted_pages", 0))
             gs = e.kv_gather_stats()
             self._gauges["gbytes"].set(gs["bytes"])
             self._gauges["gwait"].set(gs["wait_s"])
